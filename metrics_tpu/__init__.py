@@ -1,0 +1,39 @@
+"""metrics_tpu — a TPU-native metrics framework (JAX/XLA/pjit/pallas).
+
+Brand-new implementation of the capability surface of TorchMetrics
+v0.10.0dev (reference at ``/root/reference``), designed TPU-first: metric
+state is a pytree of device arrays, ``update``/``compute`` are jit-compiled
+XLA graphs, and distributed reduction is emitted as XLA collectives over
+ICI/DCN (see ``metrics_tpu/parallel/sync.py``).
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+__version__ = "0.1.0"
+
+from metrics_tpu.aggregation import (  # noqa: E402
+    BaseAggregator,
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.pure import MetricDef, functionalize  # noqa: E402
+
+__all__ = [
+    "BaseAggregator",
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MetricDef",
+    "MinMetric",
+    "SumMetric",
+    "functionalize",
+]

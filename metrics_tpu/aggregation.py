@@ -1,0 +1,173 @@
+"""Aggregation metrics (reference ``src/torchmetrics/aggregation.py``, 364 LoC).
+
+NaN handling is branchless (``jnp.where`` masks) instead of the reference's
+eager ``torch.isnan`` boolean-indexing (``aggregation.py:66-84``), so every
+update stays a static-shape XLA graph. The ``error`` strategy needs a
+concrete value check and therefore runs eagerly (it is for debugging, not the
+hot path).
+"""
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for simple value aggregators (reference ``aggregation.py:24``)."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, list],
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("error", "warn", "ignore")
+        if not (isinstance(nan_strategy, (int, float)) and not isinstance(nan_strategy, bool)) and nan_strategy not in allowed:
+            raise ValueError(f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}")
+        self.nan_strategy = nan_strategy
+        self.add_state("value", default=default_value, dist_reduce_fx=fn)
+        if nan_strategy == "error" or nan_strategy == "warn":
+            # needs concrete values for the raise/warn path
+            object.__setattr__(self, "jittable_update", False)
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Union[float, Array, None] = None):
+        """Mask NaNs per strategy (reference ``aggregation.py:66-84``)."""
+        x = jnp.asarray(x, dtype=jnp.float32)
+        if weight is not None:
+            weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), x.shape)
+        nans = jnp.isnan(x)
+        if self.nan_strategy == "error":
+            if bool(jnp.any(nans)):
+                raise RuntimeError("Encountered `nan` values in tensor")
+        elif self.nan_strategy == "warn":
+            if bool(jnp.any(nans)):
+                import warnings
+
+                warnings.warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+            x = jnp.where(nans, self._neutral_value(), x)
+            if weight is not None:
+                weight = jnp.where(nans, 0.0, weight)
+        elif self.nan_strategy == "ignore":
+            x = jnp.where(nans, self._neutral_value(), x)
+            if weight is not None:
+                weight = jnp.where(nans, 0.0, weight)
+        else:  # float imputation
+            x = jnp.where(nans, float(self.nan_strategy), x)
+        if weight is None:
+            return x, None
+        return x, weight
+
+    def _neutral_value(self) -> float:
+        return 0.0
+
+    def update(self, value: Union[float, Array]) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self.value
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference ``aggregation.py:95``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def _neutral_value(self) -> float:
+        return -jnp.inf
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.value = jnp.maximum(self.value, jnp.max(value) if value.ndim > 0 else value)
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference ``aggregation.py:146``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def _neutral_value(self) -> float:
+        return jnp.inf
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.value = jnp.minimum(self.value, jnp.min(value) if value.ndim > 0 else value)
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference ``aggregation.py:197``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.value = self.value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference ``aggregation.py:246``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    # NaN *removal* changes the shape → host-side by nature, run eagerly
+    jittable_update = False
+
+    def update(self, value: Union[float, Array]) -> None:
+        import warnings
+
+        import numpy as np
+
+        arr = np.asarray(jnp.asarray(value, dtype=jnp.float32)).reshape(-1)
+        nans = np.isnan(arr)
+        if nans.any():
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            if self.nan_strategy == "warn":
+                warnings.warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+            if self.nan_strategy in ("warn", "ignore"):
+                arr = arr[~nans]
+            else:
+                arr = np.where(nans, float(self.nan_strategy), arr)
+        if arr.size > 0:
+            self.value.append(jnp.asarray(arr))
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value if not isinstance(self.value, list) else jnp.zeros(0)
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:296-364``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value = jnp.atleast_1d(jnp.asarray(value, dtype=jnp.float32))
+        weight = jnp.asarray(weight, dtype=jnp.float32)
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.value / self.weight

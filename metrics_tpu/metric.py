@@ -1,0 +1,770 @@
+"""Core ``Metric`` runtime — TPU-first redesign of reference
+``src/torchmetrics/metric.py`` (953 LoC).
+
+Design stance (SURVEY.md §7): a metric is a **pytree of arrays + pure
+functions**. The stateful ``Metric`` object is a thin host-side shell over a
+pure ``update(state, *batch) -> state`` and ``compute(state) -> value``; both
+are jit-compiled XLA graphs (the reference runs eager torch ops with no
+compilation anywhere, reference ``metric.py:220-346``). Key differences from
+the reference, by subsystem:
+
+- **State registry** (`add_state`, reference ``metric.py:150-217``): states
+  are immutable ``jax.Array`` leaves (or python lists of arrays for ``cat``
+  states). "Reset" rebuilds defaults; no in-place mutation exists, so the
+  reference's detach/clone defensive copies are unnecessary.
+- **Compilation**: the subclass's ``update``/``compute`` bodies are traced
+  once into XLA graphs via a state-swap closure and cached per input
+  shape/dtype. Metrics with list (``cat``) states or host-side work (text)
+  opt out with ``jittable_update/compute = False`` and still run every array
+  op through XLA eagerly.
+- **Forward protocol** (reference ``metric.py:220-346``): same dual
+  semantics — accumulate globally AND return the batch-local value — with the
+  same two strategies (``full_state_update`` True/False) selected by class
+  attribute.
+- **Distributed sync** (reference ``metric.py:348-498``): under ``pjit`` with
+  sharded inputs, state is already globally correct (GSPMD inserts the
+  collectives), so sync is the identity. Across *processes* (multi-host), the
+  sync/unsync/sync_context lifecycle exists with identical semantics, but
+  rides ``multihost_utils`` instead of NCCL (see
+  ``metrics_tpu/parallel/sync.py``). Inside ``shard_map``, use the pure
+  functional API with ``axis_name`` (``metrics_tpu.pure``).
+- **Serialization** (reference ``metric.py:654-692``): state is a pytree —
+  ``state_dict`` returns numpy copies; orbax/flax checkpointing works on the
+  same pytree for free.
+"""
+import functools
+import inspect
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.sync import distributed_available, gather_all_arrays, sync_state
+from metrics_tpu.utilities.data import _flatten, _squeeze_if_scalar, dim_zero_cat
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+Reduction = Union[str, Callable, None]
+
+# Errors meaning "this update body needs concrete values → run it eagerly".
+_TRACE_ERRORS = tuple(
+    getattr(jax.errors, name)
+    for name in (
+        "ConcretizationTypeError",
+        "TracerArrayConversionError",
+        "TracerBoolConversionError",
+        "TracerIntegerConversionError",
+    )
+    if hasattr(jax.errors, name)
+)
+
+
+def jit_distributed_available() -> bool:
+    """Reference ``metric.py:40-41``."""
+    return distributed_available()
+
+
+class Metric:
+    """Base class for all metrics (reference ``metric.py:44``).
+
+    Not an ``nn.Module``: JAX has no module system to inherit device/dtype
+    handling from, and none is needed — state lives wherever XLA put it and
+    moves with shardings, not ``.to()`` calls.
+    """
+
+    __jit_unwrapped__ = True
+
+    # class-constant behavior flags (reference ``metric.py:75-77``)
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: bool = False
+
+    # compilation opt-outs (no reference analogue; the reference never compiles)
+    jittable_update: bool = True
+    jittable_compute: bool = True
+
+    def __init__(
+        self,
+        compute_on_cpu: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        sync_on_compute: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        # kwargs popped like reference ``metric.py:91-109``
+        object.__setattr__(self, "_state", {})
+        object.__setattr__(self, "_defaults", {})
+        object.__setattr__(self, "_reductions", {})
+        object.__setattr__(self, "_persistent", {})
+        self.compute_on_cpu = compute_on_cpu
+        self.dist_sync_on_step = dist_sync_on_step
+        self.process_group = process_group
+        self.dist_sync_fn = dist_sync_fn
+        self.sync_on_compute = sync_on_compute
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
+
+        self._update_count = 0
+        self._update_called = False
+        self._computed: Any = None
+        self._is_synced = False
+        self._cache: Optional[Dict[str, Any]] = None
+        self._to_sync = True
+        self._should_unsync = True
+        self._enable_grad = False
+
+        # wrap the subclass's update/compute (reference ``metric.py:113-114``)
+        self._original_update = self.update
+        self._original_compute = self.compute
+        object.__setattr__(self, "update", self._wrap_update(self._original_update))
+        object.__setattr__(self, "compute", self._wrap_compute(self._original_compute))
+        self._update_jit: Optional[Callable] = None
+        self._compute_jit: Optional[Callable] = None
+        self._update_signature = inspect.signature(self._original_update)
+
+    # ------------------------------------------------------------------
+    # state registry
+    # ------------------------------------------------------------------
+
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, list],
+        dist_reduce_fx: Reduction = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a named state leaf (reference ``metric.py:150-217``).
+
+        ``default`` is either an array (fixed-shape accumulator) or an empty
+        list (a ``cat`` state — batches appended, concatenated lazily).
+        """
+        if not isinstance(default, list) or default:
+            if not isinstance(default, (jax.Array, np.ndarray, int, float)):
+                raise ValueError("state variable must be an array or an empty list (any value)")
+            default = jnp.asarray(default)
+        if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", None) and not callable(dist_reduce_fx):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        self._defaults[name] = deepcopy(default) if isinstance(default, list) else default
+        self._reductions[name] = dist_reduce_fx
+        self._persistent[name] = persistent
+        self._state[name] = [] if isinstance(default, list) else default
+
+    # attribute routing so subclass code can write ``self.tp += x``
+    def __setattr__(self, name: str, value: Any) -> None:
+        defaults = self.__dict__.get("_defaults")
+        if defaults is not None and name in defaults:
+            self.__dict__["_state"][name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        defaults = self.__dict__.get("_defaults")
+        if defaults is not None and name in defaults:
+            return self.__dict__["_state"][name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    @property
+    def metric_state(self) -> Dict[str, Any]:
+        """Current state pytree (read-only view)."""
+        return dict(self._state)
+
+    @property
+    def update_called(self) -> bool:
+        return self._update_called
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    # ------------------------------------------------------------------
+    # update / compute wrapping (reference ``metric.py:376-399,500-528``)
+    # ------------------------------------------------------------------
+
+    def _can_jit_update(self) -> bool:
+        if not self.jittable_update:
+            return False
+        return not any(isinstance(d, list) for d in self._defaults.values())
+
+    def _can_jit_compute(self) -> bool:
+        if not self.jittable_compute:
+            return False
+        return not any(isinstance(d, list) for d in self._defaults.values())
+
+    def _make_update_jit(self) -> Callable:
+        def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
+            prev = self.__dict__["_state"]
+            object.__setattr__(self, "_state", dict(state))
+            try:
+                self._original_update(*args, **kwargs)
+                return dict(self.__dict__["_state"])
+            finally:
+                object.__setattr__(self, "_state", prev)
+
+        return jax.jit(pure_update)
+
+    def _make_compute_jit(self) -> Callable:
+        def pure_compute(state: Dict[str, Any]) -> Any:
+            prev = self.__dict__["_state"]
+            object.__setattr__(self, "_state", dict(state))
+            try:
+                return self._original_compute()
+            finally:
+                object.__setattr__(self, "_state", prev)
+
+        return jax.jit(pure_compute)
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            self._computed = None
+            self._update_count += 1
+            self._update_called = True
+            if self._is_synced:
+                raise MetricsTPUUserError(
+                    "The Metric shouldn't be synced when performing ``update``. "
+                    "HINT: Did you forget to call ``unsync``?"
+                )
+            if self._can_jit_update() and not self.compute_on_cpu:
+                if self._update_jit is None:
+                    self._update_jit = self._make_update_jit()
+                try:
+                    new_state = self._update_jit(dict(self._state), args, kwargs)
+                except (_TRACE_ERRORS + (TypeError,)):
+                    # update body needs concrete values, or takes non-array
+                    # args jit can't stage → fall back to eager (a genuine
+                    # bug will re-raise from the eager call below)
+                    object.__setattr__(self, "jittable_update", False)
+                    update(*args, **kwargs)
+                else:
+                    object.__setattr__(self, "_state", new_state)
+            else:
+                update(*args, **kwargs)
+            if self.compute_on_cpu:
+                self._move_list_states_to_host()
+
+        return wrapped_func
+
+    def _move_list_states_to_host(self) -> None:
+        """Offload accumulated list ("cat") states to host memory.
+
+        The reference's ``compute_on_cpu`` (``metric.py:91,396-406``) moves
+        list states to CPU after each update so unbounded concat states don't
+        exhaust accelerator memory. Here entries become numpy arrays on the
+        host; the final ``compute`` still runs through XLA on the default
+        device (divergence: the reference computes on CPU too).
+        """
+        for name, value in self._state.items():
+            if isinstance(value, list):
+                self._state[name] = [np.asarray(v) if isinstance(v, jax.Array) else v for v in value]
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if not self._update_called:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` "
+                    "method which may lead to errors, as metric states have not yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed  # cache (reference ``metric.py:512``)
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                should_sync=self._to_sync and self.sync_on_compute,
+                should_unsync=self._should_unsync,
+            ):
+                value = self._compute_unsynced(*args, **kwargs)
+            self._computed = _squeeze_if_scalar(value)
+            return self._computed
+
+        return wrapped_func
+
+    def _compute_unsynced(self, *args: Any, **kwargs: Any) -> Any:
+        if self._can_jit_compute() and not args and not kwargs:
+            if self._compute_jit is None:
+                self._compute_jit = self._make_compute_jit()
+            try:
+                return self._compute_jit(dict(self._state))
+            except _TRACE_ERRORS:
+                object.__setattr__(self, "jittable_compute", False)
+        return self._original_compute(*args, **kwargs)
+
+    # ------------------------------------------------------------------
+    # forward protocol (reference ``metric.py:220-346``)
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate into global state AND return the batch-local value."""
+        if self.full_state_update or self.dist_sync_on_step:
+            return self._forward_full_state_update(*args, **kwargs)
+        return self._forward_reduce_state_update(*args, **kwargs)
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """Two update calls; batch value from a fresh state (reference ``metric.py:241-280``)."""
+        self.update(*args, **kwargs)
+        self._to_sync = self.dist_sync_on_step
+        cache = self._copy_state()
+        cached_count = self._update_count
+        self._restore_defaults()
+        self.update(*args, **kwargs)
+        self._should_unsync = False
+        batch_val = self.compute()
+        # restore global state
+        object.__setattr__(self, "_state", cache)
+        self._update_count = cached_count
+        self._should_unsync = True
+        self._to_sync = True
+        self._computed = None
+        self._is_synced = False
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """One update on a reset state, then merge into the global state
+        (reference ``metric.py:282-346``)."""
+        global_state = self._copy_state()
+        global_count = self._update_count
+        self._restore_defaults()
+        self.update(*args, **kwargs)
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        batch_val = self.compute()
+        # merge batch state into global state (reference ``metric.py:319``)
+        batch_state = self._copy_state()
+        merged = self._reduce_states(global_state, batch_state, global_count)
+        object.__setattr__(self, "_state", merged)
+        self._update_count = global_count + 1
+        self._should_unsync = True
+        self._to_sync = True
+        self._computed = None
+        self._is_synced = False
+        return batch_val
+
+    def _reduce_states(
+        self, global_state: Dict[str, Any], batch_state: Dict[str, Any], global_count: int
+    ) -> Dict[str, Any]:
+        """Merge rules keyed by reduction tag (reference ``metric.py:319-346``)."""
+        merged: Dict[str, Any] = {}
+        for name, reduce_fn in self._reductions.items():
+            g, b = global_state[name], batch_state[name]
+            if reduce_fn == "sum":
+                merged[name] = g + b
+            elif reduce_fn == "mean":
+                if global_count == 0:
+                    merged[name] = b
+                else:
+                    merged[name] = (g * global_count + b) / (global_count + 1)
+            elif reduce_fn == "max":
+                merged[name] = jnp.maximum(g, b)
+            elif reduce_fn == "min":
+                merged[name] = jnp.minimum(g, b)
+            elif reduce_fn == "cat" or (reduce_fn is None and isinstance(g, list)):
+                merged[name] = list(g) + list(b)
+            elif callable(reduce_fn):
+                # same contract as every other call site (and reference
+                # ``metric.py:344``): one stacked array argument
+                merged[name] = reduce_fn(jnp.stack([g, b]))
+            else:
+                # no valid merge rule: keep the batch-updated-on-global result
+                # by re-running update on the global state
+                raise MetricsTPUUserError(
+                    f"State {name!r} has dist_reduce_fx={reduce_fn!r} which has no forward merge rule; "
+                    f"set class attribute ``full_state_update = True`` for {type(self).__name__}."
+                )
+        return merged
+
+    def _copy_state(self) -> Dict[str, Any]:
+        # jax arrays are immutable → shallow copy suffices; lists copied
+        return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
+
+    def _restore_defaults(self) -> None:
+        state = {}
+        for name, default in self._defaults.items():
+            state[name] = deepcopy(default) if isinstance(default, list) else default
+        object.__setattr__(self, "_state", state)
+
+    # ------------------------------------------------------------------
+    # distributed sync lifecycle (reference ``metric.py:408-498``)
+    # ------------------------------------------------------------------
+
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
+        """Gather + reduce every state across processes (reference ``metric.py:348-374``)."""
+        input_dict = {attr: self._state[attr] for attr in self._reductions}
+        for attr, reduction_fn in self._reductions.items():
+            # pre-concat list states to minimize gathers (reference ``metric.py:352-354``)
+            if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
+                input_dict[attr] = [dim_zero_cat(input_dict[attr])]
+
+        output_dict = {
+            attr: [dist_sync_fn(x, self.process_group if process_group is None else process_group) for x in v]
+            if isinstance(v, list)
+            else dist_sync_fn(v, self.process_group if process_group is None else process_group)
+            for attr, v in input_dict.items()
+        }
+
+        for attr, reduction_fn in self._reductions.items():
+            out = output_dict[attr]
+            if isinstance(self._state[attr], list):
+                self._state[attr] = _flatten(out) if out else []
+                continue
+            # out is a list of per-rank arrays
+            stacked = jnp.stack(out, axis=0)
+            if reduction_fn == "sum":
+                self._state[attr] = jnp.sum(stacked, axis=0)
+            elif reduction_fn == "mean":
+                self._state[attr] = jnp.mean(stacked, axis=0)
+            elif reduction_fn == "max":
+                self._state[attr] = jnp.max(stacked, axis=0)
+            elif reduction_fn == "min":
+                self._state[attr] = jnp.min(stacked, axis=0)
+            elif reduction_fn == "cat":
+                self._state[attr] = jnp.concatenate([jnp.atleast_1d(o) for o in out], axis=0)
+            elif callable(reduction_fn):
+                self._state[attr] = reduction_fn(stacked)
+            elif reduction_fn is None:
+                self._state[attr] = stacked
+            else:
+                raise MetricsTPUUserError(f"Unsupported reduction: {reduction_fn}")
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available_fn: Optional[Callable] = None,
+    ) -> None:
+        """Cache local state, replace with gathered+reduced state (reference ``metric.py:408-442``)."""
+        if self._is_synced and should_sync:
+            raise MetricsTPUUserError("The Metric has already been synced.")
+        is_distributed = (distributed_available_fn or distributed_available)()
+        if not should_sync or not is_distributed:
+            return
+        if dist_sync_fn is None:
+            dist_sync_fn = gather_all_arrays
+        self._cache = self._copy_state()
+        self._sync_dist(dist_sync_fn, process_group=process_group)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore cached local state (reference ``metric.py:444-464``)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsTPUUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsTPUUserError("The internal cache should exist to unsync the Metric.")
+        object.__setattr__(self, "_state", self._cache)
+        self._is_synced = False
+        self._cache = None
+
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available_fn: Optional[Callable] = None,
+    ):
+        """RAII sync/unsync wrapper used by compute (reference ``metric.py:466-498``)."""
+        metric = self
+
+        class _SyncCtx:
+            def __enter__(self_ctx):
+                metric.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    process_group=process_group,
+                    should_sync=should_sync,
+                    distributed_available_fn=distributed_available_fn,
+                )
+                return self_ctx
+
+            def __exit__(self_ctx, *exc):
+                if metric._is_synced and should_unsync:
+                    metric.unsync()
+                return False
+
+        return _SyncCtx()
+
+    # ------------------------------------------------------------------
+    # abstract interface
+    # ------------------------------------------------------------------
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # pragma: no cover - abstract
+        """Override to update state with batch data (reference ``metric.py:530``)."""
+        raise NotImplementedError
+
+    def compute(self) -> Any:  # pragma: no cover - abstract
+        """Override to compute the final value from state (reference ``metric.py:535``)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # reset / clone / persistence (reference ``metric.py:539-569,649-692``)
+    # ------------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Restore default state (reference ``metric.py:539``)."""
+        self._update_count = 0
+        self._update_called = False
+        self._computed = None
+        self._restore_defaults()
+        self._cache = None
+        self._is_synced = False
+
+    def clone(self) -> "Metric":
+        """Deep copy (reference ``metric.py:556``)."""
+        return deepcopy(self)
+
+    def persistent(self, mode: bool = False) -> None:
+        """Flip the persistence flag of all states (reference ``metric.py:649``)."""
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        """Persistent states as numpy copies (reference ``metric.py:654-672``)."""
+        out: Dict[str, Any] = {}
+        for key in self._defaults:
+            if not self._persistent[key]:
+                continue
+            current = self._state[key]
+            if isinstance(current, list):
+                out[prefix + key] = [np.asarray(x) for x in current]
+            else:
+                out[prefix + key] = np.asarray(current)
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
+        """Restore states saved by :meth:`state_dict` (reference ``metric.py:674-692``)."""
+        for key in self._defaults:
+            name = prefix + key
+            if name in state_dict:
+                v = state_dict[name]
+                if isinstance(v, list):
+                    self._state[key] = [jnp.asarray(x) for x in v]
+                else:
+                    self._state[key] = jnp.asarray(v)
+                self._update_called = True
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Pickle support: drop wrapped/bound/jitted fns (reference ``metric.py:560-569``)."""
+        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit", "_update_signature"}
+        state = {k: v for k, v in self.__dict__.items() if k not in skip}
+        state["_state"] = jax.tree_util.tree_map(np.asarray, self.__dict__["_state"])
+        state["_defaults"] = jax.tree_util.tree_map(np.asarray, self.__dict__["_defaults"])
+        state["_cache"] = jax.tree_util.tree_map(np.asarray, self.__dict__.get("_cache"))
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self.__dict__["_state"] = jax.tree_util.tree_map(jnp.asarray, state["_state"])
+        self.__dict__["_defaults"] = jax.tree_util.tree_map(jnp.asarray, state["_defaults"])
+        object.__setattr__(self, "_original_update", type(self).update.__get__(self))
+        object.__setattr__(self, "_original_compute", type(self).compute.__get__(self))
+        object.__setattr__(self, "update", self._wrap_update(self._original_update))
+        object.__setattr__(self, "compute", self._wrap_compute(self._original_compute))
+        self._update_jit = None
+        self._compute_jit = None
+        self._update_signature = inspect.signature(self._original_update)
+
+    def __deepcopy__(self, memo: dict) -> "Metric":
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit"}
+        for k, v in self.__dict__.items():
+            if k in skip:
+                continue
+            if k in ("_state", "_defaults", "_cache"):
+                # arrays are immutable; copy containers only
+                object.__setattr__(new, k, jax.tree_util.tree_map(lambda x: x, v) if v is not None else None)
+            else:
+                object.__setattr__(new, k, deepcopy(v, memo))
+        object.__setattr__(new, "_original_update", type(new).update.__get__(new))
+        object.__setattr__(new, "_original_compute", type(new).compute.__get__(new))
+        object.__setattr__(new, "update", new._wrap_update(new._original_update))
+        object.__setattr__(new, "compute", new._wrap_compute(new._original_compute))
+        object.__setattr__(new, "_update_jit", None)
+        object.__setattr__(new, "_compute_jit", None)
+        return new
+
+    # ------------------------------------------------------------------
+    # misc (reference ``metric.py:694-733``)
+    # ------------------------------------------------------------------
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Filter kwargs against the update signature (reference ``metric.py:694-714``)."""
+        _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        _sign_params = self._update_signature.parameters
+        filtered_kwargs = {
+            k: v for k, v in kwargs.items() if (k in _sign_params and _sign_params[k].kind not in _params)
+        }
+        exists_var_keyword = any(v.kind == inspect.Parameter.VAR_KEYWORD for v in _sign_params.values())
+        if exists_var_keyword:
+            filtered_kwargs = kwargs
+        return filtered_kwargs
+
+    def __hash__(self) -> int:
+        # include list-state ids so equal-config metrics hash differently
+        # (reference ``metric.py:716-724``)
+        hash_vals = [type(self).__name__]
+        for key in self._defaults:
+            val = self._state.get(key)
+            if isinstance(val, list):
+                hash_vals.append(id(val))
+                hash_vals.extend(id(v) for v in val)
+            else:
+                hash_vals.append(id(val))
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    def type(self, *_: Any, **__: Any) -> "Metric":
+        """No-op (reference makes float/double/half no-ops, ``metric.py:598-614``)."""
+        return self
+
+    float = double = half = type
+
+    def set_dtype(self, dst_type: Any) -> "Metric":
+        """Cast all floating states (reference ``metric.py:616``)."""
+
+        def _cast(x):
+            if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dst_type)
+            return x
+
+        object.__setattr__(self, "_state", jax.tree_util.tree_map(_cast, self._state))
+        object.__setattr__(self, "_defaults", jax.tree_util.tree_map(_cast, self._defaults))
+        self._update_jit = None
+        self._compute_jit = None
+        return self
+
+    # ------------------------------------------------------------------
+    # metric arithmetic (reference ``metric.py:735-838``)
+    # ------------------------------------------------------------------
+
+    def __add__(self, other): return CompositionalMetric(jnp.add, self, other)
+    def __radd__(self, other): return CompositionalMetric(jnp.add, other, self)
+    def __sub__(self, other): return CompositionalMetric(jnp.subtract, self, other)
+    def __rsub__(self, other): return CompositionalMetric(jnp.subtract, other, self)
+    def __mul__(self, other): return CompositionalMetric(jnp.multiply, self, other)
+    def __rmul__(self, other): return CompositionalMetric(jnp.multiply, other, self)
+    def __truediv__(self, other): return CompositionalMetric(jnp.true_divide, self, other)
+    def __rtruediv__(self, other): return CompositionalMetric(jnp.true_divide, other, self)
+    def __floordiv__(self, other): return CompositionalMetric(jnp.floor_divide, self, other)
+    def __rfloordiv__(self, other): return CompositionalMetric(jnp.floor_divide, other, self)
+    def __mod__(self, other): return CompositionalMetric(jnp.mod, self, other)
+    def __rmod__(self, other): return CompositionalMetric(jnp.mod, other, self)
+    def __pow__(self, other): return CompositionalMetric(jnp.power, self, other)
+    def __rpow__(self, other): return CompositionalMetric(jnp.power, other, self)
+    def __matmul__(self, other): return CompositionalMetric(jnp.matmul, self, other)
+    def __rmatmul__(self, other): return CompositionalMetric(jnp.matmul, other, self)
+    def __and__(self, other): return CompositionalMetric(jnp.bitwise_and, self, other)
+    def __rand__(self, other): return CompositionalMetric(jnp.bitwise_and, other, self)
+    def __or__(self, other): return CompositionalMetric(jnp.bitwise_or, self, other)
+    def __ror__(self, other): return CompositionalMetric(jnp.bitwise_or, other, self)
+    def __xor__(self, other): return CompositionalMetric(jnp.bitwise_xor, self, other)
+    def __rxor__(self, other): return CompositionalMetric(jnp.bitwise_xor, other, self)
+    def __eq__(self, other): return CompositionalMetric(jnp.equal, self, other)
+    def __ne__(self, other): return CompositionalMetric(jnp.not_equal, self, other)
+    def __ge__(self, other): return CompositionalMetric(jnp.greater_equal, self, other)
+    def __gt__(self, other): return CompositionalMetric(jnp.greater, self, other)
+    def __le__(self, other): return CompositionalMetric(jnp.less_equal, self, other)
+    def __lt__(self, other): return CompositionalMetric(jnp.less, self, other)
+    def __abs__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __neg__(self): return CompositionalMetric(_neg, self, None)
+    def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __invert__(self): return CompositionalMetric(jnp.logical_not, self, None)
+    def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic composition of metrics (reference ``metric.py:845-953``)."""
+
+    # children manage their own compilation; tracing through their wrapped
+    # compute would cache tracers
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, Array],
+        metric_b: Union[Metric, float, int, Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else (jnp.asarray(metric_a) if metric_a is not None else None)
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (jnp.asarray(metric_b) if metric_b is not None else None)
+
+    def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
+        pass  # children sync themselves (reference ``metric.py:870``)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    @property
+    def _update_called(self) -> bool:
+        # delegate to children so compute() doesn't warn spuriously
+        a = self.metric_a._update_called if isinstance(self.metric_a, Metric) else True
+        b = self.metric_b._update_called if isinstance(self.metric_b, Metric) else True
+        return a and b
+
+    @_update_called.setter
+    def _update_called(self, value: bool) -> None:
+        pass  # children own the flag
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            return None
+        if val_b is None:
+            if isinstance(self.metric_b, Metric):
+                return None
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else self.op}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

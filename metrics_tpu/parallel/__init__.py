@@ -1,0 +1,9 @@
+from metrics_tpu.parallel.sync import (  # noqa: F401
+    class_reduce,
+    distributed_available,
+    fused_sync,
+    gather_all_arrays,
+    reduce,
+    sync_leaf,
+    sync_state,
+)

@@ -1,0 +1,126 @@
+"""Pure-functional metric API — the idiomatic TPU entry point.
+
+No reference analogue: the reference's only execution mode is an eager,
+stateful ``nn.Module`` (``src/torchmetrics/metric.py:44``). On TPU the hot
+path must live *inside* the jitted training step, so this module converts any
+:class:`metrics_tpu.Metric` into a triple of pure functions over an explicit
+state pytree:
+
+    mdef = functionalize(Accuracy(num_classes=10))
+    state = mdef.init()
+    state = mdef.update(state, preds, target)      # pure, jittable, donate-able
+    value = mdef.compute(state)                     # pure, jittable
+
+Distributed semantics by regime:
+
+- Under ``pjit``/GSPMD with sharded ``preds/target``, ``update`` is already
+  globally correct — XLA inserts the cross-chip collectives for the batch
+  reductions. Merge per-step states with ``merge`` if accumulating outside.
+- Under ``shard_map`` (per-device code), pass ``axis_name`` to
+  :func:`functionalize`; ``compute`` then applies the tag-keyed collectives
+  (``psum``/``all_gather``) from ``metrics_tpu.parallel.sync`` before the
+  final math — the XLA-native version of reference ``metric.py:348-374``.
+"""
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+
+from metrics_tpu.parallel.sync import sync_state
+
+
+class MetricDef(NamedTuple):
+    """Pure functions over an explicit state pytree."""
+
+    init: Callable[[], Dict[str, Any]]
+    update: Callable[..., Dict[str, Any]]
+    compute: Callable[[Dict[str, Any]], Any]
+    merge: Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]
+
+
+def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDef:
+    """Build pure ``init/update/compute/merge`` from a stateful metric.
+
+    The metric instance is used as a *template*: its (unwrapped) update and
+    compute bodies are traced with state passed explicitly, so the returned
+    functions are pure and safe under ``jit``/``shard_map``/``vmap``. Metrics
+    with list (``cat``) states are not functionalizable yet — use their
+    binned/static-capacity variants inside compiled code.
+    """
+    from metrics_tpu.metric import Metric  # local import to avoid cycle
+
+    assert isinstance(metric, Metric)
+    if any(isinstance(d, list) for d in metric._defaults.values()):
+        raise ValueError(
+            f"{type(metric).__name__} has list ('cat') states and cannot be functionalized; "
+            "use its binned / fixed-capacity variant inside compiled code."
+        )
+    if not metric.jittable_update or not metric.jittable_compute:
+        raise ValueError(
+            f"{type(metric).__name__} is not trace-safe (jittable_update/compute is False) — its "
+            "update/compute needs concrete values. For aggregators, construct with "
+            "nan_strategy='ignore' or a float; host-side metrics (text, detection) cannot run "
+            "inside compiled code."
+        )
+
+    reductions = dict(metric._reductions)
+
+    def init() -> Dict[str, Any]:
+        return dict(metric._defaults)
+
+    def update(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        prev = metric.__dict__["_state"]
+        object.__setattr__(metric, "_state", dict(state))
+        try:
+            metric._original_update(*args, **kwargs)
+            return dict(metric.__dict__["_state"])
+        finally:
+            object.__setattr__(metric, "_state", prev)
+
+    def compute(state: Dict[str, Any]) -> Any:
+        if axis_name is not None:
+            state = sync_state(state, reductions, axis_name)
+        prev = metric.__dict__["_state"]
+        object.__setattr__(metric, "_state", dict(state))
+        try:
+            return metric._original_compute()
+        finally:
+            object.__setattr__(metric, "_state", prev)
+
+    has_mean_state = any(fx == "mean" for fx in reductions.values())
+
+    def merge(
+        state_a: Dict[str, Any],
+        state_b: Dict[str, Any],
+        count_a: Optional[float] = None,
+        count_b: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Combine two accumulated states (for scan/tree-reduce).
+
+        Associative for sum/max/min/cat-tagged states. States with a
+        ``'mean'`` reduction need the number of updates folded into each side
+        (``count_a``/``count_b``) to stay correct under tree reduction —
+        omitting them raises rather than silently averaging pairwise.
+        """
+        if has_mean_state and (count_a is None or count_b is None):
+            raise ValueError(
+                f"{type(metric).__name__} has 'mean'-reduced state; merge() needs count_a/count_b "
+                "(the number of updates folded into each side) to combine correctly."
+            )
+        merged: Dict[str, Any] = {}
+        for name, fx in reductions.items():
+            a, b = state_a[name], state_b[name]
+            if fx == "sum":
+                merged[name] = a + b
+            elif fx == "mean":
+                merged[name] = (a * count_a + b * count_b) / (count_a + count_b)
+            elif fx == "max":
+                merged[name] = jax.numpy.maximum(a, b)
+            elif fx == "min":
+                merged[name] = jax.numpy.minimum(a, b)
+            elif callable(fx):
+                merged[name] = fx(jax.numpy.stack([a, b]))
+            else:
+                raise ValueError(f"State {name!r} with reduction {fx!r} has no pure merge rule.")
+        return merged
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge)

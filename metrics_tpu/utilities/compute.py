@@ -1,0 +1,55 @@
+"""Numeric-safety helpers (reference ``src/torchmetrics/utilities/compute.py:18-40``)."""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """``num/denom`` with 0 where ``denom == 0`` (NaN/Inf-free, XLA-safe)."""
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    if not jnp.issubdtype(num.dtype, jnp.floating):
+        num = num.astype(jnp.float32)
+    if not jnp.issubdtype(denom.dtype, jnp.floating):
+        denom = denom.astype(jnp.float32)
+    zero = denom == 0
+    return jnp.where(zero, 0.0, num / jnp.where(zero, 1.0, denom))
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` that is 0 when ``x == 0`` (reference ``utilities/compute.py:33``)."""
+    x = jnp.asarray(x, dtype=jnp.result_type(x, jnp.float32))
+    y = jnp.asarray(y, dtype=x.dtype)
+    return jnp.where(x == 0, 0.0, x * jnp.log(jnp.where(x == 0, 1.0, y)))
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul with fp16→fp32 upcast (reference ``utilities/compute.py:18``).
+
+    On TPU the MXU accumulates bf16 matmuls in fp32 natively, so we only force
+    the output dtype up — no copy round-trip like the reference's CUDA path.
+    """
+    if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
+        return jnp.matmul(x, y, preferred_element_type=jnp.float32)
+    return jnp.matmul(x, y)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under (x, y) with a fixed sign (reference ``functional/classification/auc.py:43-78``)."""
+    dx = jnp.diff(x, axis=axis)
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    return jnp.sum((y0 + y1) * dx / 2.0, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Trapezoidal AUC with optional sorting by x (reference ``functional/classification/auc.py:81-109``)."""
+    if reorder:
+        order = jnp.argsort(x, stable=True)
+        x = x[order]
+        y = y[order]
+        return _auc_compute_without_check(x, y, 1.0)
+    dx = jnp.diff(x)
+    sign = jnp.where(jnp.all(dx >= 0), 1.0, jnp.where(jnp.all(dx <= 0), -1.0, jnp.nan))
+    return _auc_compute_without_check(x, y, 1.0) * sign

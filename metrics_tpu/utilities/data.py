@@ -1,0 +1,169 @@
+"""Shared array utilities.
+
+TPU-first redesigns of the helpers in reference
+``src/torchmetrics/utilities/data.py``:
+
+- ``_bincount`` (reference ``:244-264``) is a one-hot ``segment_sum`` — static
+  shape, deterministic, XLA-friendly (no data-dependent fallback loop needed).
+- ``apply_to_collection`` (reference ``:160-207``) is replaced by
+  ``jax.tree_util`` mapping where possible; a compatible shim is kept for the
+  dict/namedtuple cases used by the sync layer.
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array], Tuple[Array, ...]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0 (reference ``utilities/data.py:36``)."""
+    if isinstance(x, (list, tuple)):
+        if len(x) == 0:
+            raise ValueError("No samples to concatenate")
+        x = [jnp.atleast_1d(v) for v in x]
+        return jnp.concatenate(x, axis=0) if len(x) > 1 else x[0]
+    return jnp.atleast_1d(x)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    """Summation along dim 0 (reference ``utilities/data.py:46``)."""
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    """Average along dim 0 (reference ``utilities/data.py:51``)."""
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    """Max along dim 0 (reference ``utilities/data.py:56``)."""
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    """Min along dim 0 (reference ``utilities/data.py:61``)."""
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists one level (reference ``utilities/data.py:65``)."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Dict:
+    """Flatten dict of dicts one level (reference ``utilities/data.py:71``)."""
+    new_dict = {}
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                new_dict[k] = v
+        else:
+            new_dict[key] = value
+    return new_dict
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert integer labels ``(N, ...)`` to dense one-hot ``(N, C, ...)``.
+
+    Reference ``utilities/data.py:82-113``. TPU-first: implemented as a direct
+    comparison against an iota over a new class axis — a single fused XLA op,
+    no scatter.
+    """
+    labels = jnp.asarray(label_tensor)
+    iota = jnp.arange(num_classes, dtype=labels.dtype)
+    iota = iota.reshape((1, num_classes) + (1,) * (labels.ndim - 1))
+    return (labels[:, None] == iota).astype(jnp.int32)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference ``utilities/data.py:116-139``).
+
+    Uses ``jax.lax.top_k`` (static k) and a one-hot scatter-free mask.
+    """
+    x = jnp.asarray(prob_tensor)
+    if topk == 1:  # fast path: argmax one-hot
+        idx = jnp.argmax(x, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(x, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    x_moved = jnp.moveaxis(x, dim, -1)
+    _, idx = jax.lax.top_k(x_moved, topk)
+    onehot = jax.nn.one_hot(idx, x_moved.shape[-1], dtype=jnp.int32)
+    mask = onehot.sum(axis=-2)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/one-hot to integer labels via argmax (reference ``utilities/data.py:142-157``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-shape deterministic bincount (reference ``utilities/data.py:244-264``).
+
+    The reference needs a deterministic fallback loop on CUDA; on TPU we use a
+    one-hot sum, which XLA lowers to a single matmul/reduce — deterministic by
+    construction and MXU-friendly.
+
+    ``minlength`` is required (static shapes): the reference's dynamic
+    ``minlength=None`` mode cannot exist under XLA.
+    """
+    x = jnp.asarray(x).reshape(-1)
+    oh = x[:, None] == jnp.arange(minlength, dtype=x.dtype)[None, :]
+    return oh.sum(axis=0).astype(jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32)
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    """Cumulative sum wrapper (deterministic on TPU by default)."""
+    return jnp.cumsum(x, axis=axis)
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    """Squeeze single-element arrays to 0-d (reference ``utilities/data.py:240``)."""
+
+    def _sq(x):
+        if isinstance(x, jax.Array) and x.size == 1 and x.ndim > 0:
+            return x.reshape(())
+        return x
+
+    return jax.tree_util.tree_map(_sq, data)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all elements of type ``dtype``.
+
+    Compatible with reference ``utilities/data.py:160-207`` for the cases the
+    sync layer uses (dicts of arrays / lists of arrays).
+    """
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, dict):
+        return {k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()}
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, (list, tuple)):
+        return type(data)(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data)
+    return data
+
+
+def get_group_indexes(indexes: Array) -> List[np.ndarray]:
+    """Group positions by query id (reference ``utilities/data.py:210-233``).
+
+    Host-side helper retained for API parity; the retrieval metrics themselves
+    use ``jax.ops.segment_*`` with static ``num_segments`` instead of this
+    python loop (see ``metrics_tpu/functional/retrieval``).
+    """
+    idx = np.asarray(indexes).reshape(-1)
+    groups: Dict[int, List[int]] = {}
+    for i, v in enumerate(idx.tolist()):
+        groups.setdefault(v, []).append(i)
+    return [np.asarray(g, dtype=np.int64) for g in groups.values()]

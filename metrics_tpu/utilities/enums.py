@@ -1,0 +1,58 @@
+"""String enums shared across the library.
+
+Parity: reference ``src/torchmetrics/utilities/enums.py:18-83``.
+"""
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """Case-insensitive string enum (reference ``utilities/enums.py:18``)."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            return None
+
+    @classmethod
+    def coerce(cls, value: Union[str, "EnumStr", None]) -> Optional["EnumStr"]:
+        if value is None:
+            return None
+        if isinstance(value, cls):
+            return value
+        out = cls.from_str(str(value))
+        if out is None:
+            valid = [e.value for e in cls]
+            raise ValueError(f"Invalid value {value!r}; expected one of {valid}.")
+        return out
+
+    def __str__(self) -> str:
+        return self.value
+
+
+class DataType(EnumStr):
+    """Classification input case (reference ``utilities/enums.py:28``)."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Reduction over classes (reference ``utilities/enums.py:45``)."""
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = "none"
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class reduction (reference ``utilities/enums.py:70``)."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

@@ -1,0 +1,12 @@
+"""Error types for metrics_tpu.
+
+Parity: reference ``src/torchmetrics/utilities/exceptions.py:16``.
+"""
+
+
+class MetricsTPUUserError(Exception):
+    """Error raised on wrong usage of the metric lifecycle (update/compute/sync)."""
+
+
+class MetricsTPUUserWarning(UserWarning):
+    """Warning category for misuse that does not prevent computation."""

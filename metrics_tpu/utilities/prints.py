@@ -1,0 +1,51 @@
+"""Rank-zero-gated printing (reference ``src/torchmetrics/utilities/prints.py:22-50``).
+
+Rank is ``jax.process_index()`` (multi-host JAX) instead of the ``LOCAL_RANK``
+env var the reference reads.
+"""
+import logging
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _get_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 (reference ``utilities/prints.py:22``)."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
+    kwargs.setdefault("stacklevel", 5)
+    warnings.warn(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(*args: Any, **kwargs: Any) -> None:
+    log.info(*args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(*args: Any, **kwargs: Any) -> None:
+    log.debug(*args, **kwargs)
+
+
+rank_zero_warn_cached = partial(rank_zero_warn)

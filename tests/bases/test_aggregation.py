@@ -1,0 +1,81 @@
+"""Aggregator tests (model: reference ``test/unittests/bases/test_aggregation.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+@pytest.mark.parametrize(
+    "metric_cls, compare_fn",
+    [
+        (MinMetric, np.min),
+        (MaxMetric, np.max),
+        (SumMetric, np.sum),
+        (MeanMetric, np.mean),
+    ],
+)
+@pytest.mark.parametrize("nan_strategy", ["error", "warn", "ignore"])
+def test_aggregators(metric_cls, compare_fn, nan_strategy):
+    rng = np.random.RandomState(42)
+    values = rng.rand(10, 5).astype(np.float32)
+    metric = metric_cls(nan_strategy=nan_strategy)
+    for row in values:
+        metric.update(jnp.asarray(row))
+    result = np.asarray(metric.compute())
+    np.testing.assert_allclose(result, compare_fn(values), rtol=1e-5)
+
+
+def test_cat_metric():
+    rng = np.random.RandomState(0)
+    values = rng.rand(4, 3).astype(np.float32)
+    metric = CatMetric()
+    for row in values:
+        metric.update(jnp.asarray(row))
+    np.testing.assert_allclose(np.asarray(metric.compute()), values.reshape(-1), rtol=1e-6)
+
+
+@pytest.mark.parametrize("metric_cls", [MinMetric, MaxMetric, SumMetric, MeanMetric, CatMetric])
+def test_nan_error(metric_cls):
+    metric = metric_cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="Encountered `nan` values"):
+        metric.update(jnp.asarray([1.0, float("nan")]))
+
+
+@pytest.mark.parametrize(
+    "metric_cls, expected", [(MinMetric, 2.0), (MaxMetric, 5.0), (SumMetric, 7.0), (MeanMetric, 3.5)]
+)
+def test_nan_ignore(metric_cls, expected):
+    metric = metric_cls(nan_strategy="ignore")
+    metric.update(jnp.asarray([2.0, float("nan"), 5.0]))
+    if metric_cls is MeanMetric:
+        # nan gets weight 0
+        assert np.asarray(metric.compute()) == pytest.approx(7.0 / 2.0)
+    else:
+        assert np.asarray(metric.compute()) == pytest.approx(expected)
+
+
+def test_nan_impute():
+    metric = SumMetric(nan_strategy=0.5)
+    metric.update(jnp.asarray([2.0, float("nan"), 5.0]))
+    assert np.asarray(metric.compute()) == pytest.approx(7.5)
+
+
+def test_mean_metric_weighted():
+    metric = MeanMetric(nan_strategy="ignore")
+    metric.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([0.2, 0.8]))
+    metric.update(3.0)
+    expected = (1.0 * 0.2 + 2.0 * 0.8 + 3.0) / (0.2 + 0.8 + 1.0)
+    assert np.asarray(metric.compute()) == pytest.approx(expected, rel=1e-5)
+
+
+def test_reset_and_forward():
+    metric = SumMetric(nan_strategy="ignore")
+    batch_val = metric(jnp.asarray([1.0, 2.0]))
+    assert np.asarray(batch_val) == pytest.approx(3.0)
+    batch_val = metric(jnp.asarray([4.0]))
+    assert np.asarray(batch_val) == pytest.approx(4.0)
+    assert np.asarray(metric.compute()) == pytest.approx(7.0)
+    metric.reset()
+    metric.update(jnp.asarray([5.0]))
+    assert np.asarray(metric.compute()) == pytest.approx(5.0)

@@ -1,0 +1,204 @@
+"""Core Metric lifecycle tests (model: reference ``test/unittests/bases/test_metric.py``, 455 LoC)."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric, functionalize
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+
+class DummySum(Metric):
+    """Analogue of the reference's DummyMetricSum (``testers.py:595``)."""
+
+    full_state_update = True
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DummyListCat(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.x.append(jnp.atleast_1d(x))
+
+    def compute(self):
+        from metrics_tpu.utilities.data import dim_zero_cat
+
+        return dim_zero_cat(self.x)
+
+
+class DummyMean(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total / self.count
+
+
+def test_add_state_validation():
+    m = DummySum()
+    with pytest.raises(ValueError, match="dist_reduce_fx"):
+        m.add_state("bad", jnp.asarray(0.0), dist_reduce_fx="nonsense")
+    with pytest.raises(ValueError, match="state variable"):
+        m.add_state("bad", "a string")
+
+
+def test_update_count_and_cache():
+    m = DummySum()
+    assert m.update_count == 0 and not m.update_called
+    m.update(1.0)
+    assert m.update_count == 1 and m.update_called
+    v1 = m.compute()
+    assert m._computed is not None
+    m.update(2.0)
+    assert m._computed is None  # cache invalidated
+    assert np.asarray(m.compute()) == pytest.approx(3.0)
+    m.reset()
+    assert m.update_count == 0
+
+
+def test_forward_full_state():
+    m = DummySum()
+    assert np.asarray(m(1.0)) == pytest.approx(1.0)
+    assert np.asarray(m(2.0)) == pytest.approx(2.0)
+    assert np.asarray(m.compute()) == pytest.approx(3.0)
+
+
+def test_forward_reduce_state():
+    m = DummyMean()
+    assert m.full_state_update is False
+    v = m(jnp.asarray([1.0, 3.0]))
+    assert np.asarray(v) == pytest.approx(2.0)
+    v = m(jnp.asarray([5.0]))
+    assert np.asarray(v) == pytest.approx(5.0)
+    assert np.asarray(m.compute()) == pytest.approx(3.0)
+
+
+def test_forward_cat_state():
+    m = DummyListCat()
+    v = m(jnp.asarray([1.0, 2.0]))
+    np.testing.assert_allclose(np.asarray(v), [1.0, 2.0])
+    m(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_compute_before_update_warns():
+    m = DummySum()
+    with pytest.warns(UserWarning, match="called before"):
+        m.compute()
+
+
+def test_pickle_roundtrip():
+    m = DummySum()
+    m.update(5.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert np.asarray(m2.compute()) == pytest.approx(5.0)
+    m2.update(1.0)
+    assert np.asarray(m2.compute()) == pytest.approx(6.0)
+
+
+def test_clone_independent():
+    m = DummySum()
+    m.update(2.0)
+    c = m.clone()
+    c.update(3.0)
+    assert np.asarray(m.compute()) == pytest.approx(2.0)
+    assert np.asarray(c.compute()) == pytest.approx(5.0)
+
+
+def test_state_dict_persistence():
+    m = DummySum()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    m.update(4.0)
+    sd = m.state_dict()
+    assert np.asarray(sd["x"]) == pytest.approx(4.0)
+    m2 = DummySum()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert np.asarray(m2.compute()) == pytest.approx(4.0)
+
+
+def test_hash_differs_between_instances():
+    a, b = DummyListCat(), DummyListCat()
+    assert hash(a) != hash(b) or a is b
+
+
+def test_metric_arithmetic():
+    a, b = DummySum(), DummySum()
+    comp = a + b
+    a.update(1.0)
+    b.update(2.0)
+    assert np.asarray(comp.compute()) == pytest.approx(3.0)
+    comp2 = a * 2.0
+    assert np.asarray(comp2.compute()) == pytest.approx(2.0)
+    comp3 = 10.0 - a
+    assert np.asarray(comp3.compute()) == pytest.approx(9.0)
+    assert np.asarray(abs(-1.0 * a).compute()) == pytest.approx(1.0)
+
+
+def test_double_sync_raises():
+    m = DummySum()
+    m.update(1.0)
+    m.sync(distributed_available_fn=lambda: False)
+    # no-op sync (not distributed) → unsync must raise
+    with pytest.raises(MetricsTPUUserError):
+        m.unsync()
+
+
+def test_functionalize_pure():
+    mdef = functionalize(DummyMean())
+    state = mdef.init()
+    state = jax.jit(mdef.update)(state, jnp.asarray([1.0, 3.0]))
+    state = jax.jit(mdef.update)(state, jnp.asarray([5.0]))
+    assert np.asarray(jax.jit(mdef.compute)(state)) == pytest.approx(3.0)
+    # merge is associative combine
+    s1 = mdef.update(mdef.init(), jnp.asarray([2.0]))
+    s2 = mdef.update(mdef.init(), jnp.asarray([4.0]))
+    assert np.asarray(mdef.compute(mdef.merge(s1, s2))) == pytest.approx(3.0)
+
+
+def test_functionalize_rejects_list_state():
+    with pytest.raises(ValueError, match="cat"):
+        functionalize(DummyListCat())
+
+
+def test_functionalize_shard_map_sync():
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    mdef = functionalize(DummyMean(), axis_name="data")
+
+    data = jnp.arange(16.0)
+
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P())
+    def run(x):
+        state = mdef.init()
+        state = mdef.update(state, x)
+        return mdef.compute(state)
+
+    out = run(data)
+    assert np.asarray(out) == pytest.approx(np.mean(np.arange(16.0)))

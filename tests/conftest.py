@@ -1,0 +1,25 @@
+"""Test configuration: force CPU jax with 8 virtual devices.
+
+The analogue of the reference's 2-process Gloo pool
+(``test/unittests/helpers/testers.py:35-61``): distributed behavior is tested
+on a virtual 8-device CPU mesh via ``shard_map``/``pjit`` instead of a
+process-pool DDP simulation.
+
+The surrounding environment pins ``JAX_PLATFORMS=axon`` (single-chip TPU
+tunnel) and initializes the backend at interpreter startup via
+sitecustomize, so we must clear and re-create backends — env vars alone are
+too late.
+"""
+import jax
+
+NUM_DEVICES = 8
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", NUM_DEVICES)
+from jax.extend import backend as _jeb  # noqa: E402
+
+_jeb.clear_backends()
+
+
+def pytest_configure(config):
+    assert jax.device_count() >= NUM_DEVICES, f"expected {NUM_DEVICES} devices, got {jax.device_count()}"
