@@ -22,18 +22,46 @@ from metrics_tpu.aggregation import (  # noqa: E402
     MinMetric,
     SumMetric,
 )
+from metrics_tpu.classification import (  # noqa: E402
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    Dice,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.pure import MetricDef, functionalize  # noqa: E402
 
 __all__ = [
+    "Accuracy",
     "BaseAggregator",
     "CatMetric",
+    "CohenKappa",
     "CompositionalMetric",
+    "ConfusionMatrix",
+    "Dice",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
     "MaxMetric",
     "MeanMetric",
     "Metric",
     "MetricDef",
     "MinMetric",
+    "Precision",
+    "Recall",
+    "Specificity",
+    "StatScores",
     "SumMetric",
     "functionalize",
 ]

@@ -1,0 +1,53 @@
+"""``Dice`` module metric (reference
+``src/torchmetrics/classification/dice.py``, 167 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.dice import _dice_compute
+
+Array = jax.Array
+
+
+class Dice(StatScores):
+    """Dice = 2*TP / (2*TP + FP + FN) (reference ``dice.py:26-167``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        zero_division: int = 0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        allowed_average = ("micro", "macro", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+        super().__init__(
+            reduce="macro" if average in ("weighted", "none", None) else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        self.average = average
+        self.zero_division = zero_division
+
+    def compute(self) -> Array:
+        """Reference ``dice.py:160-167``."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _dice_compute(tp, fp, fn, self.average, self.mdmc_reduce, self.zero_division)

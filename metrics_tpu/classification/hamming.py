@@ -1,0 +1,34 @@
+"""``HammingDistance`` module metric (reference
+``src/torchmetrics/classification/hamming.py``, 93 LoC).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming import _hamming_distance_compute, _hamming_distance_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class HammingDistance(Metric):
+    """Average Hamming loss (reference ``hamming.py:24-93``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, threshold: float = 0.5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.threshold = threshold
+        self.add_state("correct", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct += correct
+        self.total += total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

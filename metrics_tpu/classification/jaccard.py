@@ -1,0 +1,47 @@
+"""``JaccardIndex`` module metric (reference
+``src/torchmetrics/classification/jaccard.py``, 113 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.jaccard import _jaccard_from_confmat
+
+Array = jax.Array
+
+
+class JaccardIndex(ConfusionMatrix):
+    """Jaccard index (IoU) over an accumulated confusion matrix
+    (reference ``jaccard.py:24-113``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            normalize=None,
+            threshold=threshold,
+            multilabel=multilabel,
+            **kwargs,
+        )
+        self.average = average
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def compute(self) -> Array:
+        """Reference ``jaccard.py:106-113``."""
+        return _jaccard_from_confmat(
+            self.confmat, self.num_classes, self.average, self.ignore_index, self.absent_score
+        )

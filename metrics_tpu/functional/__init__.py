@@ -1,0 +1,18 @@
+"""Functional metrics API (reference
+``src/torchmetrics/functional/__init__.py``)."""
+from metrics_tpu.functional.classification import (  # noqa: F401
+    accuracy,
+    cohen_kappa,
+    confusion_matrix,
+    dice,
+    f1_score,
+    fbeta_score,
+    hamming_distance,
+    jaccard_index,
+    matthews_corrcoef,
+    precision,
+    precision_recall,
+    recall,
+    specificity,
+    stat_scores,
+)

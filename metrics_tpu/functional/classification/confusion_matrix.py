@@ -1,0 +1,95 @@
+"""Confusion-matrix kernel (reference
+``src/torchmetrics/functional/classification/confusion_matrix.py``, 186 LoC).
+
+TPU-first: the bincount over ``target * C + pred`` is a one-hot reduction
+(``utilities/data._bincount``) that XLA lowers onto the MXU — deterministic by
+construction, unlike the reference's CUDA ``torch.bincount`` path.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import (
+    _check_shape_and_type_consistency,
+    _input_format_classification,
+    _input_squeeze,
+    _is_concrete,
+)
+from metrics_tpu.utilities.data import _bincount
+from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_update(
+    preds: Array, target: Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> Array:
+    """Accumulate the (un-normalized) confusion matrix
+    (reference ``confusion_matrix.py:25-54``): ``(C, C)`` counts, or
+    ``(C, 2, 2)`` per-class binary matrices when ``multilabel=True``."""
+    # resolve the case statically so num_classes can be passed through for
+    # multiclass inputs — keeps the canonicalizer free of data-dependent
+    # class-count inference (stays jittable; reference infers from data)
+    p_sq, t_sq = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    static_case, _ = _check_shape_and_type_consistency(p_sq, t_sq)
+    nc_arg = num_classes if static_case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) else None
+    preds, target, mode = _input_format_classification(p_sq, t_sq, threshold, num_classes=nc_arg)
+    if mode not in (DataType.BINARY, DataType.MULTILABEL):
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+    if multilabel:
+        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).reshape(-1)
+        minlength = 4 * num_classes
+    else:
+        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
+        minlength = num_classes**2
+
+    bins = _bincount(unique_mapping, minlength=minlength)
+    if multilabel:
+        return bins.reshape(num_classes, 2, 2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalize the accumulated matrix (reference ``confusion_matrix.py:57-115``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / confmat.sum(axis=1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / confmat.sum(axis=0, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / confmat.sum()
+        if _is_concrete(confmat):
+            nan_elements = int(jnp.isnan(confmat).sum())
+            if nan_elements:
+                rank_zero_warn(f"{nan_elements} nan values found in confusion matrix have been replaced with zeros.")
+        confmat = jnp.nan_to_num(confmat, nan=0.0, posinf=jnp.inf, neginf=-jnp.inf)
+    return confmat
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Array:
+    """Confusion matrix (reference ``confusion_matrix.py:118-186``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> confusion_matrix(preds, target, num_classes=2)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
+    return _confusion_matrix_compute(confmat, normalize)

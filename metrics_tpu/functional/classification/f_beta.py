@@ -1,0 +1,148 @@
+"""F-beta / F1 kernels (reference
+``src/torchmetrics/functional/classification/f_beta.py``, 354 LoC).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores, _stat_scores_update
+from metrics_tpu.utilities.compute import _safe_divide
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _masked_sum(x: Array, mask: Array) -> Array:
+    return jnp.sum(jnp.where(mask, x, 0))
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> Array:
+    """F-beta from stat scores (reference ``f_beta.py:30-108``); boolean
+    compression replaced by masked sums / the -1 ignore sentinel."""
+    tp = jnp.asarray(tp)
+    fp = jnp.asarray(fp)
+    fn = jnp.asarray(fn)
+    if average == AverageMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        mask = tp >= 0  # drop classes carrying the macro ignore sentinel
+        tp_s, fp_s, fn_s = _masked_sum(tp, mask), _masked_sum(fp, mask), _masked_sum(fn, mask)
+        precision = _safe_divide(tp_s, tp_s + fp_s)
+        recall = _safe_divide(tp_s, tp_s + fn_s)
+    else:
+        precision = _safe_divide(tp, tp + fp)
+        recall = _safe_divide(tp, tp + fn)
+
+    num = (1 + beta**2) * precision * recall
+    denom = beta**2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    # classes absent from preds AND target are meaningless (reference ``:83-92``)
+    sentinel = None
+    if average == AverageMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        sentinel = (tp + fp + fn) == 0
+        if ignore_index is not None:
+            sentinel = sentinel | (jnp.arange(tp.shape[-1]) == ignore_index)
+    elif ignore_index is not None:
+        if average not in (AverageMethod.MICRO, AverageMethod.SAMPLES):
+            sentinel = jnp.arange(tp.shape[-1]) == ignore_index
+            if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+                sentinel = jnp.broadcast_to(sentinel, num.shape)
+
+    if sentinel is not None:
+        num = jnp.where(sentinel, -1, num)
+        denom = jnp.where(sentinel, -1, denom)
+
+    if average == AverageMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        num = jnp.where(cond, -1, num)
+        denom = jnp.where(cond, -1, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AverageMethod.WEIGHTED else (tp + fn).astype(jnp.float32),
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _check_fbeta_args(average, mdmc_average, num_classes, ignore_index):
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F-beta score (reference ``f_beta.py:111-252``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> fbeta_score(preds, target, beta=0.5)
+        Array(0.33333334, dtype=float32)
+    """
+    _check_fbeta_args(average, mdmc_average, num_classes, ignore_index)
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1 (reference ``f_beta.py:255-354``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> f1_score(preds, target)
+        Array(0.33333334, dtype=float32)
+    """
+    return fbeta_score(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, multiclass)

@@ -1,0 +1,42 @@
+"""Hamming distance kernel (reference
+``src/torchmetrics/functional/classification/hamming.py``, 96 LoC).
+"""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+
+Array = jax.Array
+
+
+def _hamming_distance_update(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+) -> Tuple[Array, int]:
+    """Count positions where prediction equals target (reference ``hamming.py:23-42``)."""
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target).astype(jnp.int32)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    """Reference ``hamming.py:45-60``."""
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    """Average Hamming loss (reference ``hamming.py:63-96``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> preds = jnp.array([[0, 1], [0, 1]])
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

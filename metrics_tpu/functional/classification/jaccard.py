@@ -1,0 +1,74 @@
+"""Jaccard index kernel (reference
+``src/torchmetrics/functional/classification/jaccard.py``, 164 LoC).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+Array = jax.Array
+
+
+def _jaccard_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+) -> Array:
+    """Intersection-over-union from a confusion matrix
+    (reference ``jaccard.py:22-94``). ``ignore_index`` removal is a static
+    slice (the index is a Python int), so shapes stay XLA-compatible."""
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    confmat = jnp.asarray(confmat)
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(0.0)
+
+    if average in ("none", None):
+        intersection = jnp.diag(confmat)
+        union = confmat.sum(0) + confmat.sum(1) - intersection
+        scores = intersection.astype(jnp.float32) / jnp.where(union == 0, 1, union).astype(jnp.float32)
+        scores = jnp.where(union == 0, absent_score, scores)
+        if ignore_index is not None and 0 <= ignore_index < num_classes:
+            scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1 :]])
+        return scores
+
+    if average == "macro":
+        scores = _jaccard_from_confmat(confmat, num_classes, average="none", ignore_index=ignore_index, absent_score=absent_score)
+        return jnp.mean(scores)
+
+    if average == "micro":
+        intersection = jnp.sum(jnp.diag(confmat))
+        union = jnp.sum(confmat.sum(0) + confmat.sum(1) - jnp.diag(confmat))
+        return intersection.astype(jnp.float32) / union.astype(jnp.float32)
+
+    weights = confmat.sum(axis=1).astype(jnp.float32) / confmat.sum().astype(jnp.float32)
+    scores = _jaccard_from_confmat(confmat, num_classes, average="none", ignore_index=None, absent_score=absent_score)
+    return jnp.sum(weights * scores)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+) -> Array:
+    """Jaccard index (reference ``jaccard.py:97-164``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1, 1], [1, 1, 0]])
+        >>> preds = jnp.array([[0, 1, 0], [1, 1, 1]])
+        >>> jaccard_index(preds, target, num_classes=2).round(4)
+        Array(0.4667, dtype=float32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _jaccard_from_confmat(confmat, num_classes, average, ignore_index, absent_score)

@@ -1,0 +1,231 @@
+"""True/false positive/negative counting — the classification backbone.
+
+TPU-first redesign of reference
+``src/torchmetrics/functional/classification/stat_scores.py``:
+
+- ``_stat_scores`` (reference ``:63-107``) is elementwise masks + axis
+  reductions — XLA fuses the whole thing into one pass over the inputs.
+- ``_reduce_stat_scores`` (reference ``:231-289``) is rewritten **without
+  boolean compression**: the reference drops classes via ``x[~cond]``
+  (a dynamic shape, illegal under XLA); here droppable classes are marked
+  with the ``-1`` sentinel and masked with ``where``, which is numerically
+  identical (ignored classes get weight 0 and the weight renormalization
+  reproduces the mean-over-kept-classes semantics).
+- Negative ``ignore_index`` row-dropping (reference ``:28-60``) is
+  inherently dynamic-shape and only supported eagerly (concrete inputs).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import AverageMethod, DataType, MDMCAverageMethod
+
+Array = jax.Array
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    """Drop column ``idx`` (static shape; reference ``stat_scores.py:23-25``)."""
+    return jnp.concatenate([data[:, :idx], data[:, idx + 1 :]], axis=1)
+
+
+def _drop_negative_ignored_indices(
+    preds: Array, target: Array, ignore_index: int, mode: DataType
+) -> Tuple[Array, Array]:
+    """Remove samples whose target equals a negative ``ignore_index``
+    (reference ``stat_scores.py:28-60``). Dynamic output shape → eager only."""
+    if mode == DataType.MULTIDIM_MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating):
+        num_classes = preds.shape[1]
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+        target = target.reshape(-1)
+    if mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        keep = target != ignore_index
+        preds = preds[keep]
+        target = target[keep]
+    return preds, target
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn over canonical ``(N, C)`` / ``(N, C, X)`` binary
+    inputs (reference ``stat_scores.py:63-107``); output shape per ``reduce``
+    as documented there."""
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+    else:  # samples
+        dim = 1
+
+    true_pred = target == preds
+    pos_pred = preds == 1
+
+    tp = jnp.sum(true_pred & pos_pred, axis=dim)
+    fp = jnp.sum((~true_pred) & pos_pred, axis=dim)
+    tn = jnp.sum(true_pred & ~pos_pred, axis=dim)
+    fn = jnp.sum((~true_pred) & ~pos_pred, axis=dim)
+    dtype = jnp.int32
+    return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    mode: Optional[DataType] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Canonicalize inputs and count tp/fp/tn/fn
+    (reference ``stat_scores.py:110-193``)."""
+    _negative_index_dropped = False
+    if ignore_index is not None and ignore_index < 0:
+        # resolve the case statically if the caller didn't pass it — without
+        # this, a negative index would reach _del_column and silently
+        # duplicate columns (the reference has this hole for every caller but
+        # Accuracy; here the drop always runs)
+        if mode is None:
+            from metrics_tpu.utilities.checks import _check_shape_and_type_consistency, _input_squeeze
+
+            mode, _ = _check_shape_and_type_consistency(*_input_squeeze(jnp.asarray(preds), jnp.asarray(target)))
+        preds, target = _drop_negative_ignored_indices(preds, target, ignore_index, mode)
+        _negative_index_dropped = True
+
+    preds, target, _ = _input_format_classification(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if ignore_index is not None and ignore_index >= preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.moveaxis(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.moveaxis(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro" and not _negative_index_dropped:
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
+        # mark the ignored class with the -1 sentinel (reference ``:187-191``)
+        idx = jnp.arange(tp.shape[-1]) == ignore_index
+        tp = jnp.where(idx, -1, tp)
+        fp = jnp.where(idx, -1, fp)
+        tn = jnp.where(idx, -1, tn)
+        fn = jnp.where(idx, -1, fn)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Stack [tp, fp, tn, fn, support] along a trailing axis
+    (reference ``stat_scores.py:196-228``)."""
+    outputs = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: float = 0.0,
+) -> Array:
+    """Score reduction ``weights * num / denom`` with sentinel semantics
+    (reference ``stat_scores.py:231-289``): ``denominator < 0`` marks an
+    ignored class (weight 0 / NaN when ``average=None``); ``denominator == 0``
+    yields ``zero_division``. Pure ``where`` masking — no dynamic shapes."""
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else jnp.asarray(weights, jnp.float32)
+
+    numerator = jnp.where(zero_div_mask, zero_division, numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    scores = jnp.where(jnp.isnan(scores), zero_division, scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        scores = jnp.where(ignore_mask, jnp.nan, scores)
+    else:
+        scores = jnp.sum(scores)
+
+    return scores
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Number of tp/fp/tn/fn/support (reference ``stat_scores.py:292-442``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([1, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> stat_scores(preds, target, reduce='micro')
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

@@ -1,0 +1,319 @@
+"""Input validation & canonicalization for classification inputs.
+
+TPU-first redesign of reference ``src/torchmetrics/utilities/checks.py``:
+
+- **Case detection is static.** The reference's ``_check_shape_and_type_consistency``
+  (``checks.py:68-122``) branches on ``ndim`` and floating-ness only — both are
+  static under tracing — so the ``DataType`` case is always resolved at trace
+  time and never costs a device sync.
+- **Value validation is trace-aware.** The reference's value checks
+  (``checks.py:38-65``: target non-negative, probabilities in [0,1], label
+  ranges) need concrete data; here they run only when inputs are concrete
+  (eager / outside ``jit``) and are skipped for tracers. Structural errors
+  (shape/dtype/argument consistency) always raise.
+- **``num_classes`` inference needs concrete data** (reference
+  ``checks.py:432``: ``max(preds.max(), target.max()) + 1``). Under tracing
+  this raises ``ConcretizationTypeError``, which the ``Metric`` runtime
+  catches to fall back to eager — pass ``num_classes`` explicitly to stay
+  compiled (the static-shape contract from SURVEY.md §7).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+
+Array = jax.Array
+
+
+def _is_concrete(*arrays: Array) -> bool:
+    """True if none of the inputs is a JAX tracer (value checks are possible)."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _check_for_empty_tensors(preds: Array, target: Array) -> bool:
+    return preds.size == 0 and target.size == 0
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Reference ``checks.py:32-35``."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {preds.shape} and {target.shape}."
+        )
+
+
+def _basic_input_validation(
+    preds: Array, target: Array, threshold: float, multiclass: Optional[bool], ignore_index: Optional[int]
+) -> None:
+    """Case-independent validation (reference ``checks.py:38-65``).
+
+    Value checks run only on concrete arrays.
+    """
+    if _check_for_empty_tensors(preds, target):
+        return
+
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("The `target` has to be an integer tensor.")
+
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+
+    if preds.shape[0] != target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+
+    if _is_concrete(preds, target):
+        tmin = int(target.min())
+        if ignore_index is None and tmin < 0:
+            raise ValueError("The `target` has to be a non-negative tensor.")
+        if ignore_index is not None and ignore_index >= 0 and tmin < 0:
+            raise ValueError("The `target` has to be a non-negative tensor.")
+        if not preds_float and int(preds.min()) < 0:
+            raise ValueError("If `preds` are integers, they have to be non-negative.")
+        if multiclass is False and int(target.max()) > 1:
+            raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+        if multiclass is False and not preds_float and int(preds.max()) > 1:
+            raise ValueError(
+                "If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1."
+            )
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Resolve the input case from static shape/dtype info (reference ``checks.py:68-122``)."""
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"The `preds` and `target` should have the same shape, "
+                f"got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if preds_float and target.size > 0 and _is_concrete(target) and int(target.max()) > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif preds.ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif preds.ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+        implied_classes = (preds.size // preds.shape[0]) if preds.size > 0 else 0
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        implied_classes = preds.shape[1] if preds.size > 0 else 0
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, multiclass: Optional[bool]) -> None:
+    """Reference ``checks.py:125-140``."""
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and multiclass:
+        raise ValueError(
+            "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+            " Either set `multiclass=None` (default) or set `num_classes=2`"
+            " to transform binary data to multi-class format."
+        )
+
+
+def _check_num_classes_mc(
+    preds: Array, target: Array, num_classes: int, multiclass: Optional[bool], implied_classes: int
+) -> None:
+    """Reference ``checks.py:143-171``."""
+    if num_classes == 1 and multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `multiclass=False`."
+        )
+    if num_classes > 1:
+        if multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `multiclass=False`, but the implied number of classes"
+                " (from shape of inputs) does not match `num_classes`."
+            )
+        if target.size > 0 and _is_concrete(target) and num_classes <= int(target.max()):
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if preds.shape != target.shape and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, multiclass: Optional[bool], implied_classes: int) -> None:
+    """Reference ``checks.py:174-185``."""
+    if multiclass and num_classes != 2:
+        raise ValueError(
+            "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2 class multi-dimensional"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool) -> None:
+    """Reference ``checks.py:188-203``."""
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            "multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Full input-consistency check; returns the resolved case
+    (reference ``checks.py:206-298``)."""
+    _basic_input_validation(preds, target, threshold, multiclass, ignore_index)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if target.size > 0 and _is_concrete(target) and int(target.max()) >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds, target, num_classes, multiclass, implied_classes)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, jnp.issubdtype(preds.dtype, jnp.floating))
+
+    return case
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Remove excess size-1 dimensions (reference ``checks.py:301-310``)."""
+    if preds.shape and preds.shape[0] == 1:
+        preds = jnp.expand_dims(preds.squeeze(), 0)
+        target = jnp.expand_dims(target.squeeze(), 0)
+    else:
+        preds, target = preds.squeeze(), target.squeeze()
+    return preds, target
+
+
+def _infer_num_classes(preds: Array, target: Array) -> int:
+    """Data-dependent class-count inference (reference ``checks.py:432``).
+
+    Requires concrete arrays; under tracing JAX raises
+    ``ConcretizationTypeError``, which the Metric runtime translates into an
+    eager fallback. Pass ``num_classes`` to stay fully compiled.
+    """
+    return int(max(int(preds.max()), int(target.max())) + 1)
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Canonicalize ``(preds, target)`` into dense binary ``(N, C)`` /
+    ``(N, C, X)`` int arrays (reference ``checks.py:313-452``).
+
+    All shape logic is static; the only data-dependent step is
+    ``num_classes`` inference for integer multi-class preds without an
+    explicit ``num_classes`` (see :func:`_infer_num_classes`).
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _input_squeeze(preds, target)
+
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    case = _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        top_k=top_k,
+        ignore_index=ignore_index,
+    )
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32)
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            num_classes = num_classes if num_classes else _infer_num_classes(preds, target)
+            preds = to_onehot(preds, max(2, num_classes))
+
+        target = to_onehot(target, max(2, num_classes))
+
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if not _check_for_empty_tensors(preds, target):
+        if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+            target = target.reshape(target.shape[0], target.shape[1], -1)
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        else:
+            target = target.reshape(target.shape[0], -1)
+            preds = preds.reshape(preds.shape[0], -1)
+
+    # drop the trailing X=1 axis created above for plain (N, C) cases
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = preds.squeeze(-1), target.squeeze(-1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case

@@ -1,0 +1,48 @@
+"""Deterministic seeded classification fixtures (analogue of reference
+``test/unittests/classification/inputs.py:25-60``)."""
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+seed_all(1)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+
+def _rand(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def _randint(high, *shape):
+    return np.random.randint(0, high, shape, dtype=np.int64)
+
+
+_input_binary_prob = Input(preds=_rand(NUM_BATCHES, BATCH_SIZE), target=_randint(2, NUM_BATCHES, BATCH_SIZE))
+_input_binary = Input(preds=_randint(2, NUM_BATCHES, BATCH_SIZE), target=_randint(2, NUM_BATCHES, BATCH_SIZE))
+_input_multilabel_prob = Input(
+    preds=_rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), target=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+)
+_input_multilabel = Input(
+    preds=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES), target=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+)
+
+_mc_prob_raw = _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+_input_multiclass_prob = Input(
+    preds=_mc_prob_raw / _mc_prob_raw.sum(-1, keepdims=True),
+    target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE),
+)
+_input_multiclass = Input(
+    preds=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE), target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE)
+)
+_input_multidim_multiclass = Input(
+    preds=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
+    target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
+)
+_mdmc_prob_raw = _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)
+_input_multidim_multiclass_prob = Input(
+    preds=_mdmc_prob_raw / _mdmc_prob_raw.sum(2, keepdims=True),
+    target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
+)

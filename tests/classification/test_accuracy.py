@@ -1,0 +1,89 @@
+"""Accuracy parity vs sklearn (analogue of reference
+``test/unittests/classification/test_accuracy.py``)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu.classification import Accuracy
+from metrics_tpu.functional import accuracy
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    """Canonicalize exactly like the metric, then sklearn accuracy
+    (mirrors reference ``test_accuracy.py:34-49``)."""
+    if preds.ndim == target.ndim and np.issubdtype(preds.dtype, np.floating):
+        # binary prob / multilabel prob
+        preds = (preds >= THRESHOLD).astype(int)
+    elif preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    preds, target = np.asarray(preds), np.asarray(target)
+    if subset_accuracy and preds.ndim > 1:
+        return sk_accuracy(target, preds)  # row-exact match
+    return sk_accuracy(target.reshape(-1), preds.reshape(-1))
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, True),
+        (_input_multilabel.preds, _input_multilabel.target, True),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, False),
+        (_input_multiclass.preds, _input_multiclass.target, False),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False),
+    ],
+)
+class TestAccuracy(MetricTester):
+    def test_accuracy_class(self, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            preds,
+            target,
+            Accuracy,
+            lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, "mdmc_average": "global"},
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            accuracy,
+            lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy, "mdmc_average": "global"},
+        )
+
+
+def test_accuracy_sharded():
+    """DDP analogue: state synced over the 8-device mesh."""
+    MetricTester().run_sharded_metric_test(
+        _input_multiclass.preds,
+        _input_multiclass.target,
+        Accuracy,
+        lambda p, t: _sk_accuracy(p, t),
+        metric_args={"num_classes": NUM_CLASSES},
+    )
+
+
+def test_accuracy_topk():
+    """top_k accuracy on multiclass probabilities (reference
+    ``test_accuracy.py`` top-k block)."""
+    preds = _input_multiclass_prob.preds
+    target = _input_multiclass_prob.target
+    m = Accuracy(top_k=2, num_classes=NUM_CLASSES)
+    for i in range(preds.shape[0]):
+        m.update(preds[i], target[i])
+    # manual top-2 reference
+    top2 = np.argsort(-preds.reshape(-1, NUM_CLASSES), axis=1)[:, :2]
+    expected = np.mean([t in p for t, p in zip(target.reshape(-1), top2)])
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)

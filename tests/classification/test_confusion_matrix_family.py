@@ -1,0 +1,148 @@
+"""ConfusionMatrix / CohenKappa / MatthewsCorrCoef / JaccardIndex /
+HammingDistance / StatScores / Dice parity vs sklearn (analogue of reference
+``test/unittests/classification/test_{confusion_matrix,cohen_kappa,...}.py``)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusion_matrix
+
+from metrics_tpu.classification import (
+    CohenKappa,
+    ConfusionMatrix,
+    Dice,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    StatScores,
+)
+from metrics_tpu.functional import cohen_kappa, confusion_matrix, hamming_distance, jaccard_index, matthews_corrcoef, stat_scores
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _canonical(preds, target):
+    if preds.ndim == target.ndim and np.issubdtype(preds.dtype, np.floating):
+        preds = (preds >= THRESHOLD).astype(int)
+    elif preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    return preds.reshape(-1) if preds.ndim == 1 or target.ndim == 1 else preds, target
+
+
+CM_CASES = [
+    (_input_binary_prob.preds, _input_binary_prob.target, 2),
+    (_input_binary.preds, _input_binary.target, 2),
+    (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES),
+    (_input_multiclass_prob.preds, _input_multiclass_prob.target, NUM_CLASSES),
+]
+
+
+@pytest.mark.parametrize("preds, target, num_classes", CM_CASES)
+class TestConfusionMatrixFamily(MetricTester):
+    def test_confusion_matrix(self, preds, target, num_classes):
+        def sk(p, t):
+            p, t = _canonical(p, t)
+            return sk_confusion_matrix(t, p, labels=list(range(num_classes)))
+
+        args = {"num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, ConfusionMatrix, sk, metric_args=args)
+        self.run_functional_metric_test(preds, target, confusion_matrix, sk, metric_args=args)
+
+    def test_cohen_kappa(self, preds, target, num_classes):
+        def sk(p, t):
+            p, t = _canonical(p, t)
+            return sk_cohen_kappa(t, p)
+
+        args = {"num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, CohenKappa, sk, metric_args=args, check_batch=False)
+        self.run_functional_metric_test(preds, target, cohen_kappa, sk, metric_args=args)
+
+    def test_matthews(self, preds, target, num_classes):
+        def sk(p, t):
+            p, t = _canonical(p, t)
+            return sk_matthews(t, p)
+
+        args = {"num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, MatthewsCorrCoef, sk, metric_args=args, check_batch=False)
+        self.run_functional_metric_test(preds, target, matthews_corrcoef, sk, metric_args=args)
+
+    def test_jaccard(self, preds, target, num_classes):
+        def sk(p, t):
+            p, t = _canonical(p, t)
+            return sk_jaccard(t, p, average="macro", labels=list(range(num_classes)), zero_division=0)
+
+        args = {"num_classes": num_classes, "threshold": THRESHOLD, "average": "macro"}
+        self.run_class_metric_test(preds, target, JaccardIndex, sk, metric_args=args, check_batch=False)
+        self.run_functional_metric_test(preds, target, jaccard_index, sk, metric_args=args)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target),
+    ],
+)
+def test_hamming(preds, target):
+    def sk(p, t):
+        if p.ndim == t.ndim and np.issubdtype(p.dtype, np.floating):
+            p = (p >= THRESHOLD).astype(int)
+        elif p.ndim == t.ndim + 1:
+            p = np.argmax(p, axis=1)
+        if t.max() > 1 or p.max() > 1:  # multiclass treated as per-label
+            C = max(t.max(), p.max()) + 1
+            p = np.eye(C, dtype=int)[p.reshape(-1)]
+            t = np.eye(C, dtype=int)[t.reshape(-1)]
+        return sk_hamming_loss(t.reshape(t.shape[0], -1), p.reshape(p.shape[0], -1))
+
+    MetricTester().run_class_metric_test(preds, target, HammingDistance, sk, metric_args={"threshold": THRESHOLD})
+    MetricTester().run_functional_metric_test(preds, target, hamming_distance, sk, metric_args={"threshold": THRESHOLD})
+
+
+def test_stat_scores_macro():
+    preds, target = _input_multiclass.preds, _input_multiclass.target
+
+    def sk(p, t):
+        mcm = sk_multilabel_confusion_matrix(t.reshape(-1), p.reshape(-1), labels=list(range(NUM_CLASSES)))
+        tn, fp, fn, tp = mcm[:, 0, 0], mcm[:, 0, 1], mcm[:, 1, 0], mcm[:, 1, 1]
+        return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+
+    MetricTester().run_class_metric_test(
+        preds, target, StatScores, sk, metric_args={"reduce": "macro", "num_classes": NUM_CLASSES}
+    )
+    MetricTester().run_functional_metric_test(
+        preds, target, stat_scores, sk, metric_args={"reduce": "macro", "num_classes": NUM_CLASSES}
+    )
+
+
+def test_dice_micro():
+    preds, target = _input_multiclass.preds, _input_multiclass.target
+
+    def sk(p, t):
+        mcm = sk_multilabel_confusion_matrix(t.reshape(-1), p.reshape(-1), labels=list(range(NUM_CLASSES)))
+        fp, fn, tp = mcm[:, 0, 1].sum(), mcm[:, 1, 0].sum(), mcm[:, 1, 1].sum()
+        return 2 * tp / (2 * tp + fp + fn)
+
+    MetricTester().run_class_metric_test(preds, target, Dice, sk, metric_args={"average": "micro"})
+
+
+def test_confusion_matrix_sharded():
+    MetricTester().run_sharded_metric_test(
+        _input_multiclass.preds,
+        _input_multiclass.target,
+        ConfusionMatrix,
+        lambda p, t: sk_confusion_matrix(t.reshape(-1), p.reshape(-1), labels=list(range(NUM_CLASSES))),
+        metric_args={"num_classes": NUM_CLASSES},
+    )

@@ -1,0 +1,127 @@
+"""Precision / Recall / F-beta parity vs sklearn (analogue of reference
+``test/unittests/classification/{test_precision_recall,test_f_beta}.py``)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu.classification import F1Score, FBetaScore, Precision, Recall, Specificity
+from metrics_tpu.functional import f1_score, fbeta_score, precision, recall, specificity
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _canonical(preds, target):
+    if preds.ndim == target.ndim and np.issubdtype(preds.dtype, np.floating):
+        preds = (preds >= THRESHOLD).astype(int)
+    elif preds.ndim == target.ndim + 1:
+        preds = np.argmax(preds, axis=1)
+    return preds, target
+
+
+def _sk_wrapper(preds, target, sk_fn, average):
+    # BINARY case (float 1-d preds) scores the positive class only; integer
+    # 1-d preds are canonicalized to 2-class multiclass by the reference
+    is_binary_case = preds.ndim == 1 and np.issubdtype(preds.dtype, np.floating)
+    preds, target = _canonical(preds, target)
+    if preds.ndim > 1:  # multilabel
+        return sk_fn(target, preds, average=average, zero_division=0)
+    if is_binary_case:
+        return sk_fn(target.reshape(-1), preds.reshape(-1), average="binary", zero_division=0)
+    nc = max(2, NUM_CLASSES if preds.max() >= 2 or target.max() >= 2 else 2)
+    labels = list(range(nc)) if average != "micro" else None
+    return sk_fn(target.reshape(-1), preds.reshape(-1), average=average, labels=labels, zero_division=0)
+
+
+CASES = [
+    (_input_binary_prob.preds, _input_binary_prob.target, "micro", None),
+    (_input_binary.preds, _input_binary.target, "micro", None),
+    (_input_multiclass.preds, _input_multiclass.target, "micro", NUM_CLASSES),
+    (_input_multiclass.preds, _input_multiclass.target, "macro", NUM_CLASSES),
+    (_input_multiclass.preds, _input_multiclass.target, "weighted", NUM_CLASSES),
+    (_input_multiclass_prob.preds, _input_multiclass_prob.target, "macro", NUM_CLASSES),
+    (_input_multilabel_prob.preds, _input_multilabel_prob.target, "micro", NUM_CLASSES),
+    (_input_multilabel_prob.preds, _input_multilabel_prob.target, "macro", NUM_CLASSES),
+]
+
+
+@pytest.mark.parametrize("preds, target, average, num_classes", CASES)
+class TestPrecisionRecallF1(MetricTester):
+    def test_precision(self, preds, target, average, num_classes):
+        sk = partial(_sk_wrapper, sk_fn=sk_precision, average=average)
+        args = {"average": average, "num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, Precision, sk, metric_args=args)
+        self.run_functional_metric_test(preds, target, precision, sk, metric_args=args)
+
+    def test_recall(self, preds, target, average, num_classes):
+        sk = partial(_sk_wrapper, sk_fn=sk_recall, average=average)
+        args = {"average": average, "num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, Recall, sk, metric_args=args)
+        self.run_functional_metric_test(preds, target, recall, sk, metric_args=args)
+
+    def test_f1(self, preds, target, average, num_classes):
+        sk = partial(_sk_wrapper, sk_fn=partial(sk_fbeta, beta=1.0), average=average)
+        args = {"average": average, "num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, F1Score, sk, metric_args=args)
+        self.run_functional_metric_test(preds, target, f1_score, sk, metric_args=args)
+
+    def test_fbeta(self, preds, target, average, num_classes):
+        sk = partial(_sk_wrapper, sk_fn=partial(sk_fbeta, beta=2.0), average=average)
+        args = {"beta": 2.0, "average": average, "num_classes": num_classes, "threshold": THRESHOLD}
+        self.run_class_metric_test(preds, target, FBetaScore, sk, metric_args=args)
+        self.run_functional_metric_test(preds, target, fbeta_score, sk, metric_args={**args})
+
+
+def test_precision_none_average():
+    """per-class scores with average=None."""
+    preds, target = _input_multiclass.preds, _input_multiclass.target
+    m = Precision(average="none", num_classes=NUM_CLASSES)
+    for i in range(preds.shape[0]):
+        m.update(preds[i], target[i])
+    sk = sk_precision(target.reshape(-1), preds.reshape(-1), average=None, labels=list(range(NUM_CLASSES)), zero_division=0)
+    np.testing.assert_allclose(np.asarray(m.compute()), sk, atol=1e-5)
+
+
+def test_specificity_micro_macro():
+    """Specificity vs manual tn/(tn+fp)."""
+    preds, target = _input_multiclass.preds, _input_multiclass.target
+    from sklearn.metrics import multilabel_confusion_matrix
+
+    mcm = multilabel_confusion_matrix(target.reshape(-1), preds.reshape(-1), labels=list(range(NUM_CLASSES)))
+    tn, fp = mcm[:, 0, 0], mcm[:, 0, 1]
+    m = Specificity(average="micro")
+    for i in range(preds.shape[0]):
+        m.update(preds[i], target[i])
+    np.testing.assert_allclose(np.asarray(m.compute()), tn.sum() / (tn.sum() + fp.sum()), atol=1e-5)
+
+    m = Specificity(average="macro", num_classes=NUM_CLASSES)
+    for i in range(preds.shape[0]):
+        m.update(preds[i], target[i])
+    np.testing.assert_allclose(np.asarray(m.compute()), np.mean(tn / (tn + fp)), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(specificity(preds[0], target[0], average="macro", num_classes=NUM_CLASSES)),
+        None
+        or (lambda mcm0: np.mean(mcm0[:, 0, 0] / (mcm0[:, 0, 0] + mcm0[:, 0, 1])))(
+            multilabel_confusion_matrix(target[0], preds[0], labels=list(range(NUM_CLASSES)))
+        ),
+        atol=1e-5,
+    )
+
+
+def test_f1_sharded():
+    MetricTester().run_sharded_metric_test(
+        _input_multiclass.preds,
+        _input_multiclass.target,
+        Precision,
+        partial(_sk_wrapper, sk_fn=sk_precision, average="macro"),
+        metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+    )

@@ -1,0 +1,10 @@
+"""Test helpers (analogue of reference ``test/unittests/helpers``)."""
+import random
+
+import numpy as np
+
+
+def seed_all(seed: int) -> None:
+    """Deterministic test inputs (reference ``helpers/__init__.py:26-30``)."""
+    random.seed(seed)
+    np.random.seed(seed)
