@@ -1,0 +1,57 @@
+"""Benchmark: fused MetricCollection step (update + compute) on one chip.
+
+Headline number tracked against the BASELINE.md north star: the reference's
+target is a ``MetricCollection([Accuracy, F1, ...]).compute()`` under 2 ms
+(BASELINE.json; the reference itself publishes no absolute numbers — see
+BASELINE.md). ``vs_baseline`` is the speedup vs that 2 ms budget (>1 = faster
+than target).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    from __graft_entry__ import entry
+
+    step, (state, _, _) = entry()
+
+    B, C = 8192, 16
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random((B, C)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, C, B), jnp.int32)
+
+    jit_step = jax.jit(step, donate_argnums=0)
+
+    # warmup / compile
+    state_w, metrics = jit_step(dict(state), preds, target)
+    jax.block_until_ready(metrics)
+
+    iters = 50
+    st = state_w  # warmup donated `state`'s buffers; continue from its output
+    start = time.perf_counter()
+    for _ in range(iters):
+        st, metrics = jit_step(st, preds, target)
+    jax.block_until_ready(metrics)
+    elapsed_ms = (time.perf_counter() - start) / iters * 1e3
+
+    target_ms = 2.0  # BASELINE.md north-star budget for a fused collection step
+    print(
+        json.dumps(
+            {
+                "metric": "fused_collection_step_ms",
+                "value": round(elapsed_ms, 4),
+                "unit": "ms/step (update+4-metric compute, B=8192, C=16)",
+                "vs_baseline": round(target_ms / elapsed_ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,7 @@ from metrics_tpu.classification import (  # noqa: E402
     Specificity,
     StatScores,
 )
+from metrics_tpu.collections import MetricCollection  # noqa: E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.pure import MetricDef, functionalize  # noqa: E402
 
@@ -56,6 +57,7 @@ __all__ = [
     "MaxMetric",
     "MeanMetric",
     "Metric",
+    "MetricCollection",
     "MetricDef",
     "MinMetric",
     "Precision",

@@ -30,7 +30,7 @@ class Dice(StatScores):
         multiclass: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
-        allowed_average = ("micro", "macro", "samples", "none", None)
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
         if average not in allowed_average:
             raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
 

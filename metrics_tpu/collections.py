@@ -1,0 +1,357 @@
+"""``MetricCollection`` with automatic compute groups (reference
+``src/torchmetrics/collections.py:29``).
+
+TPU-first notes:
+
+- **Compute groups** (reference ``collections.py:191-267``) dedupe metrics
+  whose states are identical (e.g. Accuracy/Precision/Recall/F1 all backed by
+  the same tp/fp/tn/fn counters): after the first update each group's head is
+  the only member that runs ``update``. Because JAX arrays are immutable, the
+  reference's persistent tensor aliasing is replaced by re-pointing member
+  states at the head's state before any read (``_compute_groups_create_state_ref``
+  is called lazily on every access) — a dict copy, no device work.
+- **Fused sync**: ``sync_states`` collapses every sum/mean/max/min leaf of
+  every member into one flat vector per (reduction, dtype) and emits a single
+  ``psum``-style collective for the whole collection
+  (``metrics_tpu/parallel/sync.py:fused_sync``) — the "single cross-chip
+  collective" target from SURVEY.md §6, vs the reference's 2 all_gathers per
+  state per metric (``metric.py:348-374``).
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import _flatten_dict
+
+
+class MetricCollection:
+    """Chain metrics with the same call pattern (reference ``collections.py:29-446``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MetricCollection, Accuracy, Precision, Recall
+        >>> target = jnp.array([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.array([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([Accuracy(),
+        ...                             Precision(num_classes=3, average='macro'),
+        ...                             Recall(num_classes=3, average='macro')])
+        >>> sorted(metrics(preds, target).items())
+        [('Accuracy', Array(0.125, dtype=float32)), ('Precision', Array(0.06666667, dtype=float32)), ('Recall', Array(0.11111111, dtype=float32))]
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked = False
+        self._state_is_copy = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------------
+    # call surface
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-metric forward; kwargs filtered per update signature
+        (reference ``collections.py:151-159``)."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items(keep_base=True, copy_state=False)}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update group heads only once groups are formed
+        (reference ``collections.py:161-189``)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+                for name in cg[1:]:
+                    self._modules[name]._update_count = m0._update_count
+                    self._modules[name]._update_called = True
+                    self._modules[name]._computed = None
+            self._state_is_copy = False
+        else:
+            for _, m in self.items(keep_base=True, copy_state=False):
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+                self._compute_groups_create_state_ref()
+                self._groups_checked = True
+
+    def compute(self) -> Dict[str, Any]:
+        """Reference ``collections.py:269-273``."""
+        self._compute_groups_create_state_ref()
+        res = {k: m.compute() for k, m in self._modules.items()}
+        res = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def reset(self) -> None:
+        """Reference ``collections.py:275-281``."""
+        for _, m in self.items(keep_base=True, copy_state=False):
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._compute_groups_create_state_ref()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Reference ``collections.py:283-295``."""
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        """Reference ``collections.py:297-300``."""
+        for _, m in self.items(keep_base=True, copy_state=False):
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Per-metric state dicts keyed by base name."""
+        return {k: m.state_dict() for k, m in self.items(keep_base=True, copy_state=True)}
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        for k, m in self._modules.items():
+            if k in state_dict:
+                m.load_state_dict(state_dict[k])
+        # loaded states override group aliasing until the next update
+        self._state_is_copy = True
+
+    # ------------------------------------------------------------------
+    # compute groups
+    # ------------------------------------------------------------------
+
+    def _merge_compute_groups(self) -> None:
+        """Pairwise state-equality merge (reference ``collections.py:191-224``)."""
+        n_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in list(self._groups.items()):
+                merged = False
+                for cg_idx2, cg_members2 in list(self._groups.items()):
+                    if cg_idx1 == cg_idx2 or cg_idx2 not in self._groups:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        merged = True
+                        break
+                if merged:
+                    break
+            if len(self._groups) == n_groups:
+                break
+            n_groups = len(self._groups)
+        self._groups = {i: v for i, v in enumerate(self._groups.values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Shape + value equality of two metrics' states
+        (reference ``collections.py:227-248``). One host sync at group-forming
+        time only."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = metric1._state[key]
+            state2 = metric2._state[key]
+            if type(state1) is not type(state2):
+                return False
+            if isinstance(state1, list):
+                if len(state1) != len(state2):
+                    return False
+                if not all(s1.shape == s2.shape and np.allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            else:
+                if state1.shape != state2.shape or not np.allclose(state1, state2):
+                    return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Point member states at the group head's state
+        (reference ``collections.py:251-267``). Must re-run before every read
+        because jitted updates rebind the head's state dict rather than
+        mutating arrays in place."""
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            for name in cg[1:]:
+                mi = self._modules[name]
+                for state in m0._defaults:
+                    m0_state = m0._state[state]
+                    if copy:
+                        m0_state = list(m0_state) if isinstance(m0_state, list) else m0_state
+                    mi._state[state] = m0_state
+                mi._computed = None
+        self._state_is_copy = copy
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Reference ``collections.py:386-388``."""
+        return self._groups
+
+    # ------------------------------------------------------------------
+    # container surface
+    # ------------------------------------------------------------------
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Reference ``collections.py:302-363``."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, dict):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(f"You have passes extra arguments {remain} which are not Metrics.")
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                " with first passed dictionary."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = type(metric).__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _init_compute_groups(self) -> None:
+        """Reference ``collections.py:365-383``."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = {i: k for i, k in enumerate(self._enable_compute_groups)}
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
+                            f" Please make sure that {self._enable_compute_groups} matches {list(self._modules)}"
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(self._modules)}
+
+    def _set_name(self, base: str) -> str:
+        """Reference ``collections.py:390-394``."""
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> "OrderedDict[str, Metric]":
+        od: "OrderedDict[str, Metric]" = OrderedDict()
+        for k, v in self._modules.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        """Reference ``collections.py:402-409``."""
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        """Reference ``collections.py:411-422``."""
+        self._compute_groups_create_state_ref(copy_state)
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_ordered_dict().items()
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        """Reference ``collections.py:424-432``."""
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
+        """Reference ``collections.py:434-443``."""
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules[key]
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for k, v in self._modules.items():
+            repr_str += f"\n  {k}: {v!r}"
+        if self.prefix:
+            repr_str += f",\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f",\n  postfix={self.postfix}"
+        return repr_str + "\n)" if len(self._modules) else repr_str + ")"
+
+    # ------------------------------------------------------------------
+    # TPU-first fused sync
+    # ------------------------------------------------------------------
+
+    def sync_states(self, axis_name: str) -> None:
+        """Sync every member's state with one collective per (reduction, dtype)
+        via ``fused_sync`` — for use inside ``shard_map`` code. No reference
+        analogue (the reference gathers per-tensor, ``metric.py:348-374``)."""
+        from metrics_tpu.parallel.sync import fused_sync
+
+        self._compute_groups_create_state_ref()
+        heads = [self._modules[cg[0]] for cg in self._groups.values()] if self._groups else list(self._modules.values())
+        states = [dict(m._state) for m in heads]
+        reductions = [m._reductions for m in heads]
+        synced = fused_sync(states, reductions, axis_name)
+        for m, s in zip(heads, synced):
+            object.__setattr__(m, "_state", s)
+        self._compute_groups_create_state_ref()

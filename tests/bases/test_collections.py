@@ -1,0 +1,115 @@
+"""MetricCollection behavior (analogue of reference
+``test/unittests/bases/test_collections.py``, 558 LoC)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, f1_score, precision_score, recall_score
+
+from metrics_tpu import Accuracy, F1Score, MetricCollection, Precision, Recall
+from metrics_tpu.classification import ConfusionMatrix
+from tests.helpers import seed_all
+
+seed_all(42)
+
+NC = 5
+PREDS = [np.random.randint(0, NC, 32) for _ in range(4)]
+TARGET = [np.random.randint(0, NC, 32) for _ in range(4)]
+ALL_P = np.concatenate(PREDS)
+ALL_T = np.concatenate(TARGET)
+
+
+def _make_collection(**kwargs):
+    return MetricCollection(
+        [
+            Accuracy(num_classes=NC, average="micro"),
+            Precision(num_classes=NC, average="micro"),
+            Recall(num_classes=NC, average="micro"),
+            F1Score(num_classes=NC, average="micro"),
+        ],
+        **kwargs,
+    )
+
+
+def test_compute_groups_formed():
+    """StatScores-backed metrics collapse into one compute group
+    (reference ``collections.py:191`` behavior)."""
+    col = _make_collection()
+    for p, t in zip(PREDS, TARGET):
+        col.update(p, t)
+    groups = col.compute_groups
+    assert len(groups) == 1, f"expected one fused group, got {groups}"
+    res = col.compute()
+    np.testing.assert_allclose(np.asarray(res["Accuracy"]), accuracy_score(ALL_T, ALL_P), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(res["Precision"]), precision_score(ALL_T, ALL_P, average="micro"), atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(res["F1Score"]), f1_score(ALL_T, ALL_P, average="micro"), atol=1e-6)
+
+
+def test_compute_groups_update_count():
+    col = _make_collection()
+    for p, t in zip(PREDS, TARGET):
+        col.update(p, t)
+    counts = [m.update_count for m in col.values()]
+    assert all(c == len(PREDS) for c in counts), counts
+
+
+def test_heterogeneous_groups():
+    """Metrics with different state shapes stay in separate groups."""
+    col = MetricCollection([Accuracy(num_classes=NC, average="micro"), ConfusionMatrix(num_classes=NC)])
+    for p, t in zip(PREDS, TARGET):
+        col.update(p, t)
+    assert len(col.compute_groups) == 2
+
+
+def test_prefix_postfix_and_clone():
+    col = _make_collection(prefix="train_", postfix="_x")
+    col.update(PREDS[0], TARGET[0])
+    res = col.compute()
+    assert set(res) == {"train_Accuracy_x", "train_Precision_x", "train_Recall_x", "train_F1Score_x"}
+    col2 = col.clone(prefix="val_")
+    res2 = col2.compute()
+    assert "val_Accuracy_x" in res2
+
+
+def test_forward_returns_batch_values():
+    col = _make_collection()
+    out = col(PREDS[0], TARGET[0])
+    np.testing.assert_allclose(np.asarray(out["Accuracy"]), accuracy_score(TARGET[0], PREDS[0]), atol=1e-6)
+
+
+def test_dict_input_and_getitem():
+    col = MetricCollection({"acc": Accuracy(), "prec": Precision(num_classes=NC, average="macro")})
+    col.update(PREDS[0], TARGET[0])
+    res = col.compute()
+    assert set(res) == {"acc", "prec"}
+    assert isinstance(col["acc"], Accuracy)
+
+
+def test_reset_and_reuse():
+    col = _make_collection()
+    for p, t in zip(PREDS, TARGET):
+        col.update(p, t)
+    col.compute()
+    col.reset()
+    col.update(PREDS[0], TARGET[0])
+    res = col.compute()
+    np.testing.assert_allclose(np.asarray(res["Accuracy"]), accuracy_score(TARGET[0], PREDS[0]), atol=1e-6)
+
+
+def test_compute_groups_disabled_matches():
+    col_on = _make_collection(compute_groups=True)
+    col_off = _make_collection(compute_groups=False)
+    for p, t in zip(PREDS, TARGET):
+        col_on.update(p, t)
+        col_off.update(p, t)
+    res_on = col_on.compute()
+    res_off = col_off.compute()
+    for k in res_on:
+        np.testing.assert_allclose(np.asarray(res_on[k]), np.asarray(res_off[k]), atol=1e-7)
+
+
+def test_error_on_duplicate_and_bad_input():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([Accuracy(), Accuracy()])
+    with pytest.raises(ValueError):
+        MetricCollection([Accuracy()], "not-a-metric")
