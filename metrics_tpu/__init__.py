@@ -23,13 +23,27 @@ from metrics_tpu.aggregation import (  # noqa: E402
     SumMetric,
 )
 from metrics_tpu.classification import (  # noqa: E402
+    AUC,
+    AUROC,
     Accuracy,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    CalibrationError,
     CohenKappa,
     ConfusionMatrix,
+    CoverageError,
     Dice,
     F1Score,
     FBetaScore,
     HammingDistance,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+    PrecisionRecallCurve,
+    ROC,
     JaccardIndex,
     MatthewsCorrCoef,
     Precision,
@@ -42,16 +56,28 @@ from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.pure import MetricDef, functionalize  # noqa: E402
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
     "BaseAggregator",
     "CatMetric",
+    "CalibrationError",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",
+    "CoverageError",
     "Dice",
     "F1Score",
     "FBetaScore",
     "HammingDistance",
+    "HingeLoss",
+    "KLDivergence",
+    "LabelRankingAveragePrecision",
+    "LabelRankingLoss",
     "JaccardIndex",
     "MatthewsCorrCoef",
     "MaxMetric",
@@ -61,6 +87,8 @@ __all__ = [
     "MetricDef",
     "MinMetric",
     "Precision",
+    "PrecisionRecallCurve",
+    "ROC",
     "Recall",
     "Specificity",
     "StatScores",

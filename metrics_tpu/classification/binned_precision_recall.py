@@ -1,0 +1,176 @@
+"""Binned (constant-memory) precision-recall metrics (reference
+``src/torchmetrics/classification/binned_precision_recall.py``, 300 LoC).
+
+This is the TPU-preferred formulation of the curve metrics (SURVEY.md §7):
+static ``(C, T)`` TP/FP/FN counters, fully jittable update and compute —
+unlike the exact cat-state curves, these run inside compiled training steps
+and sync with one ``psum``.
+
+TPU-first change vs the reference: the reference loops over thresholds one at
+a time "to conserve memory" (``binned_precision_recall.py:152-157``, an eager
+CUDA concern); here the comparison is vectorized over a broadcast threshold
+axis — one fused XLA reduction, no loop.
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute_with_precision_recall,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import METRIC_EPS, to_onehot
+
+Array = jax.Array
+
+
+def _recall_at_precision(
+    precision: Array,
+    recall: Array,
+    thresholds: Array,
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    """Highest recall (tie-broken by precision, then threshold) subject to a
+    precision floor (reference ``binned_precision_recall.py:24-43``) —
+    vectorized lexicographic max instead of the reference's Python generator."""
+    n = thresholds.shape[0]
+    prec = precision[:n]
+    rec = recall[:n]
+    mask = prec >= min_precision
+    r_max = jnp.max(jnp.where(mask, rec, -jnp.inf))
+    mask2 = mask & (rec == r_max)
+    p_max = jnp.max(jnp.where(mask2, prec, -jnp.inf))
+    mask3 = mask2 & (prec == p_max)
+    t_best = jnp.max(jnp.where(mask3, thresholds, -jnp.inf))
+
+    any_valid = jnp.any(mask)
+    max_recall = jnp.where(any_valid, r_max, 0.0).astype(recall.dtype)
+    best_threshold = jnp.where(any_valid, t_best, 0.0)
+    best_threshold = jnp.where(max_recall == 0.0, jnp.asarray(1e6, thresholds.dtype), best_threshold)
+    return max_recall, best_threshold.astype(thresholds.dtype)
+
+
+class BinnedPrecisionRecallCurve(Metric):
+    """Constant-memory PR curve over fixed thresholds
+    (reference ``binned_precision_recall.py:45-180``).
+
+    Example (binary case):
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 0.1, 0.8, 0.4])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        >>> precision, recall, thresholds = pr_curve(pred, target)
+        >>> precision
+        Array([0.5      , 0.5      , 1.       , 0.9999999, 0.9999999, 1.       ],      dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0.5, 0. , 0. , 0. ], dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better: Optional[bool] = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Array, List[float]] = 100,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        if isinstance(thresholds, int):
+            self.num_thresholds = thresholds
+            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+        elif thresholds is not None:
+            if not isinstance(thresholds, (list, jax.Array)):
+                raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+            self.thresholds = jnp.asarray(thresholds)
+            self.num_thresholds = self.thresholds.size
+
+        for name in ("TPs", "FPs", "FNs"):
+            self.add_state(
+                name=name,
+                default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Vectorized threshold counting (reference ``binned_precision_recall.py:139-157``)."""
+        preds = jnp.asarray(preds)
+        target = jnp.asarray(target)
+        if preds.ndim == target.ndim == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        if preds.ndim == target.ndim + 1:
+            target = to_onehot(target, num_classes=self.num_classes)
+
+        tgt = (target == 1)[..., None]  # (N, C, 1)
+        pred = preds[..., None] >= self.thresholds  # (N, C, T)
+        self.TPs += jnp.sum(tgt & pred, axis=0).astype(jnp.float32)
+        self.FPs += jnp.sum((~tgt) & pred, axis=0).astype(jnp.float32)
+        self.FNs += jnp.sum(tgt & (~pred), axis=0).astype(jnp.float32)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """Reference ``binned_precision_recall.py:159-172``."""
+        precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
+        recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
+        precisions = jnp.concatenate([precisions, jnp.ones((self.num_classes, 1), precisions.dtype)], axis=1)
+        recalls = jnp.concatenate([recalls, jnp.zeros((self.num_classes, 1), recalls.dtype)], axis=1)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
+
+class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
+    """Constant-memory average precision
+    (reference ``binned_precision_recall.py:183-233``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3], jnp.float32)
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> BinnedAveragePrecision(num_classes=1, thresholds=10)(pred, target)
+        Array(1., dtype=float32)
+    """
+
+    def compute(self) -> Union[List[Array], Array]:
+        precisions, recalls, _ = super().compute()
+        return _average_precision_compute_with_precision_recall(precisions, recalls, self.num_classes, average=None)
+
+
+class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
+    """Highest recall at a minimum precision
+    (reference ``binned_precision_recall.py:236-300``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 0.2, 0.5, 0.8])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+        >>> m(pred, target)
+        (Array(1., dtype=float32), Array(0.11111111, dtype=float32))
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Union[int, Array, List[float]] = 100,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, **kwargs)
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        precisions, recalls, thresholds = super().compute()
+        if self.num_classes == 1:
+            return _recall_at_precision(precisions, recalls, thresholds, self.min_precision)
+
+        recalls_at_p = []
+        thresholds_at_p = []
+        for i in range(self.num_classes):
+            r, t = _recall_at_precision(precisions[i], recalls[i], thresholds[i], self.min_precision)
+            recalls_at_p.append(r)
+            thresholds_at_p.append(t)
+        return jnp.stack(recalls_at_p), jnp.stack(thresholds_at_p)

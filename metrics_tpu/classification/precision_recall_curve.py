@@ -1,0 +1,57 @@
+"""``PrecisionRecallCurve`` module metric (reference
+``src/torchmetrics/classification/precision_recall_curve.py:28``).
+
+Exact-curve form: raw preds/target accumulate in ``cat`` list states and the
+curve is computed eagerly on the gathered concatenation (the reference's
+all_gather-heavy path, SURVEY.md §2.5). Inside compiled code prefer
+``BinnedPrecisionRecallCurve``.
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PrecisionRecallCurve(Metric):
+    """Exact precision-recall pairs per threshold
+    (reference ``precision_recall_curve.py:28-144``)."""
+
+    is_differentiable = False
+    higher_is_better: Optional[bool] = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Reference ``precision_recall_curve.py:119-133``."""
+        preds, target, num_classes, pos_label = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """Reference ``precision_recall_curve.py:135-144``."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

@@ -1,0 +1,85 @@
+"""Multilabel ranking module metrics (reference
+``src/torchmetrics/classification/ranking.py``, 195 LoC).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.ranking import (
+    _coverage_error_compute,
+    _coverage_error_update,
+    _label_ranking_average_precision_compute,
+    _label_ranking_average_precision_update,
+    _label_ranking_loss_compute,
+    _label_ranking_loss_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _RankingBase(Metric):
+    is_differentiable = False
+    higher_is_better: Optional[bool] = None
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sample_weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._weighted = False
+
+    def _accumulate(self, score: Array, total: int, sample_weight: Optional[Array]) -> None:
+        self.score = score + self.score
+        self.total = total + self.total
+        if sample_weight is not None:
+            self._weighted = True
+            self.sample_weight = sample_weight + self.sample_weight
+
+    def _final(self, compute_fn) -> Array:
+        sw = self.sample_weight if self._weighted else None
+        return compute_fn(self.score, self.total, sw)
+
+
+class CoverageError(_RankingBase):
+    """How far down the ranking to go to cover all true labels
+    (reference ``ranking.py:24-77``)."""
+
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, total, sw = _coverage_error_update(preds, target, sample_weight)
+        self._accumulate(score, total, sw)
+
+    def compute(self) -> Array:
+        return self._final(_coverage_error_compute)
+
+
+class LabelRankingAveragePrecision(_RankingBase):
+    """Average fraction of correctly-ordered relevant labels
+    (reference ``ranking.py:80-135``)."""
+
+    higher_is_better = True
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, total, sw = _label_ranking_average_precision_update(preds, target, sample_weight)
+        self._accumulate(score, total, sw)
+
+    def compute(self) -> Array:
+        return self._final(_label_ranking_average_precision_compute)
+
+
+class LabelRankingLoss(_RankingBase):
+    """Average number of incorrectly-ordered label pairs
+    (reference ``ranking.py:138-195``)."""
+
+    higher_is_better = False
+
+    def update(self, preds: Array, target: Array, sample_weight: Optional[Array] = None) -> None:
+        score, total, sw = _label_ranking_loss_update(preds, target, sample_weight)
+        self._accumulate(score, total, sw)
+
+    def compute(self) -> Array:
+        return self._final(_label_ranking_loss_compute)

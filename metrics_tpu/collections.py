@@ -188,17 +188,20 @@ class MetricCollection:
         """Point member states at the group head's state
         (reference ``collections.py:251-267``). Must re-run before every read
         because jitted updates rebind the head's state dict rather than
-        mutating arrays in place."""
-        for cg in self._groups.values():
-            m0 = self._modules[cg[0]]
-            for name in cg[1:]:
-                mi = self._modules[name]
-                for state in m0._defaults:
-                    m0_state = m0._state[state]
-                    if copy:
-                        m0_state = list(m0_state) if isinstance(m0_state, list) else m0_state
-                    mi._state[state] = m0_state
-                mi._computed = None
+        mutating arrays in place. When states were externally loaded
+        (``_state_is_copy`` True, reference ``collections.py:258``) aliasing
+        is skipped so the loaded values survive until the next update."""
+        if not self._state_is_copy:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    for state in m0._defaults:
+                        m0_state = m0._state[state]
+                        if copy:
+                            m0_state = list(m0_state) if isinstance(m0_state, list) else m0_state
+                        mi._state[state] = m0_state
+                    mi._computed = None
         self._state_is_copy = copy
 
     @property

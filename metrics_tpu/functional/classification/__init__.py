@@ -11,3 +11,16 @@ from metrics_tpu.functional.classification.matthews_corrcoef import matthews_cor
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
 from metrics_tpu.functional.classification.specificity import specificity  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.classification.auc import auc  # noqa: F401
+from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
+from metrics_tpu.functional.classification.average_precision import average_precision  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
+from metrics_tpu.functional.classification.roc import roc  # noqa: F401
+from metrics_tpu.functional.classification.calibration_error import calibration_error  # noqa: F401
+from metrics_tpu.functional.classification.hinge import hinge_loss  # noqa: F401
+from metrics_tpu.functional.classification.kl_divergence import kl_divergence  # noqa: F401
+from metrics_tpu.functional.classification.ranking import (  # noqa: F401
+    coverage_error,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)

@@ -1,0 +1,38 @@
+"""AUC kernel (reference
+``src/torchmetrics/functional/classification/auc.py``, 133 LoC).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.compute import _auc_compute
+
+Array = jax.Array
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    """Shape checks (reference ``auc.py:20-40``)."""
+    if x.ndim > 1:
+        x = x.squeeze()
+    if y.ndim > 1:
+        y = y.squeeze()
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimension {x.ndim} and {y.ndim}")
+    if x.shape != y.shape:
+        raise ValueError(f"Expected the same shape for `x` and `y` tensors, but got {x.shape} and {y.shape}")
+    return x, y
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve via the trapezoidal rule (reference ``auc.py:112-133``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0, 1, 2, 3])
+        >>> y = jnp.array([0, 1, 2, 2])
+        >>> auc(x, y)
+        Array(4., dtype=float32)
+    """
+    x, y = _auc_update(jnp.asarray(x), jnp.asarray(y))
+    return _auc_compute(x.astype(jnp.float32), y.astype(jnp.float32), reorder=reorder)

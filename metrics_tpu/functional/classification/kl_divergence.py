@@ -1,0 +1,55 @@
+"""KL divergence kernel (reference
+``src/torchmetrics/functional/classification/kl_divergence.py``, 112 LoC).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    """Reference ``kl_divergence.py:24-47``."""
+    p = jnp.asarray(p)
+    q = jnp.asarray(q)
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / p.sum(axis=-1, keepdims=True)
+        q = q / q.sum(axis=-1, keepdims=True)
+        measures = _safe_xlogy(p, p / q).sum(axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean") -> Array:
+    """Reference ``kl_divergence.py:50-77``."""
+    if reduction == "sum":
+        return measures.sum()
+    if reduction == "mean":
+        return measures.sum() / total
+    if reduction is None or reduction == "none":
+        return measures
+    return measures / total
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """KL(P || Q) (reference ``kl_divergence.py:80-112``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> kl_divergence(p, q).round(4)
+        Array(0.0853, dtype=float32)
+    """
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)

@@ -17,6 +17,8 @@ import numpy as np
 
 Array = jax.Array
 
+METRIC_EPS = 1e-6  # reference ``utilities/data.py`` METRIC_EPS
+
 
 def dim_zero_cat(x: Union[Array, List[Array], Tuple[Array, ...]]) -> Array:
     """Concatenate a (list of) array(s) along dim 0 (reference ``utilities/data.py:36``)."""

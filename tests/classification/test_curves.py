@@ -1,0 +1,175 @@
+"""Curve-metric parity vs sklearn (analogue of reference
+``test/unittests/classification/test_{auroc,roc,precision_recall_curve,
+average_precision,binned_precision_recall,auc}.py``)."""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_auc_score as sk_roc_auc
+from sklearn.metrics import roc_curve as sk_roc
+
+from metrics_tpu.classification import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+class TestAUROC(MetricTester):
+    def test_binary(self):
+        preds, target = _input_binary_prob.preds, _input_binary_prob.target
+        self.run_class_metric_test(preds, target, AUROC, lambda p, t: sk_roc_auc(t, p), metric_args={"pos_label": 1})
+        self.run_functional_metric_test(preds, target, auroc, lambda p, t: sk_roc_auc(t, p), metric_args={"pos_label": 1})
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_multiclass(self, average):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        sk = lambda p, t: sk_roc_auc(t, p, multi_class="ovr", average=average, labels=list(range(NUM_CLASSES)))
+        self.run_class_metric_test(
+            preds, target, AUROC, sk, metric_args={"num_classes": NUM_CLASSES, "average": average}
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_multilabel(self, average):
+        preds, target = _input_multilabel_prob.preds, _input_multilabel_prob.target
+        sk = lambda p, t: sk_roc_auc(t, p, average=average)
+        self.run_class_metric_test(
+            preds, target, AUROC, sk, metric_args={"num_classes": NUM_CLASSES, "average": average}
+        )
+
+    def test_max_fpr(self):
+        preds, target = _input_binary_prob.preds, _input_binary_prob.target
+        sk = lambda p, t: sk_roc_auc(t, p, max_fpr=0.5)
+        self.run_functional_metric_test(preds, target, auroc, sk, metric_args={"pos_label": 1, "max_fpr": 0.5})
+
+
+class TestAveragePrecision(MetricTester):
+    def test_binary(self):
+        preds, target = _input_binary_prob.preds, _input_binary_prob.target
+        self.run_class_metric_test(preds, target, AveragePrecision, lambda p, t: sk_ap(t, p), metric_args={"pos_label": 1})
+
+    @pytest.mark.parametrize("average", ["macro", "weighted"])
+    def test_multiclass(self, average):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        sk = lambda p, t: sk_ap(np.eye(NUM_CLASSES)[t], p, average=average)
+        self.run_class_metric_test(
+            preds, target, AveragePrecision, sk, metric_args={"num_classes": NUM_CLASSES, "average": average}
+        )
+
+
+def test_roc_binary():
+    preds, target = _input_binary_prob.preds, _input_binary_prob.target
+    m = ROC(pos_label=1)
+    for i in range(preds.shape[0]):
+        m.update(preds[i], target[i])
+    fpr, tpr, _ = m.compute()
+    sk_fpr, sk_tpr, _ = sk_roc(target.reshape(-1), preds.reshape(-1), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-5)
+
+
+def _sk_prc_truncated(t, p):
+    """sklearn >=1.1 stopped truncating the curve at first full recall; the
+    reference (pinned sklearn <1.1.1, ``precision_recall_curve.py:148-150``)
+    truncates. Trim modern sklearn output to reference semantics."""
+    sk_p, sk_r, sk_t = sk_prc(t, p)
+    k = int((sk_r == 1.0).sum()) - 1  # drop duplicate full-recall points, keep one
+    return sk_p[k:], sk_r[k:], sk_t[k:]
+
+
+def test_prc_binary():
+    preds, target = _input_binary_prob.preds, _input_binary_prob.target
+    m = PrecisionRecallCurve(pos_label=1)
+    for i in range(preds.shape[0]):
+        m.update(preds[i], target[i])
+    precision, recall, thresholds = m.compute()
+    sk_p, sk_r, sk_t = _sk_prc_truncated(target.reshape(-1), preds.reshape(-1))
+    np.testing.assert_allclose(np.asarray(precision), sk_p, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(recall), sk_r, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(thresholds), sk_t, atol=1e-5)
+
+
+def test_prc_multiclass():
+    preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+    ps, rs, _ = precision_recall_curve(
+        preds.reshape(-1, NUM_CLASSES), target.reshape(-1), num_classes=NUM_CLASSES
+    )
+    for c in range(NUM_CLASSES):
+        sk_p, sk_r, _ = _sk_prc_truncated((target.reshape(-1) == c).astype(int), preds.reshape(-1, NUM_CLASSES)[:, c])
+        np.testing.assert_allclose(np.asarray(ps[c]), sk_p, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(rs[c]), sk_r, atol=1e-5)
+
+
+def test_auc_function():
+    x = np.array([0.0, 0.5, 1.0])
+    y = np.array([0.0, 0.8, 1.0])
+    from sklearn.metrics import auc as sk_auc
+
+    np.testing.assert_allclose(np.asarray(auc(x, y)), sk_auc(x, y), atol=1e-6)
+    m = AUC()
+    m.update(x[:2], y[:2])
+    m.update(x[2:], y[2:])
+    np.testing.assert_allclose(np.asarray(m.compute()), sk_auc(x, y), atol=1e-6)
+
+
+class TestBinned:
+    """Binned variants converge to the exact metric with dense thresholds and
+    stay jittable (static shapes)."""
+
+    def test_binned_ap_close_to_exact(self):
+        preds, target = _input_binary_prob.preds, _input_binary_prob.target
+        m = BinnedAveragePrecision(num_classes=1, thresholds=1001)
+        for i in range(preds.shape[0]):
+            m.update(preds[i], target[i])
+        exact = sk_ap(target.reshape(-1), preds.reshape(-1))
+        np.testing.assert_allclose(np.asarray(m.compute()), exact, atol=5e-3)
+
+    def test_binned_pr_curve_monotone_recall(self):
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=50)
+        for i in range(preds.shape[0]):
+            m.update(preds[i], target[i])
+        precisions, recalls, thresholds = m.compute()
+        assert len(precisions) == NUM_CLASSES
+        for r in recalls:
+            assert bool((np.diff(np.asarray(r)) <= 1e-6).all()), "recall must be non-increasing"
+
+    def test_binned_update_is_jittable(self):
+        """The binned update must stay inside one compiled graph (jit path
+        taken, no eager fallback)."""
+        m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=50)
+        preds, target = _input_multiclass_prob.preds, _input_multiclass_prob.target
+        m.update(preds[0], target[0])
+        assert m.jittable_update and m._update_jit is not None
+
+    def test_binned_recall_at_precision(self):
+        preds, target = _input_binary_prob.preds, _input_binary_prob.target
+        m = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.5, thresholds=200)
+        for i in range(preds.shape[0]):
+            m.update(preds[i], target[i])
+        recall_at, thresh_at = m.compute()
+        # manual reference on the dense grid
+        p_all, t_all = preds.reshape(-1), target.reshape(-1)
+        best = 0.0
+        for th in np.linspace(0, 1, 200):
+            pred_pos = p_all >= th
+            tp = (pred_pos & (t_all == 1)).sum()
+            if pred_pos.sum() == 0:
+                continue
+            prec = tp / pred_pos.sum()
+            rec = tp / (t_all == 1).sum()
+            if prec >= 0.5 - 1e-9:
+                best = max(best, rec)
+        np.testing.assert_allclose(np.asarray(recall_at), best, atol=2e-2)

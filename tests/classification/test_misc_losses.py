@@ -1,0 +1,129 @@
+"""Hinge / KLDivergence / CalibrationError / ranking parity (analogue of
+reference ``test/unittests/classification/test_{hinge,kl_divergence,
+calibration_error,ranking}.py``)."""
+import numpy as np
+import pytest
+from scipy.stats import entropy
+from sklearn.metrics import coverage_error as sk_coverage
+from sklearn.metrics import hinge_loss as sk_hinge
+from sklearn.metrics import label_ranking_average_precision_score as sk_lrap
+from sklearn.metrics import label_ranking_loss as sk_lrl
+
+from metrics_tpu.classification import (
+    CalibrationError,
+    CoverageError,
+    HingeLoss,
+    KLDivergence,
+    LabelRankingAveragePrecision,
+    LabelRankingLoss,
+)
+from metrics_tpu.functional import (
+    calibration_error,
+    coverage_error,
+    hinge_loss,
+    kl_divergence,
+    label_ranking_average_precision,
+    label_ranking_loss,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(7)
+N, B, L = 4, 32, 5
+RANK_PREDS = np.random.rand(N, B, L).astype(np.float32)
+RANK_TARGET = np.random.randint(0, 2, (N, B, L))
+
+
+def test_hinge_binary():
+    preds = np.random.randn(N, B).astype(np.float32)
+    target = np.random.randint(0, 2, (N, B))
+
+    def sk(p, t):
+        return sk_hinge(t * 2 - 1, p)
+
+    MetricTester().run_class_metric_test(preds, target, HingeLoss, sk)
+    MetricTester().run_functional_metric_test(preds, target, hinge_loss, sk)
+
+
+def test_hinge_multiclass_crammer_singer():
+    preds = np.random.randn(N, B, L).astype(np.float32)
+    target = np.random.randint(0, L, (N, B))
+
+    def sk(p, t):
+        return sk_hinge(t, p, labels=list(range(L)))
+
+    MetricTester().run_class_metric_test(preds, target, HingeLoss, sk)
+
+
+def test_kl_divergence():
+    p = np.random.rand(N, B, L).astype(np.float64)
+    p /= p.sum(-1, keepdims=True)
+    q = np.random.rand(N, B, L).astype(np.float64)
+    q /= q.sum(-1, keepdims=True)
+
+    def sk(pp, qq):
+        return np.mean([entropy(pi, qi) for pi, qi in zip(pp, qq)])
+
+    m = KLDivergence()
+    for i in range(N):
+        m.update(p[i], q[i])
+    expected = np.mean([entropy(pi, qi) for pi, qi in zip(p.reshape(-1, L), q.reshape(-1, L))])
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(kl_divergence(p[0], q[0])), sk(p[0], q[0]), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "metric_cls, fn, sk_fn, kwargs",
+    [
+        (CoverageError, coverage_error, sk_coverage, {}),
+        (LabelRankingAveragePrecision, label_ranking_average_precision, sk_lrap, {}),
+        (LabelRankingLoss, label_ranking_loss, sk_lrl, {}),
+    ],
+)
+def test_ranking(metric_cls, fn, sk_fn, kwargs):
+    def sk(p, t):
+        return sk_fn(t, p)
+
+    MetricTester().run_class_metric_test(RANK_PREDS, RANK_TARGET, metric_cls, sk, metric_args=kwargs)
+    MetricTester().run_functional_metric_test(RANK_PREDS, RANK_TARGET, fn, sk, metric_args=kwargs)
+
+
+def test_calibration_error_l1():
+    """ECE vs a hand-rolled numpy reference (the reference vendors its own,
+    ``test/unittests/helpers/reference_metrics.py``)."""
+    preds = np.random.rand(N, B, L).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    target = np.random.randint(0, L, (N, B))
+    n_bins = 15
+
+    def np_ece(p, t):
+        conf = p.max(-1)
+        acc = (p.argmax(-1) == t).astype(float)
+        bins = np.linspace(0, 1, n_bins + 1)
+        idx = np.clip(np.searchsorted(bins, conf, side="left") - 1, 0, n_bins - 1)
+        ece = 0.0
+        for b in range(n_bins):
+            m = idx == b
+            if m.sum() == 0:
+                continue
+            ece += abs(acc[m].mean() - conf[m].mean()) * m.mean()
+        return ece
+
+    m = CalibrationError(n_bins=n_bins, norm="l1")
+    for i in range(N):
+        m.update(preds[i], target[i])
+    expected = np_ece(preds.reshape(-1, L), target.reshape(-1))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(calibration_error(preds[0], target[0], n_bins=n_bins)), np_ece(preds[0], target[0]), atol=1e-5
+    )
+
+
+def test_calibration_error_norms():
+    preds = np.random.rand(B).astype(np.float32)
+    target = np.random.randint(0, 2, B)
+    for norm in ("l1", "l2", "max"):
+        v = calibration_error(preds, target, norm=norm)
+        assert np.isfinite(np.asarray(v))
+    with pytest.raises(ValueError, match="Norm"):
+        calibration_error(preds, target, norm="l3")

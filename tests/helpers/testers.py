@@ -121,7 +121,8 @@ class MetricTester:
         collectives via ``axis_name`` inside ``shard_map``.
         """
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+
+        shard_map = jax.shard_map
 
         from metrics_tpu.pure import functionalize
 
