@@ -54,44 +54,82 @@ from metrics_tpu.classification import (  # noqa: E402
 from metrics_tpu.collections import MetricCollection  # noqa: E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.pure import MetricDef, functionalize  # noqa: E402
+from metrics_tpu.wrappers import (  # noqa: E402
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
+from metrics_tpu.regression import (  # noqa: E402
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
 
 __all__ = [
     "AUC",
     "AUROC",
     "Accuracy",
     "AveragePrecision",
+    "BaseAggregator",
     "BinnedAveragePrecision",
     "BinnedPrecisionRecallCurve",
     "BinnedRecallAtFixedPrecision",
-    "BaseAggregator",
-    "CatMetric",
+    "BootStrapper",
     "CalibrationError",
+    "CatMetric",
+    "ClasswiseWrapper",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",
+    "CosineSimilarity",
     "CoverageError",
     "Dice",
+    "ExplainedVariance",
     "F1Score",
     "FBetaScore",
     "HammingDistance",
     "HingeLoss",
+    "JaccardIndex",
     "KLDivergence",
     "LabelRankingAveragePrecision",
     "LabelRankingLoss",
-    "JaccardIndex",
     "MatthewsCorrCoef",
     "MaxMetric",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
     "MeanMetric",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
     "Metric",
     "MetricCollection",
     "MetricDef",
+    "MetricTracker",
+    "MinMaxMetric",
     "MinMetric",
+    "MultioutputWrapper",
+    "PearsonCorrCoef",
     "Precision",
     "PrecisionRecallCurve",
+    "R2Score",
     "ROC",
     "Recall",
+    "SpearmanCorrCoef",
     "Specificity",
     "StatScores",
     "SumMetric",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
     "functionalize",
 ]

@@ -1,0 +1,104 @@
+"""Pairwise functional metrics (reference
+``src/torchmetrics/functional/pairwise/__init__.py``).
+
+All four distances are single MXU matmuls plus elementwise math — the
+TPU-optimal formulation (the manhattan distance is the only O(N*M*d)
+broadcast).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.pairwise.helpers import _check_input, _reduce_distance_matrix
+from metrics_tpu.utilities.compute import _safe_matmul
+
+Array = jax.Array
+
+
+def pairwise_cosine_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise cosine similarity (reference ``pairwise/cosine.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.array([[1., 0], [2, 1]])
+        >>> pairwise_cosine_similarity(x, y).round(4)
+        Array([[0.5547, 0.8682],
+               [0.5145, 0.8437],
+               [0.5301, 0.8533]], dtype=float32)
+    """
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    norm_x = x / jnp.linalg.norm(x, ord=2, axis=1, keepdims=True)
+    norm_y = y / jnp.linalg.norm(y, ord=2, axis=1, keepdims=True)
+    distance = _safe_matmul(norm_x, norm_y.T)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1], dtype=distance.dtype))
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_euclidean_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise euclidean distance (reference ``pairwise/euclidean.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.array([[1., 0], [2, 1]])
+        >>> pairwise_euclidean_distance(x, y).round(4)
+        Array([[3.1623, 2.    ],
+               [5.3852, 4.1231],
+               [8.9443, 7.6158]], dtype=float32)
+    """
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = (x * x).sum(axis=1, keepdims=True)
+    y_norm = (y * y).sum(axis=1)
+    distance = x_norm + y_norm - 2 * _safe_matmul(x, y.T)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1], dtype=distance.dtype))
+    return _reduce_distance_matrix(jnp.sqrt(jnp.clip(distance, 0, None)), reduction)
+
+
+def pairwise_linear_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise linear similarity ``x @ y^T`` (reference ``pairwise/linear.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.array([[1., 0], [2, 1]])
+        >>> pairwise_linear_similarity(x, y)
+        Array([[ 2.,  7.],
+               [ 3., 11.],
+               [ 5., 18.]], dtype=float32)
+    """
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _safe_matmul(x, y.T)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1], dtype=distance.dtype))
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_manhattan_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Pairwise manhattan distance (reference ``pairwise/manhattan.py``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([[2., 3], [3, 5], [5, 8]])
+        >>> y = jnp.array([[1., 0], [2, 1]])
+        >>> pairwise_manhattan_distance(x, y)
+        Array([[ 4.,  2.],
+               [ 7.,  5.],
+               [12., 10.]], dtype=float32)
+    """
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.abs(x[:, None] - y[None, :]).sum(axis=-1)
+    if zero_diagonal:
+        distance = distance * (1 - jnp.eye(distance.shape[0], distance.shape[1], dtype=distance.dtype))
+    return _reduce_distance_matrix(distance, reduction)

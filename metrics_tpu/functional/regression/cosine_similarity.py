@@ -1,0 +1,48 @@
+"""Cosine similarity kernels (reference
+``src/torchmetrics/functional/regression/cosine_similarity.py``).
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``cosine_similarity.py:22-37``."""
+    preds = jnp.asarray(preds, jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
+    target = jnp.asarray(target, jnp.float32) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+    _check_same_shape(preds, target)
+    return preds, target
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Reference ``cosine_similarity.py:40-66``."""
+    dot_product = (preds * target).sum(axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    reduction_mapping = {
+        "sum": jnp.sum,
+        "mean": jnp.mean,
+        "none": lambda x: x,
+        None: lambda x: x,
+    }
+    return reduction_mapping[reduction](similarity)
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Cosine similarity (reference ``cosine_similarity.py:69-103``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0., 1], [1, 1]])
+        >>> preds = jnp.array([[0., 1], [0, 1]])
+        >>> cosine_similarity(preds, target, 'mean').round(4)
+        Array(0.8536, dtype=float32)
+    """
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

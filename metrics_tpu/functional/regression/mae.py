@@ -1,0 +1,38 @@
+"""MAE kernel (reference ``src/torchmetrics/functional/regression/mae.py``)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Reference ``mae.py:22-35``."""
+    preds = jnp.asarray(preds, jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
+    target = jnp.asarray(target, jnp.float32) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    n_obs = target.size
+    return sum_abs_error, n_obs
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs: Array) -> Array:
+    """Reference ``mae.py:38-52``."""
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Mean absolute error (reference ``mae.py:55-75``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 1])
+        >>> mean_absolute_error(x, y)
+        Array(0.5, dtype=float32)
+    """
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -1,0 +1,40 @@
+"""MAPE kernel (reference ``src/torchmetrics/functional/regression/mape.py``)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    """Reference ``mape.py:22-43``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), epsilon, None)
+    sum_abs_per_error = jnp.sum(abs_per_error)
+    return sum_abs_per_error, target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    """Reference ``mape.py:46-61``."""
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE (reference ``mape.py:64-94``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1., 10, 1e6])
+        >>> preds = jnp.array([0.9, 15, 1.2e6])
+        >>> mean_absolute_percentage_error(preds, target).round(4)
+        Array(0.2667, dtype=float32)
+    """
+    sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

@@ -1,0 +1,43 @@
+"""MSE kernel (reference ``src/torchmetrics/functional/regression/mse.py``)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    """Reference ``mse.py:22-40``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    n_obs = target.shape[0]
+    return sum_squared_error, n_obs
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs: Array, squared: bool = True) -> Array:
+    """Reference ``mse.py:43-60``."""
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """Mean squared error (reference ``mse.py:63-90``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> mean_squared_error(x, y)
+        Array(0.25, dtype=float32)
+    """
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared=squared)

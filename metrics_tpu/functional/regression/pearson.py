@@ -1,0 +1,67 @@
+"""Pearson correlation kernels (reference
+``src/torchmetrics/functional/regression/pearson.py``, 103 LoC).
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Streaming-moment update (reference ``pearson.py:20-60``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    preds = preds.squeeze()
+    target = target.squeeze()
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+
+    n_obs = preds.size
+    mx_new = (n_prior * mean_x + preds.mean() * n_obs) / (n_prior + n_obs)
+    my_new = (n_prior * mean_y + target.mean() * n_obs) / (n_prior + n_obs)
+    n_prior = n_prior + n_obs
+    var_x = var_x + ((preds - mx_new) * (preds - mean_x)).sum()
+    var_y = var_y + ((target - my_new) * (target - mean_y)).sum()
+    corr_xy = corr_xy + ((preds - mx_new) * (target - mean_y)).sum()
+
+    return mx_new, my_new, var_x, var_y, corr_xy, n_prior
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Reference ``pearson.py:63-81``."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = (corr_xy / jnp.sqrt(var_x * var_y)).squeeze()
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient (reference ``pearson.py:84-103``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> pearson_corrcoef(preds, target).round(4)
+        Array(0.9849, dtype=float32)
+    """
+    zero = jnp.zeros((), jnp.result_type(preds, jnp.float32))
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zero, zero, zero, zero, zero, zero
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

@@ -1,0 +1,72 @@
+"""Spearman correlation kernels (reference
+``src/torchmetrics/functional/regression/spearman.py``, 131 LoC).
+
+TPU-first: the reference ranks with a Python loop over repeated values
+(``spearman.py:35-52``); here mean-rank-of-ties is computed in one shot as
+``rank_i = (#{x_j < x_i} + #{x_j <= x_i} + 1) / 2`` via two broadcast
+comparisons — static shapes, fully jittable.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rank_data(data: Array) -> Array:
+    """1-based ranks with ties assigned the mean of their rank span
+    (reference ``spearman.py:35-52``)."""
+    data = jnp.asarray(data)
+    lt = jnp.sum(data[None, :] < data[:, None], axis=1)
+    le = jnp.sum(data[None, :] <= data[:, None], axis=1)
+    return (lt + 1 + le).astype(data.dtype) / 2.0
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``spearman.py:55-76``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    preds = preds.squeeze()
+    target = target.squeeze()
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+    return preds, target
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    """Reference ``spearman.py:79-105``."""
+    preds = _rank_data(preds)
+    target = _rank_data(target)
+
+    preds_diff = preds - preds.mean()
+    target_diff = target - target.mean()
+
+    cov = (preds_diff * target_diff).mean()
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean())
+    target_std = jnp.sqrt((target_diff * target_diff).mean())
+
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman rank correlation (reference ``spearman.py:108-131``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> spearman_corrcoef(preds, target)
+        Array(1., dtype=float32)
+    """
+    preds, target = _spearman_corrcoef_update(preds, target)
+    return _spearman_corrcoef_compute(preds, target)

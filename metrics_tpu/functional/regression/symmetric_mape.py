@@ -1,0 +1,41 @@
+"""SMAPE kernel (reference
+``src/torchmetrics/functional/regression/symmetric_mape.py``)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    """Reference ``symmetric_mape.py:22-44``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    abs_per_error = 2 * jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), epsilon, None)
+    sum_abs_per_error = jnp.sum(abs_per_error)
+    return sum_abs_per_error, target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    """Reference ``symmetric_mape.py:47-62``."""
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE (reference ``symmetric_mape.py:65-92``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1., 10, 1e6])
+        >>> preds = jnp.array([0.9, 15, 1.2e6])
+        >>> symmetric_mean_absolute_percentage_error(preds, target).round(4)
+        Array(0.2290, dtype=float32)
+    """
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

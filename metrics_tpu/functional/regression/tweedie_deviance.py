@@ -1,0 +1,78 @@
+"""Tweedie deviance kernels (reference
+``src/torchmetrics/functional/regression/tweedie_deviance.py``, 140 LoC).
+
+Value-domain validation (strictly-positive preds/targets per power) is
+data-dependent; it runs only on concrete arrays — inside jit the math
+proceeds unchecked, matching the static-shape contract.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from metrics_tpu.utilities.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Reference ``tweedie_deviance.py:24-85``."""
+    preds = jnp.asarray(preds)
+    targets = jnp.asarray(targets)
+    _check_same_shape(preds, targets)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    concrete = _is_concrete(preds, targets)
+    if power == 0:
+        deviance_score = (targets - preds) ** 2
+    elif power == 1:
+        if concrete and (bool((preds <= 0).any()) or bool((targets < 0).any())):
+            raise ValueError(
+                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+            )
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        if concrete and (bool((preds <= 0).any()) or bool((targets <= 0).any())):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        if concrete:
+            if power < 0 and bool((preds <= 0).any()):
+                raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+            if 1 < power < 2 and (bool((preds <= 0).any()) or bool((targets < 0).any())):
+                raise ValueError(
+                    f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
+                )
+            if power > 2 and (bool((preds <= 0).any()) or bool((targets <= 0).any())):
+                raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+        term_1 = jnp.power(jnp.clip(targets, 0, None), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    sum_deviance_score = jnp.sum(deviance_score)
+    num_observations = jnp.asarray(deviance_score.size)
+    return sum_deviance_score, num_observations
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    """Reference ``tweedie_deviance.py:88-103``."""
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score (reference ``tweedie_deviance.py:106-140``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> targets = jnp.array([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.array([4.0, 3.0, 2.0, 1.0])
+        >>> tweedie_deviance_score(preds, targets, power=2).round(4)
+        Array(1.2083, dtype=float32)
+    """
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

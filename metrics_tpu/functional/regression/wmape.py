@@ -1,0 +1,40 @@
+"""WMAPE kernel (reference ``src/torchmetrics/functional/regression/wmape.py``)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``wmape.py:22-37``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs((preds - target).reshape(-1)))
+    sum_scale = jnp.sum(jnp.abs(target.reshape(-1)))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06
+) -> Array:
+    """Reference ``wmape.py:40-52``."""
+    return sum_abs_error / jnp.clip(sum_scale, epsilon, None)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE (reference ``wmape.py:55-85``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1., 10, 1e6])
+        >>> preds = jnp.array([0.9, 15, 1.2e6])
+        >>> weighted_mean_absolute_percentage_error(preds, target).round(4)
+        Array(0.2, dtype=float32)
+    """
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

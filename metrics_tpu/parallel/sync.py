@@ -64,16 +64,33 @@ def sync_leaf(value: Array, reduce_fx: Reduction, axis_name: str) -> Array:
         return jax.lax.pmin(value, axis_name)
     if reduce_fx == "cat":
         # concat over the device axis: all_gather then merge the leading axis.
-        gathered = jax.lax.all_gather(value, axis_name)  # (ndev, ...)
+        gathered = _all_gather_invariant(value, axis_name)  # (ndev, ...)
         return gathered.reshape((-1,) + gathered.shape[2:])
     if reduce_fx is None:
         # keep per-rank results stacked (reference retrieval metrics sync
         # without reduction, ``retrieval/base.py:93-95``)
-        return jax.lax.all_gather(value, axis_name)
+        return _all_gather_invariant(value, axis_name)
     if callable(reduce_fx):
-        gathered = jax.lax.all_gather(value, axis_name)
+        gathered = _all_gather_invariant(value, axis_name)
         return reduce_fx(gathered)
     raise ValueError(f"Unsupported dist_reduce_fx: {reduce_fx!r}")
+
+
+def _all_gather_invariant(value: Array, axis_name: str) -> Array:
+    """``all_gather`` whose result is typed device-invariant.
+
+    ``jax.lax.all_gather`` output is value-replicated but *typed* varying by
+    shard_map's varying-manual-axes tracking, so computes built purely from
+    gathers (e.g. Pearson's ``dist_reduce_fx=None`` moments) would fail the
+    replication check on their (correctly replicated) outputs. Expressing the
+    gather as scatter-into-zeros + ``psum`` yields the same collective (XLA
+    pattern-matches it to an all-gather) with an invariant-typed result.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    out = jnp.zeros((n,) + value.shape, value.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(out, value.astype(out.dtype), idx, 0)
+    return jax.lax.psum(out, axis_name)
 
 
 def sync_state(state: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: str) -> Dict[str, Any]:

@@ -1,0 +1,16 @@
+"""Regression module metrics (reference
+``src/torchmetrics/regression/__init__.py``)."""
+from metrics_tpu.regression.basic import (  # noqa: F401
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.regression.cosine_similarity import CosineSimilarity  # noqa: F401
+from metrics_tpu.regression.explained_variance import ExplainedVariance  # noqa: F401
+from metrics_tpu.regression.pearson import PearsonCorrCoef  # noqa: F401
+from metrics_tpu.regression.r2 import R2Score  # noqa: F401
+from metrics_tpu.regression.spearman import SpearmanCorrCoef  # noqa: F401
+from metrics_tpu.regression.tweedie_deviance import TweedieDevianceScore  # noqa: F401

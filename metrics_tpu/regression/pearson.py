@@ -1,0 +1,82 @@
+"""``PearsonCorrCoef`` module metric (reference
+``src/torchmetrics/regression/pearson.py:66``).
+"""
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute, _pearson_corrcoef_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array]:
+    """Chan-style pairwise merge of per-device moment statistics
+    (reference ``regression/pearson.py:23-64``). The loop is over the device
+    count — a small static bound, unrolled at trace time."""
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+
+        element_x1 = (n1 + 1) * mean_x - n1 * mx1
+        vx1 = vx1 + (element_x1 - mx1) * (element_x1 - mean_x) - (element_x1 - mean_x) ** 2
+        element_x2 = (n2 + 1) * mean_x - n2 * mx2
+        vx2 = vx2 + (element_x2 - mx2) * (element_x2 - mean_x) - (element_x2 - mean_x) ** 2
+        var_x = vx1 + vx2
+
+        element_y1 = (n1 + 1) * mean_y - n1 * my1
+        vy1 = vy1 + (element_y1 - my1) * (element_y1 - mean_y) - (element_y1 - mean_y) ** 2
+        element_y2 = (n2 + 1) * mean_y - n2 * my2
+        vy2 = vy2 + (element_y2 - my2) * (element_y2 - mean_y) - (element_y2 - mean_y) ** 2
+        var_y = vy1 + vy2
+
+        cxy1 = cxy1 + (element_x1 - mx1) * (element_y1 - mean_y) - (element_x1 - mean_x) * (element_y1 - mean_y)
+        cxy2 = cxy2 + (element_x2 - mx2) * (element_y2 - mean_y) - (element_x2 - mean_x) * (element_y2 - mean_y)
+        corr_xy = cxy1 + cxy2
+
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return var_x, var_y, corr_xy, nb
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation with streaming moment states
+    (reference ``pearson.py:66-150``). States use ``dist_reduce_fx=None`` —
+    sync stacks the per-device moments and ``compute`` merges them with the
+    pairwise aggregation above."""
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
+            self.add_state(name, default=jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Reference ``pearson.py:118-131``."""
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    def compute(self) -> Array:
+        """Reference ``pearson.py:133-150``."""
+        if jnp.asarray(self.mean_x).ndim > 0 and jnp.asarray(self.mean_x).size > 1:
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -1,0 +1,111 @@
+"""``BootStrapper`` wrapper (reference
+``src/torchmetrics/wrappers/bootstrapping.py:49-155``).
+
+Sampling runs on the host RNG (numpy) — resample indices are data-independent,
+so only the gather itself touches the device.
+"""
+from copy import deepcopy
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import apply_to_collection
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson") -> np.ndarray:
+    """Resample-with-replacement indices (reference ``bootstrapping.py:26-46``)."""
+    if sampling_strategy == "poisson":
+        n = np.random.poisson(1.0, size)
+        return np.repeat(np.arange(size), n)
+    if sampling_strategy == "multinomial":
+        return np.random.randint(0, size, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    """Confidence intervals via bootstrapped metric copies
+    (reference ``bootstrapping.py:49-155``).
+
+    Example:
+        >>> import numpy as np
+        >>> from metrics_tpu import Accuracy, BootStrapper
+        >>> np.random.seed(123)
+        >>> bootstrap = BootStrapper(Accuracy(), num_bootstraps=20)
+        >>> bootstrap.update(np.random.randint(0, 5, 20), np.random.randint(0, 5, 20))
+        >>> sorted(bootstrap.compute())
+        ['mean', 'std']
+    """
+
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but recieved {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample every input along dim 0 per copy (reference ``:120-137``)."""
+        args_sizes = apply_to_collection(args, (jax.Array, np.ndarray), len)
+        kwargs_sizes = list(apply_to_collection(kwargs, (jax.Array, np.ndarray), len).values())
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(args, (jax.Array, np.ndarray), lambda x: jnp.asarray(x)[sample_idx])
+            new_kwargs = apply_to_collection(kwargs, (jax.Array, np.ndarray), lambda x: jnp.asarray(x)[sample_idx])
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Reference ``bootstrapping.py:139-155``."""
+        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

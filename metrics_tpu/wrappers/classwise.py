@@ -1,0 +1,43 @@
+"""``ClasswiseWrapper`` (reference
+``src/torchmetrics/wrappers/classwise.py:8-73``).
+"""
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(Metric):
+    """Unroll a per-class result tensor into a labeled dict
+    (reference ``classwise.py:8-73``)."""
+
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Any]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def reset(self) -> None:
+        self.metric.reset()
+        super().reset()

@@ -1,0 +1,59 @@
+"""``MinMaxMetric`` (reference ``src/torchmetrics/wrappers/minmax.py:23-110``)."""
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MinMaxMetric(Metric):
+    """Track the running min/max of a wrapped metric's compute value
+    (reference ``minmax.py:23-110``; min/max are plain attributes updated at
+    compute time, not registered states — matching ``minmax.py:54-88``)."""
+
+    jittable_update = False
+    jittable_compute = False
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(
+                f"Returned value from base metric should be a scalar (int, float or tensor of size 1, but got {val}"
+            )
+        val = jnp.asarray(val)
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        """Reference ``minmax.py:91-94``."""
+        super().reset()
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    @staticmethod
+    def _is_suitable_val(val: Union[int, float, Array]) -> bool:
+        """Reference ``minmax.py:97-103``."""
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jax.Array,)) or hasattr(val, "size"):
+            return val.size == 1
+        return False

@@ -1,0 +1,100 @@
+"""``MultioutputWrapper`` (reference
+``src/torchmetrics/wrappers/multioutput.py:24-145``).
+"""
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import apply_to_collection
+
+Array = jax.Array
+
+_ARRAY_TYPES = (jax.Array, np.ndarray)
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows containing NaN in any input (reference ``multioutput.py:14-21``)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for tensor in tensors:
+        permuted_tensor = jnp.asarray(tensor).reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted_tensor), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    """One metric clone per output column (reference ``multioutput.py:24-145``).
+
+    NaN-row removal is data-dependent (dynamic shapes) and therefore runs
+    eagerly, like every wrapper.
+    """
+
+    is_differentiable = False
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+    ) -> None:
+        super().__init__()
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[list, dict]]:
+        """Reference ``multioutput.py:98-117``."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def select(x, _i=i):
+                return jnp.take(jnp.asarray(x), jnp.array([_i]), axis=self.output_dim)
+
+            selected_args = list(apply_to_collection(args, _ARRAY_TYPES, select))
+            selected_kwargs = apply_to_collection(kwargs, _ARRAY_TYPES, select)
+            if self.remove_nans:
+                args_kwargs = tuple(selected_args) + tuple(selected_kwargs.values())
+                nan_idxs = _get_nan_indices(*args_kwargs)
+                selected_args = [jnp.asarray(arg)[~nan_idxs] for arg in selected_args]
+                selected_kwargs = {k: jnp.asarray(v)[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [arg.squeeze(self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: v.squeeze(self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Reference ``multioutput.py:119-123``."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> List[Array]:
+        """Reference ``multioutput.py:125-127``."""
+        return [m.compute() for m in self.metrics]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Reference ``multioutput.py:129-141``."""
+        results = []
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            results.append(metric(*selected_args, **selected_kwargs))
+        if results[0] is None:
+            return None
+        return results
+
+    def reset(self) -> None:
+        """Reference ``multioutput.py:143-145``."""
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

@@ -1,0 +1,122 @@
+"""``MetricTracker`` (reference ``src/torchmetrics/wrappers/tracker.py:26-213``)."""
+import warnings
+from copy import deepcopy
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Track a metric (or collection) over time steps
+    (reference ``tracker.py:26-213``); a plain list of copies instead of the
+    reference's ``ModuleList``."""
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                f"Metric arg need to be an instance of a metrics_tpu `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        self.maximize = maximize
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Reference ``tracker.py:112-115``."""
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Reference ``tracker.py:117-120``."""
+        self._increment_called = True
+        self._metrics.append(deepcopy(self._base_metric))
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Union[Array, Dict[str, Array]]:
+        """Reference ``tracker.py:137-144``."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._metrics]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[None, float, Tuple[int, float], Dict[str, Any], Tuple[Dict[str, Any], Dict[str, Any]]]:
+        """Reference ``tracker.py:160-208``."""
+        if isinstance(self._base_metric, Metric):
+            fn = jnp.argmax if self.maximize else jnp.argmin
+            try:
+                all_res = self.compute_all()
+                idx = int(fn(all_res))
+                best = float(all_res[idx])
+                if return_step:
+                    return idx, best
+                return best
+            except (ValueError, TypeError) as error:
+                warnings.warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    "this is probably due to the 'best' not being defined for this metric."
+                    "Returning `None` instead.",
+                    UserWarning,
+                )
+                if return_step:
+                    return None, None
+                return None
+
+        res = self.compute_all()
+        maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+        idx, best = {}, {}
+        for i, (k, v) in enumerate(res.items()):
+            try:
+                fn = jnp.argmax if maximize[i] else jnp.argmin
+                best_i = int(fn(v))
+                idx[k], best[k] = best_i, float(v[best_i])
+            except (ValueError, TypeError) as error:
+                warnings.warn(
+                    f"Encountered the following error when trying to get the best metric for metric {k}:"
+                    f"{error} this is probably due to the 'best' not being defined for this metric."
+                    "Returning `None` instead.",
+                    UserWarning,
+                )
+                idx[k], best[k] = None, None
+        if return_step:
+            return idx, best
+        return best
+
+    def _check_for_increment(self, method: str) -> None:
+        """Reference ``tracker.py:210-213``."""
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

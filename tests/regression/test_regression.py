@@ -1,0 +1,186 @@
+"""Regression-metric parity vs sklearn/scipy (analogue of reference
+``test/unittests/regression/``)."""
+from functools import partial
+
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_ev,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    mean_squared_error,
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(11)
+N, B = 4, 48
+PREDS = (np.random.randn(N, B) * 2 + 1).astype(np.float32)
+TARGET = (np.random.randn(N, B) * 2 + 1).astype(np.float32)
+POS_PREDS = np.abs(PREDS) + 0.1
+POS_TARGET = np.abs(TARGET) + 0.1
+
+
+def _sk_smape(p, t):
+    return np.mean(2 * np.abs(p - t) / (np.abs(t) + np.abs(p)))
+
+
+def _sk_wmape(p, t):
+    return np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+
+
+@pytest.mark.parametrize(
+    "metric_cls, sk_fn, preds, target",
+    [
+        (MeanSquaredError, lambda p, t: sk_mse(t, p), PREDS, TARGET),
+        (MeanAbsoluteError, lambda p, t: sk_mae(t, p), PREDS, TARGET),
+        (MeanSquaredLogError, lambda p, t: sk_msle(t, p), POS_PREDS, POS_TARGET),
+        (MeanAbsolutePercentageError, lambda p, t: sk_mape(t, p), POS_PREDS, POS_TARGET),
+        (SymmetricMeanAbsolutePercentageError, _sk_smape, POS_PREDS, POS_TARGET),
+        (WeightedMeanAbsolutePercentageError, _sk_wmape, POS_PREDS, POS_TARGET),
+    ],
+)
+def test_sum_state_regression(metric_cls, sk_fn, preds, target):
+    MetricTester().run_class_metric_test(preds, target, metric_cls, sk_fn, atol=1e-4)
+
+
+def test_rmse():
+    m = MeanSquaredError(squared=False)
+    for i in range(N):
+        m.update(PREDS[i], TARGET[i])
+    np.testing.assert_allclose(
+        np.asarray(m.compute()), np.sqrt(sk_mse(TARGET.reshape(-1), PREDS.reshape(-1))), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(mean_squared_error(PREDS[0], TARGET[0], squared=False)),
+        np.sqrt(sk_mse(TARGET[0], PREDS[0])),
+        atol=1e-5,
+    )
+
+
+def test_pearson():
+    m = PearsonCorrCoef()
+    for i in range(N):
+        m.update(PREDS[i], TARGET[i])
+    expected = pearsonr(PREDS.reshape(-1), TARGET.reshape(-1))[0]
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pearson_corrcoef(PREDS[0], TARGET[0])), pearsonr(PREDS[0], TARGET[0])[0], atol=1e-4)
+
+
+def test_pearson_sharded():
+    """The dist_reduce_fx=None stacked-moments path over the mesh."""
+    MetricTester().run_sharded_metric_test(
+        PREDS,
+        TARGET,
+        PearsonCorrCoef,
+        lambda p, t: pearsonr(p.reshape(-1), t.reshape(-1))[0],
+        atol=1e-4,
+    )
+
+
+def test_spearman():
+    # include ties via rounding
+    p = np.round(PREDS, 1)
+    t = np.round(TARGET, 1)
+    m = SpearmanCorrCoef()
+    for i in range(N):
+        m.update(p[i], t[i])
+    expected = spearmanr(p.reshape(-1), t.reshape(-1))[0]
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(spearman_corrcoef(p[0], t[0])), spearmanr(p[0], t[0])[0], atol=1e-4)
+
+
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+def test_r2_and_explained_variance(multioutput):
+    preds2 = np.random.randn(N, B, 3).astype(np.float32)
+    target2 = (preds2 + 0.5 * np.random.randn(N, B, 3)).astype(np.float32)
+
+    m = R2Score(num_outputs=3, multioutput=multioutput)
+    ev = ExplainedVariance(multioutput=multioutput)
+    for i in range(N):
+        m.update(preds2[i], target2[i])
+        ev.update(preds2[i], target2[i])
+    allp = preds2.reshape(-1, 3)
+    allt = target2.reshape(-1, 3)
+    np.testing.assert_allclose(np.asarray(m.compute()), sk_r2(allt, allp, multioutput=multioutput), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(ev.compute()), sk_ev(allt, allp, multioutput=multioutput), atol=1e-4)
+
+
+def test_r2_adjusted():
+    p, t = PREDS.reshape(-1), TARGET.reshape(-1)
+    n = p.size
+    raw = sk_r2(t, p)
+    adj = 1 - (1 - raw) * (n - 1) / (n - 5 - 1)
+    np.testing.assert_allclose(np.asarray(r2_score(p, t, adjusted=5)), adj, atol=1e-4)
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0])
+def test_tweedie(power):
+    m = TweedieDevianceScore(power=power)
+    for i in range(N):
+        m.update(POS_PREDS[i], POS_TARGET[i])
+    expected = sk_tweedie(POS_TARGET.reshape(-1), POS_PREDS.reshape(-1), power=power)
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4, rtol=1e-4)
+
+
+def test_cosine_similarity():
+    preds2 = np.random.randn(N, B, 8).astype(np.float32)
+    target2 = np.random.randn(N, B, 8).astype(np.float32)
+    m = CosineSimilarity(reduction="mean")
+    for i in range(N):
+        m.update(preds2[i], target2[i])
+    allp, allt = preds2.reshape(-1, 8), target2.reshape(-1, 8)
+    expected = np.mean(np.sum(allp * allt, -1) / (np.linalg.norm(allp, axis=-1) * np.linalg.norm(allt, axis=-1)))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(cosine_similarity(allp, allt, "mean")), expected, atol=1e-5
+    )
+
+
+def test_pairwise():
+    from sklearn.metrics.pairwise import (
+        cosine_similarity as sk_cos,
+        euclidean_distances as sk_euc,
+        linear_kernel as sk_lin,
+        manhattan_distances as sk_man,
+    )
+
+    x = np.random.randn(10, 4).astype(np.float32)
+    y = np.random.randn(7, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pairwise_cosine_similarity(x, y)), sk_cos(x, y), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pairwise_euclidean_distance(x, y)), sk_euc(x, y), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pairwise_linear_similarity(x, y)), sk_lin(x, y), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pairwise_manhattan_distance(x, y)), sk_man(x, y), atol=1e-4)
+    # x-only variants zero the diagonal
+    d = np.asarray(pairwise_euclidean_distance(x))
+    np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-6)

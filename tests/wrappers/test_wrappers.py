@@ -1,0 +1,127 @@
+"""Wrapper-metric behavior (analogue of reference
+``test/unittests/wrappers/test_{bootstrapping,classwise,minmax,multioutput,
+tracker}.py``)."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, r2_score as sk_r2
+
+from metrics_tpu import (
+    Accuracy,
+    BootStrapper,
+    ClasswiseWrapper,
+    MeanSquaredError,
+    MetricCollection,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    Precision,
+    R2Score,
+)
+from tests.helpers import seed_all
+
+seed_all(13)
+
+
+def test_bootstrapper_mean_std():
+    np.random.seed(0)
+    preds = np.random.randint(0, 5, 200)
+    target = np.random.randint(0, 5, 200)
+    b = BootStrapper(Accuracy(), num_bootstraps=30, mean=True, std=True, raw=True)
+    b.update(preds, target)
+    out = b.compute()
+    assert set(out) == {"mean", "std", "raw"}
+    true_acc = accuracy_score(target, preds)
+    assert abs(float(out["mean"]) - true_acc) < 0.1
+    assert out["raw"].shape == (30,)
+    assert float(out["std"]) > 0
+
+
+def test_bootstrapper_invalid():
+    with pytest.raises(ValueError, match="base metric"):
+        BootStrapper(object())
+    with pytest.raises(ValueError, match="sampling_strategy"):
+        BootStrapper(Accuracy(), sampling_strategy="bogus")
+
+
+def test_classwise_wrapper():
+    m = ClasswiseWrapper(Accuracy(num_classes=3, average="none"), labels=["horse", "fish", "dog"])
+    preds = np.array([0, 1, 2, 0, 1, 2])
+    target = np.array([0, 1, 1, 0, 1, 0])
+    out = m(preds, target)
+    assert set(out) == {"accuracy_horse", "accuracy_fish", "accuracy_dog"}
+    # per-class recall: horse 2/3 (idx 5 mispredicted), fish 2/3 (idx 2 mispredicted)
+    np.testing.assert_allclose(np.asarray(out["accuracy_horse"]), 2 / 3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["accuracy_fish"]), 2 / 3, atol=1e-6)
+
+
+def test_minmax():
+    m = MinMaxMetric(Accuracy())
+    m.update(np.array([0, 1]), np.array([0, 1]))  # acc 1.0
+    out1 = m.compute()
+    assert float(out1["min"]) == float(out1["max"]) == 1.0
+    m.update(np.array([1, 0, 0, 0]), np.array([0, 1, 1, 1]))  # drags acc down
+    out2 = m.compute()
+    assert float(out2["min"]) < 1.0
+    assert float(out2["max"]) == 1.0
+    m.reset()
+    assert not np.isfinite(np.asarray(m.min_val)) or float(m.min_val) == np.inf
+
+
+def test_multioutput_r2():
+    target = np.array([[0.5, 1], [-1.0, 1], [7, -6]])
+    preds = np.array([[0.0, 2], [-1.0, 2], [8, -5]])
+    m = MultioutputWrapper(R2Score(), 2)
+    m.update(preds, target)
+    out = np.asarray(m.compute())
+    np.testing.assert_allclose(out, sk_r2(target, preds, multioutput="raw_values"), atol=1e-4)
+
+
+def test_multioutput_nan_removal():
+    target = np.array([[1.0, np.nan], [2.0, 2.0], [3.0, 3.0], [4.0, 4.0]])
+    preds = np.array([[1.1, 1.0], [2.2, 2.1], [2.9, 3.1], [4.4, 3.9]])
+    m = MultioutputWrapper(MeanSquaredError(), 2)
+    m.update(preds, target)
+    out = [float(x) for x in m.compute()]
+    expected0 = np.mean((preds[:, 0] - target[:, 0]) ** 2)
+    expected1 = np.mean((preds[1:, 1] - target[1:, 1]) ** 2)  # nan row dropped
+    np.testing.assert_allclose(out, [expected0, expected1], atol=1e-5)
+
+
+def test_tracker_single_metric():
+    tracker = MetricTracker(Accuracy(), maximize=True)
+    accs = []
+    np.random.seed(3)
+    for epoch in range(4):
+        tracker.increment()
+        preds = np.random.randint(0, 5, 100)
+        target = np.random.randint(0, 5, 100)
+        tracker.update(preds, target)
+        accs.append(accuracy_score(target, preds))
+    all_res = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(all_res, accs, atol=1e-6)
+    best, step = None, None
+    best_val, best_step = tracker.best_metric(return_step=True)[1], tracker.best_metric(return_step=True)[0]
+    assert best_step == int(np.argmax(accs))
+    np.testing.assert_allclose(best_val, max(accs), atol=1e-6)
+
+
+def test_tracker_collection():
+    col = MetricCollection([MeanSquaredError(), R2Score()])
+    tracker = MetricTracker(col, maximize=[False, True])
+    np.random.seed(4)
+    for epoch in range(3):
+        tracker.increment()
+        preds = np.random.randn(50).astype(np.float32)
+        target = (preds + 0.1 * np.random.randn(50)).astype(np.float32)
+        tracker.update(preds, target)
+    res = tracker.compute_all()
+    assert set(res) == {"MeanSquaredError", "R2Score"}
+    assert res["MeanSquaredError"].shape == (3,)
+    idx, best = tracker.best_metric(return_step=True)
+    assert set(idx) == {"MeanSquaredError", "R2Score"}
+
+
+def test_tracker_requires_increment():
+    tracker = MetricTracker(Accuracy())
+    with pytest.raises(ValueError, match="increment"):
+        tracker.update(np.array([0]), np.array([0]))
