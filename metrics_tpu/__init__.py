@@ -54,6 +54,18 @@ from metrics_tpu.classification import (  # noqa: E402
 from metrics_tpu.collections import MetricCollection  # noqa: E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
 from metrics_tpu.pure import MetricDef, functionalize  # noqa: E402
+from metrics_tpu.retrieval import (  # noqa: E402
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
 from metrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
     ClasswiseWrapper,
@@ -124,6 +136,16 @@ __all__ = [
     "R2Score",
     "ROC",
     "Recall",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision",
     "SpearmanCorrCoef",
     "Specificity",
     "StatScores",

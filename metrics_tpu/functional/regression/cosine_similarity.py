@@ -7,14 +7,15 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.compute import _to_float
 
 Array = jax.Array
 
 
 def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     """Reference ``cosine_similarity.py:22-37``."""
-    preds = jnp.asarray(preds, jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
-    target = jnp.asarray(target, jnp.float32) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+    preds = _to_float(preds)
+    target = _to_float(target)
     _check_same_shape(preds, target)
     return preds, target
 

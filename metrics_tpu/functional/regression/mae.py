@@ -5,14 +5,15 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.compute import _to_float
 
 Array = jax.Array
 
 
 def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     """Reference ``mae.py:22-35``."""
-    preds = jnp.asarray(preds, jnp.float32) if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) else jnp.asarray(preds)
-    target = jnp.asarray(target, jnp.float32) if not jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating) else jnp.asarray(target)
+    preds = _to_float(preds)
+    target = _to_float(target)
     _check_same_shape(preds, target)
     sum_abs_error = jnp.sum(jnp.abs(preds - target))
     n_obs = target.size

@@ -3,8 +3,8 @@
 
 TPU-first: the reference ranks with a Python loop over repeated values
 (``spearman.py:35-52``); here mean-rank-of-ties is computed in one shot as
-``rank_i = (#{x_j < x_i} + #{x_j <= x_i} + 1) / 2`` via two broadcast
-comparisons — static shapes, fully jittable.
+``rank_i = (#{x_j < x_i} + #{x_j <= x_i} + 1) / 2`` via sort + binary search —
+static shapes, fully jittable, O(N log N).
 """
 from typing import Tuple
 
@@ -18,11 +18,13 @@ Array = jax.Array
 
 def _rank_data(data: Array) -> Array:
     """1-based ranks with ties assigned the mean of their rank span
-    (reference ``spearman.py:35-52``)."""
+    (reference ``spearman.py:35-52``): ``rank_i = (#{< x_i} + 1 + #{<= x_i})/2``
+    via sort + two binary searches — O(N log N), no N x N broadcast."""
     data = jnp.asarray(data)
-    lt = jnp.sum(data[None, :] < data[:, None], axis=1)
-    le = jnp.sum(data[None, :] <= data[:, None], axis=1)
-    return (lt + 1 + le).astype(data.dtype) / 2.0
+    sorted_data = jnp.sort(data)
+    lt = jnp.searchsorted(sorted_data, data, side="left")
+    le = jnp.searchsorted(sorted_data, data, side="right")
+    return (lt + 1 + le).astype(jnp.result_type(data, jnp.float32)) / 2.0
 
 
 def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array]:

@@ -1,0 +1,19 @@
+"""Functional retrieval API (reference
+``src/torchmetrics/functional/retrieval/__init__.py``).
+
+Every kernel operates on one query's 1-d ``(preds, target)`` pair; the module
+metrics (``metrics_tpu/retrieval``) group by query id and average these over
+queries. All kernels are sort + slice + reduce — static shapes given a static
+query length.
+"""
+from metrics_tpu.functional.retrieval.kernels import (  # noqa: F401
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)

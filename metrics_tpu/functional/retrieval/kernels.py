@@ -1,0 +1,208 @@
+"""Per-query retrieval kernels (reference
+``src/torchmetrics/functional/retrieval/*.py``).
+
+Boolean-index gathers from the reference (e.g. ``positions[target > 0]``)
+are rewritten as masked reductions so each kernel is a fixed sequence of
+sort/cumsum/where ops. ``r_precision``'s data-dependent top-R slice needs a
+concrete relevant-count and stays eager.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _sort_target_by_preds(preds: Array, target: Array) -> Array:
+    return target[jnp.argsort(-preds)]
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP of one query (reference ``retrieval/average_precision.py:22-49``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_average_precision(preds, target).round(4)
+        Array(0.8333, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sorted_target = _sort_target_by_preds(preds, target)
+    ranks = jnp.arange(1, target.size + 1, dtype=jnp.float32)
+    precision_at_hit = jnp.cumsum(sorted_target, axis=0) / ranks
+    total = jnp.sum(sorted_target)
+    return jnp.where(total == 0, 0.0, jnp.sum(precision_at_hit * sorted_target) / jnp.maximum(total, 1))
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """RR of one query (reference ``retrieval/reciprocal_rank.py:20-49``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_reciprocal_rank(jnp.array([0.2, 0.3, 0.5]), jnp.array([False, False, True]))
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    sorted_target = _sort_target_by_preds(preds, target)
+    ranks = jnp.arange(1, target.size + 1, dtype=jnp.float32)
+    first_pos = jnp.min(jnp.where(sorted_target > 0, ranks, jnp.inf))
+    return jnp.where(jnp.sum(sorted_target) == 0, 0.0, 1.0 / first_pos)
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k of one query (reference ``retrieval/precision.py:22-65``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if k is None or (adaptive_k and k > preds.shape[-1]):
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    relevant = jnp.sum(_sort_target_by_preds(preds, target)[: min(k, preds.shape[-1])]).astype(jnp.float32)
+    return jnp.where(jnp.sum(target) == 0, 0.0, relevant / k)
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Recall@k of one query (reference ``retrieval/recall.py:22-61``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_recall(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    relevant = jnp.sum(_sort_target_by_preds(preds, target)[:k]).astype(jnp.float32)
+    total = jnp.sum(target)
+    return jnp.where(total == 0, 0.0, relevant / jnp.maximum(total, 1))
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fall-out@k of one query (reference ``retrieval/fall_out.py:22-62``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_fall_out(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    target = 1 - target
+    relevant = jnp.sum(_sort_target_by_preds(preds, target)[:k]).astype(jnp.float32)
+    total = jnp.sum(target)
+    return jnp.where(total == 0, 0.0, relevant / jnp.maximum(total, 1))
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """HitRate@k of one query (reference ``retrieval/hit_rate.py:22-57``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_hit_rate(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    relevant = jnp.sum(_sort_target_by_preds(preds, target)[:k])
+    return (relevant > 0).astype(jnp.float32)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision of one query (reference ``retrieval/r_precision.py:20-49``).
+
+    The top-R slice depends on the relevant count → concrete inputs only
+    (the module metrics compute eagerly on gathered state anyway).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> retrieval_r_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    relevant_number = int(jnp.sum(target))
+    if not relevant_number:
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(_sort_target_by_preds(preds, target)[:relevant_number]).astype(jnp.float32)
+    return relevant / relevant_number
+
+
+def _dcg(target: Array) -> Array:
+    """Reference ``retrieval/ndcg.py:20-22``."""
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return (target / denom).sum(axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG@k of one query (reference ``retrieval/ndcg.py:25-71``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([.1, .2, .3, 4, 70])
+        >>> target = jnp.array([10, 0, 0, 1, 5])
+        >>> retrieval_normalized_dcg(preds, target).round(4)
+        Array(0.6957, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    k = preds.shape[-1] if k is None else k
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+    sorted_target = _sort_target_by_preds(preds, target)[:k]
+    ideal_target = jnp.sort(target)[::-1][:k]
+
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    return jnp.where(ideal_dcg == 0, 0.0, target_dcg / jnp.where(ideal_dcg == 0, 1.0, ideal_dcg))
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision/recall at every k of one query
+    (reference ``retrieval/precision_recall_curve.py:22-97``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> p, r, k = retrieval_precision_recall_curve(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]), max_k=2)
+        >>> p, r, k
+        (Array([1. , 0.5], dtype=float32), Array([0.5, 0.5], dtype=float32), Array([1, 2], dtype=int32))
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+
+    n = preds.shape[-1]
+    if adaptive_k and max_k > n:
+        topk = jnp.concatenate([jnp.arange(1, n + 1), jnp.full((max_k - n,), n)])
+    else:
+        topk = jnp.arange(1, max_k + 1)
+
+    sorted_target = _sort_target_by_preds(preds, target)[: min(max_k, n)].astype(jnp.float32)
+    relevant = jnp.cumsum(jnp.pad(sorted_target, (0, max(0, max_k - sorted_target.shape[0]))), axis=0)
+    total = jnp.sum(target)
+    recall = jnp.where(total == 0, 0.0, relevant / jnp.maximum(total, 1))
+    precision = jnp.where(total == 0, 0.0, relevant / topk)
+    return precision, recall, topk.astype(jnp.int32)

@@ -308,18 +308,22 @@ class Metric:
         return self._forward_reduce_state_update(*args, **kwargs)
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
-        """Two update calls; batch value from a fresh state (reference ``metric.py:241-280``)."""
+        """Two update calls; batch value from a fresh state (reference ``metric.py:241-280``).
+
+        Unlike the reference, the save/restore recurses into child metrics
+        (wrappers like MinMax/Classwise/BootStrapper hold their state in
+        children), so the second ``update`` never double-counts into a
+        child's accumulated state.
+        """
         self.update(*args, **kwargs)
         self._to_sync = self.dist_sync_on_step
-        cache = self._copy_state()
-        cached_count = self._update_count
-        self._restore_defaults()
+        cache = self._deep_copy_state()
+        self._deep_reset()
         self.update(*args, **kwargs)
         self._should_unsync = False
         batch_val = self.compute()
-        # restore global state
-        object.__setattr__(self, "_state", cache)
-        self._update_count = cached_count
+        # restore global state (self + children)
+        self._deep_restore(cache)
         self._should_unsync = True
         self._to_sync = True
         self._computed = None
@@ -328,24 +332,69 @@ class Metric:
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """One update on a reset state, then merge into the global state
-        (reference ``metric.py:282-346``)."""
-        global_state = self._copy_state()
-        global_count = self._update_count
-        self._restore_defaults()
+        (reference ``metric.py:282-346``); snapshot/merge recurse into child
+        metrics (see :meth:`_forward_full_state_update`)."""
+        global_snap = self._deep_copy_state()
+        self._deep_reset()
         self.update(*args, **kwargs)
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
         batch_val = self.compute()
         # merge batch state into global state (reference ``metric.py:319``)
-        batch_state = self._copy_state()
-        merged = self._reduce_states(global_state, batch_state, global_count)
-        object.__setattr__(self, "_state", merged)
-        self._update_count = global_count + 1
+        self._deep_merge(global_snap)
         self._should_unsync = True
         self._to_sync = True
         self._computed = None
         self._is_synced = False
         return batch_val
+
+    # ------------------------------------------------------------------
+    # recursive state snapshots over child metrics (no reference analogue:
+    # the reference restores own states only, silently double-updating
+    # wrapper children driven through forward)
+    # ------------------------------------------------------------------
+
+    def _child_metrics(self):
+        for key, v in self.__dict__.items():
+            if key in ("metric_a", "metric_b") and isinstance(self, CompositionalMetric):
+                continue  # CompositionalMetric overrides forward entirely
+            if isinstance(v, Metric):
+                yield v
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if isinstance(x, Metric):
+                        yield x
+
+    def _deep_copy_state(self):
+        return (
+            self._copy_state(),
+            self._update_count,
+            [c._deep_copy_state() for c in self._child_metrics()],
+        )
+
+    def _deep_restore(self, snapshot) -> None:
+        state, count, children = snapshot
+        object.__setattr__(self, "_state", state)
+        self._update_count = count
+        self._computed = None
+        for c, cs in zip(self._child_metrics(), children):
+            c._deep_restore(cs)
+
+    def _deep_reset(self) -> None:
+        self._restore_defaults()
+        self._update_count = 0
+        self._computed = None
+        for c in self._child_metrics():
+            c._deep_reset()
+
+    def _deep_merge(self, global_snap) -> None:
+        g_state, g_count, g_children = global_snap
+        merged = self._reduce_states(g_state, self._copy_state(), g_count)
+        object.__setattr__(self, "_state", merged)
+        self._update_count = g_count + 1
+        self._computed = None  # the pre-merge compute cache holds the batch value
+        for c, cs in zip(self._child_metrics(), g_children):
+            c._deep_merge(cs)
 
     def _reduce_states(
         self, global_state: Dict[str, Any], batch_state: Dict[str, Any], global_count: int

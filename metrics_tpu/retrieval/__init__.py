@@ -1,0 +1,15 @@
+"""Retrieval module metrics (reference
+``src/torchmetrics/retrieval/__init__.py``)."""
+from metrics_tpu.retrieval.base import RetrievalMetric  # noqa: F401
+from metrics_tpu.retrieval.metrics import (  # noqa: F401
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)

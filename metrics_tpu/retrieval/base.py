@@ -1,0 +1,96 @@
+"""``RetrievalMetric`` base class (reference
+``src/torchmetrics/retrieval/base.py:27``).
+
+Ragged per-query grouping is inherently host-side (the reference's
+``get_group_indexes`` dict loop, ``utilities/data.py:210``); here grouping is
+a single vectorized sort-and-split over the concatenated state — one
+``argsort`` + ``unique`` on host, then the per-query kernel runs on-device
+per group. Compute happens once per epoch, so the Python loop over queries is
+off the hot path (the hot path — update — is an append).
+"""
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.checks import _check_retrieval_inputs
+from metrics_tpu.utilities.data import dim_zero_cat, get_group_indexes
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric, ABC):
+    """Group predictions by query id and average a per-query metric
+    (reference ``retrieval/base.py:27-146``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    # list states + data-dependent grouping → eager execution
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        # dist_reduce_fx=None: sync gathers the union of all ranks' samples
+        # without reduction (reference ``base.py:93-95``)
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Reference ``base.py:98-109``."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Reference ``base.py:110-139``."""
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        res: List[Array] = []
+        groups = get_group_indexes(indexes)
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not int(jnp.sum(mini_target)):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+
+    @abstractmethod
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Per-query metric (reference ``base.py:141-146``)."""

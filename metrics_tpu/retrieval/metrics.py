@@ -1,0 +1,277 @@
+"""Retrieval module metrics (reference
+``src/torchmetrics/retrieval/{average_precision,reciprocal_rank,precision,
+recall,fall_out,ndcg,hit_rate,r_precision,precision_recall_curve}.py``).
+"""
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval.base import RetrievalMetric
+from metrics_tpu.utilities.data import dim_zero_cat, get_group_indexes
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision (reference ``retrieval/average_precision.py:24``)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_average_precision(preds, target)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py:24``)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_reciprocal_rank(preds, target)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Mean precision@k (reference ``retrieval/precision.py:24``)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.k = k
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_precision(preds, target, k=self.k, adaptive_k=self.adaptive_k)
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Mean recall@k (reference ``retrieval/recall.py:24``)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_recall(preds, target, k=self.k)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Mean fall-out@k; empty-target logic inverted — a query with no
+    *negative* target is the degenerate case (reference ``retrieval/fall_out.py:24-103``)."""
+
+    higher_is_better = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def compute(self) -> Array:
+        """Reference ``fall_out.py:80-103`` — empty-target test is on negatives."""
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        res = []
+        groups = get_group_indexes(indexes)
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not int(jnp.sum(1 - mini_target)):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no negative target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_fall_out(preds, target, k=self.k)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Mean nDCG@k; non-binary relevance allowed (reference ``retrieval/ndcg.py:24``)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+        self.allow_non_binary_target = True
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_normalized_dcg(preds, target, k=self.k)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """Mean hit-rate@k (reference ``retrieval/hit_rate.py:24``)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_hit_rate(preds, target, k=self.k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean r-precision (reference ``retrieval/r_precision.py:24``)."""
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_r_precision(preds, target)
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Lexicographic best (recall, k) subject to precision floor
+    (reference ``retrieval/precision_recall_curve.py:35-58``)."""
+    mask = np.asarray(precision) >= min_precision
+    recall_np = np.asarray(recall)
+    k_np = np.asarray(top_k)
+    if not mask.any():
+        return jnp.asarray(0.0, jnp.float32), jnp.asarray(len(k_np), k_np.dtype)
+    cand = [(recall_np[i], k_np[i]) for i in range(len(k_np)) if mask[i]]
+    max_recall, best_k = max(cand)
+    if max_recall == 0.0:
+        best_k = len(k_np)
+    return jnp.asarray(max_recall, jnp.float32), jnp.asarray(best_k, k_np.dtype)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Query-averaged precision/recall curve over k
+    (reference ``retrieval/precision_recall_curve.py:61-186``)."""
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:  # pragma: no cover - unused
+        raise NotImplementedError
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Reference ``precision_recall_curve.py:157-186``."""
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        groups = get_group_indexes(indexes)
+        max_k = self.max_k or max(map(len, groups))
+
+        precisions, recalls = [], []
+        for group in groups:
+            mini_preds = preds[group]
+            mini_target = target[group]
+            if not int(jnp.sum(mini_target)):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    precisions.append(jnp.ones(max_k))
+                    recalls.append(jnp.ones(max_k))
+                elif self.empty_target_action == "neg":
+                    precisions.append(jnp.zeros(max_k))
+                    recalls.append(jnp.zeros(max_k))
+            else:
+                precision, recall, _ = retrieval_precision_recall_curve(mini_preds, mini_target, max_k, self.adaptive_k)
+                precisions.append(precision)
+                recalls.append(recall)
+
+        if precisions:
+            precision = jnp.stack(precisions).mean(axis=0)
+            recall = jnp.stack(recalls).mean(axis=0)
+        else:
+            precision = jnp.zeros(max_k)
+            recall = jnp.zeros(max_k)
+        top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+        return precision, recall, top_k
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Best recall@k subject to a precision floor
+    (reference ``precision_recall_curve.py:189-252``)."""
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k,
+            adaptive_k=adaptive_k,
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:
+        precision, recall, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)

@@ -231,6 +231,65 @@ def _check_classification_inputs(
     return case
 
 
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Dtype/value checks for retrieval pairs; flatten + cast
+    (reference ``checks.py:581-608``)."""
+    if jnp.issubdtype(target.dtype, jnp.floating) and not allow_non_binary_target:
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if _is_concrete(target) and not allow_non_binary_target and (int(target.max()) > 1 or int(target.min()) < 0):
+        raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.int32) if not allow_non_binary_target else target.astype(jnp.float32)
+    return preds.astype(jnp.float32).reshape(-1), target.reshape(-1)
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+) -> Tuple[Array, Array]:
+    """Single-query retrieval input check (reference ``checks.py:504-531``)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """(indexes, preds, target) triple check + ignore_index masking + flatten
+    (reference ``checks.py:534-578``). The ignore mask is a dynamic-shape
+    filter → concrete (eager) inputs only, like the reference's list states."""
+    indexes = jnp.asarray(indexes)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+
+    if ignore_index is not None:
+        valid_positions = target != ignore_index
+        indexes, preds, target = indexes[valid_positions], preds[valid_positions], target[valid_positions]
+
+    if indexes.size == 0 or indexes.ndim == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+
+    preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+    return indexes.astype(jnp.int32).reshape(-1), preds, target
+
+
 def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
     """Remove excess size-1 dimensions (reference ``checks.py:301-310``)."""
     if preds.shape and preds.shape[0] == 1:

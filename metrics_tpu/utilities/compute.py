@@ -5,6 +5,12 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def _to_float(x: Array) -> Array:
+    """Promote integer/bool arrays to float32; pass floats through unchanged."""
+    x = jnp.asarray(x)
+    return x if jnp.issubdtype(x.dtype, jnp.floating) else x.astype(jnp.float32)
+
+
 def _safe_divide(num: Array, denom: Array) -> Array:
     """``num/denom`` with 0 where ``denom == 0`` (NaN/Inf-free, XLA-safe)."""
     num = jnp.asarray(num)

@@ -1,0 +1,167 @@
+"""Retrieval-metric parity (analogue of reference
+``test/unittests/retrieval/``; oracles are sklearn where available, else
+hand-rolled numpy references as the reference's own tests do)."""
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import ndcg_score as sk_ndcg
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers import seed_all
+
+seed_all(17)
+N_QUERIES, DOCS = 8, 20
+INDEXES = np.repeat(np.arange(N_QUERIES), DOCS)
+PREDS = np.random.rand(N_QUERIES * DOCS).astype(np.float32)
+TARGET = np.random.randint(0, 2, N_QUERIES * DOCS)
+# ensure every query has at least one positive
+for q in range(N_QUERIES):
+    TARGET[q * DOCS] = 1
+
+
+def _per_query(metric_fn):
+    vals = []
+    for q in range(N_QUERIES):
+        sl = slice(q * DOCS, (q + 1) * DOCS)
+        vals.append(metric_fn(PREDS[sl], TARGET[sl]))
+    return float(np.mean(vals))
+
+
+def _np_rr(p, t):
+    order = np.argsort(-p)
+    st = t[order]
+    return 1.0 / (np.nonzero(st)[0][0] + 1)
+
+
+def _np_precision_at(p, t, k):
+    order = np.argsort(-p)
+    return t[order][:k].sum() / k
+
+
+def _np_hit_rate(p, t, k):
+    order = np.argsort(-p)
+    return float(t[order][:k].sum() > 0)
+
+
+def _np_fall_out(p, t, k):
+    order = np.argsort(-p)
+    neg = 1 - t
+    return neg[order][:k].sum() / neg.sum()
+
+
+def _np_recall_at(p, t, k):
+    order = np.argsort(-p)
+    return t[order][:k].sum() / t.sum()
+
+
+def _np_r_precision(p, t):
+    r = t.sum()
+    order = np.argsort(-p)
+    return t[order][:r].sum() / r
+
+
+def _update_batched(metric, n_batches=4):
+    per = len(PREDS) // n_batches
+    for i in range(n_batches):
+        sl = slice(i * per, (i + 1) * per)
+        metric.update(PREDS[sl], TARGET[sl], indexes=INDEXES[sl])
+    return metric
+
+
+@pytest.mark.parametrize(
+    "metric_cls, kwargs, expected_fn",
+    [
+        (RetrievalMAP, {}, lambda: _per_query(lambda p, t: sk_ap(t, p))),
+        (RetrievalMRR, {}, lambda: _per_query(_np_rr)),
+        (RetrievalPrecision, {"k": 5}, lambda: _per_query(lambda p, t: _np_precision_at(p, t, 5))),
+        (RetrievalRecall, {"k": 5}, lambda: _per_query(lambda p, t: _np_recall_at(p, t, 5))),
+        (RetrievalHitRate, {"k": 3}, lambda: _per_query(lambda p, t: _np_hit_rate(p, t, 3))),
+        (RetrievalFallOut, {"k": 5}, lambda: _per_query(lambda p, t: _np_fall_out(p, t, 5))),
+        (RetrievalRPrecision, {}, lambda: _per_query(_np_r_precision)),
+        (
+            RetrievalNormalizedDCG,
+            {},
+            lambda: _per_query(lambda p, t: sk_ndcg(t[None, :], p[None, :])),
+        ),
+    ],
+)
+def test_retrieval_metrics(metric_cls, kwargs, expected_fn):
+    m = _update_batched(metric_cls(**kwargs))
+    np.testing.assert_allclose(float(m.compute()), expected_fn(), atol=1e-5)
+
+
+def test_functional_single_query():
+    p, t = PREDS[:DOCS], TARGET[:DOCS]
+    np.testing.assert_allclose(float(retrieval_average_precision(p, t)), sk_ap(t, p), atol=1e-5)
+    np.testing.assert_allclose(float(retrieval_reciprocal_rank(p, t)), _np_rr(p, t), atol=1e-6)
+    np.testing.assert_allclose(float(retrieval_precision(p, t, k=4)), _np_precision_at(p, t, 4), atol=1e-6)
+    np.testing.assert_allclose(float(retrieval_normalized_dcg(p, t)), sk_ndcg(t[None, :], p[None, :]), atol=1e-5)
+
+
+def test_empty_target_actions():
+    preds = np.array([0.5, 0.3, 0.9, 0.1], dtype=np.float32)
+    target = np.array([0, 0, 1, 1])
+    indexes = np.array([0, 0, 1, 1])
+    for action, expected in (("neg", (0.0 + 1.0) / 2), ("pos", (1.0 + 1.0) / 2)):
+        m = RetrievalMAP(empty_target_action=action)
+        m.update(preds, target, indexes=indexes)
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+    m = RetrievalMAP(empty_target_action="skip")
+    m.update(preds, target, indexes=indexes)
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-6)
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(preds, target, indexes=indexes)
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_ignore_index():
+    preds = np.array([0.5, 0.3, 0.9, 0.1], dtype=np.float32)
+    target = np.array([1, -1, 1, 0])
+    indexes = np.array([0, 0, 0, 0])
+    m = RetrievalMAP(ignore_index=-1)
+    m.update(preds, target, indexes=indexes)
+    expected = sk_ap(np.array([1, 1, 0]), np.array([0.5, 0.9, 0.1]))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_precision_recall_curve_and_fixed_precision():
+    m = _update_batched(RetrievalPrecisionRecallCurve(max_k=10))
+    precision, recall, top_k = m.compute()
+    assert precision.shape == (10,) and recall.shape == (10,)
+    # k=DOCS recall must be 1 for all queries with positives
+    m2 = _update_batched(RetrievalPrecisionRecallCurve(max_k=DOCS))
+    _, recall_full, _ = m2.compute()
+    np.testing.assert_allclose(float(np.asarray(recall_full)[-1]), 1.0, atol=1e-6)
+
+    m3 = _update_batched(RetrievalRecallAtFixedPrecision(min_precision=0.2, max_k=10))
+    max_recall, best_k = m3.compute()
+    assert 0.0 <= float(max_recall) <= 1.0
+    assert 1 <= int(best_k) <= 10
+
+
+def test_invalid_inputs():
+    with pytest.raises(ValueError, match="empty_target_action"):
+        RetrievalMAP(empty_target_action="bogus")
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="same shape"):
+        m.update(np.array([0.1, 0.2]), np.array([1]), indexes=np.array([0, 0]))
+    with pytest.raises(ValueError, match="long integers"):
+        m.update(np.array([0.1]), np.array([1]), indexes=np.array([0.5]))

@@ -125,3 +125,26 @@ def test_tracker_requires_increment():
     tracker = MetricTracker(Accuracy())
     with pytest.raises(ValueError, match="increment"):
         tracker.update(np.array([0]), np.array([0]))
+
+
+def test_minmax_forward_no_double_update():
+    """forward() must not double-count into the wrapped metric's state
+    (regression test: the reference double-updates children driven via
+    __call__; our forward snapshots children recursively)."""
+    from metrics_tpu import SumMetric
+
+    m = MinMaxMetric(SumMetric())
+    m(np.array([1.0, 2.0]))
+    out = m.compute()
+    np.testing.assert_allclose(np.asarray(out["raw"]), 3.0, atol=1e-6)
+
+
+def test_classwise_forward_returns_batch_value():
+    """forward()'s batch-local return contract holds through wrappers."""
+    m = ClasswiseWrapper(Accuracy(num_classes=2, average="none"))
+    out1 = m(np.array([0, 1]), np.array([0, 1]))  # batch acc 1.0 per class
+    out2 = m(np.array([1, 0]), np.array([0, 1]))  # batch acc 0.0 per class
+    np.testing.assert_allclose(np.asarray(out2["accuracy_0"]), 0.0, atol=1e-6)
+    # global state still accumulates both batches
+    final = m.compute()
+    np.testing.assert_allclose(np.asarray(final["accuracy_0"]), 0.5, atol=1e-6)
