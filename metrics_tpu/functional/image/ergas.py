@@ -1,0 +1,67 @@
+"""ERGAS kernel (reference ``src/torchmetrics/functional/image/ergas.py``, 126 LoC)."""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.parallel.sync import reduce
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _ergas_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``ergas.py:22-42``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ergas_compute(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Reference ``ergas.py:45-72``."""
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: Union[int, float] = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS (reference ``ergas.py:75-126``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4) / 16
+        >>> target = preds * 0.75
+        >>> error_relative_global_dimensionless_synthesis(preds, target).round(2)
+        Array(154.72, dtype=float32)
+    """
+    preds, target = _ergas_update(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

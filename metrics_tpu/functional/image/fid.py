@@ -1,0 +1,88 @@
+"""FID math (reference ``src/torchmetrics/image/fid.py``, 313 LoC).
+
+TPU-first: the reference computes the matrix square root with **scipy**
+``sqrtm`` on CPU via an autograd Function (``image/fid.py:61-95``) — a
+host round-trip per compute. Here the square root of
+``sigma1 @ sigma2`` is a Newton–Schulz iteration: pure matmuls, runs on
+the MXU, differentiable, jittable.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _newton_schulz_sqrtm(mat: Array, num_iters: int = 50, eps: float = 1e-12) -> Array:
+    """Matrix square root of a PSD matrix via Newton–Schulz iteration.
+
+    Replaces scipy ``sqrtm`` (reference ``image/fid.py:61-95``); converges
+    quadratically for matrices with ``||I - A/||A||_F|| < 1`` which holds for
+    the PSD covariance products FID feeds it.
+    """
+    dim = mat.shape[0]
+    norm = jnp.sqrt(jnp.sum(mat * mat)) + eps
+    y = mat / norm
+    ident = jnp.eye(dim, dtype=mat.dtype)
+    z = ident
+
+    def body(_, carry):
+        y, z = carry
+        t = 0.5 * (3.0 * ident - z @ y)
+        return y @ t, t @ z
+
+    y, z = jax.lax.fori_loop(0, num_iters, body, (y, z))
+    return y * jnp.sqrt(norm)
+
+
+def _mean_cov(features: Array) -> Tuple[Array, Array]:
+    """Feature mean and unbiased covariance."""
+    n = features.shape[0]
+    mu = features.mean(axis=0)
+    centered = features - mu
+    sigma = centered.T @ centered / (n - 1)
+    return mu, sigma
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6) -> Array:
+    """Frechet distance between two Gaussians (reference ``image/fid.py:98-127``)."""
+    diff = mu1 - mu2
+    covmean = _newton_schulz_sqrtm(sigma1 @ sigma2)
+    tr_covmean = jnp.trace(covmean)
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+
+
+def frechet_inception_distance_from_features(real_features: Array, fake_features: Array) -> Array:
+    """FID from pre-extracted feature matrices ``(N, D)``."""
+    real_features = jnp.asarray(real_features, jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32)
+    fake_features = jnp.asarray(fake_features, real_features.dtype)
+    mu1, sigma1 = _mean_cov(real_features)
+    mu2, sigma2 = _mean_cov(fake_features)
+    return _compute_fid(mu1, sigma1, mu2, sigma2)
+
+
+def _poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma=None, coef: float = 1.0) -> Array:
+    """Polynomial kernel (reference ``image/kid.py:24-40``)."""
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (f1 @ f2.T * gamma + coef) ** degree
+
+
+def _poly_mmd(f_real: Array, f_fake: Array, degree: int = 3, gamma=None, coef: float = 1.0) -> Array:
+    """Unbiased polynomial-kernel MMD^2 (reference ``image/kid.py:43-56``)."""
+    k_11 = _poly_kernel(f_real, f_real, degree, gamma, coef)
+    k_22 = _poly_kernel(f_fake, f_fake, degree, gamma, coef)
+    k_12 = _poly_kernel(f_real, f_fake, degree, gamma, coef)
+
+    m = f_real.shape[0]
+    diag_x = jnp.diagonal(k_11)
+    diag_y = jnp.diagonal(k_22)
+
+    kt_xx_sums = k_11.sum(axis=-1) - diag_x
+    kt_yy_sums = k_22.sum(axis=-1) - diag_y
+    k_xy_sums = k_12.sum(axis=0)
+
+    value = (kt_xx_sums.sum() + kt_yy_sums.sum()) / (m * (m - 1))
+    value -= 2 * k_xy_sums.sum() / (m**2)
+    return value

@@ -1,0 +1,42 @@
+"""Image gradients (reference
+``src/torchmetrics/functional/image/gradients.py``, 81 LoC)."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    """Reference ``gradients.py:8-13``."""
+    if not isinstance(img, (jax.Array,)) and not hasattr(img, "ndim"):
+        raise TypeError(f"The `img` expects an array type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Reference ``gradients.py:16-33``."""
+    dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(img[..., :, 1:] - img[..., :, :-1], ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """1-step finite-difference gradients ``(dy, dx)`` (reference ``gradients.py:36-81``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> image = jnp.arange(0, 1*1*5*5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :, :]
+        Array([[5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [0., 0., 0., 0., 0.]], dtype=float32)
+    """
+    img = jnp.asarray(img)
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,65 @@
+"""Image kernel helpers (reference
+``src/torchmetrics/functional/image/helper.py``, 122 LoC).
+
+Depthwise gaussian/uniform filtering is expressed as
+``lax.conv_general_dilated`` with ``feature_group_count=C`` — a native MXU
+convolution on TPU.
+"""
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype) -> Array:
+    """1-d gaussian window (reference ``helper.py:11-27``)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-((dist / sigma) ** 2) / 2)
+    return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
+    """Depthwise 2-d gaussian kernel ``(C, 1, kh, kw)`` (reference ``helper.py:30-60``)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kernel_x.T @ kernel_y  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
+    """Depthwise 3-d gaussian kernel (reference ``helper.py:63-83``)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = kernel_x.T @ kernel_y  # (kh, kw)
+    kernel = kernel_xy[:, :, None] * kernel_z.reshape(1, 1, -1)
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _uniform_kernel(channel: int, kernel_size: Sequence[int], dtype) -> Array:
+    """Depthwise uniform (box) kernel."""
+    kernel = jnp.ones(tuple(kernel_size), dtype) / jnp.prod(jnp.asarray(kernel_size, dtype))
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def _depthwise_conv(x: Array, kernel: Array) -> Array:
+    """Valid-mode depthwise convolution over NCHW / NCDHW inputs."""
+    channel = x.shape[1]
+    spatial = x.ndim - 2
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1,) * spatial,
+        padding="VALID",
+        dimension_numbers=dn,
+        feature_group_count=channel,
+    )
+
+
+def _reflect_pad(x: Array, pads: Sequence[int]) -> Array:
+    """Reflect-pad the trailing spatial dims of an NC... tensor."""
+    pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(x, pad_width, mode="reflect")

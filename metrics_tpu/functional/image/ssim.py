@@ -1,0 +1,300 @@
+"""SSIM / Multi-Scale SSIM kernels (reference
+``src/torchmetrics/functional/image/ssim.py``, 487 LoC).
+
+TPU-first: the five filtered moments (mu_p, mu_t, E[p^2], E[t^2], E[pt]) are
+computed with ONE depthwise convolution over a 5B-stacked batch (the
+reference does the same stacking, ``ssim.py:148-153``) — a single MXU conv
+per SSIM evaluation; reflect-pad + valid conv keeps parity with the
+reference's padding scheme.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.image.helper import (
+    _depthwise_conv,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflect_pad,
+    _uniform_kernel,
+)
+from metrics_tpu.parallel.sync import reduce
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _ssim_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Reference ``ssim.py:13-34``."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Reference ``ssim.py:37-185``."""
+    is_3d = preds.ndim == 5
+    spatial = 3 if is_3d else 2
+
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = spatial * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = spatial * [sigma]
+
+    if len(kernel_size) != spatial or len(sigma) != spatial:
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less than target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    channel = preds.shape[1]
+    dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
+    preds = preds.astype(dtype)
+    target = target.astype(dtype)
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+
+    if gaussian_kernel:
+        pads = [(gs - 1) // 2 for gs in gauss_kernel_size]
+        kernel = (
+            _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
+            if is_3d
+            else _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
+        )
+    else:
+        pads = [(ks - 1) // 2 for ks in kernel_size]
+        kernel = jnp.broadcast_to(_uniform_kernel(1, kernel_size, dtype), (channel, 1, *kernel_size))
+
+    preds_p = _reflect_pad(preds, pads)
+    target_p = _reflect_pad(target, pads)
+
+    input_list = jnp.concatenate(
+        (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
+    )  # (5B, C, ...)
+    outputs = _depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pred_sq - mu_pred_sq
+    sigma_target_sq = e_target_sq - mu_target_sq
+    sigma_pred_target = e_pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target + c2
+    lower = sigma_pred_sq + sigma_target_sq + c2
+
+    ssim_idx = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    if return_contrast_sensitivity:
+        contrast_sensitivity = upper / lower
+        return (
+            reduce(ssim_idx.reshape(b, -1).mean(-1), reduction),
+            reduce(contrast_sensitivity.reshape(b, -1).mean(-1), reduction),
+        )
+    if return_full_image:
+        return reduce(ssim_idx.reshape(b, -1).mean(-1), reduction), reduce(ssim_idx, reduction)
+    return reduce(ssim_idx.reshape(b, -1).mean(-1), reduction)
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """SSIM (reference ``ssim.py:253-330``).
+
+    Example:
+        >>> import jax
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 16, 16))
+        >>> target = preds * 0.75
+        >>> float(structural_similarity_index_measure(preds, target)) > 0.9
+        True
+    """
+    preds, target = _ssim_update(preds, target)
+    return _ssim_compute(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        reduction,
+        data_range,
+        k1,
+        k2,
+        return_full_image,
+        return_contrast_sensitivity,
+    )
+
+
+def _get_normalized_sim_and_cs(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    normalize: Optional[str] = None,
+) -> Tuple[Array, Array]:
+    """Reference ``ssim.py:333-360``."""
+    sim, contrast_sensitivity = _ssim_compute(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        reduction,
+        data_range,
+        k1,
+        k2,
+        return_contrast_sensitivity=True,
+    )
+    if normalize == "relu":
+        sim = jax.nn.relu(sim)
+        contrast_sensitivity = jax.nn.relu(contrast_sensitivity)
+    return sim, contrast_sensitivity
+
+
+def _avg_pool(x: Array) -> Array:
+    spatial = x.ndim - 2
+    window = (1, 1) + (2,) * spatial
+    return jax.lax.reduce_window(x, 0.0, jax.lax.add, window, window, "VALID") / (2**spatial)
+
+
+def _multiscale_ssim_compute(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """Reference ``ssim.py:363-487``."""
+    spatial = 3 if preds.ndim == 5 else 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = spatial * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = spatial * [sigma]
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    sim_list: List[Array] = []
+    cs_list: List[Array] = []
+    for _ in range(len(betas)):
+        sim, contrast_sensitivity = _get_normalized_sim_and_cs(
+            preds, target, gaussian_kernel, sigma, kernel_size, "none", data_range, k1, k2, normalize=normalize
+        )
+        sim_list.append(sim)
+        cs_list.append(contrast_sensitivity)
+        preds = _avg_pool(preds)
+        target = _avg_pool(target)
+
+    sim_stack = jnp.stack(sim_list)
+    cs_stack = jnp.stack(cs_list)
+
+    if normalize == "simple":
+        sim_stack = (sim_stack + 1) / 2
+        cs_stack = (cs_stack + 1) / 2
+
+    betas_arr = jnp.asarray(betas)
+    cs_and_sim = jnp.concatenate([cs_stack[:-1], sim_stack[-1:]])
+    mcs_weighted = cs_and_sim ** betas_arr[:, None]
+    return reduce(jnp.prod(mcs_weighted, axis=0), reduction)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """MS-SSIM (reference ``ssim.py:430-487``).
+
+    Example:
+        >>> import jax
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 64, 64))
+        >>> target = preds * 0.75
+        >>> float(multiscale_structural_similarity_index_measure(preds, target)) > 0.9
+        True
+    """
+    if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_update(preds, target)
+    return _multiscale_ssim_compute(
+        preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, betas, normalize
+    )

@@ -1,0 +1,16 @@
+"""Image module metrics (reference ``src/torchmetrics/image/__init__.py``)."""
+from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
+from metrics_tpu.image.inception import InceptionScore  # noqa: F401
+from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity  # noqa: F401
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio  # noqa: F401
+from metrics_tpu.image.simple import (  # noqa: F401
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.image.ssim import (  # noqa: F401
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)

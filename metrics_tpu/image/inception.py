@@ -1,0 +1,74 @@
+"""``InceptionScore`` module metric (reference
+``src/torchmetrics/image/inception.py``).
+
+Same feature-extractor contract as :class:`FrechetInceptionDistance`: pass a
+callable ``images -> (N, num_classes) logits`` or feed logits directly.
+"""
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    """IS = exp(E_x KL(p(y|x) || p(y))) over feature splits
+    (reference ``image/inception.py:24-163``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(
+        self,
+        feature: Union[int, str, Callable] = "logits_unbiased",
+        splits: int = 10,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if callable(feature):
+            self.extractor = feature
+        elif isinstance(feature, (int, str)):
+            self.extractor = None  # update() receives logits directly
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError("Integer input to argument `splits` expected to be larger than 0")
+        self.splits = splits
+        self.add_state("features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:
+        """Reference ``image/inception.py:125-133``."""
+        features = self.extractor(imgs) if self.extractor is not None else jnp.asarray(imgs)
+        if features.ndim != 2:
+            raise ValueError(f"Expected extracted features to be 2d (N, C) logits, got shape {features.shape}")
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Reference ``image/inception.py:135-156``."""
+        features = dim_zero_cat(self.features)
+        # random permutation of the features (reference shuffles by default)
+        idx = np.random.permutation(features.shape[0])
+        features = features[idx]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+
+        kl_ = []
+        for p, log_p in zip(prob_chunks, log_prob_chunks):
+            mean_prob = p.mean(axis=0, keepdims=True)
+            kl = p * (log_p - jnp.log(mean_prob))
+            kl_.append(jnp.exp(kl.sum(axis=1).mean()))
+        kl_arr = jnp.stack(kl_)
+        return kl_arr.mean(), kl_arr.std(ddof=1)

@@ -1,0 +1,74 @@
+"""``LearnedPerceptualImagePatchSimilarity`` module metric (reference
+``src/torchmetrics/image/lpip.py``).
+
+The reference wraps the ``lpips`` package's pretrained AlexNet/VGG
+(``image/lpip.py`` with the ``_LPIPS_AVAILABLE`` gate) — pretrained weights
+this environment cannot download. Here the perceptual network is injected:
+pass ``net`` as a callable ``(img1, img2) -> (N,) distances`` (e.g. a flax
+feature network composed with the LPIPS distance). The metric machinery
+(state accumulation, reductions, normalization) matches the reference.
+"""
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS over an injected perceptual distance network
+    (reference ``image/lpip.py:34-142``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    jittable_update = False
+    jittable_compute = False
+
+    def __init__(
+        self,
+        net: Callable,
+        reduction: str = "mean",
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not callable(net):
+            raise ValueError(
+                "Argument `net` must be a callable `(img1, img2) -> distances`; pretrained torch nets are not"
+                " bundled in the TPU build — inject a flax/jax perceptual network instead."
+            )
+        self.net = net
+
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.normalize = normalize
+
+        self.add_state("sum_scores", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Reference ``image/lpip.py:120-128``."""
+        img1 = jnp.asarray(img1)
+        img2 = jnp.asarray(img2)
+        if self.normalize:
+            # [0,1] -> [-1,1] (the range pretrained perceptual nets expect)
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        loss = jnp.asarray(self.net(img1, img2)).squeeze()
+        self.sum_scores += loss.sum()
+        self.total += jnp.asarray(img1.shape[0], jnp.float32)
+
+    def compute(self) -> Array:
+        """Reference ``image/lpip.py:130-136``."""
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

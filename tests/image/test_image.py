@@ -1,0 +1,192 @@
+"""Image-metric parity (analogue of reference ``test/unittests/image/``;
+oracles are scipy / hand-rolled numpy, as the reference vendors its own)."""
+import numpy as np
+import pytest
+import scipy.linalg
+from scipy.ndimage import correlate
+
+from metrics_tpu import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    UniversalImageQualityIndex,
+)
+from metrics_tpu.functional import (
+    image_gradients,
+    peak_signal_noise_ratio,
+    spectral_angle_mapper,
+    structural_similarity_index_measure,
+)
+from tests.helpers import seed_all
+
+seed_all(23)
+PREDS = np.random.rand(4, 3, 32, 32).astype(np.float32)
+TARGET = (PREDS * 0.75 + 0.25 * np.random.rand(4, 3, 32, 32)).astype(np.float32)
+
+
+def _np_gaussian_kernel(size, sigma):
+    dist = np.arange((1 - size) / 2, (1 + size) / 2)
+    g = np.exp(-((dist / sigma) ** 2) / 2)
+    g /= g.sum()
+    return np.outer(g, g)
+
+
+def _np_ssim(preds, target, data_range, sigma=1.5):
+    """Wang et al. SSIM with gaussian window, matching the reference's
+    gauss_kernel_size = int(3.5*sigma+0.5)*2+1 and reflect padding."""
+    size = int(3.5 * sigma + 0.5) * 2 + 1
+    kernel = _np_gaussian_kernel(size, sigma)
+    c1 = (0.01 * data_range) ** 2
+    c2 = (0.03 * data_range) ** 2
+    vals = []
+    for b in range(preds.shape[0]):
+        for c in range(preds.shape[1]):
+            x = preds[b, c].astype(np.float64)
+            y = target[b, c].astype(np.float64)
+            f = lambda im: correlate(im, kernel, mode="reflect")
+            mu_x, mu_y = f(x), f(y)
+            sxx = f(x * x) - mu_x**2
+            syy = f(y * y) - mu_y**2
+            sxy = f(x * y) - mu_x * mu_y
+            ssim_map = ((2 * mu_x * mu_y + c1) * (2 * sxy + c2)) / ((mu_x**2 + mu_y**2 + c1) * (sxx + syy + c2))
+            vals.append(ssim_map.mean())
+    return np.mean(np.asarray(vals).reshape(preds.shape[0], preds.shape[1]).mean(1))
+
+
+def test_psnr():
+    expected = 10 * np.log10(1.0 / np.mean((PREDS - TARGET) ** 2))
+    np.testing.assert_allclose(float(peak_signal_noise_ratio(PREDS, TARGET, data_range=1.0)), expected, atol=1e-4)
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    m.update(PREDS[:2], TARGET[:2])
+    m.update(PREDS[2:], TARGET[2:])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-4)
+
+
+def test_psnr_inferred_range():
+    m = PeakSignalNoiseRatio()
+    m.update(PREDS, TARGET)
+    rng = TARGET.max() - TARGET.min()
+    expected = 10 * np.log10(rng**2 / np.mean((PREDS - TARGET) ** 2))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-4)
+
+
+def test_ssim_vs_numpy():
+    got = float(structural_similarity_index_measure(PREDS, TARGET, data_range=1.0))
+    expected = _np_ssim(PREDS, TARGET, 1.0)
+    np.testing.assert_allclose(got, expected, atol=1e-4)
+
+
+def test_ssim_module_batching():
+    m = StructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(PREDS[:2], TARGET[:2])
+    m.update(PREDS[2:], TARGET[2:])
+    np.testing.assert_allclose(float(m.compute()), _np_ssim(PREDS, TARGET, 1.0), atol=1e-4)
+
+
+def test_msssim_runs():
+    p = np.random.rand(2, 1, 192, 192).astype(np.float32)
+    t = (p * 0.9).astype(np.float32)
+    m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(p, t)
+    v = float(m.compute())
+    assert 0.9 < v <= 1.0
+
+
+def test_uqi_perfect_match():
+    m = UniversalImageQualityIndex()
+    m.update(PREDS, PREDS)
+    np.testing.assert_allclose(float(m.compute()), 1.0, atol=1e-5)
+
+
+def test_sam():
+    got = float(spectral_angle_mapper(PREDS, TARGET))
+    p = PREDS.reshape(4, 3, -1).astype(np.float64)
+    t = TARGET.reshape(4, 3, -1).astype(np.float64)
+    dot = (p * t).sum(1)
+    expected = np.arccos(np.clip(dot / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1)), -1, 1)).mean()
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+    m = SpectralAngleMapper()
+    m.update(PREDS, TARGET)
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_ergas_and_dlambda():
+    m = ErrorRelativeGlobalDimensionlessSynthesis()
+    m.update(PREDS, TARGET)
+    assert float(m.compute()) > 0
+    d = SpectralDistortionIndex()
+    d.update(PREDS, PREDS)
+    np.testing.assert_allclose(float(d.compute()), 0.0, atol=1e-5)
+
+
+def test_image_gradients():
+    img = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(img)
+    np.testing.assert_allclose(np.asarray(dy)[0, 0, :4], np.full((4, 5), 5.0))
+    np.testing.assert_allclose(np.asarray(dy)[0, 0, 4], np.zeros(5))
+    np.testing.assert_allclose(np.asarray(dx)[0, 0, :, :4], np.full((5, 4), 1.0))
+
+
+def test_fid_vs_scipy():
+    f_real = np.random.randn(128, 16).astype(np.float32)
+    f_fake = (np.random.randn(128, 16) + 0.3).astype(np.float32)
+    m = FrechetInceptionDistance(feature=16)
+    m.update(f_real[:64], real=True)
+    m.update(f_real[64:], real=True)
+    m.update(f_fake, real=False)
+    got = float(m.compute())
+    mu1, mu2 = f_real.mean(0), f_fake.mean(0)
+    s1, s2 = np.cov(f_real.T), np.cov(f_fake.T)
+    expected = ((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * scipy.linalg.sqrtm(s1 @ s2).real)
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_fid_reset_real_features():
+    m = FrechetInceptionDistance(feature=8, reset_real_features=False)
+    m.update(np.random.randn(16, 8).astype(np.float32), real=True)
+    m.update(np.random.randn(16, 8).astype(np.float32), real=False)
+    m.reset()
+    assert len(m.real_features) == 1 and len(m.fake_features) == 0
+
+
+def test_kid_separates_distributions():
+    """Unbiased MMD: ~0 in expectation for identical distributions, clearly
+    positive for shifted ones."""
+    np.random.seed(5)
+    feats = np.random.randn(256, 8).astype(np.float32)
+    m = KernelInceptionDistance(feature=8, subsets=20, subset_size=128)
+    m.update(feats, real=True)
+    m.update(feats.copy(), real=False)
+    mean_same, _ = m.compute()
+
+    m2 = KernelInceptionDistance(feature=8, subsets=20, subset_size=128)
+    m2.update(feats, real=True)
+    m2.update(feats + 1.0, real=False)
+    mean_diff, _ = m2.compute()
+    assert abs(float(mean_same)) < 0.05
+    assert float(mean_diff) > 10 * abs(float(mean_same))
+
+
+def test_inception_score_uniform_is_one():
+    logits = np.zeros((100, 10), dtype=np.float32)  # uniform predictions
+    m = InceptionScore(feature=10, splits=5)
+    m.update(logits)
+    mean, std = m.compute()
+    np.testing.assert_allclose(float(mean), 1.0, atol=1e-5)
+
+
+def test_lpips_injected_net():
+    net = lambda a, b: np.abs(a - b).mean(axis=(1, 2, 3))
+    m = LearnedPerceptualImagePatchSimilarity(net=net)
+    m.update(PREDS, TARGET)
+    expected = np.abs(PREDS - TARGET).mean(axis=(1, 2, 3)).mean()
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+    with pytest.raises(ValueError, match="callable"):
+        LearnedPerceptualImagePatchSimilarity(net="vgg")
