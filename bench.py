@@ -7,16 +7,71 @@ BASELINE.md). ``vs_baseline`` is the speedup vs that 2 ms budget (>1 = faster
 than target).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Robustness: round 1 emitted no number because the environment-pinned ``axon``
+TPU backend died during init; a later run showed init can also *hang*
+indefinitely. So the backend is probed in a subprocess with a hard timeout
+(a hang can't be cancelled once it's in-process), retried, and on failure the
+bench falls back to CPU — a number always lands, and the JSON unit string
+records which platform produced it.
 """
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+_PROBE_SRC = "import jax; print(jax.devices()[0].platform)"
+
+
+def _probe_default_backend(timeout_s: float = 150.0, attempts: int = 2):
+    """Check, in a throwaway subprocess, that the default backend comes up.
+
+    A *hang* (timeout) forces the CPU fallback immediately: a backend that
+    hung once can hang again in-process, where nothing can cancel it and no
+    JSON line would ever be emitted. Only clean-but-failed probes are retried.
+    """
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"bench: backend probe hung >{timeout_s}s; not retrying", file=sys.stderr)
+            return None
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1]  # plugin chatter may precede it
+        print(
+            f"bench: backend probe attempt {attempt + 1} failed rc={proc.returncode}: "
+            f"{proc.stderr.strip()[-500:]}",
+            file=sys.stderr,
+        )
+    return None
+
+
+def _init_backend():
+    platform = _probe_default_backend()
+    if platform is None:
+        print("bench: default backend unusable; falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if platform is None:
+        from metrics_tpu.utilities.backend import force_cpu_backend
+
+        force_cpu_backend()
+        platform = jax.devices()[0].platform
+    return jax, platform
 
 
 def main() -> None:
+    jax, platform = _init_backend()
+    import jax.numpy as jnp
+    import numpy as np
+
     from __graft_entry__ import entry
 
     step, (state, _, _) = entry()
@@ -46,7 +101,7 @@ def main() -> None:
             {
                 "metric": "fused_collection_step_ms",
                 "value": round(elapsed_ms, 4),
-                "unit": "ms/step (update+4-metric compute, B=8192, C=16)",
+                "unit": f"ms/step (update+4-metric compute, B=8192, C=16, {platform})",
                 "vs_baseline": round(target_ms / elapsed_ms, 2),
             }
         )

@@ -1,0 +1,152 @@
+"""BERTScore (reference ``functional/text/bert.py:1-630``).
+
+Greedy cosine matching of contextual token embeddings with optional IDF
+weighting (Zhang et al., ICLR 2020). The matching math — normalize, masked
+``bpd,brd->bpr`` similarity, row/column max, IDF-weighted sum — is one
+jittable XLA kernel (``_bert_score_from_embeddings``).
+
+Encoder contract (same as FID's injected extractor, ``image/fid.py``): this
+environment has no network, so no pretrained weights are bundled. The
+``encoder`` callable maps a list of sentences to
+``(embeddings (N, L, D), attention_mask (N, L), input_ids (N, L))``; any HF
+flax/torch model with local weights wraps in a few lines. Alternatively pass
+precomputed dicts with those keys.
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_EncoderOutput = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+def _strip_special_tokens(attention_mask: Array) -> Array:
+    """Zero the first token ([CLS]) and last attended token ([SEP]) per row."""
+    mask = attention_mask.astype(jnp.float32)
+    idx = jnp.arange(mask.shape[1])[None, :]
+    last = (mask * (idx + 1)).max(axis=1) - 1  # index of last attended token
+    mask = jnp.where(idx == 0, 0.0, mask)
+    mask = jnp.where(idx == last[:, None], 0.0, mask)
+    return mask
+
+
+def _idf_weights(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """Corpus IDF per token id: log((N+1)/(df+1)) over reference sentences."""
+    num_docs = input_ids.shape[0]
+    df: Dict[int, int] = {}
+    for row in range(num_docs):
+        for token in set(input_ids[row][attention_mask[row] > 0].tolist()):
+            df[token] = df.get(token, 0) + 1
+    return {token: float(np.log((num_docs + 1) / (count + 1))) for token, count in df.items()}
+
+
+def _idf_scale(input_ids: np.ndarray, mask: np.ndarray, idf: Optional[Dict[int, float]]) -> np.ndarray:
+    """Per-token weights normalized to sum 1 per sentence (uniform if no idf)."""
+    if idf is None:
+        weights = mask.astype(np.float32)
+    else:
+        lookup = np.vectorize(lambda t: idf.get(int(t), 0.0), otypes=[np.float32])
+        weights = lookup(input_ids) * mask
+    denom = weights.sum(-1, keepdims=True)
+    return weights / np.where(denom > 0, denom, 1.0)
+
+
+@jax.jit
+def _bert_score_from_embeddings(
+    pred_emb: Array, pred_mask: Array, pred_scale: Array,
+    target_emb: Array, target_mask: Array, target_scale: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matching precision/recall/F1 per sentence pair (device math)."""
+    def normalize(emb, mask):
+        norm = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+        emb = emb / jnp.where(norm > 0, norm, 1.0)
+        return emb * mask[..., None]
+
+    pred_n = normalize(pred_emb, pred_mask)
+    target_n = normalize(target_emb, target_mask)
+    cos_sim = jnp.einsum("bpd,brd->bpr", pred_n, target_n)
+    precision = jnp.sum(cos_sim.max(axis=2) * pred_scale, axis=-1)
+    recall = jnp.sum(cos_sim.max(axis=1) * target_scale, axis=-1)
+    denom = precision + recall
+    f1 = jnp.where(denom > 0, 2 * precision * recall / jnp.where(denom > 0, denom, 1.0), 0.0)
+    return precision, recall, f1
+
+
+def _encode(
+    text: Union[Sequence[str], Dict[str, Any]],
+    encoder: Optional[Callable[[List[str]], _EncoderOutput]],
+    max_length: int,
+) -> _EncoderOutput:
+    if isinstance(text, dict):
+        emb = np.asarray(text["embeddings"], np.float32)
+        mask = np.asarray(text["attention_mask"])
+        ids = np.asarray(text.get("input_ids", np.zeros(mask.shape, np.int64)))
+        return emb, mask, ids
+    if encoder is None:
+        raise ValueError(
+            "BERTScore needs an `encoder` callable (or precomputed embedding dicts): this build "
+            "bundles no pretrained weights. Wrap any local HF model as "
+            "`encoder(sentences) -> (embeddings, attention_mask, input_ids)`."
+        )
+    emb, mask, ids = encoder(list(text))
+    return (
+        np.asarray(emb, np.float32)[:, :max_length],
+        np.asarray(mask)[:, :max_length],
+        np.asarray(ids)[:, :max_length],
+    )
+
+
+def _pad_to(arr: np.ndarray, length: int) -> np.ndarray:
+    if arr.shape[1] == length:
+        return arr
+    pad = [(0, 0), (0, length - arr.shape[1])] + [(0, 0)] * (arr.ndim - 2)
+    return np.pad(arr, pad)
+
+
+def bert_score(
+    preds: Union[Sequence[str], Dict[str, Any]],
+    target: Union[Sequence[str], Dict[str, Any]],
+    encoder: Optional[Callable[[List[str]], _EncoderOutput]] = None,
+    idf: bool = False,
+    max_length: int = 512,
+    rescale_with_baseline: bool = False,
+    baseline: Optional[Sequence[float]] = None,
+) -> Dict[str, Array]:
+    """BERTScore precision/recall/f1 per sentence pair.
+
+    ``baseline`` (three floats: precision/recall/f1 baselines) enables the
+    original implementation's rescaling ``(x - b) / (1 - b)`` without a
+    baseline-file download.
+    """
+    pred_emb, pred_mask, pred_ids = _encode(preds, encoder, max_length)
+    target_emb, target_mask, target_ids = _encode(target, encoder, max_length)
+    if pred_emb.shape[0] != target_emb.shape[0]:
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    length = max(pred_emb.shape[1], target_emb.shape[1])
+    pred_emb, pred_mask, pred_ids = (_pad_to(a, length) for a in (pred_emb, pred_mask, pred_ids))
+    target_emb, target_mask, target_ids = (_pad_to(a, length) for a in (target_emb, target_mask, target_ids))
+
+    pred_mask_j = _strip_special_tokens(jnp.asarray(pred_mask))
+    target_mask_j = _strip_special_tokens(jnp.asarray(target_mask))
+    idf_map = _idf_weights(target_ids, np.asarray(target_mask)) if idf else None
+    pred_scale = jnp.asarray(_idf_scale(pred_ids, np.asarray(pred_mask_j), idf_map))
+    target_scale = jnp.asarray(_idf_scale(target_ids, np.asarray(target_mask_j), idf_map))
+
+    precision, recall, f1 = _bert_score_from_embeddings(
+        jnp.asarray(pred_emb), pred_mask_j, pred_scale,
+        jnp.asarray(target_emb), target_mask_j, target_scale,
+    )
+    if rescale_with_baseline:
+        if baseline is None:
+            raise ValueError(
+                "`rescale_with_baseline` requires the `baseline` argument (no baseline files are bundled)."
+            )
+        b_p, b_r, b_f = (jnp.asarray(b, jnp.float32) for b in baseline)
+        precision = (precision - b_p) / (1.0 - b_p)
+        recall = (recall - b_r) / (1.0 - b_r)
+        f1 = (f1 - b_f) / (1.0 - b_f)
+    return {"precision": precision, "recall": recall, "f1": f1}

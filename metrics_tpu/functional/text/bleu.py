@@ -1,0 +1,129 @@
+"""BLEU score (reference ``functional/text/bleu.py:1-206``).
+
+Honest host/device split (SURVEY.md §7 hard part 4): n-gram counting is
+inherently string work and happens on host with Python ``Counter``s; the
+accumulated statistics are four tiny device tensors (clipped-match numerator
+and candidate denominator per n-gram order, plus the two corpus lengths) with
+``sum`` reduction, so distributed sync and the final precision / brevity
+penalty / geometric-mean math are pure XLA.
+"""
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _count_ngram(tokens: Sequence[str], n_gram: int) -> Counter:
+    """Multiset of all 1..n_gram grams of a token sequence."""
+    counter: Counter = Counter()
+    for order in range(1, n_gram + 1):
+        for start in range(len(tokens) - order + 1):
+            counter[tuple(tokens[start : start + order])] += 1
+    return counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Host n-gram statistics for a batch → device count tensors.
+
+    Returns ``(numerator, denominator, preds_len, target_len)`` where the
+    first two are ``(n_gram,)`` arrays of clipped matches / candidate counts
+    and the target length uses the closest-reference-length convention.
+    """
+    target_tokens = [[list(tokenizer(line)) if line else [] for line in refs] for refs in target]
+    pred_tokens = [list(tokenizer(line)) if line else [] for line in preds]
+
+    numerator = [0.0] * n_gram
+    denominator = [0.0] * n_gram
+    preds_len = 0.0
+    target_len = 0.0
+    for pred, refs in zip(pred_tokens, target_tokens):
+        preds_len += len(pred)
+        ref_lens = [len(ref) for ref in refs]
+        closest = min(ref_lens, key=lambda ref_len: (abs(len(pred) - ref_len), ref_len))
+        target_len += closest
+        pred_counter = _count_ngram(pred, n_gram)
+        ref_counter: Counter = Counter()
+        for ref in refs:
+            ref_counter |= _count_ngram(ref, n_gram)
+        clipped = pred_counter & ref_counter
+        for ngram, count in clipped.items():
+            numerator[len(ngram) - 1] += count
+        for ngram, count in pred_counter.items():
+            denominator[len(ngram) - 1] += count
+
+    return (
+        jnp.asarray(numerator, jnp.float32),
+        jnp.asarray(denominator, jnp.float32),
+        jnp.asarray(preds_len, jnp.float32),
+        jnp.asarray(target_len, jnp.float32),
+    )
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Device-side BLEU formula: smoothed precisions, BP, weighted geo-mean.
+
+    Branchless (jit-friendly): the zero-match early exit and the brevity
+    penalty condition become ``where`` masks.
+    """
+    weights_arr = jnp.asarray(weights, jnp.float32)
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+
+    any_zero = jnp.min(numerator) == 0.0
+    safe_precision = jnp.where(precision > 0, precision, 1.0)  # log input guard; masked below
+    geometric_mean = jnp.exp(jnp.sum(weights_arr * jnp.log(safe_precision)))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    return jnp.where(any_zero, 0.0, brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """Corpus BLEU of machine-translated text against one or more references.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(bleu_score(preds, target)), 4)
+        0.7598
+    """
+    preds_list = [preds] if isinstance(preds, str) else preds
+    target_list = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_list) != len(target_list):
+        raise ValueError(f"Corpus has different size {len(preds_list)} != {len(target_list)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_list, target_list, n_gram
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

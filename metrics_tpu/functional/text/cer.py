@@ -1,0 +1,36 @@
+"""Char error rate (reference ``functional/text/cer.py:23-83``)."""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distances, _tokenize_chars
+
+Array = jax.Array
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed char-level edit operations and total reference chars."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    distances, _, target_lens = _edit_distances(preds, target, _tokenize_chars)
+    return distances.sum().astype(jnp.float32), target_lens.sum().astype(jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate over reference characters (lower is better).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(char_error_rate(preds=preds, target=target)), 4)
+        0.3415
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

@@ -1,0 +1,137 @@
+"""Shared text machinery: host tokenization + a device edit-distance kernel.
+
+The reference computes Levenshtein distances with a per-pair Python DP loop on
+the host (``/root/reference/src/torchmetrics/functional/text/helper.py`` —
+``_edit_distance`` and the cached ``_LevenshteinEditDistance`` used by TER).
+Here the DP runs **on device** as an anti-diagonal wavefront: a single
+``lax.scan`` over the ``M+N`` anti-diagonals of the DP table, each scan step a
+vectorized elementwise min over one diagonal, ``vmap``-ped over the batch of
+sentence pairs. Strings are tokenized host-side into padded int32 id arrays
+(strings cannot live on a TPU); everything after that is XLA.
+
+Shapes are bucketed to powers of two so jit recompiles O(log max_len) times,
+not once per sentence length.
+"""
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+_BIG = np.int32(1 << 30)
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two to bound jit recompilation."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def _levenshtein_diag(a: Array, a_len: Array, b: Array, b_len: Array) -> Array:
+    """Edit distance between two padded id sequences via wavefront DP.
+
+    ``D[i, j]`` (cost of turning ``a[:i]`` into ``b[:j]``) is computed one
+    anti-diagonal ``k = i + j`` at a time; diagonal ``k`` depends only on
+    diagonals ``k-1`` and ``k-2`` elementwise, so each step is vector math on
+    the MXU-adjacent VPU rather than a scalar host loop. A cell ``(i, j)``
+    only ever depends on cells with smaller-or-equal ``i`` and ``j``, so the
+    pad region beyond ``(a_len, b_len)`` cannot pollute the answer.
+    """
+    m = a.shape[0]
+    n = b.shape[0]
+    idx = jnp.arange(m + 1, dtype=jnp.int32)
+
+    # diag k=0: D[0,0]=0; diag k=1: D[0,1]=D[1,0]=1
+    d_km2 = jnp.where(idx == 0, 0, _BIG).astype(jnp.int32)
+    d_km1 = jnp.where(idx <= 1, 1, _BIG).astype(jnp.int32)
+
+    def step(carry, k):
+        d1, d2 = carry  # diagonals k-1 and k-2
+        a_i = jnp.take(a, idx - 1, mode="clip")      # a[i-1]
+        b_j = jnp.take(b, k - idx - 1, mode="clip")  # b[j-1], j = k - i
+        shifted_d1 = jnp.roll(d1, 1).at[0].set(_BIG)
+        shifted_d2 = jnp.roll(d2, 1).at[0].set(_BIG)
+        substitute = shifted_d2 + jnp.where(a_i == b_j, 0, 1)
+        insert = d1 + 1          # D[i, j-1] + 1
+        delete = shifted_d1 + 1  # D[i-1, j] + 1
+        d = jnp.minimum(substitute, jnp.minimum(insert, delete))
+        d = jnp.where(idx == 0, k, d)  # D[0, k] = k
+        d = jnp.where(idx == k, k, d)  # D[k, 0] = k (no-op once k > m)
+        valid = (k - idx >= 0) & (k - idx <= n)
+        d = jnp.where(valid, d, _BIG)
+        return (d, d1), d[a_len]  # D[a_len, k - a_len]; the answer when k = a_len + b_len
+
+    (_, _), taps = lax.scan(step, (d_km1, d_km2), jnp.arange(2, m + n + 1, dtype=jnp.int32))
+    total = a_len + b_len
+    return jnp.where(total <= 1, total, taps[jnp.maximum(total - 2, 0)]).astype(jnp.int32)
+
+
+@jax.jit
+def _batched_edit_distance(
+    pred_ids: Array, pred_len: Array, target_ids: Array, target_len: Array
+) -> Array:
+    """Per-pair Levenshtein distances for a batch of padded id sequences."""
+    return jax.vmap(_levenshtein_diag)(pred_ids, pred_len, target_ids, target_len)
+
+
+def _encode_batch(
+    token_lists_a: Sequence[Sequence[str]], token_lists_b: Sequence[Sequence[str]]
+) -> Tuple[Array, Array, Array, Array]:
+    """Map two token batches onto one shared integer vocabulary, padded.
+
+    The vocabulary is throwaway (ids only need to agree within the batch);
+    lengths are bucketed to powers of two so the device kernel compiles a
+    bounded number of shapes.
+    """
+    vocab: dict = {}
+
+    def ids_of(tokens: Sequence[str]) -> List[int]:
+        out = []
+        for tok in tokens:
+            if tok not in vocab:
+                vocab[tok] = len(vocab)
+            out.append(vocab[tok])
+        return out
+
+    a_ids = [ids_of(t) for t in token_lists_a]
+    b_ids = [ids_of(t) for t in token_lists_b]
+    max_a = _bucket(max((len(x) for x in a_ids), default=1))
+    max_b = _bucket(max((len(x) for x in b_ids), default=1))
+    batch = len(a_ids)
+    a_arr = np.full((batch, max_a), -1, np.int32)
+    b_arr = np.full((batch, max_b), -2, np.int32)  # distinct pad ids: pads never match
+    for row, ids in enumerate(a_ids):
+        a_arr[row, : len(ids)] = ids
+    for row, ids in enumerate(b_ids):
+        b_arr[row, : len(ids)] = ids
+    a_len = np.asarray([len(x) for x in a_ids], np.int32)
+    b_len = np.asarray([len(x) for x in b_ids], np.int32)
+    return jnp.asarray(a_arr), jnp.asarray(a_len), jnp.asarray(b_arr), jnp.asarray(b_len)
+
+
+def _edit_distances(
+    preds: Sequence[str],
+    target: Sequence[str],
+    tokenize: Callable[[str], Sequence[str]],
+) -> Tuple[Array, Array, Array]:
+    """Host tokenization → device batched DP.
+
+    Returns per-pair ``(distances, pred_lens, target_lens)`` as device arrays.
+    """
+    pred_tokens = [list(tokenize(p)) for p in preds]
+    target_tokens = [list(tokenize(t)) for t in target]
+    a_arr, a_len, b_arr, b_len = _encode_batch(pred_tokens, target_tokens)
+    return _batched_edit_distance(a_arr, a_len, b_arr, b_len), a_len, b_len
+
+
+def _tokenize_words(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _tokenize_chars(sentence: str) -> Sequence[str]:
+    return list(sentence)

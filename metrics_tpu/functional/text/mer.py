@@ -1,0 +1,37 @@
+"""Match error rate (reference ``functional/text/mer.py:23-88``)."""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distances, _tokenize_words
+
+Array = jax.Array
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed edit operations and total = Σ max(|pred|, |target|)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    distances, pred_lens, target_lens = _edit_distances(preds, target, _tokenize_words)
+    total = jnp.maximum(pred_lens, target_lens).sum()
+    return distances.sum().astype(jnp.float32), total.astype(jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate: edits per aligned word slot (lower is better).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(match_error_rate(preds=preds, target=target)), 4)
+        0.4444
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

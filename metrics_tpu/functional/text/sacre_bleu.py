@@ -1,0 +1,162 @@
+"""SacreBLEU (reference ``functional/text/sacre_bleu.py:1-364``).
+
+Same accumulated statistics as BLEU (``bleu.py``); only the host-side
+tokenizer differs. The tokenizers implement the canonical sacrebleu specs
+(mteval-v13a, international/unicode-punctuation, zh, char — source spec:
+https://github.com/mjpost/sacrebleu/tree/master/sacrebleu/tokenizers).
+"""
+import re
+from typing import Optional, Sequence, Union
+
+import jax
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+
+Array = jax.Array
+
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+# CJK unicode ranges (sacrebleu's zh tokenizer spec).
+_CJK_RANGES = (
+    ("㐀", "䶵"),
+    ("一", "龥"),
+    ("龦", "龻"),
+    ("豈", "鶴"),
+    ("侮", "頻"),
+    ("並", "龎"),
+    ("\U00020000", "\U0002a6d6"),
+    ("\U0002f800", "\U0002fa1d"),
+    ("＀", "￯"),
+    ("⺀", "⻿"),
+    ("　", "〿"),
+    ("㇀", "㇯"),
+    ("⼀", "⿟"),
+    ("⿰", "⿿"),
+    ("㄀", "ㄯ"),
+    ("ㆠ", "ㆿ"),
+    ("︐", "︟"),
+    ("︰", "﹏"),
+    ("☀", "⛿"),
+    ("✀", "➿"),
+    ("㈀", "㋿"),
+    ("㌀", "㏿"),
+)
+
+# mteval-v13a post-split regexes.
+_13A_RULES = (
+    (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+    (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+    (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+)
+
+try:  # unicode-category rules need the third-party ``regex`` module
+    import regex as _regex_mod
+
+    _INTL_RULES = (
+        (_regex_mod.compile(r"(\P{N})(\p{P})"), r"\1 \2 "),
+        (_regex_mod.compile(r"(\p{P})(\P{N})"), r" \1 \2"),
+        (_regex_mod.compile(r"(\p{S})"), r" \1 "),
+    )
+except ImportError:  # pragma: no cover - regex is in the baked image
+    _INTL_RULES = None
+
+
+def _apply_rules(line: str, rules) -> str:
+    for pattern, repl in rules:
+        line = pattern.sub(repl, line)
+    return " ".join(line.split())
+
+
+def _unescape_html(line: str) -> str:
+    if "&" in line:
+        line = line.replace("&quot;", '"').replace("&amp;", "&")
+        line = line.replace("&lt;", "<").replace("&gt;", ">")
+    return line
+
+
+def _is_cjk(char: str) -> bool:
+    return any(lo <= char <= hi for lo, hi in _CJK_RANGES)
+
+
+def _tokenize_13a(line: str) -> str:
+    line = line.replace("<skipped>", "").replace("-\n", "").replace("\n", " ")
+    return _apply_rules(_unescape_html(line), _13A_RULES)
+
+
+def _tokenize_intl(line: str) -> str:
+    if _INTL_RULES is None:  # pragma: no cover
+        raise ModuleNotFoundError("`intl` tokenizer requires the `regex` package")
+    return _apply_rules(line, _INTL_RULES)
+
+
+def _tokenize_zh(line: str) -> str:
+    line = line.strip()
+    spaced = []
+    for char in line:
+        if _is_cjk(char):
+            spaced.extend((" ", char, " "))
+        else:
+            spaced.append(char)
+    return _apply_rules(_unescape_html("".join(spaced)), _13A_RULES)
+
+
+def _tokenize_char(line: str) -> str:
+    return " ".join(line.strip())
+
+
+_TOKENIZERS = {
+    "none": lambda line: line,
+    "13a": _tokenize_13a,
+    "zh": _tokenize_zh,
+    "intl": _tokenize_intl,
+    "char": _tokenize_char,
+}
+
+
+class _SacreBLEUTokenizer:
+    """Callable tokenizer: spec-named transform + optional lowercase + split."""
+
+    def __init__(self, tokenize: str = "13a", lowercase: bool = False) -> None:
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS}")
+        self._fn = _TOKENIZERS[tokenize]
+        self._lowercase = lowercase
+
+    def __call__(self, line: str) -> Sequence[str]:
+        tokenized = self._fn(line)
+        if self._lowercase:
+            tokenized = tokenized.lower()
+        return tokenized.split()
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """SacreBLEU: BLEU with a standardized, reproducible tokenization.
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> round(float(sacre_bleu_score(preds, target)), 4)
+        0.7598
+    """
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+    target_lists = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds, target_lists, n_gram, tokenizer
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

@@ -1,0 +1,41 @@
+"""Word error rate (reference ``functional/text/wer.py:23-81``).
+
+Tokenization is host work; the edit-distance DP runs on device as a batched
+wavefront scan (``helper._batched_edit_distance``) instead of the reference's
+per-pair Python loop.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distances, _tokenize_words
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed edit operations and total reference words for a batch."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    distances, _, target_lens = _edit_distances(preds, target, _tokenize_words)
+    return distances.sum().astype(jnp.float32), target_lens.sum().astype(jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word error rate: edit operations per reference word (lower is better).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(word_error_rate(preds=preds, target=target))
+        0.5
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

@@ -1,0 +1,48 @@
+"""Word information lost (reference ``functional/text/wil.py:22-93``).
+
+Uses the reference's hit approximation ``hits = Σ max(|pred|,|tgt|) − Σ edits``
+(stored negated, as ``errors − total``), so WIL/WIP match it exactly.
+"""
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.helper import _edit_distances, _tokenize_words
+
+Array = jax.Array
+
+
+def _wil_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Returns (edits − max-len total, total target words, total pred words)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    distances, pred_lens, target_lens = _edit_distances(preds, target, _tokenize_words)
+    total = jnp.maximum(pred_lens, target_lens).sum()
+    errors = distances.sum() - total
+    return (
+        errors.astype(jnp.float32),
+        target_lens.sum().astype(jnp.float32),
+        pred_lens.sum().astype(jnp.float32),
+    )
+
+
+def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information lost (lower is better).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_lost(preds, target)), 4)
+        0.6528
+    """
+    errors, target_total, preds_total = _wil_update(preds, target)
+    return _wil_compute(errors, target_total, preds_total)

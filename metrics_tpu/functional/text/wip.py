@@ -1,0 +1,28 @@
+"""Word information preserved (reference ``functional/text/wip.py:21-92``)."""
+from typing import List, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.text.wil import _wil_update
+
+Array = jax.Array
+
+# Same accumulated statistics as WIL (reference's _wip_update mirrors _wil_update).
+_wip_update = _wil_update
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information preserved (higher is better).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> round(float(word_information_preserved(preds, target)), 4)
+        0.3472
+    """
+    errors, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)

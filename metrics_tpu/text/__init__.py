@@ -1,0 +1,30 @@
+"""Text metric modules (reference ``text/__init__.py``)."""
+from metrics_tpu.text.bert import BERTScore  # noqa: F401
+from metrics_tpu.text.bleu import BLEUScore  # noqa: F401
+from metrics_tpu.text.cer import CharErrorRate  # noqa: F401
+from metrics_tpu.text.chrf import CHRFScore  # noqa: F401
+from metrics_tpu.text.eed import ExtendedEditDistance  # noqa: F401
+from metrics_tpu.text.mer import MatchErrorRate  # noqa: F401
+from metrics_tpu.text.rouge import ROUGEScore  # noqa: F401
+from metrics_tpu.text.sacre_bleu import SacreBLEUScore  # noqa: F401
+from metrics_tpu.text.squad import SQuAD  # noqa: F401
+from metrics_tpu.text.ter import TranslationEditRate  # noqa: F401
+from metrics_tpu.text.wer import WordErrorRate  # noqa: F401
+from metrics_tpu.text.wil import WordInfoLost  # noqa: F401
+from metrics_tpu.text.wip import WordInfoPreserved  # noqa: F401
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "ExtendedEditDistance",
+    "MatchErrorRate",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

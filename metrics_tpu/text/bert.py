@@ -1,0 +1,110 @@
+"""BERTScore module (reference ``text/bert.py:41-215``).
+
+The reference tokenizes on update and stores ``input_ids``/``attention_mask``
+cat lists, running the model at compute (``text/bert.py:170-173``). Here the
+injected encoder runs on update and the module accumulates embedding/mask/id
+arrays as cat states — sync is the standard ragged pad-gather, and compute is
+the jittable matching kernel (IDF needs the full reference corpus, hence
+compute-time weighting).
+"""
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.functional.text.bert import (
+    _bert_score_from_embeddings,
+    _encode,
+    _idf_scale,
+    _idf_weights,
+    _pad_to,
+    _strip_special_tokens,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """Accumulating BERTScore with an injected encoder (no bundled weights)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jittable_update = False
+
+    def __init__(
+        self,
+        encoder: Optional[Callable[[List[str]], Tuple[np.ndarray, np.ndarray, np.ndarray]]] = None,
+        idf: bool = False,
+        max_length: int = 512,
+        rescale_with_baseline: bool = False,
+        baseline: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.encoder = encoder
+        self.idf = idf
+        self.max_length = max_length
+        if rescale_with_baseline and baseline is None:
+            raise ValueError(
+                "`rescale_with_baseline` requires the `baseline` argument (no baseline files are bundled)."
+            )
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline = baseline
+
+        for name in (
+            "pred_embeddings", "pred_masks", "pred_ids",
+            "target_embeddings", "target_masks", "target_ids",
+        ):
+            self.add_state(name, default=[], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[Sequence[str], Dict[str, Any]],
+        target: Union[Sequence[str], Dict[str, Any]],
+    ) -> None:
+        pred_emb, pred_mask, pred_ids = _encode(preds, self.encoder, self.max_length)
+        target_emb, target_mask, target_ids = _encode(target, self.encoder, self.max_length)
+        if pred_emb.shape[0] != target_emb.shape[0]:
+            raise ValueError("Number of predicted and reference sententes must be the same!")
+        self.pred_embeddings.append(jnp.asarray(pred_emb))
+        self.pred_masks.append(jnp.asarray(pred_mask))
+        self.pred_ids.append(jnp.asarray(pred_ids))
+        self.target_embeddings.append(jnp.asarray(target_emb))
+        self.target_masks.append(jnp.asarray(target_mask))
+        self.target_ids.append(jnp.asarray(target_ids))
+
+    def compute(self) -> Dict[str, Array]:
+        length = max(
+            max(e.shape[1] for e in self.pred_embeddings),
+            max(e.shape[1] for e in self.target_embeddings),
+        )
+
+        def gather(chunks, pad_len):
+            return np.concatenate([_pad_to(np.asarray(c), pad_len) for c in chunks])
+
+        pred_emb = gather(self.pred_embeddings, length)
+        pred_mask = gather(self.pred_masks, length)
+        pred_ids = gather(self.pred_ids, length)
+        target_emb = gather(self.target_embeddings, length)
+        target_mask = gather(self.target_masks, length)
+        target_ids = gather(self.target_ids, length)
+
+        pred_mask_j = _strip_special_tokens(jnp.asarray(pred_mask))
+        target_mask_j = _strip_special_tokens(jnp.asarray(target_mask))
+        idf_map = _idf_weights(target_ids, target_mask) if self.idf else None
+        pred_scale = jnp.asarray(_idf_scale(pred_ids, np.asarray(pred_mask_j), idf_map))
+        target_scale = jnp.asarray(_idf_scale(target_ids, np.asarray(target_mask_j), idf_map))
+
+        precision, recall, f1 = _bert_score_from_embeddings(
+            jnp.asarray(pred_emb), pred_mask_j, pred_scale,
+            jnp.asarray(target_emb), target_mask_j, target_scale,
+        )
+        if self.rescale_with_baseline:
+            b_p, b_r, b_f = (jnp.asarray(b, jnp.float32) for b in self.baseline)
+            precision = (precision - b_p) / (1.0 - b_p)
+            recall = (recall - b_r) / (1.0 - b_r)
+            f1 = (f1 - b_f) / (1.0 - b_f)
+        return {"precision": precision, "recall": recall, "f1": f1}

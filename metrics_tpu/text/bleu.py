@@ -1,0 +1,62 @@
+"""BLEUScore module (reference ``text/bleu.py:26-120``)."""
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    """Corpus BLEU accumulated over batches of (preds, references).
+
+    State is four tiny ``sum``-reduced count tensors — the n-gram counting
+    itself is host work (strings), so updates run eagerly; sync and the final
+    formula are device math.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    jittable_update = False
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+
+        self.add_state("preds_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", default=jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Sequence[str], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        preds_list = [preds] if isinstance(preds, str) else preds
+        target_list = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_list) != len(target_list):
+            raise ValueError(f"Corpus has different size {len(preds_list)} != {len(target_list)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_list, target_list, self.n_gram
+        )
+        self.numerator += numerator
+        self.denominator += denominator
+        self.preds_len += preds_len
+        self.target_len += target_len
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator,
+            self.n_gram, self.weights, self.smooth,
+        )

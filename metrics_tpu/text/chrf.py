@@ -1,0 +1,79 @@
+"""CHRFScore module (reference ``text/chrf.py:30-168``)."""
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.chrf import _chrf_score_update, _fscore_from_counts
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """Corpus chrF/chrF++ with six per-order ``sum`` count states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    jittable_update = False
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.n_order = float(n_char_order + n_word_order)
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("matching_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("matching_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("pred_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("pred_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("target_char", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("target_word", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]
+    ) -> None:
+        m_char, m_word, p_char, p_word, t_char, t_word, sentence_scores = _chrf_score_update(
+            preds, target, self.n_char_order, self.n_word_order, self.beta,
+            self.lowercase, self.whitespace,
+            collect_sentence_scores=self.return_sentence_level_score,
+        )
+        self.matching_char += m_char
+        self.matching_word += m_word
+        self.pred_char += p_char
+        self.pred_word += p_word
+        self.target_char += t_char
+        self.target_word += t_word
+        if self.return_sentence_level_score:
+            self.sentence_chrf_score.extend(sentence_scores)
+
+    def compute(self):
+        score = _fscore_from_counts(
+            self.matching_char, self.matching_word, self.pred_char, self.pred_word,
+            self.target_char, self.target_word, self.n_order, self.beta,
+        )
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate(self.sentence_chrf_score) if self.sentence_chrf_score else jnp.zeros(0)
+        return score

@@ -1,0 +1,69 @@
+"""ExtendedEditDistance module (reference ``text/eed.py:25-125``).
+
+Redesign: the reference keeps every sentence score in an unbounded list; here
+the default state is a running (sum, count) pair — constant memory, one fused
+collective — with the list kept only when sentence-level scores are requested.
+"""
+from typing import Any, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.eed import _eed_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(Metric):
+    """Corpus EED over accumulated (preds, references) pairs."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    jittable_update = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        for name, value in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(value, float) or value < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("score_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sentence_count", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> None:
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion
+        )
+        self.score_sum += sum(scores) if scores else 0.0
+        self.sentence_count += len(scores)
+        if self.return_sentence_level_score:
+            self.sentence_eed.extend(jnp.atleast_1d(s) for s in scores)
+
+    def compute(self):
+        average = self.score_sum / jnp.maximum(self.sentence_count, 1.0)
+        if self.return_sentence_level_score:
+            return average, jnp.concatenate(self.sentence_eed) if self.sentence_eed else jnp.zeros(0)
+        return average

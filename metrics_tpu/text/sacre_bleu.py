@@ -1,0 +1,38 @@
+"""SacreBLEUScore module (reference ``text/sacre_bleu.py:28-110``)."""
+from typing import Any, Optional, Sequence
+
+import jax
+
+from metrics_tpu.functional.text.bleu import _bleu_score_update
+from metrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from metrics_tpu.text.bleu import BLEUScore
+
+Array = jax.Array
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with the standardized sacrebleu tokenization pipeline."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        target_list = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds) != len(target_list):
+            raise ValueError(f"Corpus has different size {len(preds)} != {len(target_list)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds, target_list, self.n_gram, self.tokenizer
+        )
+        self.numerator += numerator
+        self.denominator += denominator
+        self.preds_len += preds_len
+        self.target_len += target_len

@@ -1,0 +1,41 @@
+"""SQuAD module (reference ``text/squad.py:24-115``)."""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.squad import (
+    PREDS_TYPE,
+    TARGETS_TYPE,
+    _squad_compute,
+    _squad_input_check,
+    _squad_update,
+)
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SQuAD(Metric):
+    """SQuAD exact-match / F1 with three scalar ``sum`` states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    jittable_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("exact_match", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
+        preds_dict, target_dict = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, target_dict)
+        self.f1_score += f1
+        self.exact_match += exact_match
+        self.total += total
+
+    def compute(self) -> Dict[str, Array]:
+        return _squad_compute(self.f1_score, self.exact_match, self.total)

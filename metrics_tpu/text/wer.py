@@ -1,0 +1,37 @@
+"""WordErrorRate module (reference ``text/wer.py:23-81``)."""
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.wer import _wer_compute, _wer_update
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordErrorRate(Metric):
+    """Word error rate over accumulated (preds, target) transcript pairs.
+
+    Update takes strings (host tokenization → device wavefront DP), so the
+    update itself is not jit-staged; the two scalar ``sum`` states still sync
+    with a single fused collective.
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    jittable_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _wer_update(preds, target)
+        self.errors += errors
+        self.total += total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)

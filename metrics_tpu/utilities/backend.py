@@ -1,0 +1,22 @@
+"""Backend-reset helper for environments that pin a TPU platform at startup.
+
+The surrounding environment pins ``JAX_PLATFORMS=axon`` (single-chip TPU
+tunnel) and registers the backend at interpreter startup via sitecustomize,
+so env vars set inside Python are too late — the only way to get a CPU (or
+virtual multi-device CPU) backend is to rewrite the jax config and clear the
+already-initialized backends. Shared by ``tests/conftest.py``, ``bench.py``'s
+fallback path, and ``__graft_entry__.dryrun_multichip``.
+"""
+from typing import Optional
+
+
+def force_cpu_backend(n_devices: Optional[int] = None) -> None:
+    """Re-point jax at the host CPU platform, optionally with virtual devices."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices is not None:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    from jax.extend import backend as _jeb
+
+    _jeb.clear_backends()

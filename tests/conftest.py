@@ -3,22 +3,16 @@
 The analogue of the reference's 2-process Gloo pool
 (``test/unittests/helpers/testers.py:35-61``): distributed behavior is tested
 on a virtual 8-device CPU mesh via ``shard_map``/``pjit`` instead of a
-process-pool DDP simulation.
-
-The surrounding environment pins ``JAX_PLATFORMS=axon`` (single-chip TPU
-tunnel) and initializes the backend at interpreter startup via
-sitecustomize, so we must clear and re-create backends — env vars alone are
-too late.
+process-pool DDP simulation. Backend reset rationale lives in
+``metrics_tpu/utilities/backend.py``.
 """
 import jax
 
+from metrics_tpu.utilities.backend import force_cpu_backend
+
 NUM_DEVICES = 8
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", NUM_DEVICES)
-from jax.extend import backend as _jeb  # noqa: E402
-
-_jeb.clear_backends()
+force_cpu_backend(NUM_DEVICES)
 
 
 def pytest_configure(config):
