@@ -119,16 +119,27 @@ def _ssim_compute(
     upper = 2 * sigma_pred_target + c2
     lower = sigma_pred_sq + sigma_target_sq + c2
 
-    ssim_idx = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+    ssim_idx_full_image = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    # The reflect-padded border is excluded from the score (reference
+    # ``ssim.py:178-185``): only interior pixels whose window never touched
+    # padding enter the mean. The full (uncropped) map is still returned for
+    # ``return_full_image``.
+    crop = tuple(slice(p, -p) if p else slice(None) for p in pads)
+    ssim_idx = ssim_idx_full_image[(Ellipsis, *crop)]
 
     if return_contrast_sensitivity:
-        contrast_sensitivity = upper / lower
+        # The reference crops cs over the last two dims only, always with the
+        # first two pad amounts — even for 3D inputs, where the depth border
+        # stays in (``ssim.py:183-185``).
+        cs_crop = tuple(slice(p, -p) if p else slice(None) for p in pads[:2])
+        contrast_sensitivity = (upper / lower)[(Ellipsis, *cs_crop)]
         return (
             reduce(ssim_idx.reshape(b, -1).mean(-1), reduction),
             reduce(contrast_sensitivity.reshape(b, -1).mean(-1), reduction),
         )
     if return_full_image:
-        return reduce(ssim_idx.reshape(b, -1).mean(-1), reduction), reduce(ssim_idx, reduction)
+        return reduce(ssim_idx.reshape(b, -1).mean(-1), reduction), reduce(ssim_idx_full_image, reduction)
     return reduce(ssim_idx.reshape(b, -1).mean(-1), reduction)
 
 
@@ -244,11 +255,15 @@ def _multiscale_ssim_compute(
             f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
         )
 
+    # The per-scale sim/cs are reduced with the caller's reduction BEFORE the
+    # beta-weighted product (reference ``ssim.py:382-412``): for
+    # "elementwise_mean" each scale contributes one scalar, so heterogeneous
+    # batches are averaged per scale, not per sample.
     sim_list: List[Array] = []
     cs_list: List[Array] = []
     for _ in range(len(betas)):
         sim, contrast_sensitivity = _get_normalized_sim_and_cs(
-            preds, target, gaussian_kernel, sigma, kernel_size, "none", data_range, k1, k2, normalize=normalize
+            preds, target, gaussian_kernel, sigma, kernel_size, reduction, data_range, k1, k2, normalize=normalize
         )
         sim_list.append(sim)
         cs_list.append(contrast_sensitivity)
@@ -262,10 +277,17 @@ def _multiscale_ssim_compute(
         sim_stack = (sim_stack + 1) / 2
         cs_stack = (cs_stack + 1) / 2
 
-    betas_arr = jnp.asarray(betas)
-    cs_and_sim = jnp.concatenate([cs_stack[:-1], sim_stack[-1:]])
-    mcs_weighted = cs_and_sim ** betas_arr[:, None]
-    return reduce(jnp.prod(mcs_weighted, axis=0), reduction)
+    betas_arr = jnp.asarray(betas, dtype=sim_stack.dtype)
+    if reduction is None or reduction == "none":
+        # Per-sample path. (The reference's own "none" branch mis-shapes the
+        # exponent and only runs when batch == len(betas); this is the sane
+        # per-sample semantics instead.)
+        sim_stack = sim_stack ** betas_arr[:, None]
+        cs_stack = cs_stack ** betas_arr[:, None]
+        return jnp.prod(jnp.concatenate([cs_stack[:-1], sim_stack[-1:]]), axis=0)
+    sim_stack = sim_stack**betas_arr
+    cs_stack = cs_stack**betas_arr
+    return jnp.prod(cs_stack[:-1]) * sim_stack[-1]
 
 
 def multiscale_structural_similarity_index_measure(
@@ -279,7 +301,7 @@ def multiscale_structural_similarity_index_measure(
     k1: float = 0.01,
     k2: float = 0.03,
     betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
-    normalize: Optional[str] = "relu",
+    normalize: Optional[str] = None,
 ) -> Array:
     """MS-SSIM (reference ``ssim.py:430-487``).
 

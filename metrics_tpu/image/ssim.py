@@ -85,7 +85,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         k1: float = 0.01,
         k2: float = 0.03,
         betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
-        normalize: Optional[str] = "relu",
+        normalize: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)

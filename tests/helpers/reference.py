@@ -1,0 +1,35 @@
+"""Import the reference implementation (``/root/reference``) as a test oracle.
+
+The reference is the behavioral contract (SURVEY.md §4): wherever it is
+importable we compare against it directly instead of hand-rolled numpy
+re-derivations, which can silently encode the same bug as the implementation
+under test (that happened to SSIM in round 2).
+
+The reference's ``__about__`` machinery needs ``pkg_resources``, which newer
+setuptools no longer ships — shim just enough of it.
+"""
+import sys
+import types
+
+_REFERENCE_SRC = "/root/reference/src"
+
+
+def import_reference():
+    """Return the reference ``torchmetrics`` package, or skip-raise if absent."""
+    import pytest
+
+    if "pkg_resources" not in sys.modules:
+        try:
+            import pkg_resources  # noqa: F401
+        except ImportError:
+            shim = types.ModuleType("pkg_resources")
+            shim.DistributionNotFound = type("DistributionNotFound", (Exception,), {})
+            shim.get_distribution = lambda name: types.SimpleNamespace(version="0.0.0")
+            sys.modules["pkg_resources"] = shim
+    if _REFERENCE_SRC not in sys.path:
+        sys.path.insert(0, _REFERENCE_SRC)
+    try:
+        import torchmetrics
+    except Exception as err:  # pragma: no cover - only on broken environments
+        pytest.skip(f"reference torchmetrics not importable: {err}")
+    return torchmetrics
