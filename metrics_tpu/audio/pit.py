@@ -1,0 +1,45 @@
+"""PIT module metric (reference ``src/torchmetrics/audio/pit.py``, 102 LoC)."""
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PermutationInvariantTraining(Metric):
+    """Average best-permutation metric (reference ``audio/pit.py:22-102``).
+
+    Extra ``**kwargs`` not consumed by the base ``Metric`` are forwarded to
+    ``metric_func`` on every update, mirroring the reference's kwarg split.
+    """
+
+    full_state_update = False
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, metric_func: Callable, eval_func: str = "max", **kwargs: Any) -> None:
+        base_kwargs: Dict[str, Any] = {
+            key: kwargs.pop(key)
+            for key in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn", "sync_on_compute")
+            if key in kwargs
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric += pit_metric.sum()
+        self.total += pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

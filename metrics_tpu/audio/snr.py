@@ -1,0 +1,53 @@
+"""SNR module metrics (reference ``src/torchmetrics/audio/snr.py``, 158 LoC)."""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(Metric):
+    """Average SNR over all seen clips (reference ``audio/snr.py:22-94``)."""
+
+    full_state_update = False
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr += snr_batch.sum()
+        self.total += snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Average SI-SNR (reference ``audio/snr.py:97-158``)."""
+
+    full_state_update = False
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)
+        self.sum_si_snr += si_snr_batch.sum()
+        self.total += si_snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total

@@ -1,0 +1,43 @@
+"""STOI module metric (reference ``src/torchmetrics/audio/stoi.py``, 120 LoC).
+
+Always importable; raises ``ModuleNotFoundError`` at construction when the
+``pystoi`` backend is absent (see ``audio/pesq.py`` for the rationale).
+"""
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """Average STOI (reference ``audio/stoi.py:22-120``)."""
+
+    full_state_update = False
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "ShortTimeObjectiveIntelligibility metric requires that the `pystoi` package is installed."
+                " Install it with `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+        self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        self.sum_stoi += stoi_batch.sum()
+        self.total += stoi_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

@@ -1,0 +1,2 @@
+"""Detection metrics (reference ``src/torchmetrics/detection/__init__.py``)."""
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision  # noqa: F401

@@ -1,0 +1,53 @@
+"""Box utilities for detection metrics (the role torchvision's
+``box_convert``/``box_area``/``box_iou`` play for the reference
+``src/torchmetrics/detection/mean_ap.py:29,61``).
+
+All three are pure jnp, fully vectorized over box sets — a ``(D, G)`` IoU
+matrix is one broadcasted min/max block, MXU-free but bandwidth-friendly.
+"""
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str = "xyxy") -> Array:
+    """Convert ``(N, 4)`` boxes between ``xyxy`` / ``xywh`` / ``cxcywh``."""
+    if in_fmt == out_fmt:
+        return boxes
+    if in_fmt == "xyxy":
+        x1, y1, x2, y2 = jnp.moveaxis(boxes, -1, 0)
+    elif in_fmt == "xywh":
+        x, y, w, h = jnp.moveaxis(boxes, -1, 0)
+        x1, y1, x2, y2 = x, y, x + w, y + h
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.moveaxis(boxes, -1, 0)
+        x1, y1, x2, y2 = cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2
+    else:
+        raise ValueError(f"Unsupported box format {in_fmt}")
+    if out_fmt == "xyxy":
+        out = (x1, y1, x2, y2)
+    elif out_fmt == "xywh":
+        out = (x1, y1, x2 - x1, y2 - y1)
+    elif out_fmt == "cxcywh":
+        out = ((x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1)
+    else:
+        raise ValueError(f"Unsupported box format {out_fmt}")
+    return jnp.stack(out, axis=-1)
+
+
+def box_area(boxes: Array) -> Array:
+    """Area of ``(N, 4)`` xyxy boxes."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU matrix ``(N, M)`` for xyxy boxes."""
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)

@@ -1,0 +1,364 @@
+"""COCO-style mean Average Precision / Recall (reference
+``src/torchmetrics/detection/mean_ap.py``, 928 LoC).
+
+Architecture: the states are per-image ragged arrays gathered with the union
+(``dist_reduce_fx=None``) semantics, exactly like the reference's five list
+states (``mean_ap.py:339-343``). Box conversion and pairwise IoU are device
+jnp kernels (``detection/helpers.py``); the greedy per-image matching and the
+COCO accumulation are an explicit host boundary — the matching is a
+sequential loop over score-ranked detections (vectorized across IoU
+thresholds), which is the role the reference delegates to
+pycocotools-style Python/numpy (``mean_ap.py:537-616``).
+
+Improvement over the reference: ``iou_type="segm"`` needs no pycocotools —
+mask IoU is a dense intersection matmul over flattened masks.
+"""
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.detection.helpers import box_area, box_convert, box_iou
+from metrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _input_validator(preds: Sequence[Dict[str, Any]], targets: Sequence[Dict[str, Any]], iou_type: str = "bbox") -> None:
+    """Validate the list-of-dicts input contract (reference ``mean_ap.py:138-183``)."""
+    item_key = "boxes" if iou_type == "bbox" else "masks"
+    if not isinstance(preds, Sequence):
+        raise ValueError("Expected argument `preds` to be of type Sequence")
+    if not isinstance(targets, Sequence):
+        raise ValueError("Expected argument `target` to be of type Sequence")
+    if len(preds) != len(targets):
+        raise ValueError("Expected argument `preds` and `target` to have the same length")
+
+    for k in (item_key, "scores", "labels"):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in (item_key, "labels"):
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+    for i, item in enumerate(preds):
+        n = np.asarray(item[item_key]).shape[0]
+        if np.asarray(item["scores"]).shape[0] != n or np.asarray(item["labels"]).shape[0] != n:
+            raise ValueError(
+                f"Input {item_key} scores and labels of sample {i} in predictions have a different length"
+            )
+    for i, item in enumerate(targets):
+        if np.asarray(item[item_key]).shape[0] != np.asarray(item["labels"]).shape[0]:
+            raise ValueError(f"Input {item_key} and labels of sample {i} in targets have a different length")
+
+
+def _fix_empty_boxes(boxes: np.ndarray) -> np.ndarray:
+    if boxes.size == 0:
+        return boxes.reshape(0, 4).astype(np.float32)
+    return boxes
+
+
+def _mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Pairwise mask IoU ``(D, G)`` from dense ``(N, H, W)`` bool masks."""
+    d = det.reshape(det.shape[0], -1).astype(np.float32)
+    g = gt.reshape(gt.shape[0], -1).astype(np.float32)
+    inter = d @ g.T
+    union = d.sum(1)[:, None] + g.sum(1)[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1), 0.0)
+
+
+class MeanAveragePrecision(Metric):
+    """COCO mAP / mAR (reference ``detection/mean_ap.py:199``).
+
+    Accepts per-image prediction dicts (``boxes``/``scores``/``labels`` —
+    ``masks`` instead of boxes for ``iou_type="segm"``) and target dicts
+    (``boxes``/``labels``), accumulates them as ragged union states, and
+    computes the full COCO summary at 10 IoU thresholds, 101 recall points,
+    4 area ranges and 3 max-detection caps.
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: str = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"Expected argument `iou_type` to be one of ('segm', 'bbox') but got {iou_type}")
+        self.iou_type = iou_type
+        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, 10).tolist()
+        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.0, 101).tolist()
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        self.bbox_area_ranges = {
+            "all": (0**2, int(1e5**2)),
+            "small": (0**2, 32**2),
+            "medium": (32**2, 96**2),
+            "large": (96**2, int(1e5**2)),
+        }
+
+        self.add_state("detections", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruths", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Sequence[Dict[str, Any]], target: Sequence[Dict[str, Any]]) -> None:
+        _input_validator(preds, target, iou_type=self.iou_type)
+
+        for item in preds:
+            self.detections.append(self._get_safe_item_values(item))
+            self.detection_labels.append(np.asarray(item["labels"]).astype(np.int64).reshape(-1))
+            self.detection_scores.append(np.asarray(item["scores"]).astype(np.float32).reshape(-1))
+
+        for item in target:
+            self.groundtruths.append(self._get_safe_item_values(item))
+            self.groundtruth_labels.append(np.asarray(item["labels"]).astype(np.int64).reshape(-1))
+
+    def _get_safe_item_values(self, item: Dict[str, Any]) -> np.ndarray:
+        if self.iou_type == "bbox":
+            boxes = _fix_empty_boxes(np.asarray(item["boxes"], dtype=np.float32))
+            return np.asarray(box_convert(jnp.asarray(boxes), in_fmt=self.box_format, out_fmt="xyxy"))
+        return np.asarray(item["masks"]).astype(bool)
+
+    # ---- evaluation (host boundary) -------------------------------------
+
+    def _get_classes(self) -> List[int]:
+        labels = list(self.detection_labels) + list(self.groundtruth_labels)
+        if not labels:
+            return []
+        return sorted(np.unique(np.concatenate([np.asarray(la) for la in labels])).astype(int).tolist())
+
+    def _area(self, items: np.ndarray) -> np.ndarray:
+        if self.iou_type == "bbox":
+            return np.asarray(box_area(jnp.asarray(items)))
+        return items.reshape(items.shape[0], -1).sum(-1).astype(np.float64)
+
+    def _iou(self, det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+        if self.iou_type == "bbox":
+            return np.asarray(box_iou(jnp.asarray(det), jnp.asarray(gt)))
+        return _mask_iou(det, gt)
+
+    def _prepare_image_class(self, idx: int, class_id: int, max_det: int) -> Optional[Dict[str, np.ndarray]]:
+        """Label-filter, score-sort, cap, and IoU once per (image, class) —
+        the reference's per-(image, class) ious cache (``mean_ap.py:722-729``);
+        area ranges only change the ignore masks downstream."""
+        gt_all = np.asarray(self.groundtruths[idx])
+        det_all = np.asarray(self.detections[idx])
+        gt_mask = np.asarray(self.groundtruth_labels[idx]) == class_id
+        det_mask = np.asarray(self.detection_labels[idx]) == class_id
+        if not gt_mask.any() and not det_mask.any():
+            return None
+
+        # detections: score-descending (stable, matlab-style), capped
+        scores = np.asarray(self.detection_scores[idx])[det_mask]
+        dtind = np.argsort(-scores, kind="stable")[:max_det]
+        det = det_all[det_mask][dtind]
+        gt = gt_all[gt_mask]
+        nb_det, nb_gt = det.shape[0], gt.shape[0]
+        return {
+            "scores": scores[dtind],
+            "det_areas": self._area(det) if nb_det else np.zeros(0),
+            "gt_areas": self._area(gt) if nb_gt else np.zeros(0),
+            "ious": self._iou(det, gt) if nb_det and nb_gt else np.zeros((nb_det, nb_gt)),
+        }
+
+    def _evaluate_image(
+        self, entry: Optional[Dict[str, np.ndarray]], area_range: Tuple[int, int]
+    ) -> Optional[Dict[str, np.ndarray]]:
+        """Greedy matching for one (image, class, area-range) cell (reference
+        ``mean_ap.py:537-616``), vectorized over IoU thresholds."""
+        if entry is None:
+            return None
+        nb_thrs = len(self.iou_thresholds)
+        scores_sorted = entry["scores"]
+        nb_det = scores_sorted.shape[0]
+        nb_gt = entry["gt_areas"].shape[0]
+
+        if nb_gt == 0:
+            det_ig = (entry["det_areas"] < area_range[0]) | (entry["det_areas"] > area_range[1])
+            return {
+                "dtMatches": np.zeros((nb_thrs, nb_det), dtype=bool),
+                "dtScores": scores_sorted,
+                "gtIgnore": np.zeros(0, dtype=bool),
+                "dtIgnore": np.broadcast_to(det_ig[None, :], (nb_thrs, nb_det)).copy(),
+            }
+
+        # ground truths: ignored-last (stable)
+        ignore_area = (entry["gt_areas"] < area_range[0]) | (entry["gt_areas"] > area_range[1])
+        gtind = np.argsort(ignore_area.astype(np.uint8), kind="stable")
+        gt_ignore = ignore_area[gtind]
+
+        if nb_det == 0:
+            return {
+                "dtMatches": np.zeros((nb_thrs, 0), dtype=bool),
+                "dtScores": np.zeros(0),
+                "gtIgnore": gt_ignore,
+                "dtIgnore": np.zeros((nb_thrs, 0), dtype=bool),
+            }
+
+        ious = entry["ious"][:, gtind]  # rows score-sorted, cols ignored-last
+        thrs = np.asarray(self.iou_thresholds)
+        gt_matches = np.zeros((nb_thrs, nb_gt), dtype=bool)
+        det_matches = np.zeros((nb_thrs, nb_det), dtype=bool)
+        det_ignore = np.zeros((nb_thrs, nb_det), dtype=bool)
+
+        for d in range(nb_det):
+            # per threshold: best still-available, non-ignored gt
+            avail = ~(gt_matches | gt_ignore[None, :])  # (T, G)
+            cand = ious[d][None, :] * avail
+            m = cand.argmax(axis=1)  # (T,)
+            ok = cand[np.arange(nb_thrs), m] > thrs
+            det_ignore[ok, d] = gt_ignore[m[ok]]
+            det_matches[ok, d] = True
+            gt_matches[ok, m[ok]] = True
+
+        det_ig_area = (entry["det_areas"] < area_range[0]) | (entry["det_areas"] > area_range[1])
+        det_ignore |= (~det_matches) & det_ig_area[None, :]
+
+        return {
+            "dtMatches": det_matches,
+            "dtScores": scores_sorted,
+            "gtIgnore": gt_ignore,
+            "dtIgnore": det_ignore,
+        }
+
+    def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Accumulate precision/recall over all (class, area, max_det) cells
+        (reference ``mean_ap.py:711-870``)."""
+        nb_imgs = len(self.groundtruths)
+        nb_thrs = len(self.iou_thresholds)
+        nb_rec = len(self.rec_thresholds)
+        nb_cls = len(class_ids)
+        nb_areas = len(self.bbox_area_ranges)
+        nb_mdets = len(self.max_detection_thresholds)
+        max_det = self.max_detection_thresholds[-1]
+        rec_thrs = np.asarray(self.rec_thresholds)
+
+        precision = -np.ones((nb_thrs, nb_rec, nb_cls, nb_areas, nb_mdets))
+        recall = -np.ones((nb_thrs, nb_cls, nb_areas, nb_mdets))
+
+        for idx_cls, class_id in enumerate(class_ids):
+            entries = [self._prepare_image_class(i, class_id, max_det) for i in range(nb_imgs)]
+            for idx_area, area_rng in enumerate(self.bbox_area_ranges.values()):
+                evals = [self._evaluate_image(e, area_rng) for e in entries]
+                evals = [e for e in evals if e is not None]
+                if not evals:
+                    continue
+                for idx_mdet, mdet in enumerate(self.max_detection_thresholds):
+                    det_scores = np.concatenate([e["dtScores"][:mdet] for e in evals])
+                    inds = np.argsort(-det_scores, kind="stable")
+                    det_scores_sorted = det_scores[inds]
+                    det_matches = np.concatenate([e["dtMatches"][:, :mdet] for e in evals], axis=1)[:, inds]
+                    det_ignore = np.concatenate([e["dtIgnore"][:, :mdet] for e in evals], axis=1)[:, inds]
+                    gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
+                    npig = int((~gt_ignore).sum())
+                    if npig == 0:
+                        continue
+                    tps = det_matches & ~det_ignore
+                    fps = ~det_matches & ~det_ignore
+                    tp_sum = tps.cumsum(axis=1).astype(np.float64)
+                    fp_sum = fps.cumsum(axis=1).astype(np.float64)
+                    for idx_thr in range(nb_thrs):
+                        tp, fp = tp_sum[idx_thr], fp_sum[idx_thr]
+                        nd = tp.shape[0]
+                        rc = tp / npig
+                        pr = tp / (fp + tp + np.finfo(np.float64).eps)
+                        recall[idx_thr, idx_cls, idx_area, idx_mdet] = rc[-1] if nd else 0.0
+                        # precision envelope: non-increasing from the right
+                        pr = np.maximum.accumulate(pr[::-1])[::-1]
+                        inds_r = np.searchsorted(rc, rec_thrs, side="left")
+                        num_inds = int(inds_r.argmax()) if inds_r.max() >= nd else nb_rec
+                        prec = np.zeros(nb_rec)
+                        prec[:num_inds] = pr[inds_r[:num_inds]]
+                        precision[idx_thr, :, idx_cls, idx_area, idx_mdet] = prec
+
+        return precision, recall
+
+    def _summarize(
+        self,
+        precision: np.ndarray,
+        recall: np.ndarray,
+        avg_prec: bool = True,
+        iou_threshold: Optional[float] = None,
+        area_range: str = "all",
+        max_dets: int = 100,
+    ) -> float:
+        area_idx = list(self.bbox_area_ranges).index(area_range)
+        mdet_idx = self.max_detection_thresholds.index(max_dets)
+        if avg_prec:
+            prec = precision[..., area_idx, mdet_idx]
+            if iou_threshold is not None:
+                prec = prec[self.iou_thresholds.index(iou_threshold)]
+        else:
+            prec = recall[..., area_idx, mdet_idx]
+            if iou_threshold is not None:
+                prec = prec[self.iou_thresholds.index(iou_threshold)]
+        valid = prec[prec > -1]
+        return float(valid.mean()) if valid.size else -1.0
+
+    def _summarize_results(self, precision: np.ndarray, recall: np.ndarray) -> Dict[str, float]:
+        last_mdet = self.max_detection_thresholds[-1]
+        res = {
+            "map": self._summarize(precision, recall, True, max_dets=last_mdet),
+            "map_small": self._summarize(precision, recall, True, area_range="small", max_dets=last_mdet),
+            "map_medium": self._summarize(precision, recall, True, area_range="medium", max_dets=last_mdet),
+            "map_large": self._summarize(precision, recall, True, area_range="large", max_dets=last_mdet),
+            "mar_small": self._summarize(precision, recall, False, area_range="small", max_dets=last_mdet),
+            "mar_medium": self._summarize(precision, recall, False, area_range="medium", max_dets=last_mdet),
+            "mar_large": self._summarize(precision, recall, False, area_range="large", max_dets=last_mdet),
+        }
+        res["map_50"] = (
+            self._summarize(precision, recall, True, iou_threshold=0.5, max_dets=last_mdet)
+            if 0.5 in self.iou_thresholds
+            else -1.0
+        )
+        res["map_75"] = (
+            self._summarize(precision, recall, True, iou_threshold=0.75, max_dets=last_mdet)
+            if 0.75 in self.iou_thresholds
+            else -1.0
+        )
+        for mdet in self.max_detection_thresholds:
+            res[f"mar_{mdet}"] = self._summarize(precision, recall, False, max_dets=mdet)
+        return res
+
+    def compute(self) -> Dict[str, Array]:
+        classes = self._get_classes()
+        precision, recall = self._calculate(classes)
+        results = self._summarize_results(precision, recall)
+
+        map_per_class: Any = [-1.0]
+        mar_per_class: Any = [-1.0]
+        if self.class_metrics:
+            map_per_class = []
+            mar_per_class = []
+            for idx_cls in range(len(classes)):
+                cls_prec = precision[:, :, idx_cls : idx_cls + 1]
+                cls_rec = recall[:, idx_cls : idx_cls + 1]
+                cls_res = self._summarize_results(cls_prec, cls_rec)
+                map_per_class.append(cls_res["map"])
+                mar_per_class.append(cls_res[f"mar_{self.max_detection_thresholds[-1]}"])
+
+        out = {k: jnp.asarray(v, dtype=jnp.float32) for k, v in results.items()}
+        # always 1-D, matching the reference's shape contract (sentinel [-1.])
+        out["map_per_class"] = jnp.asarray(np.asarray(map_per_class, dtype=np.float32))
+        out[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(
+            np.asarray(mar_per_class, dtype=np.float32)
+        )
+        return out

@@ -1,0 +1,57 @@
+"""PESQ wrapper (reference ``src/torchmetrics/functional/audio/pesq.py``,
+101 LoC).
+
+PESQ is an ITU-T P.862 C implementation — inherently host-side, like the
+reference's use of the ``pesq`` wheel. This is an explicit host boundary
+(SURVEY.md §7 hard part #4): inputs are pulled to host numpy, scored per
+clip, and the scores returned as a device array. Gated on the optional
+``pesq`` package.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["perceptual_evaluation_speech_quality"]
+
+
+def perceptual_evaluation_speech_quality(preds: Array, target: Array, fs: int, mode: str) -> Array:
+    """PESQ score per clip (reference ``pesq.py:30-101``).
+
+    Args:
+        preds: estimated signal ``[..., time]``.
+        target: reference signal ``[..., time]``.
+        fs: sampling frequency — 8000 or 16000 Hz.
+        mode: ``'wb'`` (wide-band, 16 kHz only) or ``'nb'`` (narrow-band).
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that the `pesq` package is installed."
+            " Install it with `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.ndim == 1:
+        scores = np.float32(pesq_backend.pesq(fs, target_np, preds_np, mode))
+    else:
+        flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+        flat_t = target_np.reshape(-1, target_np.shape[-1])
+        scores = np.asarray(
+            [pesq_backend.pesq(fs, t, p, mode) for t, p in zip(flat_t, flat_p)], dtype=np.float32
+        ).reshape(preds_np.shape[:-1])
+    return jnp.asarray(scores)

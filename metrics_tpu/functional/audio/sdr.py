@@ -1,0 +1,185 @@
+"""Signal-to-distortion ratio kernels (reference
+``src/torchmetrics/functional/audio/sdr.py``, 279 LoC).
+
+TPU-first redesign of the BSS-eval SDR: the optimal distortion filter is
+found from FFT auto/cross-correlations (XLA FFT on device), and the
+``R h = b`` Toeplitz system is solved either by a dense batched
+``jnp.linalg.solve`` (default; an L x L solve is cheap on the MXU for the
+reference's L=512) or by an on-device conjugate-gradient loop whose matvec
+uses circulant embedding — the role the reference delegates to the optional
+``fast_bss_eval`` wheel. Everything runs in fp32: the reference upcasts to
+fp64, which TPUs only emulate; the unit-norm pre-scaling keeps the system
+well-conditioned and the dB-scale result agrees to ~1e-3.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int):
+    """FFT-based autocorrelation of ``target`` and cross-correlation with
+    ``preds`` (reference ``sdr.py:71-116``), truncated to ``corr_len``."""
+    n_fft = _next_pow2(preds.shape[-1] + target.shape[-1] - 1)
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real**2 + t_fft.imag**2, n=n_fft)[..., :corr_len]
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return r_0, b
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix ``M[..., i, j] = vector[..., |i-j|]``
+    (reference ``sdr.py:44-68``) — built by one gather, no strided views."""
+    length = vector.shape[-1]
+    idx = jnp.abs(jnp.arange(length)[:, None] - jnp.arange(length)[None, :])
+    return vector[..., idx]
+
+
+def _toeplitz_matvec(r_0: Array, x: Array, n_fft: int) -> Array:
+    """Multiply the symmetric Toeplitz matrix defined by first row ``r_0``
+    with ``x`` via circulant embedding: one rfft/irfft pair instead of an
+    L x L contraction."""
+    length = r_0.shape[-1]
+    pad = n_fft - (2 * length - 1)
+    circ = jnp.concatenate(
+        [r_0, jnp.zeros(r_0.shape[:-1] + (pad,), r_0.dtype), jnp.flip(r_0[..., 1:], axis=-1)], axis=-1
+    )
+    x_f = jnp.fft.rfft(x, n=n_fft, axis=-1)
+    c_f = jnp.fft.rfft(circ, axis=-1)
+    return jnp.fft.irfft(c_f * x_f, n=n_fft, axis=-1)[..., :length]
+
+
+def _toeplitz_conjugate_gradient(r_0: Array, b: Array, n_iter: int) -> Array:
+    """Plain CG on the SPD Toeplitz system ``R x = b`` with an FFT matvec —
+    the on-device analogue of ``fast_bss_eval``'s solver the reference
+    imports (``sdr.py:38-41``)."""
+    length = r_0.shape[-1]
+    n_fft = _next_pow2(2 * length - 1)
+    eps = jnp.finfo(b.dtype).eps
+
+    x0 = jnp.zeros_like(b)
+    r = b - _toeplitz_matvec(r_0, x0, n_fft)
+    p = r
+    rs = jnp.sum(r * r, axis=-1, keepdims=True)
+
+    def body(_, carry):
+        x, r, p, rs = carry
+        ap = _toeplitz_matvec(r_0, p, n_fft)
+        alpha = rs / (jnp.sum(p * ap, axis=-1, keepdims=True) + eps)
+        x = x + alpha * p
+        r = r - alpha * ap
+        rs_new = jnp.sum(r * r, axis=-1, keepdims=True)
+        p = r + (rs_new / (rs + eps)) * p
+        return x, r, p, rs_new
+
+    x, _, _, _ = lax.fori_loop(0, n_iter, body, (x0, r, p, rs))
+    return x
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR in dB over the last axis (reference ``sdr.py:119-240``).
+
+    Args:
+        preds: estimated signal ``[..., time]``.
+        target: reference signal ``[..., time]``.
+        use_cg_iter: if given, solve the filter system with that many
+            conjugate-gradient iterations (on device) instead of the dense
+            solve. ``10`` is typically enough.
+        filter_length: length of the allowed distortion filter.
+        zero_mean: subtract the per-signal mean first.
+        load_diag: optional diagonal loading to stabilize near-singular
+            autocorrelations (e.g. silent references).
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
+    preds = preds.astype(dtype)
+    target = target.astype(dtype)
+
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+
+    # unit-norm scaling keeps the Toeplitz system well conditioned in fp32
+    target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), min=1e-6)
+    preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), min=1e-6)
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+
+    if load_diag is not None:
+        r_0 = r_0.at[..., 0].add(load_diag)
+
+    if use_cg_iter is not None:
+        sol = _toeplitz_conjugate_gradient(r_0, b, n_iter=use_cg_iter)
+    else:
+        r = _symmetric_toeplitz(r_0)
+        sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+
+    coh = jnp.einsum("...l,...l->...", b, sol)
+
+    # The reference computes the distortion energy as 1 - coh (it runs in
+    # fp64). In fp32 that difference cancels catastrophically above ~40 dB
+    # (and coh can round past 1.0, NaN-ing the log). Instead evaluate the
+    # projection residual ``preds - target (*) sol`` in the time domain —
+    # a sum of small squares, accurate at any SDR, identical to 1 - coh in
+    # exact arithmetic.
+    time_len = preds.shape[-1]
+    out_len = time_len + filter_length - 1
+    n_full = _next_pow2(out_len)
+    proj = jnp.fft.irfft(
+        jnp.fft.rfft(target, n=n_full, axis=-1) * jnp.fft.rfft(sol, n=n_full, axis=-1), n=n_full, axis=-1
+    )[..., :out_len]
+    preds_pad = jnp.concatenate(
+        [preds, jnp.zeros(preds.shape[:-1] + (out_len - time_len,), preds.dtype)], axis=-1
+    )
+    distortion = jnp.sum((preds_pad - proj) ** 2, axis=-1)
+
+    ratio = coh / distortion
+    return 10.0 * jnp.log10(ratio)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR in dB over the last axis (reference ``sdr.py:243-279``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(f"{scale_invariant_signal_distortion_ratio(preds, target):.4f}")
+        18.4030
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(val)

@@ -1,0 +1,50 @@
+"""Signal-to-noise ratio kernels (reference
+``src/torchmetrics/functional/audio/snr.py``, 90 LoC).
+
+Pure elementwise/reduction math over the trailing time axis — jittable,
+vmappable, and shardable over any leading batch axes as-is.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+from metrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR in dB over the last axis (reference ``snr.py:22-66``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(f"{signal_noise_ratio(preds, target):.4f}")
+        16.1805
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR (reference ``snr.py:69-90``): SI-SDR with zero-mean inputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(f"{scale_invariant_signal_noise_ratio(preds, target):.4f}")
+        15.0918
+    """
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

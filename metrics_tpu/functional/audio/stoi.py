@@ -1,0 +1,49 @@
+"""STOI wrapper (reference ``src/torchmetrics/functional/audio/stoi.py``,
+102 LoC).
+
+Same explicit host boundary as PESQ: the ``pystoi`` reference implementation
+runs on host numpy per clip; scores come back as a device array.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+__doctest_skip__ = ["short_time_objective_intelligibility"]
+
+
+def short_time_objective_intelligibility(preds: Array, target: Array, fs: int, extended: bool = False) -> Array:
+    """STOI score per clip (reference ``stoi.py:28-102``).
+
+    Args:
+        preds: estimated signal ``[..., time]``.
+        target: reference signal ``[..., time]``.
+        fs: sampling frequency in Hz.
+        extended: use the extended STOI variant.
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that the `pystoi` package is installed."
+            " Install it with `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.ndim == 1:
+        scores = np.float32(stoi_backend(target_np, preds_np, fs, extended))
+    else:
+        flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+        flat_t = target_np.reshape(-1, target_np.shape[-1])
+        scores = np.asarray(
+            [stoi_backend(t, p, fs, extended) for t, p in zip(flat_t, flat_p)], dtype=np.float32
+        ).reshape(preds_np.shape[:-1])
+    return jnp.asarray(scores)

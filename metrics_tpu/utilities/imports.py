@@ -1,0 +1,18 @@
+"""Optional-dependency probing (reference ``utilities/imports.py:26-125``).
+
+Only the host-side audio backends are gated today; jax/flax/optax are hard
+dependencies of the framework and never probed.
+"""
+import importlib.util
+
+
+def _package_available(name: str) -> bool:
+    """True if ``name`` is importable (reference ``imports.py:26-40``)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")

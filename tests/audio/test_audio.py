@@ -1,0 +1,247 @@
+"""Audio-metric parity (analogue of reference ``test/unittests/audio/``).
+
+Oracles: the importable reference itself (its SNR/SI-SDR math is plain
+tensor algebra; its SDR path runs in float64 — we assert our fp32 on-device
+solve stays within audio-meaningful tolerance of it).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from tests.helpers import seed_all
+from tests.helpers.reference import import_reference
+from tests.helpers.testers import MetricTester, _assert_allclose
+
+seed_all(31)
+# (num_batches, batch, time) fixtures, reference-style strided accumulation
+PREDS = np.random.randn(4, 3, 500).astype(np.float32)
+TARGET = np.random.randn(4, 3, 500).astype(np.float32)
+# correlated pair — the realistic separation regime
+PREDS_C = (TARGET + 0.3 * np.random.randn(4, 3, 500)).astype(np.float32)
+
+
+def _ref_audio(name):
+    import torch
+
+    ref = import_reference()
+    fn = getattr(ref.functional, name)
+
+    def oracle(*arrays, **kwargs):
+        out = fn(*(torch.from_numpy(np.asarray(a)) for a in arrays), **kwargs)
+        return out.numpy()
+
+    return oracle
+
+
+class TestSNR(MetricTester):
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_functional(self, zero_mean):
+        oracle = _ref_audio("signal_noise_ratio")
+        for i in range(2):
+            got = np.asarray(signal_noise_ratio(PREDS_C[i], TARGET[i], zero_mean=zero_mean))
+            np.testing.assert_allclose(got, oracle(PREDS_C[i], TARGET[i], zero_mean=zero_mean), atol=1e-4)
+
+    def test_module(self):
+        oracle = _ref_audio("signal_noise_ratio")
+        self.run_class_metric_test(
+            PREDS_C, TARGET, SignalNoiseRatio, lambda p, t: oracle(p, t).mean(), atol=1e-4
+        )
+
+    def test_sharded(self):
+        oracle = _ref_audio("signal_noise_ratio")
+        self.run_sharded_metric_test(
+            PREDS_C, TARGET, SignalNoiseRatio, lambda p, t: oracle(p, t).mean(), atol=1e-4
+        )
+
+
+class TestSiSNR(MetricTester):
+    def test_functional(self):
+        oracle = _ref_audio("scale_invariant_signal_noise_ratio")
+        for i in range(2):
+            got = np.asarray(scale_invariant_signal_noise_ratio(PREDS_C[i], TARGET[i]))
+            np.testing.assert_allclose(got, oracle(PREDS_C[i], TARGET[i]), atol=1e-4)
+
+    def test_module(self):
+        oracle = _ref_audio("scale_invariant_signal_noise_ratio")
+        self.run_class_metric_test(
+            PREDS_C, TARGET, ScaleInvariantSignalNoiseRatio, lambda p, t: oracle(p, t).mean(), atol=1e-4
+        )
+
+
+class TestSiSDR(MetricTester):
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_functional(self, zero_mean):
+        oracle = _ref_audio("scale_invariant_signal_distortion_ratio")
+        for i in range(2):
+            got = np.asarray(scale_invariant_signal_distortion_ratio(PREDS_C[i], TARGET[i], zero_mean=zero_mean))
+            np.testing.assert_allclose(got, oracle(PREDS_C[i], TARGET[i], zero_mean=zero_mean), atol=1e-4)
+
+    def test_module(self):
+        oracle = _ref_audio("scale_invariant_signal_distortion_ratio")
+        self.run_class_metric_test(
+            PREDS_C, TARGET, ScaleInvariantSignalDistortionRatio, lambda p, t: oracle(p, t).mean(), atol=1e-4
+        )
+
+
+class TestSDR(MetricTester):
+    """SDR: reference solves the filter system in float64; our on-device
+    fp32 solve is compared at dB-scale tolerance."""
+
+    @pytest.mark.parametrize("kwargs", [{}, {"zero_mean": True}, {"load_diag": 1e-6}])
+    def test_functional(self, kwargs):
+        oracle = _ref_audio("signal_distortion_ratio")
+        got = np.asarray(signal_distortion_ratio(PREDS_C[0], TARGET[0], filter_length=128, **kwargs))
+        exp = oracle(PREDS_C[0], TARGET[0], filter_length=128, **kwargs)
+        np.testing.assert_allclose(got, exp, atol=1e-2)
+
+    def test_high_sdr_regime(self):
+        """preds ~ target: the fp32 `1 - coh` cancellation regime — the
+        time-domain residual must track the fp64 reference to ~1e-3 dB."""
+        oracle = _ref_audio("signal_distortion_ratio")
+        rng = np.random.default_rng(0)
+        t = rng.standard_normal(4000).astype(np.float32)
+        for scale in (1e-4, 1e-3, 1e-2):
+            p = (t + scale * rng.standard_normal(4000)).astype(np.float32)
+            got = float(signal_distortion_ratio(p, t, filter_length=128))
+            exp = float(oracle(p, t, filter_length=128))
+            assert exp > 39, "fixture should sit in the high-SDR regime"
+            np.testing.assert_allclose(got, exp, atol=1e-3)
+
+    def test_cg_close_to_direct(self):
+        direct = np.asarray(signal_distortion_ratio(PREDS_C[0], TARGET[0], filter_length=128))
+        cg = np.asarray(signal_distortion_ratio(PREDS_C[0], TARGET[0], filter_length=128, use_cg_iter=30))
+        np.testing.assert_allclose(cg, direct, atol=5e-2)
+
+    def test_module(self):
+        oracle = _ref_audio("signal_distortion_ratio")
+        self.run_class_metric_test(
+            PREDS_C,
+            TARGET,
+            SignalDistortionRatio,
+            lambda p, t: oracle(p, t, filter_length=128).mean(),
+            metric_args={"filter_length": 128},
+            atol=1e-2,
+        )
+
+
+class TestPIT(MetricTester):
+    # [num_batches, batch, spk, time]
+    PIT_PREDS = np.random.randn(3, 4, 2, 100).astype(np.float32)
+    PIT_TARGET = np.random.randn(3, 4, 2, 100).astype(np.float32)
+
+    def _ref_pit(self, p, t, spk=None):
+        import torch
+
+        ref = import_reference()
+        best, _ = ref.functional.permutation_invariant_training(
+            torch.from_numpy(np.asarray(p)), torch.from_numpy(np.asarray(t)),
+            ref.functional.scale_invariant_signal_distortion_ratio, "max",
+        )
+        return best.numpy()
+
+    def test_functional_parity(self):
+        for i in range(2):
+            best, perm = permutation_invariant_training(
+                self.PIT_PREDS[i], self.PIT_TARGET[i], scale_invariant_signal_distortion_ratio, "max"
+            )
+            np.testing.assert_allclose(np.asarray(best), self._ref_pit(self.PIT_PREDS[i], self.PIT_TARGET[i]), atol=1e-4)
+
+    @pytest.mark.parametrize("spk", [3, 4])
+    def test_more_speakers_vs_bruteforce(self, spk):
+        """Exhaustive search against a numpy brute force (covers the regime
+        where the reference switches to scipy linear_sum_assignment)."""
+        from itertools import permutations as iperm
+
+        rng = np.random.default_rng(3)
+        p = rng.standard_normal((2, spk, 64)).astype(np.float32)
+        t = rng.standard_normal((2, spk, 64)).astype(np.float32)
+        best, perm = permutation_invariant_training(p, t, scale_invariant_signal_distortion_ratio, "max")
+
+        def si_sdr_np(est, ref):
+            alpha = (est * ref).sum(-1, keepdims=True) / (ref**2).sum(-1, keepdims=True)
+            noise = alpha * ref - est
+            return 10 * np.log10(((alpha * ref) ** 2).sum(-1) / (noise**2).sum(-1))
+
+        for b in range(p.shape[0]):
+            scores = []
+            for pm in iperm(range(spk)):
+                scores.append(np.mean([si_sdr_np(p[b, pm[j]], t[b, j]) for j in range(spk)]))
+            np.testing.assert_allclose(float(best[b]), max(scores), atol=1e-3)
+
+    def test_permutate(self):
+        perm = np.array([[1, 0], [0, 1]])
+        preds = np.arange(2 * 2 * 3).reshape(2, 2, 3).astype(np.float32)
+        out = np.asarray(pit_permutate(preds, perm))
+        np.testing.assert_allclose(out[0], preds[0][[1, 0]])
+        np.testing.assert_allclose(out[1], preds[1])
+
+    def test_eval_func_min_and_errors(self):
+        best_max, _ = permutation_invariant_training(
+            self.PIT_PREDS[0], self.PIT_TARGET[0], scale_invariant_signal_distortion_ratio, "max"
+        )
+        best_min, _ = permutation_invariant_training(
+            self.PIT_PREDS[0], self.PIT_TARGET[0], scale_invariant_signal_distortion_ratio, "min"
+        )
+        assert (np.asarray(best_max) >= np.asarray(best_min)).all()
+        with pytest.raises(ValueError, match="eval_func"):
+            permutation_invariant_training(
+                self.PIT_PREDS[0], self.PIT_TARGET[0], scale_invariant_signal_distortion_ratio, "median"
+            )
+        with pytest.raises(RuntimeError, match="same shape"):
+            permutation_invariant_training(
+                self.PIT_PREDS[0], self.PIT_TARGET[0][:, :1], scale_invariant_signal_distortion_ratio
+            )
+
+    def test_module(self):
+        self.run_class_metric_test(
+            self.PIT_PREDS,
+            self.PIT_TARGET,
+            PermutationInvariantTraining,
+            lambda p, t: self._ref_pit(p, t).mean(),
+            metric_args={"metric_func": scale_invariant_signal_distortion_ratio, "eval_func": "max"},
+            atol=1e-4,
+        )
+
+    def test_sharded(self):
+        self.run_sharded_metric_test(
+            self.PIT_PREDS,
+            self.PIT_TARGET,
+            PermutationInvariantTraining,
+            lambda p, t: self._ref_pit(p, t).mean(),
+            metric_args={"metric_func": scale_invariant_signal_distortion_ratio, "eval_func": "max"},
+            atol=1e-4,
+        )
+
+
+def test_pesq_stoi_raise_without_backend():
+    """pesq/pystoi are not installed here: the wrappers must fail with an
+    actionable ModuleNotFoundError, not an ImportError at package import."""
+    from metrics_tpu.functional import perceptual_evaluation_speech_quality, short_time_objective_intelligibility
+    from metrics_tpu import PerceptualEvaluationSpeechQuality, ShortTimeObjectiveIntelligibility
+    from metrics_tpu.utilities.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+    p = np.random.randn(8000).astype(np.float32)
+    if not _PESQ_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            perceptual_evaluation_speech_quality(p, p, 16000, "wb")
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            PerceptualEvaluationSpeechQuality(16000, "wb")
+    if not _PYSTOI_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="pystoi"):
+            short_time_objective_intelligibility(p, p, 16000)
+        with pytest.raises(ModuleNotFoundError, match="pystoi"):
+            ShortTimeObjectiveIntelligibility(16000)

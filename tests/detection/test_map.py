@@ -1,0 +1,213 @@
+"""MeanAveragePrecision parity (analogue of reference
+``test/unittests/detection/test_map.py``).
+
+The oracle values are the official pycocotools results for the COCO-sample
+fixture (reference ``test_map.py:190-247`` documents their provenance from
+``cocodataset/cocoapi`` results) — pycocotools/torchvision are not installed
+here, so those published constants are the contract.
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.detection.helpers import box_convert, box_iou
+
+# COCO-sample fixture (image ids 42, 73, 74, 133), reference test_map.py:60-134
+_PREDS = [
+    [
+        dict(
+            boxes=np.array([[258.15, 41.29, 606.41, 285.07]], np.float32),
+            scores=np.array([0.236], np.float32),
+            labels=np.array([4]),
+        ),
+        dict(
+            boxes=np.array([[61.00, 22.75, 565.00, 632.42], [12.66, 3.32, 281.26, 275.23]], np.float32),
+            scores=np.array([0.318, 0.726], np.float32),
+            labels=np.array([3, 2]),
+        ),
+    ],
+    [
+        dict(
+            boxes=np.array(
+                [
+                    [87.87, 276.25, 384.29, 379.43],
+                    [0.00, 3.66, 142.15, 316.06],
+                    [296.55, 93.96, 314.97, 152.79],
+                    [328.94, 97.05, 342.49, 122.98],
+                    [356.62, 95.47, 372.33, 147.55],
+                    [464.08, 105.09, 495.74, 146.99],
+                    [276.11, 103.84, 291.44, 150.72],
+                ],
+                np.float32,
+            ),
+            scores=np.array([0.546, 0.3, 0.407, 0.611, 0.335, 0.805, 0.953], np.float32),
+            labels=np.array([4, 1, 0, 0, 0, 0, 0]),
+        ),
+        dict(
+            boxes=np.array([[0.00, 2.87, 601.00, 421.52]], np.float32),
+            scores=np.array([0.699], np.float32),
+            labels=np.array([5]),
+        ),
+    ],
+]
+_TARGET = [
+    [
+        dict(boxes=np.array([[214.1500, 41.2900, 562.4100, 285.0700]], np.float32), labels=np.array([4])),
+        dict(
+            boxes=np.array([[13.00, 22.75, 548.98, 632.42], [1.66, 3.32, 270.26, 275.23]], np.float32),
+            labels=np.array([2, 2]),
+        ),
+    ],
+    [
+        dict(
+            boxes=np.array(
+                [
+                    [61.87, 276.25, 358.29, 379.43],
+                    [2.75, 3.66, 162.15, 316.06],
+                    [295.55, 93.96, 313.97, 152.79],
+                    [326.94, 97.05, 340.49, 122.98],
+                    [356.62, 95.47, 372.33, 147.55],
+                    [462.08, 105.09, 493.74, 146.99],
+                    [277.11, 103.84, 292.44, 150.72],
+                ],
+                np.float32,
+            ),
+            labels=np.array([4, 1, 0, 0, 0, 0, 0]),
+        ),
+        dict(boxes=np.array([[13.99, 2.87, 640.00, 421.52]], np.float32), labels=np.array([5])),
+    ],
+]
+
+# official pycocotools values (reference test_map.py:190-247)
+_EXPECTED = {
+    "map": 0.706,
+    "map_50": 0.901,
+    "map_75": 0.846,
+    "map_small": 0.689,
+    "map_medium": 0.800,
+    "map_large": 0.701,
+    "mar_1": 0.592,
+    "mar_10": 0.716,
+    "mar_100": 0.716,
+    "mar_small": 0.767,
+    "mar_medium": 0.800,
+    "mar_large": 0.700,
+}
+_EXPECTED_PER_CLASS = {
+    "map_per_class": [0.725, 0.800, 0.454, -1.000, 0.650, 0.900],
+    "mar_100_per_class": [0.780, 0.800, 0.450, -1.000, 0.650, 0.900],
+}
+
+
+def test_map_coco_sample_parity():
+    metric = MeanAveragePrecision(class_metrics=True)
+    for preds, target in zip(_PREDS, _TARGET):
+        metric.update(preds, target)
+    result = metric.compute()
+    for key, exp in _EXPECTED.items():
+        np.testing.assert_allclose(float(result[key]), exp, atol=1e-2, err_msg=key)
+    for key, exp in _EXPECTED_PER_CLASS.items():
+        np.testing.assert_allclose(np.asarray(result[key]), exp, atol=1e-2, err_msg=key)
+
+
+def test_map_single_box():
+    """Reference class doctest (``mean_ap.py:243-276``)."""
+    metric = MeanAveragePrecision()
+    metric.update(
+        [dict(boxes=np.array([[258.0, 41.0, 606.0, 285.0]], np.float32), scores=np.array([0.536], np.float32), labels=np.array([0]))],
+        [dict(boxes=np.array([[214.0, 41.0, 562.0, 285.0]], np.float32), labels=np.array([0]))],
+    )
+    result = metric.compute()
+    np.testing.assert_allclose(float(result["map"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_50"]), 1.0, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_75"]), 1.0, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_large"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(float(result["map_medium"]), -1.0, atol=1e-4)
+    np.testing.assert_allclose(float(result["mar_1"]), 0.6, atol=1e-4)
+    np.testing.assert_allclose(float(result["mar_100"]), 0.6, atol=1e-4)
+
+
+def test_map_empty_preds_and_gt_missing():
+    """False-negative-only image (reference issues #943/#981 cases)."""
+    metric = MeanAveragePrecision()
+    metric.update(
+        [dict(boxes=np.zeros((0, 4), np.float32), scores=np.zeros(0, np.float32), labels=np.zeros(0, np.int64))],
+        [dict(boxes=np.array([[1.0, 2.0, 3.0, 4.0]], np.float32), labels=np.array([1]))],
+    )
+    result = metric.compute()
+    np.testing.assert_allclose(float(result["map"]), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(result["mar_100"]), 0.0, atol=1e-6)
+
+    # detection with no gt in its image still counts as FP globally
+    metric2 = MeanAveragePrecision()
+    metric2.update(
+        [
+            dict(boxes=np.array([[258.0, 41.0, 606.0, 285.0]], np.float32), scores=np.array([0.536], np.float32), labels=np.array([0])),
+            dict(boxes=np.array([[258.0, 41.0, 606.0, 285.0]], np.float32), scores=np.array([0.536], np.float32), labels=np.array([0])),
+        ],
+        [
+            dict(boxes=np.array([[214.0, 41.0, 562.0, 285.0]], np.float32), labels=np.array([0])),
+            dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros(0, np.int64)),
+        ],
+    )
+    result2 = metric2.compute()
+    assert 0.0 < float(result2["map"]) <= 0.6 + 1e-6
+
+
+def test_map_segm_perfect_and_half():
+    """Native mask IoU (the reference needs pycocotools for this path)."""
+    m1 = np.zeros((1, 10, 10), bool)
+    m1[0, :5, :5] = True
+    m2 = np.zeros((1, 10, 10), bool)
+    m2[0, :5, :] = True  # IoU vs m1 = 25/50 = 0.5
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(
+        [dict(masks=m1, scores=np.array([0.9], np.float32), labels=np.array([0]))],
+        [dict(masks=m1.copy(), labels=np.array([0]))],
+    )
+    result = metric.compute()
+    np.testing.assert_allclose(float(result["map"]), 1.0, atol=1e-6)
+
+    metric2 = MeanAveragePrecision(iou_type="segm", iou_thresholds=[0.4, 0.6])
+    metric2.update(
+        [dict(masks=m2, scores=np.array([0.9], np.float32), labels=np.array([0]))],
+        [dict(masks=m1.copy(), labels=np.array([0]))],
+    )
+    result2 = metric2.compute()
+    np.testing.assert_allclose(float(result2["map"]), 0.5, atol=1e-6)  # hit at 0.4, miss at 0.6
+
+
+def test_map_input_validation():
+    metric = MeanAveragePrecision()
+    with pytest.raises(ValueError, match="same length"):
+        metric.update([], [dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros(0, np.int64))])
+    with pytest.raises(ValueError, match="boxes"):
+        metric.update([dict(scores=np.zeros(0, np.float32), labels=np.zeros(0, np.int64))], [dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros(0, np.int64))])
+    with pytest.raises(ValueError, match="box_format"):
+        MeanAveragePrecision(box_format="xxyy")
+    with pytest.raises(ValueError, match="iou_type"):
+        MeanAveragePrecision(iou_type="mask")
+    with pytest.raises(ValueError, match="class_metrics"):
+        MeanAveragePrecision(class_metrics="yes")
+
+
+def test_box_helpers():
+    xywh = np.array([[10.0, 20.0, 30.0, 40.0]], np.float32)
+    xyxy = np.asarray(box_convert(xywh, "xywh", "xyxy"))
+    np.testing.assert_allclose(xyxy, [[10, 20, 40, 60]])
+    cxcywh = np.asarray(box_convert(xyxy, "xyxy", "cxcywh"))
+    np.testing.assert_allclose(cxcywh, [[25, 40, 30, 40]])
+    a = np.array([[0, 0, 10, 10]], np.float32)
+    b = np.array([[5, 5, 15, 15], [20, 20, 30, 30]], np.float32)
+    iou = np.asarray(box_iou(a, b))
+    np.testing.assert_allclose(iou, [[25 / 175, 0.0]], atol=1e-6)
+
+
+def test_map_box_format_xywh():
+    metric = MeanAveragePrecision(box_format="xywh")
+    metric.update(
+        [dict(boxes=np.array([[258.0, 41.0, 348.0, 244.0]], np.float32), scores=np.array([0.536], np.float32), labels=np.array([0]))],
+        [dict(boxes=np.array([[214.0, 41.0, 348.0, 244.0]], np.float32), labels=np.array([0]))],
+    )
+    result = metric.compute()
+    np.testing.assert_allclose(float(result["map"]), 0.6, atol=1e-4)
