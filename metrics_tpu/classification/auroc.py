@@ -4,11 +4,18 @@
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.functional.classification.auroc import (
+    _auroc_compute,
+    _auroc_update,
+    _binary_auroc_masked,
+    _multiclass_auroc_masked,
+)
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.enums import AverageMethod, DataType
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append
 
 Array = jax.Array
 
@@ -16,9 +23,17 @@ Array = jax.Array
 class AUROC(Metric):
     """Area under the ROC curve (reference ``auroc.py:27-195``).
 
-    Raw preds/target accumulate in ``cat`` list states (the reference's
-    all_gather-heavy pattern, SURVEY.md §2.5); compute runs eagerly on the
-    concatenation.
+    Two accumulation modes:
+
+    - default: raw preds/target accumulate in ``cat`` list states (the
+      reference's all_gather-heavy pattern, SURVEY.md §2.5); compute runs
+      eagerly on the concatenation.
+    - ``capacity=N``: a fixed-size :class:`CatBuffer` ring state — update,
+      compute, and cross-device sync are all static-shape and fully
+      jittable (compute is the tie-averaged rank statistic, identical to
+      the trapezoidal ROC area). This is the form that lives inside a
+      compiled training step / ``functionalize``. Samples past capacity
+      are dropped.
     """
 
     is_differentiable = False
@@ -31,6 +46,7 @@ class AUROC(Metric):
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
         max_fpr: Optional[float] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -38,6 +54,7 @@ class AUROC(Metric):
         self.pos_label = pos_label
         self.average = average
         self.max_fpr = max_fpr
+        self.capacity = capacity
 
         allowed_average = (AverageMethod.MICRO, AverageMethod.MACRO, AverageMethod.WEIGHTED, AverageMethod.NONE, None, "none")
         if average not in allowed_average:
@@ -47,12 +64,43 @@ class AUROC(Metric):
         if max_fpr is not None and (not isinstance(max_fpr, float) or not 0 < max_fpr <= 1):
             raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
 
-        self.mode: Optional[DataType] = None
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if capacity is not None:
+            # static-shape mode: the data mode is fixed at construction
+            # (binary unless num_classes declares one-vs-rest multiclass)
+            if max_fpr is not None:
+                raise ValueError("`max_fpr` is not supported together with `capacity` (static-shape) mode")
+            if average == AverageMethod.MICRO:
+                raise ValueError("`average='micro'` is not supported together with `capacity` mode")
+            if pos_label not in (None, 1):
+                raise ValueError("`pos_label` other than 1 is not supported together with `capacity` mode")
+            self.mode = DataType.MULTICLASS if num_classes and num_classes > 1 else DataType.BINARY
+            row = (num_classes,) if self.mode == DataType.MULTICLASS else ()
+            self.add_state("preds", default=CatBuffer.zeros(capacity, row, jnp.float32), dist_reduce_fx="cat")
+            self.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.int32), dist_reduce_fx="cat")
+        else:
+            self.mode: Optional[DataType] = None
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
-        """Reference ``auroc.py:160-175``."""
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """Reference ``auroc.py:160-175``.
+
+        ``valid`` is accepted in capacity mode only: a per-row bool mask so
+        sharded SPMD updates can contribute ragged sample counts from
+        equal-shaped blocks (e.g. a final partial batch per device).
+        """
+        if self.capacity is not None:
+            preds = jnp.asarray(preds)
+            target = jnp.asarray(target)
+            if self.mode == DataType.MULTICLASS and preds.ndim != 2:
+                raise ValueError("capacity-mode multiclass AUROC expects (N, C) scores")
+            if self.mode == DataType.BINARY and preds.ndim != 1:
+                raise ValueError("capacity-mode binary AUROC expects (N,) scores")
+            self.preds = cat_append(self.preds, preds, valid)
+            self.target = cat_append(self.target, target.astype(jnp.int32), valid)
+            return
+        if valid is not None:
+            raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")
         preds, target, mode = _auroc_update(preds, target)
         self.preds.append(preds)
         self.target.append(target)
@@ -67,6 +115,12 @@ class AUROC(Metric):
         """Reference ``auroc.py:177-195``."""
         if not self.mode:
             raise RuntimeError("You have to have determined mode.")
+        if self.capacity is not None:
+            if self.mode == DataType.MULTICLASS:
+                return _multiclass_auroc_masked(
+                    self.preds.data, self.target.data, self.preds.mask, self.num_classes, self.average
+                )
+            return _binary_auroc_masked(self.preds.data, self.target.data, self.preds.mask)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _auroc_compute(

@@ -225,10 +225,10 @@ class MetricCollection:
             for m in additional_metrics:
                 (metrics if isinstance(m, Metric) else remain).append(m)
             if remain:
-                raise ValueError(f"You have passes extra arguments {remain} which are not Metrics.")
+                raise ValueError(f"Received extra arguments {remain} that are not metrics.")
         elif additional_metrics:
             raise ValueError(
-                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f"Received extra arguments {additional_metrics} that are not compatible"
                 " with first passed dictionary."
             )
 

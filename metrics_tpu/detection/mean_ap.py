@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.detection.helpers import box_area, box_convert, box_iou
+from metrics_tpu.detection.helpers import box_convert
 from metrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -146,13 +146,20 @@ class MeanAveragePrecision(Metric):
         return sorted(np.unique(np.concatenate([np.asarray(la) for la in labels])).astype(int).tolist())
 
     def _area(self, items: np.ndarray) -> np.ndarray:
+        # numpy, not jnp: this runs inside the per-(image, class) host loop
+        # where a device dispatch per call would dominate compute() wall time
         if self.iou_type == "bbox":
-            return np.asarray(box_area(jnp.asarray(items)))
+            return (items[:, 2] - items[:, 0]) * (items[:, 3] - items[:, 1])
         return items.reshape(items.shape[0], -1).sum(-1).astype(np.float64)
 
     def _iou(self, det: np.ndarray, gt: np.ndarray) -> np.ndarray:
         if self.iou_type == "bbox":
-            return np.asarray(box_iou(jnp.asarray(det), jnp.asarray(gt)))
+            lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+            rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[..., 0] * wh[..., 1]
+            union = self._area(det)[:, None] + self._area(gt)[None, :] - inter
+            return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
         return _mask_iou(det, gt)
 
     def _prepare_image_class(self, idx: int, class_id: int, max_det: int) -> Optional[Dict[str, np.ndarray]]:

@@ -141,3 +141,57 @@ def auroc(
     """
     preds, target, mode = _auroc_update(preds, target)
     return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)
+
+
+# --------------------------------------------------------------------------
+# Masked (static-shape) AUROC — the jittable compute for CatBuffer states
+# --------------------------------------------------------------------------
+
+
+def _binary_auroc_masked(preds: Array, target: Array, mask: Array) -> Array:
+    """AUROC of the rows where ``mask`` is True, as the tie-averaged rank
+    statistic (Mann-Whitney U) — exactly the trapezoidal ROC area the eager
+    kernel computes, but with static shapes: one sort + two searchsorteds,
+    no data-dependent thresholds. Designed for :class:`CatBuffer` states
+    (padding rows are zero-weight).
+    """
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target)
+    mask = jnp.asarray(mask, bool)
+    pos = mask & (target == 1)
+    neg = mask & (target != 1)
+    n_pos = jnp.sum(pos.astype(jnp.float32))
+    n_neg = jnp.sum(neg.astype(jnp.float32))
+    # negatives sorted with padding pushed to +inf (never counted as "less")
+    neg_sorted = jnp.sort(jnp.where(neg, preds, jnp.inf))
+    less = jnp.searchsorted(neg_sorted, preds, side="left").astype(jnp.float32)
+    leq = jnp.searchsorted(neg_sorted, preds, side="right").astype(jnp.float32)
+    u = jnp.sum(jnp.where(pos, less + 0.5 * (leq - less), 0.0))
+    return u / (n_pos * n_neg)
+
+
+def _multiclass_auroc_masked(
+    preds: Array,
+    target: Array,
+    mask: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+) -> Array:
+    """One-vs-rest masked AUROC over a ``(cap, C)`` score buffer."""
+    per_class = jax.vmap(
+        lambda c: _binary_auroc_masked(preds[:, c], (target == c).astype(jnp.int32), mask)
+    )(jnp.arange(num_classes))
+    if average in (AverageMethod.NONE, "none", None):
+        return per_class
+    # classes absent from the buffer (no positives or no negatives) are NaN
+    # (0/0); averages are taken over the defined classes only
+    counts = jax.vmap(lambda c: jnp.sum((mask & (target == c)).astype(jnp.float32)))(jnp.arange(num_classes))
+    n_valid = jnp.sum(mask.astype(jnp.float32))
+    defined = (counts > 0) & (counts < n_valid)
+    safe = jnp.where(defined, per_class, 0.0)
+    if average == AverageMethod.MACRO:
+        return jnp.sum(safe) / jnp.sum(defined.astype(jnp.float32))
+    if average == AverageMethod.WEIGHTED:
+        weights = jnp.where(defined, counts, 0.0)
+        return jnp.sum(safe * weights / jnp.sum(weights))
+    raise ValueError(f"Average {average!r} is not supported in masked AUROC")

@@ -124,7 +124,7 @@ def bert_score(
     pred_emb, pred_mask, pred_ids = _encode(preds, encoder, max_length)
     target_emb, target_mask, target_ids = _encode(target, encoder, max_length)
     if pred_emb.shape[0] != target_emb.shape[0]:
-        raise ValueError("Number of predicted and reference sententes must be the same!")
+        raise ValueError("Expected the same number of predicted and reference sentences.")
 
     length = max(pred_emb.shape[1], target_emb.shape[1])
     pred_emb, pred_mask, pred_ids = (_pad_to(a, length) for a in (pred_emb, pred_mask, pred_ids))

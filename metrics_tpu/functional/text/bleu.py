@@ -51,8 +51,11 @@ def _bleu_score_update(
     for pred, refs in zip(pred_tokens, target_tokens):
         preds_len += len(pred)
         ref_lens = [len(ref) for ref in refs]
-        closest = min(ref_lens, key=lambda ref_len: (abs(len(pred) - ref_len), ref_len))
-        target_len += closest
+        # closest reference length; ties break to the first reference in list
+        # order (the reference's convention — nltk instead breaks to the
+        # shortest, which diverges on corpora with tied |len-diff|)
+        diffs = [abs(len(pred) - ref_len) for ref_len in ref_lens]
+        target_len += ref_lens[diffs.index(min(diffs))]
         pred_counter = _count_ngram(pred, n_gram)
         ref_counter: Counter = Counter()
         for ref in refs:

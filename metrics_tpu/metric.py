@@ -142,9 +142,13 @@ class Metric:
         ``default`` is either an array (fixed-shape accumulator) or an empty
         list (a ``cat`` state — batches appended, concatenated lazily).
         """
-        if not isinstance(default, list) or default:
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        if isinstance(default, CatBuffer):
+            pass  # static-shape concat state (jittable cat)
+        elif not isinstance(default, list) or default:
             if not isinstance(default, (jax.Array, np.ndarray, int, float)):
-                raise ValueError("state variable must be an array or an empty list (any value)")
+                raise ValueError("state variable must be an array, a CatBuffer, or an empty list (any value)")
             default = jnp.asarray(default)
         if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", None) and not callable(dist_reduce_fx):
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
@@ -415,7 +419,14 @@ class Metric:
             elif reduce_fn == "min":
                 merged[name] = jnp.minimum(g, b)
             elif reduce_fn == "cat" or (reduce_fn is None and isinstance(g, list)):
-                merged[name] = list(g) + list(b)
+                from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append
+
+                if isinstance(g, CatBuffer):
+                    # fold the batch buffer's valid rows into the global ring
+                    # (capacity preserved; overflow rows drop, as in update)
+                    merged[name] = cat_append(g, b.data, valid=b.mask)
+                else:
+                    merged[name] = list(g) + list(b)
             elif callable(reduce_fn):
                 # same contract as every other call site (and reference
                 # ``metric.py:344``): one stacked array argument
@@ -445,7 +456,20 @@ class Metric:
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
         """Gather + reduce every state across processes (reference ``metric.py:348-374``)."""
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
         input_dict = {attr: self._state[attr] for attr in self._reductions}
+        # CatBuffer states: gather data and mask; the union of valid rows is
+        # the stacked buffers (masked rows stay masked)
+        for attr, value in list(input_dict.items()):
+            if isinstance(value, CatBuffer):
+                group = self.process_group if process_group is None else process_group
+                data = jnp.concatenate(dist_sync_fn(value.data, group), axis=0)
+                mask = jnp.concatenate(dist_sync_fn(value.mask, group), axis=0)
+                self._state[attr] = CatBuffer(data=data, mask=mask)
+                del input_dict[attr]
+        if not input_dict:
+            return
         for attr, reduction_fn in self._reductions.items():
             # pre-concat list states to minimize gathers (reference ``metric.py:352-354``)
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
@@ -459,6 +483,8 @@ class Metric:
         }
 
         for attr, reduction_fn in self._reductions.items():
+            if attr not in output_dict:  # CatBuffer states handled above
+                continue
             out = output_dict[attr]
             if isinstance(self._state[attr], list):
                 self._state[attr] = _flatten(out) if out else []

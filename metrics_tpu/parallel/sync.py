@@ -93,11 +93,27 @@ def _all_gather_invariant(value: Array, axis_name: str) -> Array:
     return jax.lax.psum(out, axis_name)
 
 
+def sync_cat_buffer(buffer: Any, axis_name: str) -> Any:
+    """Cross-device union of a :class:`CatBuffer`: gather data and mask and
+    stack along capacity — masked rows stay masked, so the result is a valid
+    (bigger) buffer with no ragged-shape handling."""
+    from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+    data = sync_leaf(buffer.data, "cat", axis_name)
+    mask = sync_leaf(buffer.mask, "cat", axis_name)
+    return CatBuffer(data=data, mask=mask)
+
+
 def sync_state(state: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: str) -> Dict[str, Any]:
     """Sync a metric-state dict across ``axis_name`` (explicit-collective regime)."""
+    from metrics_tpu.utilities.ringbuffer import CatBuffer
+
     out = {}
     for name, value in state.items():
         fx = reductions[name]
+        if isinstance(value, CatBuffer):
+            out[name] = sync_cat_buffer(value, axis_name)
+            continue
         if isinstance(value, (list, tuple)):
             value = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
             fx = "cat" if fx in ("cat", None) else fx
@@ -118,6 +134,8 @@ def fused_sync(
     all_gather loop (``metric.py:356``): a ``MetricCollection`` of K metrics
     with S scalar states costs **1** ICI collective instead of ``2*K*S``.
     """
+    from metrics_tpu.utilities.ringbuffer import CatBuffer
+
     buckets: Dict[Tuple[str, Any], List[Tuple[int, str, Array]]] = {}
     passthrough: List[Tuple[int, str, Array, Reduction]] = []
     for i, (state, reds) in enumerate(zip(states, reductions)):
@@ -137,6 +155,9 @@ def fused_sync(
             out[i][name] = jax.lax.dynamic_slice_in_dim(synced, offset, v.size).reshape(v.shape)
             offset += v.size
     for (i, name, value, fx) in passthrough:
+        if isinstance(value, CatBuffer):
+            out[i][name] = sync_cat_buffer(value, axis_name)
+            continue
         if isinstance(value, (list, tuple)):
             value = jnp.concatenate([jnp.atleast_1d(x) for x in value], axis=0) if value else jnp.zeros((0,))
             fx = "cat" if fx in ("cat", None) else fx

@@ -43,16 +43,18 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     The metric instance is used as a *template*: its (unwrapped) update and
     compute bodies are traced with state passed explicitly, so the returned
     functions are pure and safe under ``jit``/``shard_map``/``vmap``. Metrics
-    with list (``cat``) states are not functionalizable yet — use their
-    binned/static-capacity variants inside compiled code.
+    with unbounded list (``cat``) states are not functionalizable — construct
+    them with a fixed ``capacity=N`` (a :class:`CatBuffer` ring state, e.g.
+    ``AUROC(capacity=N)``) or use the binned variants inside compiled code.
     """
     from metrics_tpu.metric import Metric  # local import to avoid cycle
 
     assert isinstance(metric, Metric)
     if any(isinstance(d, list) for d in metric._defaults.values()):
         raise ValueError(
-            f"{type(metric).__name__} has list ('cat') states and cannot be functionalized; "
-            "use its binned / fixed-capacity variant inside compiled code."
+            f"{type(metric).__name__} has unbounded list ('cat') states and cannot be functionalized; "
+            "construct it with capacity=N (CatBuffer ring state) or use its binned variant "
+            "inside compiled code."
         )
     if not metric.jittable_update or not metric.jittable_compute:
         raise ValueError(
@@ -106,10 +108,14 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
                 f"{type(metric).__name__} has 'mean'-reduced state; merge() needs count_a/count_b "
                 "(the number of updates folded into each side) to combine correctly."
             )
+        from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_concat
+
         merged: Dict[str, Any] = {}
         for name, fx in reductions.items():
             a, b = state_a[name], state_b[name]
-            if fx == "sum":
+            if isinstance(a, CatBuffer):
+                merged[name] = cat_concat(a, b)
+            elif fx == "sum":
                 merged[name] = a + b
             elif fx == "mean":
                 merged[name] = (a * count_a + b * count_b) / (count_a + count_b)

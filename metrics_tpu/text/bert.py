@@ -68,7 +68,7 @@ class BERTScore(Metric):
         pred_emb, pred_mask, pred_ids = _encode(preds, self.encoder, self.max_length)
         target_emb, target_mask, target_ids = _encode(target, self.encoder, self.max_length)
         if pred_emb.shape[0] != target_emb.shape[0]:
-            raise ValueError("Number of predicted and reference sententes must be the same!")
+            raise ValueError("Expected the same number of predicted and reference sentences.")
         self.pred_embeddings.append(jnp.asarray(pred_emb))
         self.pred_masks.append(jnp.asarray(pred_mask))
         self.pred_ids.append(jnp.asarray(pred_ids))

@@ -1,0 +1,106 @@
+"""Capacity-bounded cat-state buffers — the XLA-compatible form of the
+reference's unbounded ``cat`` list states (SURVEY.md §7 hard part #1).
+
+The reference accumulates raw predictions in growing Python lists
+(``classification/auroc.py:137-138``), which cannot live inside compiled
+code. A :class:`CatBuffer` is the static-shape equivalent: a preallocated
+``(capacity, *row_shape)`` array plus a validity mask. ``append`` is a
+scatter at the current fill level (out-of-capacity rows are dropped, the
+mask saturates), so update/compute/sync all trace into fixed-shape XLA
+programs, and the cross-device union is just an ``all_gather`` of data and
+mask — no ragged-shape dance.
+
+Compute kernels consume the buffer as (data, mask) and treat masked-out rows
+as zero-weight samples.
+"""
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+class CatBuffer:
+    """A fixed-capacity concat state: ``data (cap, *row)`` + ``mask (cap,)``."""
+
+    __slots__ = ("data", "mask")
+
+    def __init__(self, data: Array, mask: Array) -> None:
+        self.data = data
+        self.mask = mask
+
+    # pytree protocol ---------------------------------------------------
+    def tree_flatten(self) -> Tuple[Tuple[Array, Array], None]:
+        return (self.data, self.mask), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux: None, children: Tuple[Array, Array]) -> "CatBuffer":
+        return cls(*children)
+
+    # constructors ------------------------------------------------------
+    @classmethod
+    def zeros(cls, capacity: int, row_shape: Sequence[int] = (), dtype: Any = jnp.float32) -> "CatBuffer":
+        return cls(
+            data=jnp.zeros((capacity, *row_shape), dtype),
+            mask=jnp.zeros((capacity,), bool),
+        )
+
+    # properties --------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def count(self) -> Array:
+        """Number of valid rows (traced value)."""
+        return jnp.sum(self.mask.astype(jnp.int32))
+
+    def values(self) -> Array:
+        """Concrete valid rows — eager/host use only (boolean indexing does
+        not trace; compiled code consumes ``data``/``mask`` directly)."""
+        import numpy as np
+
+        return jnp.asarray(np.asarray(self.data)[np.asarray(self.mask)])
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"CatBuffer(capacity={self.capacity}, row_shape={self.data.shape[1:]}, dtype={self.data.dtype})"
+
+
+def cat_append(buffer: CatBuffer, rows: Array, valid: Array = None) -> CatBuffer:
+    """Append ``rows`` (leading axis = batch) at the current fill level.
+
+    Fully jittable: a scatter with ``mode='drop'`` — rows past capacity are
+    silently dropped and the mask saturates, keeping shapes static. (The
+    unbounded-list eager mode remains available for exact semantics.)
+
+    ``valid`` (optional bool ``(batch,)``) appends only the flagged rows,
+    compacted — the ragged-shard case: devices in an SPMD step can each
+    contribute a different (traced) number of samples from equal-shaped
+    blocks, e.g. a final partial batch.
+    """
+    rows = jnp.asarray(rows)
+    if rows.shape[1:] != buffer.data.shape[1:]:
+        raise ValueError(
+            f"Row shape {rows.shape[1:]} does not match buffer row shape {buffer.data.shape[1:]}"
+        )
+    count = buffer.count()
+    if valid is None:
+        idx = count + jnp.arange(rows.shape[0])
+    else:
+        valid = jnp.asarray(valid, bool)
+        # compact valid rows to consecutive slots; invalid rows scatter
+        # out-of-bounds and are dropped
+        idx = jnp.where(valid, count + jnp.cumsum(valid) - 1, buffer.capacity)
+    return CatBuffer(
+        data=buffer.data.at[idx].set(rows.astype(buffer.data.dtype), mode="drop"),
+        mask=buffer.mask.at[idx].set(True, mode="drop"),
+    )
+
+
+def cat_concat(a: CatBuffer, b: CatBuffer) -> CatBuffer:
+    """Union of two buffers (capacity grows; used by merge/sync)."""
+    return CatBuffer(
+        data=jnp.concatenate([a.data, b.data], axis=0),
+        mask=jnp.concatenate([a.mask, b.mask], axis=0),
+    )

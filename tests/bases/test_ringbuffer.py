@@ -1,0 +1,241 @@
+"""CatBuffer ring states + capacity-mode AUROC (SURVEY.md §7 hard part #1).
+
+The static-shape answer to the reference's unbounded ``cat`` list states:
+everything here must hold under jit/shard_map, with sklearn as oracle.
+"""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from sklearn.metrics import roc_auc_score
+
+import metrics_tpu as mt
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, cat_concat
+from tests.helpers import seed_all
+
+seed_all(41)
+PREDS = np.random.rand(320).astype(np.float32)
+PREDS[50:100] = PREDS[0]  # tie block — rank statistic must average ties
+TARGET = np.random.randint(0, 2, 320)
+
+
+class TestCatBuffer:
+    def test_append_and_values(self):
+        buf = CatBuffer.zeros(8)
+        buf = cat_append(buf, jnp.asarray([1.0, 2.0]))
+        buf = cat_append(buf, jnp.asarray([3.0]))
+        assert int(buf.count()) == 3
+        np.testing.assert_allclose(np.asarray(buf.values()), [1.0, 2.0, 3.0])
+
+    def test_overflow_drops_and_saturates(self):
+        buf = CatBuffer.zeros(4)
+        buf = cat_append(buf, jnp.asarray([1.0, 2.0, 3.0]))
+        buf = cat_append(buf, jnp.asarray([4.0, 5.0, 6.0]))  # 5, 6 dropped
+        assert int(buf.count()) == 4
+        np.testing.assert_allclose(np.asarray(buf.values()), [1.0, 2.0, 3.0, 4.0])
+
+    def test_valid_mask_compacts(self):
+        buf = CatBuffer.zeros(8)
+        buf = cat_append(buf, jnp.asarray([1.0, 2.0, 3.0, 4.0]), valid=jnp.asarray([True, False, True, False]))
+        assert int(buf.count()) == 2
+        np.testing.assert_allclose(np.asarray(buf.values()), [1.0, 3.0])
+        buf = cat_append(buf, jnp.asarray([5.0]))
+        np.testing.assert_allclose(np.asarray(buf.values()), [1.0, 3.0, 5.0])
+
+    def test_append_jits(self):
+        buf = CatBuffer.zeros(16)
+        step = jax.jit(cat_append)
+        for i in range(3):
+            buf = step(buf, jnp.arange(4, dtype=jnp.float32) + i)
+        assert int(buf.count()) == 12
+
+    def test_concat(self):
+        a = cat_append(CatBuffer.zeros(4), jnp.asarray([1.0]))
+        b = cat_append(CatBuffer.zeros(4), jnp.asarray([2.0, 3.0]))
+        c = cat_concat(a, b)
+        assert c.capacity == 8 and int(c.count()) == 3
+        np.testing.assert_allclose(sorted(np.asarray(c.values())), [1.0, 2.0, 3.0])
+
+    def test_row_shape_mismatch(self):
+        with pytest.raises(ValueError, match="Row shape"):
+            cat_append(CatBuffer.zeros(4, (3,)), jnp.zeros((2, 5)))
+
+
+class TestCapacityAUROC:
+    def test_binary_parity_with_ties(self):
+        m_cap = mt.AUROC(capacity=512)
+        m_list = mt.AUROC()
+        for i in range(4):
+            sl = slice(i * 80, (i + 1) * 80)
+            m_cap.update(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]))
+            m_list.update(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]))
+        sk = roc_auc_score(TARGET, PREDS)
+        np.testing.assert_allclose(float(m_cap.compute()), sk, atol=1e-6)
+        np.testing.assert_allclose(float(m_cap.compute()), float(m_list.compute()), atol=1e-6)
+
+    @pytest.mark.parametrize("average", ["macro", "weighted", None])
+    def test_multiclass_parity(self, average):
+        rng = np.random.default_rng(3)
+        C = 5
+        p = rng.random((400, C)).astype(np.float32)
+        p /= p.sum(1, keepdims=True)
+        t = rng.integers(0, C, 400)
+        m = mt.AUROC(num_classes=C, capacity=512, average=average)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        got = np.asarray(m.compute())
+        if average is None:
+            exp = [roc_auc_score((t == c).astype(int), p[:, c]) for c in range(C)]
+        else:
+            exp = roc_auc_score(t, p, multi_class="ovr", average=average)
+        np.testing.assert_allclose(got, exp, atol=1e-5)
+
+    def test_capacity_overflow_drops_tail(self):
+        m = mt.AUROC(capacity=100)
+        m.update(jnp.asarray(PREDS), jnp.asarray(TARGET))  # 320 rows -> first 100 kept
+        sk = roc_auc_score(TARGET[:100], PREDS[:100])
+        np.testing.assert_allclose(float(m.compute()), sk, atol=1e-6)
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError, match="max_fpr"):
+            mt.AUROC(capacity=16, max_fpr=0.5)
+        with pytest.raises(ValueError, match="micro"):
+            mt.AUROC(capacity=16, average="micro")
+        with pytest.raises(ValueError, match="valid"):
+            mt.AUROC().update(jnp.asarray(PREDS[:4]), jnp.asarray(TARGET[:4]), valid=jnp.ones(4, bool))
+
+    def test_forward_protocol(self):
+        """m(batch) must work in capacity mode: batch value + global fold."""
+        m = mt.AUROC(capacity=512)
+        vals = []
+        for i in range(4):
+            sl = slice(i * 80, (i + 1) * 80)
+            vals.append(float(m(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]))))
+            np.testing.assert_allclose(
+                vals[-1], roc_auc_score(TARGET[sl], PREDS[sl]), atol=1e-6
+            )
+        np.testing.assert_allclose(float(m.compute()), roc_auc_score(TARGET, PREDS), atol=1e-6)
+
+    def test_absent_class_averaging(self):
+        """A class missing from the buffer must not NaN macro/weighted."""
+        rng = np.random.default_rng(7)
+        C = 4
+        p = rng.random((100, C)).astype(np.float32)
+        t = rng.integers(0, C - 1, 100)  # class 3 never appears
+        for avg in ("macro", "weighted"):
+            m = mt.AUROC(num_classes=C, capacity=128, average=avg)
+            m.update(jnp.asarray(p), jnp.asarray(t))
+            got = float(m.compute())
+            assert np.isfinite(got), avg
+            exp = roc_auc_score(t, p[:, : C - 1] / p[:, : C - 1].sum(1, keepdims=True),
+                                multi_class="ovr", average=avg, labels=list(range(C - 1)))
+            # sklearn renormalizes scores over present classes; ours keeps raw
+            # per-class scores, so compare per-class instead
+            per = mt.AUROC(num_classes=C, capacity=128, average=None)
+            per.update(jnp.asarray(p), jnp.asarray(t))
+            per_vals = np.asarray(per.compute())
+            assert np.isnan(per_vals[C - 1])
+            defined = per_vals[: C - 1]
+            if avg == "macro":
+                np.testing.assert_allclose(got, defined.mean(), atol=1e-6)
+            else:
+                w = np.array([(t == c).sum() for c in range(C - 1)], np.float32)
+                np.testing.assert_allclose(got, (defined * w / w.sum()).sum(), atol=1e-6)
+
+    def test_pos_label_rejected_in_capacity_mode(self):
+        with pytest.raises(ValueError, match="pos_label"):
+            mt.AUROC(capacity=16, pos_label=0)
+
+    def test_pickle_and_reset(self):
+        m = mt.AUROC(capacity=64)
+        m.update(jnp.asarray(PREDS[:32]), jnp.asarray(TARGET[:32]))
+        m2 = pickle.loads(pickle.dumps(m))
+        np.testing.assert_allclose(float(m2.compute()), float(m.compute()), atol=1e-7)
+        m.reset()
+        assert int(m.preds.count()) == 0
+
+    def test_functionalize_jit(self):
+        mdef = mt.functionalize(mt.AUROC(capacity=512))
+        state = mdef.init()
+        upd = jax.jit(mdef.update)
+        for i in range(4):
+            sl = slice(i * 80, (i + 1) * 80)
+            state = upd(state, jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]))
+        val = jax.jit(mdef.compute)(state)
+        np.testing.assert_allclose(float(val), roc_auc_score(TARGET, PREDS), atol=1e-6)
+
+    def test_merge_concatenates(self):
+        mdef = mt.functionalize(mt.AUROC(capacity=256))
+        a = mdef.update(mdef.init(), jnp.asarray(PREDS[:160]), jnp.asarray(TARGET[:160]))
+        b = mdef.update(mdef.init(), jnp.asarray(PREDS[160:]), jnp.asarray(TARGET[160:]))
+        merged = mdef.merge(a, b)
+        np.testing.assert_allclose(float(mdef.compute(merged)), roc_auc_score(TARGET, PREDS), atol=1e-6)
+
+    def test_sharded_ragged_counts(self):
+        """Each device contributes a different number of valid rows; the
+        synced result must equal sklearn on exactly the union of valid rows."""
+        ndev = jax.device_count()
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        mdef = mt.functionalize(mt.AUROC(capacity=64), axis_name="data")
+        block = 40
+        p_dev = PREDS[: ndev * block].reshape(ndev, block)
+        t_dev = TARGET[: ndev * block].reshape(ndev, block)
+
+        def per_device(p, t):
+            p, t = p[0], t[0]
+            d = jax.lax.axis_index("data")
+            valid = jnp.arange(block) < (block - 2 * d)  # ragged: 40, 38, 36, ...
+            s = mdef.init()
+            s = jax.tree_util.tree_map(lambda x: jax.lax.pcast(x, ("data",), to="varying"), s)
+            s = mdef.update(s, p, t, valid=valid)
+            return mdef.compute(s)
+
+        fn = jax.shard_map(per_device, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+        got = float(jax.jit(fn)(jnp.asarray(p_dev), jnp.asarray(t_dev)))
+
+        keep = np.concatenate([np.arange(block) < (block - 2 * d) for d in range(ndev)])
+        exp = roc_auc_score(t_dev.reshape(-1)[keep], p_dev.reshape(-1)[keep])
+        np.testing.assert_allclose(got, exp, atol=1e-6)
+
+    def test_north_star_fused_collection(self):
+        """MetricCollection([Accuracy, F1, AUROC]) as ONE compiled graph:
+        shared statscores state + AUROC ring buffer, one jitted step."""
+        num_classes = 4
+        rng = np.random.default_rng(9)
+        logits = rng.random((256, num_classes)).astype(np.float32)
+        logits /= logits.sum(1, keepdims=True)
+        labels = rng.integers(0, num_classes, 256)
+
+        acc = mt.functionalize(mt.Accuracy(num_classes=num_classes, average="macro"))
+        f1 = mt.functionalize(mt.F1Score(num_classes=num_classes, average="macro"))
+        auroc = mt.functionalize(mt.AUROC(num_classes=num_classes, capacity=512))
+
+        @jax.jit
+        def step(states, preds, target):
+            sa, sf, su = states
+            sa = acc.update(sa, preds, target)
+            sf = f1.update(sf, preds, target)
+            su = auroc.update(su, preds, target)
+            return (sa, sf, su)
+
+        @jax.jit
+        def compute(states):
+            sa, sf, su = states
+            return {"acc": acc.compute(sa), "f1": f1.compute(sf), "auroc": auroc.compute(su)}
+
+        states = (acc.init(), f1.init(), auroc.init())
+        for i in range(4):
+            sl = slice(i * 64, (i + 1) * 64)
+            states = step(states, jnp.asarray(logits[sl]), jnp.asarray(labels[sl]))
+        out = compute(states)
+
+        from sklearn.metrics import accuracy_score, f1_score
+
+        np.testing.assert_allclose(
+            float(out["auroc"]), roc_auc_score(labels, logits, multi_class="ovr", average="macro"), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(out["f1"]), f1_score(labels, logits.argmax(1), average="macro"), atol=1e-5
+        )

@@ -1,0 +1,335 @@
+"""Text-metric parity (analogue of reference ``test/unittests/text/``).
+
+Oracles, mirroring the reference's choices: nltk for BLEU
+(``test_bleu.py:18``), sacrebleu for SacreBLEU/CHRF/TER, ``rouge_score``
+for ROUGE, and the importable reference implementation for the
+edit-distance family (jiwer is not installed here) and SQuAD/EED.
+"""
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+import metrics_tpu.functional as F
+from tests.helpers.reference import import_reference
+
+# a small parallel corpus with varied lengths, punctuation and casing
+PREDS = [
+    "the cat is on the mat",
+    "There is a big tree near the house .",
+    "a quick brown fox jumps over the lazy dog",
+    "hello world",
+]
+TARGETS_SINGLE = [
+    "a cat is on the mat",
+    "There is a tall tree close to the house .",
+    "the quick brown fox jumped over the lazy dog",
+    "hello beautiful world",
+]
+# no tied closest-reference lengths: the reference breaks |len-diff| ties to
+# the first reference while nltk/sacrebleu break to the shortest, so tied
+# corpora are only comparable against the reference itself
+TARGETS_MULTI = [
+    ["a cat is on the mat", "there is a cat on the mat"],
+    ["There is a tall tree close to the house .", "A big tree near the house ."],
+    ["the quick brown fox jumped over the lazy dog"],
+    ["hello beautiful world", "hello world !"],
+]
+TARGETS_TIED = [
+    ["a cat is on the mat", "there is a cat on the mat"],
+    ["There is a tall tree close to the house .", "A big tree is here near the house now ."],
+    ["the quick brown fox jumped over the lazy dog"],
+    ["hello beautiful world", "hello world !"],
+]
+
+
+def _ref_text(name):
+    ref = import_reference()
+    fn = getattr(ref.functional, name)
+
+    def oracle(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if isinstance(out, dict):
+            return {k: v.numpy() for k, v in out.items()}
+        if isinstance(out, tuple):
+            return tuple(o.numpy() for o in out)
+        return out.numpy()
+
+    return oracle
+
+
+# ---------------------------------------------------------------------------
+# BLEU family
+# ---------------------------------------------------------------------------
+
+
+# corpus where nltk and the reference agree: no sentence shorter than the
+# max n-gram order (nltk clamps short-sentence denominators to 1) and no
+# tied closest-reference lengths (tie-break conventions differ)
+BLEU_PREDS = PREDS[:3]
+BLEU_TARGETS = TARGETS_MULTI[:3]
+
+
+class TestBLEU:
+    @pytest.mark.parametrize(("n_gram", "smooth"), [(4, False), (2, False), (4, True)])
+    def test_vs_nltk(self, n_gram, smooth):
+        from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu
+
+        weights = [1.0 / n_gram] * n_gram
+        # method2 (add-1 on orders >= 2) is the smoothing scheme the
+        # implementation uses, matching the reference's oracle choice
+        smoothing = SmoothingFunction().method2 if smooth else SmoothingFunction().method0
+        expected = corpus_bleu(
+            [[t.split() for t in refs] for refs in BLEU_TARGETS],
+            [p.split() for p in BLEU_PREDS],
+            weights=weights,
+            smoothing_function=smoothing,
+        )
+        got = float(F.bleu_score(BLEU_PREDS, BLEU_TARGETS, n_gram=n_gram, smooth=smooth))
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    @pytest.mark.parametrize("smooth", [False, True])
+    def test_vs_reference_full_corpus(self, smooth):
+        """The tied corpus (short sentences + length ties) against the
+        reference implementation — the behavioral contract where nltk's
+        conventions diverge."""
+        oracle = _ref_text("bleu_score")
+        got = float(F.bleu_score(PREDS, TARGETS_TIED, smooth=smooth))
+        np.testing.assert_allclose(got, oracle(PREDS, TARGETS_TIED, smooth=smooth), atol=1e-5)
+
+    def test_module_accumulation(self):
+        oracle = _ref_text("bleu_score")
+        m = mt.BLEUScore()
+        m.update(PREDS[:2], TARGETS_MULTI[:2])
+        m.update(PREDS[2:], TARGETS_MULTI[2:])
+        np.testing.assert_allclose(float(m.compute()), oracle(PREDS, TARGETS_MULTI), atol=1e-5)
+
+
+class TestSacreBLEU:
+    @pytest.mark.parametrize("tokenize", ["13a", "intl", "char", "none"])
+    @pytest.mark.parametrize("lowercase", [False, True])
+    def test_vs_sacrebleu(self, tokenize, lowercase):
+        from sacrebleu.metrics import BLEU
+
+        # sacrebleu wants per-reference-position lists
+        max_refs = max(len(r) for r in TARGETS_MULTI)
+        padded = [list(r) + [r[0]] * (max_refs - len(r)) for r in TARGETS_MULTI]
+        ref_streams = [[padded[i][j] for i in range(len(PREDS))] for j in range(max_refs)]
+        bleu = BLEU(tokenize=tokenize, lowercase=lowercase)
+        expected = bleu.corpus_score(PREDS, ref_streams).score / 100
+        got = float(F.sacre_bleu_score(PREDS, padded, tokenize=tokenize, lowercase=lowercase))
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+class TestCHRF:
+    @pytest.mark.parametrize(("n_word_order", "whitespace"), [(2, False), (0, False), (2, True)])
+    def test_vs_sacrebleu(self, n_word_order, whitespace):
+        from sacrebleu.metrics import CHRF
+
+        max_refs = max(len(r) for r in TARGETS_MULTI)
+        padded = [list(r) + [r[0]] * (max_refs - len(r)) for r in TARGETS_MULTI]
+        ref_streams = [[padded[i][j] for i in range(len(PREDS))] for j in range(max_refs)]
+        chrf = CHRF(word_order=n_word_order, whitespace=whitespace, eps_smoothing=True)
+        expected = chrf.corpus_score(PREDS, ref_streams).score / 100
+        got = float(F.chrf_score(PREDS, padded, n_word_order=n_word_order, whitespace=whitespace))
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+class TestTER:
+    @pytest.mark.parametrize(
+        "kwargs",
+        [{}, {"normalize": True}, {"lowercase": False}, {"no_punctuation": True}],
+    )
+    def test_vs_sacrebleu(self, kwargs):
+        from sacrebleu.metrics import TER as SacreTER
+
+        max_refs = max(len(r) for r in TARGETS_MULTI)
+        padded = [list(r) + [r[0]] * (max_refs - len(r)) for r in TARGETS_MULTI]
+        ref_streams = [[padded[i][j] for i in range(len(PREDS))] for j in range(max_refs)]
+        ter = SacreTER(
+            normalized=kwargs.get("normalize", False),
+            no_punct=kwargs.get("no_punctuation", False),
+            case_sensitive=not kwargs.get("lowercase", True),
+        )
+        expected = ter.corpus_score(PREDS, ref_streams).score / 100
+        got = float(F.translation_edit_rate(PREDS, padded, **kwargs))
+        np.testing.assert_allclose(got, expected, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Edit-distance family (oracle: importable reference — jiwer not installed)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["word_error_rate", "char_error_rate", "match_error_rate", "word_information_lost", "word_information_preserved"],
+)
+def test_edit_distance_family_vs_reference(name):
+    oracle = _ref_text(name)
+    got = float(getattr(F, name)(PREDS, TARGETS_SINGLE))
+    np.testing.assert_allclose(got, oracle(PREDS, TARGETS_SINGLE), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ("cls_name", "fn_name"),
+    [
+        ("WordErrorRate", "word_error_rate"),
+        ("CharErrorRate", "char_error_rate"),
+        ("MatchErrorRate", "match_error_rate"),
+        ("WordInfoLost", "word_information_lost"),
+        ("WordInfoPreserved", "word_information_preserved"),
+    ],
+)
+def test_edit_distance_modules_accumulate(cls_name, fn_name):
+    oracle = _ref_text(fn_name)
+    m = getattr(mt, cls_name)()
+    m.update(PREDS[:2], TARGETS_SINGLE[:2])
+    m.update(PREDS[2:], TARGETS_SINGLE[2:])
+    np.testing.assert_allclose(float(m.compute()), oracle(PREDS, TARGETS_SINGLE), atol=1e-6)
+
+
+def test_eed_vs_reference():
+    oracle = _ref_text("extended_edit_distance")
+    got = float(F.extended_edit_distance(PREDS, TARGETS_SINGLE))
+    np.testing.assert_allclose(got, oracle(PREDS, TARGETS_SINGLE), atol=1e-5)
+    m = mt.ExtendedEditDistance()
+    m.update(PREDS[:2], TARGETS_SINGLE[:2])
+    m.update(PREDS[2:], TARGETS_SINGLE[2:])
+    np.testing.assert_allclose(float(m.compute()), oracle(PREDS, TARGETS_SINGLE), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ROUGE (oracle: rouge_score, the package the reference validates against)
+# ---------------------------------------------------------------------------
+
+
+class TestROUGE:
+    @pytest.mark.parametrize("use_stemmer", [False, True])
+    def test_vs_rouge_score(self, use_stemmer):
+        from rouge_score.rouge_scorer import RougeScorer
+        from rouge_score.scoring import BootstrapAggregator
+
+        keys = ("rouge1", "rouge2", "rougeL", "rougeLsum")
+        scorer = RougeScorer(list(keys), use_stemmer=use_stemmer)
+        # single-reference corpus: aggregate the per-pair fmeasure as the mean
+        got = F.rouge_score(PREDS, TARGETS_SINGLE, use_stemmer=use_stemmer, rouge_keys=keys)
+        for key in keys:
+            scores = [scorer.score(t, p)[key].fmeasure for p, t in zip(PREDS, TARGETS_SINGLE)]
+            np.testing.assert_allclose(float(got[f"{key}_fmeasure"]), np.mean(scores), atol=1e-5)
+
+    def test_rougelsum_multiline(self):
+        from rouge_score.rouge_scorer import RougeScorer
+
+        pred = "The cat sat .\nIt was happy ."
+        target = "A cat sat .\nIt looked happy ."
+        scorer = RougeScorer(["rougeLsum"], use_stemmer=False)
+        expected = scorer.score_multi([target], pred)["rougeLsum"].fmeasure
+        got = F.rouge_score(pred, target, rouge_keys=("rougeLsum",))
+        np.testing.assert_allclose(float(got["rougeLsum_fmeasure"]), expected, atol=1e-5)
+
+    def test_module(self):
+        m = mt.ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+        m.update(PREDS[:2], TARGETS_SINGLE[:2])
+        m.update(PREDS[2:], TARGETS_SINGLE[2:])
+        out = m.compute()
+        assert set(out) == {"rouge1_fmeasure", "rouge1_precision", "rouge1_recall",
+                            "rougeL_fmeasure", "rougeL_precision", "rougeL_recall"}
+
+
+# ---------------------------------------------------------------------------
+# SQuAD (oracle: importable reference, which vendors the official script)
+# ---------------------------------------------------------------------------
+
+
+def test_squad_vs_reference():
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"},
+             {"prediction_text": "the Eiffel Tower", "id": "id2"}]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"},
+        {"answers": {"answer_start": [1], "text": ["Eiffel Tower", "the tower"]}, "id": "id2"},
+    ]
+    oracle = _ref_text("squad")
+    expected = oracle(preds, target)
+    got = F.squad(preds, target)
+    np.testing.assert_allclose(float(got["exact_match"]), expected["exact_match"], atol=1e-5)
+    np.testing.assert_allclose(float(got["f1"]), expected["f1"], atol=1e-5)
+
+    m = mt.SQuAD()
+    m.update(preds[:1], target[:1])
+    m.update(preds[1:], target[1:])
+    out = m.compute()
+    np.testing.assert_allclose(float(out["f1"]), expected["f1"], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# BERTScore with a deterministic fake encoder
+# ---------------------------------------------------------------------------
+
+
+def _fake_encoder(sentences, dim=8):
+    """Deterministic per-token embeddings from a hash, plus mask/ids."""
+    import numpy as np
+
+    toks = [s.lower().split() for s in sentences]
+    max_len = max(len(t) for t in toks) + 2  # cls/sep slots
+    emb = np.zeros((len(toks), max_len, dim), np.float32)
+    mask = np.zeros((len(toks), max_len), np.int32)
+    ids = np.zeros((len(toks), max_len), np.int32)
+    for i, ts in enumerate(toks):
+        mask[i, : len(ts) + 2] = 1
+        ids[i, 0] = 101
+        ids[i, len(ts) + 1] = 102
+        for j, tok in enumerate(ts):
+            h = abs(hash(tok)) % (2**31)
+            rng = np.random.default_rng(h)
+            emb[i, j + 1] = rng.standard_normal(dim).astype(np.float32)
+            ids[i, j + 1] = h % 30000 + 1000
+    return emb, mask, ids
+
+
+def test_bertscore_identity_and_symmetry():
+    out = F.bert_score(PREDS, PREDS, encoder=_fake_encoder)
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-5)
+    out2 = F.bert_score(PREDS, TARGETS_SINGLE, encoder=_fake_encoder)
+    out3 = F.bert_score(TARGETS_SINGLE, PREDS, encoder=_fake_encoder)
+    np.testing.assert_allclose(np.asarray(out2["precision"]), np.asarray(out3["recall"]), atol=1e-5)
+    assert (np.asarray(out2["f1"]) <= 1.0 + 1e-6).all()
+
+
+def test_bertscore_greedy_matching_hand_case():
+    """Two-token sentences with known cosine structure."""
+    import numpy as np
+
+    def enc(sentences):
+        table = {
+            "a": [1.0, 0.0, 0.0, 0.0],
+            "b": [0.0, 1.0, 0.0, 0.0],
+            "c": [np.sqrt(0.5), np.sqrt(0.5), 0.0, 0.0],
+        }
+        toks = [s.split() for s in sentences]
+        max_len = max(len(t) for t in toks) + 2
+        emb = np.zeros((len(toks), max_len, 4), np.float32)
+        mask = np.zeros((len(toks), max_len), np.int32)
+        ids = np.zeros((len(toks), max_len), np.int32)
+        for i, ts in enumerate(toks):
+            mask[i, : len(ts) + 2] = 1
+            ids[i, 0], ids[i, len(ts) + 1] = 101, 102
+            for j, tok in enumerate(ts):
+                emb[i, j + 1] = table[tok]
+                ids[i, j + 1] = ord(tok)
+        return emb, mask, ids
+
+    out = F.bert_score(["a b"], ["a c"], encoder=enc)
+    # precision: a->a (1.0), b->c (sqrt(.5)); recall: a->a (1.0), c->b (sqrt(.5))
+    exp = np.mean([1.0, np.sqrt(0.5)])
+    np.testing.assert_allclose(float(np.asarray(out["precision"])[0]), exp, atol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(out["recall"])[0]), exp, atol=1e-5)
+
+
+def test_bertscore_module():
+    m = mt.BERTScore(encoder=_fake_encoder)
+    m.update(PREDS[:2], TARGETS_SINGLE[:2])
+    m.update(PREDS[2:], TARGETS_SINGLE[2:])
+    out = m.compute()
+    single = F.bert_score(PREDS, TARGETS_SINGLE, encoder=_fake_encoder)
+    np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(single["f1"]), atol=1e-5)
