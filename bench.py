@@ -67,12 +67,142 @@ def _init_backend():
     return jax, platform
 
 
+_SYNC_BENCH_SRC = """
+import jax
+jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_num_cpu_devices', 8)
+import time, numpy as np, jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from metrics_tpu.parallel.sync import fused_sync
+mesh = Mesh(np.array(jax.devices()), ('data',))
+state = {k: jnp.ones((16,), jnp.int32) for k in ('tp', 'fp', 'tn', 'fn')}
+def sync_only(s):
+    return fused_sync([s], [{k: 'sum' for k in s}], 'data')[0]
+fn = jax.jit(jax.shard_map(sync_only, mesh=mesh, in_specs=(P(),), out_specs=P()))
+out = fn(state); jax.block_until_ready(out)
+iters = 200
+t0 = time.perf_counter()
+for _ in range(iters):
+    out = fn(state)
+jax.block_until_ready(out)
+print((time.perf_counter() - t0) / iters * 1e6)
+"""
+
+
+def _emit(metric: str, value: float, unit: str, vs_baseline=None) -> None:
+    print(json.dumps({"metric": metric, "value": value, "unit": unit, "vs_baseline": vs_baseline}))
+
+
+def _bench_extras(jax, platform) -> None:
+    """Secondary numbers (each its own JSON line; the headline stays last).
+
+    Every block is independent and failure-isolated: a broken path loses one
+    line, never the whole bench.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    # --- AUROC at 1M accumulated samples (CatBuffer capacity mode) -------
+    try:
+        from metrics_tpu import functionalize, AUROC
+
+        n = 1_000_000
+        mdef = functionalize(AUROC(capacity=n))
+        rng = np.random.default_rng(0)
+        batch_p = jnp.asarray(rng.random(n), jnp.float32)
+        batch_t = jnp.asarray(rng.integers(0, 2, n), jnp.int32)
+        state = jax.jit(mdef.update)(mdef.init(), batch_p, batch_t)
+        compute = jax.jit(mdef.compute)
+        jax.block_until_ready(compute(state))  # compile
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = compute(state)
+        jax.block_until_ready(out)
+        _emit(
+            "auroc_1m_compute_ms",
+            round((time.perf_counter() - t0) / iters * 1e3, 4),
+            f"ms/compute (exact rank-based AUROC, 1M samples, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: auroc_1m failed: {err}", file=sys.stderr)
+
+    # --- SSIM on 2x3x512x512 ---------------------------------------------
+    try:
+        from metrics_tpu.functional import structural_similarity_index_measure
+
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.random((2, 3, 512, 512)), jnp.float32)
+        b = jnp.asarray(rng.random((2, 3, 512, 512)), jnp.float32)
+        fn = jax.jit(lambda x, y: structural_similarity_index_measure(x, y, data_range=1.0))
+        jax.block_until_ready(fn(a, b))
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(a, b)
+        jax.block_until_ready(out)
+        _emit(
+            "ssim_512_ms",
+            round((time.perf_counter() - t0) / iters * 1e3, 4),
+            f"ms (SSIM 2x3x512x512, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: ssim_512 failed: {err}", file=sys.stderr)
+
+    # --- retrieval: 100k ragged queries, bucketed vectorized compute -----
+    try:
+        from metrics_tpu import RetrievalMAP
+
+        rng = np.random.default_rng(2)
+        nq = 100_000
+        sizes = rng.integers(5, 50, nq)
+        idx = np.repeat(np.arange(nq), sizes)
+        preds = rng.random(idx.size).astype(np.float32)
+        target = (rng.random(idx.size) < 0.2).astype(np.int64)
+        m = RetrievalMAP()
+        m.update(preds, target, indexes=idx)
+        t0 = time.perf_counter()
+        m.compute()
+        _emit(
+            "retrieval_map_100k_s",
+            round(time.perf_counter() - t0, 3),
+            f"s/compute (100k ragged queries, {idx.size} docs, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: retrieval_100k failed: {err}", file=sys.stderr)
+
+    # --- fused-collection sync µs on a virtual 8-device mesh -------------
+    # (BASELINE.md's tracked sync metric; real multi-chip is unavailable, so
+    # this runs in a CPU-mesh subprocess — an upper bound on collective count,
+    # not ICI latency)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SYNC_BENCH_SRC],
+            timeout=300,
+            capture_output=True,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            _emit(
+                "fused_sync_us",
+                round(float(proc.stdout.strip().splitlines()[-1]), 2),
+                "us/sync (4-state fused psum, 8-device cpu mesh)",
+            )
+        else:
+            print(f"bench: sync bench rc={proc.returncode}: {proc.stderr[-300:]}", file=sys.stderr)
+    except Exception as err:  # pragma: no cover
+        print(f"bench: sync bench failed: {err}", file=sys.stderr)
+
+
 def main() -> None:
     jax, platform = _init_backend()
     import jax.numpy as jnp
     import numpy as np
 
     from __graft_entry__ import entry
+
+    _bench_extras(jax, platform)
 
     step, (state, _, _) = entry()
 

@@ -68,7 +68,10 @@ def _stat_scores(
     fp = jnp.sum((~true_pred) & pos_pred, axis=dim)
     tn = jnp.sum(true_pred & ~pos_pred, axis=dim)
     fn = jnp.sum((~true_pred) & ~pos_pred, axis=dim)
-    dtype = jnp.int32
+    # int64 counters (the reference uses long) when x64 is enabled; under
+    # JAX's default x64-off config int64 silently downcasts, so int32 is the
+    # honest dtype there — accumulators overflow past ~2.1B counts per entry.
+    dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
     return tp.astype(dtype), fp.astype(dtype), tn.astype(dtype), fn.astype(dtype)
 
 

@@ -206,3 +206,119 @@ def retrieval_precision_recall_curve(
     recall = jnp.where(total == 0, 0.0, relevant / jnp.maximum(total, 1))
     precision = jnp.where(total == 0, 0.0, relevant / topk)
     return precision, recall, topk.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------
+# Masked row kernels — the vectorized per-query form (SURVEY.md §7 step 5)
+#
+# Each takes one (L,) padded row plus a validity mask and is vmapped over a
+# (Q, L) bucket of queries by `RetrievalMetric.compute`, replacing the
+# reference's per-query Python loop (`retrieval/base.py:110-139`,
+# `utilities/data.py:210`) with O(#size-buckets) device dispatches. Padding
+# rows sort last (preds forced to -inf) and carry zero target weight.
+# --------------------------------------------------------------------------
+
+
+def _masked_sort(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
+    """Target and mask reordered by descending score, padding last."""
+    order = jnp.argsort(-jnp.where(mask, preds, -jnp.inf))
+    return (target * mask)[order].astype(jnp.float32), mask[order]
+
+
+def _masked_average_precision(preds: Array, target: Array, mask: Array) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    ranks = jnp.arange(1, preds.shape[-1] + 1, dtype=jnp.float32)
+    pah = jnp.cumsum(st) / ranks
+    total = jnp.sum(st)
+    return jnp.where(total == 0, 0.0, jnp.sum(pah * st) / jnp.maximum(total, 1))
+
+
+def _masked_reciprocal_rank(preds: Array, target: Array, mask: Array) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    ranks = jnp.arange(1, preds.shape[-1] + 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(st > 0, ranks, jnp.inf))
+    return jnp.where(jnp.sum(st) == 0, 0.0, 1.0 / first)
+
+
+def _masked_precision(preds: Array, target: Array, mask: Array, k: Optional[int], adaptive_k: bool) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    length = preds.shape[-1]
+    n = jnp.sum(mask.astype(jnp.float32))
+    k_eff = jnp.asarray(float(k if k is not None else length))
+    if k is None:
+        k_eff = n
+    elif adaptive_k:
+        k_eff = jnp.where(k > n, n, float(k))
+    ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
+    relevant = jnp.sum(st * (ranks <= k_eff))
+    return jnp.where(jnp.sum(st) == 0, 0.0, relevant / k_eff)
+
+
+def _masked_recall(preds: Array, target: Array, mask: Array, k: Optional[int]) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    length = preds.shape[-1]
+    n = jnp.sum(mask.astype(jnp.float32))
+    k_eff = n if k is None else jnp.asarray(float(k))
+    ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
+    relevant = jnp.sum(st * (ranks <= k_eff))
+    total = jnp.sum(st)
+    return jnp.where(total == 0, 0.0, relevant / jnp.maximum(total, 1))
+
+
+def _masked_fall_out(preds: Array, target: Array, mask: Array, k: Optional[int]) -> Array:
+    neg = jnp.where(mask, 1.0 - target.astype(jnp.float32), 0.0)
+    sn, _ = _masked_sort(preds, neg, mask)
+    length = preds.shape[-1]
+    n = jnp.sum(mask.astype(jnp.float32))
+    k_eff = n if k is None else jnp.asarray(float(k))
+    ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
+    retrieved_neg = jnp.sum(sn * (ranks <= k_eff))
+    total_neg = jnp.sum(neg)
+    return jnp.where(total_neg == 0, 0.0, retrieved_neg / jnp.maximum(total_neg, 1))
+
+
+def _masked_hit_rate(preds: Array, target: Array, mask: Array, k: Optional[int]) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    length = preds.shape[-1]
+    n = jnp.sum(mask.astype(jnp.float32))
+    k_eff = n if k is None else jnp.asarray(float(k))
+    ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
+    return (jnp.sum(st * (ranks <= k_eff)) > 0).astype(jnp.float32)
+
+
+def _masked_r_precision(preds: Array, target: Array, mask: Array) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    ranks = jnp.arange(1, preds.shape[-1] + 1, dtype=jnp.float32)
+    r = jnp.sum(st)
+    relevant = jnp.sum(st * (ranks <= r))
+    return jnp.where(r == 0, 0.0, relevant / jnp.maximum(r, 1))
+
+
+def _masked_normalized_dcg(preds: Array, target: Array, mask: Array, k: Optional[int]) -> Array:
+    st, _ = _masked_sort(preds, target, mask)
+    length = preds.shape[-1]
+    it = jnp.sort(jnp.where(mask, target.astype(jnp.float32), -jnp.inf))[::-1]
+    it = jnp.where(jnp.isfinite(it), it, 0.0)
+    n = jnp.sum(mask.astype(jnp.float32))
+    k_eff = n if k is None else jnp.asarray(float(k))
+    ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
+    discount = (ranks <= k_eff) / jnp.log2(ranks + 1.0)
+    dcg = jnp.sum(st * discount)
+    ideal = jnp.sum(it * discount)
+    return jnp.where(ideal == 0, 0.0, dcg / jnp.where(ideal == 0, 1.0, ideal))
+
+
+def _masked_precision_recall_curve(
+    preds: Array, target: Array, mask: Array, max_k: int, adaptive_k: bool
+) -> Tuple[Array, Array]:
+    st, _ = _masked_sort(preds, target, mask)
+    length = preds.shape[-1]
+    n = jnp.sum(mask.astype(jnp.float32))
+    ks = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    topk = jnp.where(adaptive_k & (ks > n), jnp.maximum(n, 1.0), ks) if adaptive_k else ks
+    ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
+    rel_at_k = jnp.sum(st[None, :] * (ranks[None, :] <= ks[:, None]), axis=1)
+    total = jnp.sum(st)
+    recall = jnp.where(total == 0, 0.0, rel_at_k / jnp.maximum(total, 1))
+    precision = jnp.where(total == 0, 0.0, rel_at_k / topk)
+    return precision, recall

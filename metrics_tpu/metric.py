@@ -470,7 +470,7 @@ class Metric:
                 del input_dict[attr]
         if not input_dict:
             return
-        for attr, reduction_fn in self._reductions.items():
+        for attr in input_dict:
             # pre-concat list states to minimize gathers (reference ``metric.py:352-354``)
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
@@ -628,7 +628,7 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Pickle support: drop wrapped/bound/jitted fns (reference ``metric.py:560-569``)."""
-        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit", "_update_signature"}
+        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit", "_update_signature", "_bucket_kernels"}
         state = {k: v for k, v in self.__dict__.items() if k not in skip}
         state["_state"] = jax.tree_util.tree_map(np.asarray, self.__dict__["_state"])
         state["_defaults"] = jax.tree_util.tree_map(np.asarray, self.__dict__["_defaults"])

@@ -1,15 +1,15 @@
 """``RetrievalMetric`` base class (reference
 ``src/torchmetrics/retrieval/base.py:27``).
 
-Ragged per-query grouping is inherently host-side (the reference's
-``get_group_indexes`` dict loop, ``utilities/data.py:210``); here grouping is
-a single vectorized sort-and-split over the concatenated state — one
-``argsort`` + ``unique`` on host, then the per-query kernel runs on-device
-per group. Compute happens once per epoch, so the Python loop over queries is
-off the hot path (the hot path — update — is an append).
+The reference computes per query in a Python loop over ``get_group_indexes``
+(``retrieval/base.py:110-139``, ``utilities/data.py:210``) — one device
+dispatch per query. Here compute is vectorized: queries are grouped by one
+host ``argsort``+``unique``, bucketed by padded power-of-two length, and each
+bucket runs as ONE ``vmap``-ped masked-row kernel on device — O(log max_docs)
+dispatches total regardless of query count (SURVEY.md §7 hard part #2).
 """
 from abc import ABC, abstractmethod
-from typing import Any, List, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -17,9 +17,34 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
-from metrics_tpu.utilities.data import dim_zero_cat, get_group_indexes
+from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
+
+
+def _group_layout(indexes: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Sort order + per-query (start, count) over the concatenated state."""
+    order = np.argsort(indexes, kind="stable")
+    _, starts, counts = np.unique(indexes[order], return_index=True, return_counts=True)
+    return order, starts, counts
+
+
+def _bucket_rows(
+    values: Tuple[np.ndarray, ...], starts: np.ndarray, counts: np.ndarray, sel: np.ndarray, length: int
+):
+    """Pack the selected queries' ragged docs into padded (Q, L) blocks."""
+    c = counts[sel]
+    offs = np.arange(int(c.sum())) - np.repeat(np.cumsum(c) - c, c)
+    src = np.repeat(starts[sel], c) + offs
+    row_ids = np.repeat(np.arange(len(sel)), c)
+    mask = np.zeros((len(sel), length), bool)
+    mask[row_ids, offs] = True
+    out = []
+    for v in values:
+        block = np.zeros((len(sel), length), v.dtype)
+        block[row_ids, offs] = v[src]
+        out.append(block)
+    return (*out, mask)
 
 
 class RetrievalMetric(Metric, ABC):
@@ -70,27 +95,79 @@ class RetrievalMetric(Metric, ABC):
         self.target.append(target)
 
     def compute(self) -> Array:
-        """Reference ``base.py:110-139``."""
+        """Vectorized equivalent of reference ``base.py:110-139``."""
         indexes = np.asarray(dim_zero_cat(self.indexes))
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
+        values = self._per_query_values(indexes, preds, target)
+        return values.mean() if values.size else jnp.asarray(0.0)
 
-        res: List[Array] = []
-        groups = get_group_indexes(indexes)
-        for group in groups:
-            mini_preds = preds[group]
-            mini_target = target[group]
-            if not int(jnp.sum(mini_target)):
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no positive target.")
-                if self.empty_target_action == "pos":
-                    res.append(jnp.asarray(1.0))
-                elif self.empty_target_action == "neg":
-                    res.append(jnp.asarray(0.0))
-            else:
-                res.append(self._metric(mini_preds, mini_target))
-        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+    def _query_is_empty(self, pos_counts: np.ndarray, neg_counts: np.ndarray) -> np.ndarray:
+        """Which queries hit the degenerate case (no positives by default;
+        FallOut overrides to no negatives, reference ``fall_out.py:80-103``)."""
+        return pos_counts == 0
+
+    def _empty_message(self) -> str:
+        return "`compute` method was provided with a query with no positive target."
+
+    def _per_query_values(
+        self,
+        indexes: np.ndarray,
+        preds: np.ndarray,
+        target: np.ndarray,
+        kernel: Optional[Callable] = None,
+        kernel_key: Any = None,
+        out_shape: Tuple[int, ...] = (),
+    ) -> Array:
+        """Per-query results — scalar by default, ``out_shape``-shaped for
+        vector-valued kernels (e.g. precision/recall curves) — from a
+        bucketed vmap of the masked row kernel, with the empty-target action
+        applied host-side ("pos" fills ones, "neg" zeros, "skip" drops the
+        query, "error" raises)."""
+        if indexes.size == 0:
+            return jnp.zeros((0,) + out_shape)
+        order, starts, counts = _group_layout(indexes)
+        p, t = preds[order], target[order]
+        pos_counts = np.add.reduceat((t > 0).astype(np.int64), starts)
+        neg_counts = counts - pos_counts
+        empty = self._query_is_empty(pos_counts, neg_counts)
+
+        if empty.any() and self.empty_target_action == "error":
+            raise ValueError(self._empty_message())
+
+        num_queries = len(counts)
+        values = np.zeros((num_queries,) + out_shape, np.float32)
+        if self.empty_target_action == "pos":
+            values[empty] = 1.0
+        # padded power-of-two length per query
+        lengths = np.asarray([1 << int(np.ceil(np.log2(max(c, 1)))) if c > 1 else 1 for c in counts])
+        todo = ~empty
+        for length in np.unique(lengths[todo]):
+            sel = np.where(todo & (lengths == length))[0]
+            pb, tb, mb = _bucket_rows((p, t), starts, counts, sel, int(length))
+            jitted = self._bucket_kernel(int(length), kernel, kernel_key)
+            values[sel] = np.asarray(jitted(jnp.asarray(pb), jnp.asarray(tb), jnp.asarray(mb)))
+        if self.empty_target_action == "skip":
+            values = values[todo]
+        return jnp.asarray(values)
+
+    def _bucket_kernel(self, length: int, kernel: Optional[Callable] = None, kernel_key: Any = None) -> Callable:
+        """Jitted vmap of a masked row kernel, cached per (padded length,
+        caller key) so repeated computes never re-trace."""
+        cache: Dict[Any, Callable] = self.__dict__.setdefault("_bucket_kernels", {})
+        key = (length, kernel_key)
+        if key not in cache:
+            cache[key] = jax.jit(jax.vmap(kernel if kernel is not None else self._row_metric))
+        return cache[key]
 
     @abstractmethod
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        """Masked per-query kernel over one padded ``(L,)`` row — jittable,
+        vmapped over a bucket of queries (vectorized form of reference
+        ``base.py:141-146``)."""
+
     def _metric(self, preds: Array, target: Array) -> Array:
-        """Per-query metric (reference ``base.py:141-146``)."""
+        """Per-query metric on concrete arrays (reference ``base.py:141-146``) —
+        kept for API parity; compute uses the vectorized row kernels."""
+        mask = jnp.ones(preds.shape[-1], bool)
+        return self._row_metric(jnp.asarray(preds), jnp.asarray(target), mask)

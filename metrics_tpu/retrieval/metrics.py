@@ -8,19 +8,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.functional.retrieval import (
-    retrieval_average_precision,
-    retrieval_fall_out,
-    retrieval_hit_rate,
-    retrieval_normalized_dcg,
-    retrieval_precision,
-    retrieval_precision_recall_curve,
-    retrieval_r_precision,
-    retrieval_recall,
-    retrieval_reciprocal_rank,
+from metrics_tpu.functional.retrieval.kernels import (
+    _masked_average_precision,
+    _masked_fall_out,
+    _masked_hit_rate,
+    _masked_normalized_dcg,
+    _masked_precision,
+    _masked_precision_recall_curve,
+    _masked_r_precision,
+    _masked_recall,
+    _masked_reciprocal_rank,
 )
 from metrics_tpu.retrieval.base import RetrievalMetric
-from metrics_tpu.utilities.data import dim_zero_cat, get_group_indexes
+from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
 
@@ -28,15 +28,15 @@ Array = jax.Array
 class RetrievalMAP(RetrievalMetric):
     """Mean average precision (reference ``retrieval/average_precision.py:24``)."""
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_average_precision(preds, target)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_average_precision(preds, target, mask)
 
 
 class RetrievalMRR(RetrievalMetric):
     """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py:24``)."""
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_reciprocal_rank(preds, target)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_reciprocal_rank(preds, target, mask)
 
 
 class RetrievalPrecision(RetrievalMetric):
@@ -58,8 +58,8 @@ class RetrievalPrecision(RetrievalMetric):
         self.k = k
         self.adaptive_k = adaptive_k
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_precision(preds, target, k=self.k, adaptive_k=self.adaptive_k)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_precision(preds, target, mask, k=self.k, adaptive_k=self.adaptive_k)
 
 
 class RetrievalRecall(RetrievalMetric):
@@ -77,8 +77,8 @@ class RetrievalRecall(RetrievalMetric):
             raise ValueError("`k` has to be a positive integer or None")
         self.k = k
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_recall(preds, target, k=self.k)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_recall(preds, target, mask, k=self.k)
 
 
 class RetrievalFallOut(RetrievalMetric):
@@ -99,30 +99,15 @@ class RetrievalFallOut(RetrievalMetric):
             raise ValueError("`k` has to be a positive integer or None")
         self.k = k
 
-    def compute(self) -> Array:
+    def _query_is_empty(self, pos_counts: np.ndarray, neg_counts: np.ndarray) -> np.ndarray:
         """Reference ``fall_out.py:80-103`` — empty-target test is on negatives."""
-        indexes = np.asarray(dim_zero_cat(self.indexes))
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        return neg_counts == 0
 
-        res = []
-        groups = get_group_indexes(indexes)
-        for group in groups:
-            mini_preds = preds[group]
-            mini_target = target[group]
-            if not int(jnp.sum(1 - mini_target)):
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no negative target.")
-                if self.empty_target_action == "pos":
-                    res.append(jnp.asarray(1.0))
-                elif self.empty_target_action == "neg":
-                    res.append(jnp.asarray(0.0))
-            else:
-                res.append(self._metric(mini_preds, mini_target))
-        return jnp.stack(res).mean() if res else jnp.asarray(0.0)
+    def _empty_message(self) -> str:
+        return "`compute` method was provided with a query with no negative target."
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_fall_out(preds, target, k=self.k)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_fall_out(preds, target, mask, k=self.k)
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
@@ -141,8 +126,8 @@ class RetrievalNormalizedDCG(RetrievalMetric):
         self.k = k
         self.allow_non_binary_target = True
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_normalized_dcg(preds, target, k=self.k)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_normalized_dcg(preds, target, mask, k=self.k)
 
 
 class RetrievalHitRate(RetrievalMetric):
@@ -160,15 +145,15 @@ class RetrievalHitRate(RetrievalMetric):
             raise ValueError("`k` has to be a positive integer or None")
         self.k = k
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_hit_rate(preds, target, k=self.k)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_hit_rate(preds, target, mask, k=self.k)
 
 
 class RetrievalRPrecision(RetrievalMetric):
     """Mean r-precision (reference ``retrieval/r_precision.py:24``)."""
 
-    def _metric(self, preds: Array, target: Array) -> Array:
-        return retrieval_r_precision(preds, target)
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _masked_r_precision(preds, target, mask)
 
 
 def _retrieval_recall_at_fixed_precision(
@@ -208,44 +193,36 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         self.max_k = max_k
         self.adaptive_k = adaptive_k
 
-    def _metric(self, preds: Array, target: Array) -> Array:  # pragma: no cover - unused
+    def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:  # pragma: no cover - unused
         raise NotImplementedError
 
     def compute(self) -> Tuple[Array, Array, Array]:
-        """Reference ``precision_recall_curve.py:157-186``."""
+        """Vectorized form of reference ``precision_recall_curve.py:157-186``:
+        per-query (2, max_k) curves from the shared bucketed helper, then
+        average over (non-skipped) queries."""
         indexes = np.asarray(dim_zero_cat(self.indexes))
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
 
-        groups = get_group_indexes(indexes)
-        max_k = self.max_k or max(map(len, groups))
+        max_k = self.max_k
+        if max_k is None:
+            max_k = int(np.unique(indexes, return_counts=True)[1].max()) if indexes.size else 1
 
-        precisions, recalls = [], []
-        for group in groups:
-            mini_preds = preds[group]
-            mini_target = target[group]
-            if not int(jnp.sum(mini_target)):
-                if self.empty_target_action == "error":
-                    raise ValueError("`compute` method was provided with a query with no positive target.")
-                if self.empty_target_action == "pos":
-                    precisions.append(jnp.ones(max_k))
-                    recalls.append(jnp.ones(max_k))
-                elif self.empty_target_action == "neg":
-                    precisions.append(jnp.zeros(max_k))
-                    recalls.append(jnp.zeros(max_k))
-            else:
-                precision, recall, _ = retrieval_precision_recall_curve(mini_preds, mini_target, max_k, self.adaptive_k)
-                precisions.append(precision)
-                recalls.append(recall)
+        def curve_kernel(pp: Array, tt: Array, mm: Array) -> Array:
+            return jnp.stack(_masked_precision_recall_curve(pp, tt, mm, max_k, self.adaptive_k))
 
-        if precisions:
-            precision = jnp.stack(precisions).mean(axis=0)
-            recall = jnp.stack(recalls).mean(axis=0)
-        else:
-            precision = jnp.zeros(max_k)
-            recall = jnp.zeros(max_k)
+        values = self._per_query_values(
+            indexes,
+            preds,
+            target,
+            kernel=curve_kernel,
+            kernel_key=("pr_curve", max_k, self.adaptive_k),
+            out_shape=(2, max_k),
+        )
         top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
-        return precision, recall, top_k
+        if values.shape[0] == 0:
+            return jnp.zeros(max_k), jnp.zeros(max_k), top_k
+        return values[:, 0].mean(axis=0), values[:, 1].mean(axis=0), top_k
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):

@@ -148,6 +148,35 @@ class TestCapacityAUROC:
         with pytest.raises(ValueError, match="pos_label"):
             mt.AUROC(capacity=16, pos_label=0)
 
+    def test_sync_dist_mixed_states(self):
+        """Regime-3 process gather on a metric mixing CatBuffer and scalar
+        states (stubbed 2-process gather)."""
+        from metrics_tpu.metric import Metric
+        from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append
+
+        class Mixed(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("buf", default=CatBuffer.zeros(8), dist_reduce_fx="cat")
+                self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.buf = cat_append(self.buf, x)
+                self.total = self.total + x.shape[0]
+
+            def compute(self):
+                return jnp.sum(jnp.where(self.buf.mask, self.buf.data, 0.0)) / self.total
+
+        m = Mixed()
+        m.update(jnp.asarray([1.0, 2.0]))
+        fake_gather = lambda x, group=None: [x, x]  # 2 identical "processes"
+        m._sync_dist(dist_sync_fn=fake_gather)
+        assert m.buf.capacity == 16 and int(m.buf.count()) == 4
+        assert int(m.total) == 4
+        np.testing.assert_allclose(float(m._original_compute()), 1.5)
+
     def test_pickle_and_reset(self):
         m = mt.AUROC(capacity=64)
         m.update(jnp.asarray(PREDS[:32]), jnp.asarray(TARGET[:32]))

@@ -165,3 +165,77 @@ def test_invalid_inputs():
         m.update(np.array([0.1, 0.2]), np.array([1]), indexes=np.array([0, 0]))
     with pytest.raises(ValueError, match="long integers"):
         m.update(np.array([0.1]), np.array([1]), indexes=np.array([0.5]))
+
+
+# ---------------------------------------------------------------------------
+# Randomized ragged parity vs the importable reference (vectorized compute)
+# ---------------------------------------------------------------------------
+
+
+def _ragged_fixture(seed=5, n_queries=37, binary=True):
+    """Queries with wildly different sizes (1..70 docs), some with no
+    positives, shuffled — the regime the bucketed vectorized compute must
+    handle identically to the reference's per-query loop."""
+    rng = np.random.default_rng(seed)
+    sizes = rng.integers(1, 70, n_queries)
+    idx = np.concatenate([np.full(s, q) for q, s in enumerate(sizes)])
+    preds = rng.random(idx.size).astype(np.float32)
+    if binary:
+        target = (rng.random(idx.size) < 0.3).astype(np.int64)
+    else:
+        target = rng.integers(0, 5, idx.size)
+    shuffle = rng.permutation(idx.size)
+    return idx[shuffle], preds[shuffle], target[shuffle]
+
+
+@pytest.mark.parametrize(
+    ("cls", "ref_name", "kwargs", "binary"),
+    [
+        (RetrievalMAP, "RetrievalMAP", {}, True),
+        (RetrievalMRR, "RetrievalMRR", {}, True),
+        (RetrievalPrecision, "RetrievalPrecision", {"k": 5}, True),
+        (RetrievalPrecision, "RetrievalPrecision", {"k": 100, "adaptive_k": True}, True),
+        (RetrievalRecall, "RetrievalRecall", {"k": 5}, True),
+        (RetrievalFallOut, "RetrievalFallOut", {"k": 5}, True),
+        (RetrievalHitRate, "RetrievalHitRate", {"k": 5}, True),
+        (RetrievalRPrecision, "RetrievalRPrecision", {}, True),
+        (RetrievalNormalizedDCG, "RetrievalNormalizedDCG", {"k": 10}, False),
+        (RetrievalMAP, "RetrievalMAP", {"empty_target_action": "skip"}, True),
+        (RetrievalMAP, "RetrievalMAP", {"empty_target_action": "pos"}, True),
+    ],
+)
+def test_ragged_parity_vs_reference(cls, ref_name, kwargs, binary):
+    import torch
+
+    from tests.helpers.reference import import_reference
+
+    ref = import_reference()
+    idx, preds, target = _ragged_fixture(binary=binary)
+
+    m = cls(**kwargs)
+    ref_m = getattr(ref, ref_name)(**kwargs)
+    # strided two-batch accumulation
+    half = idx.size // 2
+    for sl in (slice(0, half), slice(half, None)):
+        m.update(preds[sl], target[sl], indexes=idx[sl])
+        ref_m.update(torch.tensor(preds[sl]), torch.tensor(target[sl]), indexes=torch.tensor(idx[sl]))
+    np.testing.assert_allclose(float(m.compute()), ref_m.compute().item(), atol=1e-5)
+
+
+@pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+def test_ragged_pr_curve_vs_reference(action):
+    import torch
+
+    from tests.helpers.reference import import_reference
+
+    ref = import_reference()
+    idx, preds, target = _ragged_fixture()
+    m = RetrievalPrecisionRecallCurve(max_k=10, empty_target_action=action)
+    ref_m = ref.RetrievalPrecisionRecallCurve(max_k=10, empty_target_action=action)
+    m.update(preds, target, indexes=idx)
+    ref_m.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(idx))
+    prec, rec, top_k = m.compute()
+    r_prec, r_rec, r_top_k = ref_m.compute()
+    np.testing.assert_allclose(np.asarray(prec), r_prec.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(rec), r_rec.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(top_k), r_top_k.numpy())
