@@ -3,7 +3,7 @@
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
 from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa  # noqa: F401
 from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix  # noqa: F401
-from metrics_tpu.functional.classification.dice import dice  # noqa: F401
+from metrics_tpu.functional.classification.dice import dice, dice_score  # noqa: F401
 from metrics_tpu.functional.classification.f_beta import f1_score, fbeta_score  # noqa: F401
 from metrics_tpu.functional.classification.hamming import hamming_distance  # noqa: F401
 from metrics_tpu.functional.classification.jaccard import jaccard_index  # noqa: F401

@@ -244,11 +244,12 @@ def _masked_precision(preds: Array, target: Array, mask: Array, k: Optional[int]
     st, _ = _masked_sort(preds, target, mask)
     length = preds.shape[-1]
     n = jnp.sum(mask.astype(jnp.float32))
-    k_eff = jnp.asarray(float(k if k is not None else length))
     if k is None:
         k_eff = n
     elif adaptive_k:
         k_eff = jnp.where(k > n, n, float(k))
+    else:
+        k_eff = jnp.asarray(float(k))
     ranks = jnp.arange(1, length + 1, dtype=jnp.float32)
     relevant = jnp.sum(st * (ranks <= k_eff))
     return jnp.where(jnp.sum(st) == 0, 0.0, relevant / k_eff)

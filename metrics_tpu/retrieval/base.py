@@ -139,8 +139,8 @@ class RetrievalMetric(Metric, ABC):
         values = np.zeros((num_queries,) + out_shape, np.float32)
         if self.empty_target_action == "pos":
             values[empty] = 1.0
-        # padded power-of-two length per query
-        lengths = np.asarray([1 << int(np.ceil(np.log2(max(c, 1)))) if c > 1 else 1 for c in counts])
+        # padded power-of-two length per query (vectorized: one array op)
+        lengths = np.where(counts > 1, 1 << np.ceil(np.log2(np.maximum(counts, 1))).astype(np.int64), 1)
         todo = ~empty
         for length in np.unique(lengths[todo]):
             sel = np.where(todo & (lengths == length))[0]

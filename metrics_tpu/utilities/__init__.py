@@ -1,0 +1,3 @@
+"""Utility surface (reference ``src/torchmetrics/utilities/__init__.py``)."""
+from metrics_tpu.utilities.checks import check_forward_full_state_property  # noqa: F401
+from metrics_tpu.utilities.prints import rank_zero_info, rank_zero_warn  # noqa: F401

@@ -17,7 +17,7 @@ TPU-first redesign of reference ``src/torchmetrics/utilities/checks.py``:
   catches to fall back to eager — pass ``num_classes`` explicitly to stay
   compiled (the static-shape contract from SURVEY.md §7).
 """
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -376,3 +376,105 @@ def _input_format_classification(
         preds, target = preds.squeeze(-1), target.squeeze(-1)
 
     return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+    """Elementwise closeness over nested dict/sequence results
+    (reference ``checks.py:607-624``)."""
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    import numpy as np
+
+    return bool(np.allclose(np.asarray(res1), np.asarray(res2), atol=atol, equal_nan=True))
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare: Sequence[int] = (10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Probe whether ``full_state_update=False`` is safe (and faster) for a
+    metric class — the reference's recommendation tool
+    (``utilities/checks.py:627-727``).
+
+    Runs the metric's ``forward`` under both strategies on the same inputs:
+    if the per-batch values and the final compute agree, times both and
+    prints the recommended flag.
+
+    Example:
+        >>> import numpy as np
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> rng = np.random.default_rng(0)
+        >>> check_forward_full_state_property(
+        ...     ConfusionMatrix,
+        ...     init_args={'num_classes': 3},
+        ...     input_args={'preds': rng.integers(3, size=10), 'target': rng.integers(3, size=10)},
+        ...     num_update_to_compare=(2, 4),
+        ...     reps=2,
+        ... )  # doctest: +ELLIPSIS
+        Full state for 2 steps took: ...
+        Recommended setting `full_state_update=...`
+    """
+    from time import perf_counter
+
+    import numpy as np
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    fullstate = FullState(**init_args)
+    partstate = PartState(**init_args)
+
+    from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+    equal = True
+    for _ in range(num_update_to_compare[0]):
+        out1 = fullstate(**input_args)
+        try:  # failure usually means update needs the full prior state
+            out2 = partstate(**input_args)
+        except (RuntimeError, MetricsTPUUserError):
+            equal = False
+            break
+        equal = equal and _allclose_recursive(out1, out2)
+
+    if equal:
+        res1 = fullstate.compute()
+        try:
+            res2 = partstate.compute()
+        except (RuntimeError, MetricsTPUUserError):
+            equal = False
+        else:
+            equal = equal and _allclose_recursive(res1, res2)
+
+    if not equal:
+        print("Recommended setting `full_state_update=True`")
+        return
+
+    timings = np.zeros((2, len(num_update_to_compare), reps))
+    for i, metric in enumerate((fullstate, partstate)):
+        for j, steps in enumerate(num_update_to_compare):
+            for r in range(reps):
+                start = perf_counter()
+                for _ in range(steps):
+                    metric(**input_args)
+                timings[i, j, r] = perf_counter() - start
+                metric.reset()
+
+    mean = timings.mean(-1)
+    std = timings.std(-1)
+    for j, steps in enumerate(num_update_to_compare):
+        print(f"Full state for {steps} steps took: {mean[0, j]:0.3f}+-{std[0, j]:0.3f}")
+        print(f"Partial state for {steps} steps took: {mean[1, j]:0.3f}+-{std[1, j]:0.3f}")
+
+    faster = bool(mean[1, -1] < mean[0, -1])
+    print(f"Recommended setting `full_state_update={not faster}`")

@@ -1,0 +1,114 @@
+"""Differentiability + bf16 precision checks (analogue of reference
+``testers.py:479-570``), across state patterns and domains."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+import metrics_tpu.functional as F
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(47)
+B, N = 4, 64
+REG_PREDS = np.random.rand(B, N).astype(np.float32)
+REG_TARGET = np.random.rand(B, N).astype(np.float32)
+AUDIO_PREDS = np.random.randn(B, 2, 200).astype(np.float32)
+AUDIO_TARGET = np.random.randn(B, 2, 200).astype(np.float32)
+
+
+class TestDifferentiability(MetricTester):
+    """jax.grad through every is_differentiable functional family."""
+
+    @pytest.mark.parametrize(
+        ("fn", "preds", "target", "kwargs"),
+        [
+            (F.mean_squared_error, REG_PREDS, REG_TARGET, {}),
+            (F.mean_absolute_error, REG_PREDS, REG_TARGET, {}),
+            (F.explained_variance, REG_PREDS, REG_TARGET, {}),
+            (F.cosine_similarity, REG_PREDS, REG_TARGET, {}),
+            (F.signal_noise_ratio, AUDIO_PREDS, AUDIO_TARGET, {}),
+            (F.scale_invariant_signal_distortion_ratio, AUDIO_PREDS, AUDIO_TARGET, {}),
+        ],
+    )
+    def test_grad_matches_finite_difference(self, fn, preds, target, kwargs):
+        self.run_differentiability_test(preds, target, fn, metric_args=kwargs)
+
+    def test_grad_through_ssim(self):
+        p = np.random.rand(1, 2, 1, 16, 16).astype(np.float32)
+        t = np.random.rand(1, 2, 1, 16, 16).astype(np.float32)
+        self.run_differentiability_test(
+            p, t, lambda a, b: F.structural_similarity_index_measure(a, b, data_range=1.0)
+        )
+
+    def test_grad_through_pairwise(self):
+        p = np.random.rand(1, 6, 8).astype(np.float32)
+        t = np.random.rand(1, 6, 8).astype(np.float32)
+        self.run_differentiability_test(
+            p, t, lambda a, b: F.pairwise_cosine_similarity(a, b)
+        )
+
+
+class TestPrecisionBf16(MetricTester):
+    """bf16 state casting via set_dtype stays close to fp32."""
+
+    def test_mse(self):
+        self.run_precision_test(REG_PREDS, REG_TARGET, mt.MeanSquaredError, atol=5e-2)
+
+    def test_mean_metric(self):
+        self.run_precision_test(REG_PREDS, REG_TARGET, mt.MeanMetric, atol=5e-2)
+
+    def test_snr(self):
+        self.run_precision_test(AUDIO_PREDS, AUDIO_TARGET, mt.SignalNoiseRatio, atol=1.0)
+
+    def test_accuracy_ints_untouched(self):
+        """Integer count states must survive set_dtype unchanged."""
+        logits = np.random.rand(B, 32, 5).astype(np.float32)
+        labels = np.random.randint(0, 5, (B, 32))
+        m32 = mt.Accuracy(num_classes=5)
+        m16 = mt.Accuracy(num_classes=5).set_dtype(jnp.bfloat16)
+        for i in range(B):
+            m32.update(jnp.asarray(logits[i]), jnp.asarray(labels[i]))
+            m16.update(jnp.asarray(logits[i]), jnp.asarray(labels[i]))
+        np.testing.assert_allclose(float(m32.compute()), float(m16.compute()), atol=1e-6)
+
+    def test_flags_immutable(self):
+        """is_differentiable/higher_is_better are class contracts
+        (reference ``testers.py:158-161``)."""
+        m = mt.MeanSquaredError()
+        assert m.is_differentiable is True and m.higher_is_better is False
+        assert mt.AUROC().higher_is_better is True
+        assert mt.SignalDistortionRatio().is_differentiable is True
+
+
+def test_check_forward_full_state_property(capsys):
+    """The strategy-recommendation prober runs end to end and prints a
+    recommendation (reference ``utilities/checks.py:627-727``)."""
+    from metrics_tpu.utilities import check_forward_full_state_property
+
+    rng = np.random.default_rng(0)
+    check_forward_full_state_property(
+        mt.ConfusionMatrix,
+        init_args={"num_classes": 3},
+        input_args={"preds": rng.integers(3, size=10), "target": rng.integers(3, size=10)},
+        num_update_to_compare=(3, 6),
+        reps=2,
+    )
+    out = capsys.readouterr().out
+    assert "Recommended setting `full_state_update=" in out
+
+    class StatefulReset(mt.ConfusionMatrix):
+        def update(self, preds, target):
+            super().update(preds, target)
+            if float(jnp.sum(self.confmat)) > 20:
+                self.reset()
+
+    check_forward_full_state_property(
+        StatefulReset,
+        init_args={"num_classes": 3},
+        input_args={"preds": rng.integers(3, size=10), "target": rng.integers(3, size=10)},
+        num_update_to_compare=(5, 10),
+        reps=1,
+    )
+    out = capsys.readouterr().out
+    assert "Recommended setting `full_state_update=True`" in out

@@ -163,3 +163,61 @@ class MetricTester:
 
         sk_result = sk_metric(np.concatenate(list(preds_dev)), np.concatenate(list(target_dev)))
         _assert_allclose(result, sk_result, atol=atol or self.atol)
+
+    def run_differentiability_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+        rtol: float = 5e-2,
+        atol: float = 1e-3,
+    ) -> None:
+        """``jax.grad`` flows through the metric and matches a central finite
+        difference along a random direction — the analogue of the reference's
+        ``run_differentiability_test`` (``testers.py:537-570``, which uses
+        ``torch.autograd.gradcheck``)."""
+        metric_args = metric_args or {}
+        p = jnp.asarray(preds[0], jnp.float32)
+        t = jnp.asarray(target[0])
+
+        def scalar_fn(x):
+            return jnp.sum(metric_functional(x, t, **metric_args))
+
+        grad = jax.grad(scalar_fn)(p)
+        assert grad.shape == p.shape
+        assert bool(jnp.all(jnp.isfinite(grad))), "gradient has non-finite entries"
+
+        rng = np.random.default_rng(0)
+        direction = jnp.asarray(rng.standard_normal(p.shape), jnp.float32)
+        direction = direction / jnp.linalg.norm(direction)
+        eps = 1e-3
+        numeric = (scalar_fn(p + eps * direction) - scalar_fn(p - eps * direction)) / (2 * eps)
+        analytic = jnp.sum(grad * direction)
+        np.testing.assert_allclose(float(analytic), float(numeric), rtol=rtol, atol=atol)
+
+    def run_precision_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class: type,
+        metric_args: Optional[dict] = None,
+        atol: float = 1e-2,
+        **kwargs_update: Any,
+    ) -> None:
+        """bf16 state path stays close to the fp32 result — the analogue of
+        the reference's fp16 ``run_precision_test_cpu/gpu``
+        (``testers.py:479-534``)."""
+        metric_args = metric_args or {}
+        m32 = metric_class(**metric_args)
+        m16 = metric_class(**metric_args).set_dtype(jnp.bfloat16)
+        for i in range(preds.shape[0]):
+            m32.update(jnp.asarray(preds[i]), jnp.asarray(target[i]), **kwargs_update)
+            m16.update(
+                jnp.asarray(preds[i], jnp.bfloat16), jnp.asarray(target[i]), **kwargs_update
+            )
+        r32 = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), m32.compute())
+        r16 = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), m16.compute())
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=atol, rtol=5e-2), r32, r16
+        )

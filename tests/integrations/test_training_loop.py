@@ -1,0 +1,131 @@
+"""End-to-end integration: metrics inside a real jitted flax/optax training
+loop — the analogue of reference ``test/integrations/test_lightning.py``.
+
+Covers the whole L5 contract (SURVEY.md §3.5): per-step forward logging,
+epoch-end compute, reset between epochs, a MetricCollection alongside single
+metrics, and the pure-functional path living INSIDE the jitted train step.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import metrics_tpu as mt
+from tests.helpers import seed_all
+
+seed_all(53)
+NUM_CLASSES = 4
+N, DIM = 256, 8
+X = np.random.randn(N, DIM).astype(np.float32)
+W_TRUE = np.random.randn(DIM, NUM_CLASSES).astype(np.float32)
+Y = (X @ W_TRUE + 0.1 * np.random.randn(N, NUM_CLASSES)).argmax(1)
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def test_module_metrics_in_training_loop():
+    """Eager module metrics around a jitted train step: forward logging per
+    batch, epoch compute/reset — the self.log(metric) pattern."""
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), X[:2])
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss, logits
+
+    acc = mt.Accuracy(num_classes=NUM_CLASSES)
+    collection = mt.MetricCollection(
+        [mt.Precision(num_classes=NUM_CLASSES, average="macro"), mt.Recall(num_classes=NUM_CLASSES, average="macro")]
+    )
+
+    batch = 64
+    epoch_values = []
+    for epoch in range(3):
+        for i in range(0, N, batch):
+            x, y = jnp.asarray(X[i : i + batch]), jnp.asarray(Y[i : i + batch])
+            params, opt_state, loss, logits = train_step(params, opt_state, x, y)
+            step_acc = acc(jax.nn.softmax(logits), y)  # forward: batch value
+            assert 0.0 <= float(step_acc) <= 1.0
+            collection.update(jax.nn.softmax(logits), y)
+        epoch_values.append(float(acc.compute()))
+        epoch_coll = {k: float(v) for k, v in collection.compute().items()}
+        assert set(epoch_coll) == {"Precision", "Recall"}
+        acc.reset()
+        collection.reset()
+        assert acc.update_count == 0
+
+    # training on separable-ish data must improve accuracy
+    assert epoch_values[-1] > epoch_values[0] - 1e-6
+    assert epoch_values[-1] > 0.5
+
+
+def test_functional_metrics_inside_jitted_step():
+    """Pure-functional metric state threaded THROUGH the jitted train step —
+    the TPU-idiomatic integration (no reference analogue; the reference can
+    only run metrics eagerly outside the graph)."""
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(1), X[:2])
+    tx = optax.sgd(1e-2)
+    opt_state = tx.init(params)
+
+    acc = mt.functionalize(mt.Accuracy(num_classes=NUM_CLASSES))
+    auroc = mt.functionalize(mt.AUROC(num_classes=NUM_CLASSES, capacity=2048))
+
+    @jax.jit
+    def train_step(params, opt_state, metric_states, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+        (_, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        probs = jax.nn.softmax(logits)
+        sa, su = metric_states
+        metric_states = (acc.update(sa, probs, y), auroc.update(su, probs, y))
+        return optax.apply_updates(params, updates), opt_state, metric_states
+
+    states = (acc.init(), auroc.init())
+    for i in range(0, N, 64):
+        params, opt_state, states = train_step(
+            params, opt_state, states, jnp.asarray(X[i : i + 64]), jnp.asarray(Y[i : i + 64])
+        )
+
+    final_acc = float(acc.compute(states[0]))
+    final_auroc = float(auroc.compute(states[1]))
+    assert 0.0 <= final_acc <= 1.0
+    assert 0.0 <= final_auroc <= 1.0
+
+    # cross-check against the eager module path on the same predictions
+    m = mt.AUROC(num_classes=NUM_CLASSES, capacity=2048)
+    model_probs = jax.nn.softmax(model.apply(params, jnp.asarray(X)))
+    # (states saw evolving params; just sanity-check the final-epoch value range)
+    m.update(model_probs, jnp.asarray(Y))
+    assert 0.0 <= float(m.compute()) <= 1.0
+
+
+def test_checkpoint_roundtrip_mid_epoch():
+    """Metric state must survive an orbax-style checkpoint (pytree of
+    arrays) mid-accumulation."""
+    acc = mt.functionalize(mt.Accuracy(num_classes=NUM_CLASSES))
+    state = acc.init()
+    state = acc.update(state, jnp.asarray(np.eye(NUM_CLASSES, dtype=np.float32)), jnp.arange(NUM_CLASSES))
+    # simulate checkpoint: host round-trip through numpy
+    restored = jax.tree_util.tree_map(lambda x: jnp.asarray(np.asarray(x)), state)
+    state2 = acc.update(restored, jnp.asarray(np.eye(NUM_CLASSES, dtype=np.float32)), jnp.arange(NUM_CLASSES))
+    np.testing.assert_allclose(float(acc.compute(state2)), 1.0)
