@@ -75,10 +75,14 @@ class BinnedPrecisionRecallCurve(Metric):
         self,
         num_classes: int,
         thresholds: Union[int, Array, List[float]] = 100,
+        use_pallas: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
+        # the hand-tiled VMEM kernel (ops/binned_counters.py) avoids the
+        # (N, C, T) HBM intermediate; default on for real TPU backends
+        self.use_pallas = jax.default_backend() == "tpu" if use_pallas is None else use_pallas
         if isinstance(thresholds, int):
             self.num_thresholds = thresholds
             self.thresholds = jnp.linspace(0, 1.0, thresholds)
@@ -105,6 +109,19 @@ class BinnedPrecisionRecallCurve(Metric):
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
 
+        if self.use_pallas:
+            from metrics_tpu.ops.binned_counters import binned_counter_update
+
+            tps, fps, fns = binned_counter_update(
+                preds,
+                (target == 1).astype(jnp.float32),
+                self.thresholds,
+                interpret=jax.default_backend() != "tpu",
+            )
+            self.TPs += tps
+            self.FPs += fps
+            self.FNs += fns
+            return
         tgt = (target == 1)[..., None]  # (N, C, 1)
         pred = preds[..., None] >= self.thresholds  # (N, C, T)
         self.TPs += jnp.sum(tgt & pred, axis=0).astype(jnp.float32)

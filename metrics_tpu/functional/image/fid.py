@@ -46,10 +46,33 @@ def _mean_cov(features: Array) -> Tuple[Array, Array]:
 
 
 def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6) -> Array:
-    """Frechet distance between two Gaussians (reference ``image/fid.py:98-127``)."""
+    """Frechet distance between two Gaussians (reference ``image/fid.py:98-127``).
+
+    Near-singular covariance products can carry tiny negative numerical
+    eigenvalues, which the Newton–Schulz iteration turns into NaN; like the
+    reference's scipy path, the computation falls back to diagonally-loaded
+    covariances ``sigma + eps * I`` when that happens (selected branchlessly
+    so the whole thing stays jittable).
+    """
     diff = mu1 - mu2
-    covmean = _newton_schulz_sqrtm(sigma1 @ sigma2)
-    tr_covmean = jnp.trace(covmean)
+    offset = jnp.eye(sigma1.shape[0], dtype=sigma1.dtype) * eps
+
+    # Validity needs more than finiteness: on ill-conditioned products the
+    # fp32 iteration can "converge" to finite garbage. Probe under
+    # stop_gradient (no backward is ever built through a bad iteration) and
+    # accept only if the residual ||S@S - A||/||A|| is small; otherwise run
+    # the diagonally-loaded fallback — selected via lax.cond so just one
+    # branch executes and differentiates.
+    prod = jax.lax.stop_gradient(sigma1 @ sigma2)
+    probe = _newton_schulz_sqrtm(prod)
+    prod_norm = jnp.sqrt(jnp.sum(prod * prod))
+    residual = jnp.sqrt(jnp.sum((probe @ probe - prod) ** 2)) / (prod_norm + 1e-30)
+    ok = jnp.isfinite(residual) & (residual < 1e-2)
+    tr_covmean = jax.lax.cond(
+        ok,
+        lambda: jnp.trace(_newton_schulz_sqrtm(sigma1 @ sigma2)),
+        lambda: jnp.trace(_newton_schulz_sqrtm((sigma1 + offset) @ (sigma2 + offset))),
+    )
     return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 

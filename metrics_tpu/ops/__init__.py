@@ -1,0 +1,8 @@
+"""Hand-written TPU kernels (pallas) for hot metric ops.
+
+XLA handles most fusion; these kernels cover the few update paths where the
+default lowering materializes a large intermediate (see each module's
+docstring). Every kernel has an identical-semantics XLA fallback and runs in
+pallas interpret mode off-TPU, so parity tests execute everywhere.
+"""
+from metrics_tpu.ops.binned_counters import binned_counter_update  # noqa: F401

@@ -46,9 +46,21 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     with unbounded list (``cat``) states are not functionalizable — construct
     them with a fixed ``capacity=N`` (a :class:`CatBuffer` ring state, e.g.
     ``AUROC(capacity=N)``) or use the binned variants inside compiled code.
+
+    A :class:`~metrics_tpu.MetricCollection` functionalizes too: state is a
+    dict keyed by metric name, ``compute`` returns the named results dict
+    (with the collection's prefix/postfix), and under ``axis_name`` the whole
+    collection syncs through ``fused_sync`` — one collective per (reduction,
+    dtype). No runtime compute-group probing is needed: duplicated update
+    subgraphs (e.g. four StatScores-backed metrics) are merged by XLA CSE
+    inside the single jitted graph, which is the compile-time form of the
+    reference's compute groups (``collections.py:191-267``).
     """
+    from metrics_tpu.collections import MetricCollection  # local import to avoid cycle
     from metrics_tpu.metric import Metric  # local import to avoid cycle
 
+    if isinstance(metric, MetricCollection):
+        return _functionalize_collection(metric, axis_name)
     assert isinstance(metric, Metric)
     if any(isinstance(d, list) for d in metric._defaults.values()):
         raise ValueError(
@@ -128,5 +140,38 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
             else:
                 raise ValueError(f"State {name!r} with reduction {fx!r} has no pure merge rule.")
         return merged
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+
+
+def _functionalize_collection(collection: "MetricCollection", axis_name: Optional[str] = None) -> MetricDef:
+    """Pure functions over a ``{metric_name: state}`` dict for a collection."""
+    from metrics_tpu.parallel.sync import fused_sync
+    from metrics_tpu.utilities.data import _flatten_dict
+
+    members = list(collection.items(keep_base=True, copy_state=False))
+    mdefs = {name: functionalize(m) for name, m in members}
+    reductions = {name: dict(m._reductions) for name, m in members}
+
+    def init() -> Dict[str, Any]:
+        return {name: mdefs[name].init() for name, _ in members}
+
+    def update(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return {
+            name: mdefs[name].update(state[name], *args, **m._filter_kwargs(**kwargs))
+            for name, m in members
+        }
+
+    def compute(state: Dict[str, Any]) -> Dict[str, Any]:
+        if axis_name is not None:
+            ordered = [state[name] for name, _ in members]
+            synced = fused_sync(ordered, [reductions[name] for name, _ in members], axis_name)
+            state = {name: s for (name, _), s in zip(members, synced)}
+        res = {name: mdefs[name].compute(state[name]) for name, _ in members}
+        res = _flatten_dict(res)
+        return {collection._set_name(k): v for k, v in res.items()}
+
+    def merge(state_a: Dict[str, Any], state_b: Dict[str, Any], **counts: Any) -> Dict[str, Any]:
+        return {name: mdefs[name].merge(state_a[name], state_b[name], **counts) for name, _ in members}
 
     return MetricDef(init=init, update=update, compute=compute, merge=merge)
