@@ -755,7 +755,7 @@ class Metric:
     def __abs__(self): return CompositionalMetric(jnp.abs, self, None)
     def __neg__(self): return CompositionalMetric(_neg, self, None)
     def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
-    def __invert__(self): return CompositionalMetric(jnp.logical_not, self, None)
+    def __invert__(self): return CompositionalMetric(jnp.bitwise_not, self, None)
     def __getitem__(self, idx): return CompositionalMetric(lambda x: x[idx], self, None)
 
 
@@ -784,6 +784,12 @@ class CompositionalMetric(Metric):
 
     def _sync_dist(self, dist_sync_fn=None, process_group=None) -> None:
         pass  # children sync themselves (reference ``metric.py:870``)
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        # no composition-level cache: children cache their own computes, and
+        # a cached composition value would survive reset (reference
+        # ``metric.py:938-939`` disables the wrapper the same way)
+        return compute
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):
