@@ -61,10 +61,11 @@ class BinnedPrecisionRecallCurve(Metric):
         >>> target = jnp.array([0, 1, 1, 0])
         >>> pr_curve = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
         >>> precision, recall, thresholds = pr_curve(pred, target)
-        >>> precision
-        Array([0.5      , 0.5      , 1.       , 0.9999999, 0.9999999, 1.       ],      dtype=float32)
-        >>> recall
-        Array([1. , 0.5, 0.5, 0. , 0. , 0. ], dtype=float32)
+        >>> import numpy as np
+        >>> np.asarray(precision).round(2)
+        array([0.5, 0.5, 1. , 1. , 1. , 1. ], dtype=float32)
+        >>> np.asarray(recall).round(2)
+        array([1. , 0.5, 0.5, 0.5, 0. , 0. ], dtype=float32)
     """
 
     is_differentiable = False
@@ -147,8 +148,8 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
         >>> import jax.numpy as jnp
         >>> pred = jnp.array([0, 1, 2, 3], jnp.float32)
         >>> target = jnp.array([0, 1, 1, 1])
-        >>> BinnedAveragePrecision(num_classes=1, thresholds=10)(pred, target)
-        Array(1., dtype=float32)
+        >>> print(f"{BinnedAveragePrecision(num_classes=1, thresholds=10)(pred, target):.4f}")
+        1.0000
     """
 
     def compute(self) -> Union[List[Array], Array]:
@@ -165,8 +166,9 @@ class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
         >>> pred = jnp.array([0, 0.2, 0.5, 0.8])
         >>> target = jnp.array([0, 1, 1, 0])
         >>> m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
-        >>> m(pred, target)
-        (Array(1., dtype=float32), Array(0.11111111, dtype=float32))
+        >>> recall, threshold = m(pred, target)
+        >>> print(f"{recall:.4f} {threshold:.4f}")
+        1.0000 0.1111
     """
 
     def __init__(

@@ -40,7 +40,7 @@ class MetricCollection:
         ...                             Precision(num_classes=3, average='macro'),
         ...                             Recall(num_classes=3, average='macro')])
         >>> sorted(metrics(preds, target).items())
-        [('Accuracy', Array(0.125, dtype=float32)), ('Precision', Array(0.06666667, dtype=float32)), ('Recall', Array(0.11111111, dtype=float32))]
+        [('Accuracy', Array(0.125, dtype=float32)), ('Precision', Array(0.06666667, dtype=float32)), ('Recall', Array(0.11111112, dtype=float32))]
     """
 
     def __init__(

@@ -99,8 +99,8 @@ def hinge_loss(
         >>> import jax.numpy as jnp
         >>> target = jnp.array([0, 1, 1])
         >>> preds = jnp.array([-2.2, 2.4, 0.1])
-        >>> hinge_loss(preds, target)
-        Array(0.3, dtype=float32)
+        >>> print(f"{hinge_loss(preds, target):.4f}")
+        0.3000
     """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

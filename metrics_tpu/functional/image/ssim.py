@@ -307,9 +307,9 @@ def multiscale_structural_similarity_index_measure(
 
     Example:
         >>> import jax
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 64, 64))
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 1, 192, 192))
         >>> target = preds * 0.75
-        >>> float(multiscale_structural_similarity_index_measure(preds, target)) > 0.9
+        >>> float(multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)) > 0.9
         True
     """
     if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):

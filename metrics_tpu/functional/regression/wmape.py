@@ -33,8 +33,8 @@ def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Arra
         >>> import jax.numpy as jnp
         >>> target = jnp.array([1., 10, 1e6])
         >>> preds = jnp.array([0.9, 15, 1.2e6])
-        >>> weighted_mean_absolute_percentage_error(preds, target).round(4)
-        Array(0.2, dtype=float32)
+        >>> print(f"{weighted_mean_absolute_percentage_error(preds, target):.4f}")
+        0.2000
     """
     sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
     return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

@@ -212,7 +212,7 @@ def rouge_score(
         >>> target = "Is your name John"
         >>> res = rouge_score(preds, target, rouge_keys="rouge1")
         >>> round(float(res["rouge1_fmeasure"]), 4)
-        0.5
+        0.75
     """
     if accumulate not in ALLOWED_ACCUMULATE_VALUES:
         raise ValueError(

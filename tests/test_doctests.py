@@ -1,0 +1,31 @@
+"""Run every docstring example in the package (the reference runs its
+doctests in CI, ``Makefile:23-26``) — examples are part of the API contract
+and must stay executable and correct."""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+_MODULES = sorted(
+    info.name
+    for info in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu.")
+    if not info.ispkg
+)
+
+
+@pytest.mark.parametrize("module_name", _MODULES)
+def test_module_doctests(module_name):
+    module = importlib.import_module(module_name)
+    skips = set(getattr(module, "__doctest_skip__", ()))
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE)
+    failures = 0
+    for test in finder.find(module, module.__name__):
+        if any(skip in test.name for skip in skips):
+            continue
+        result = runner.run(test)
+        failures += result.failed
+    assert failures == 0, f"{failures} doctest failure(s) in {module_name}"
