@@ -13,13 +13,23 @@ import jax.numpy as jnp
 
 Array = jax.Array
 
+# All FID linear algebra runs at full fp32 MXU precision: the Newton–Schulz
+# iteration is only locally stable, and the TPU default (one bf16 pass) loses
+# enough bits to push marginally-conditioned products into divergence.
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _mm(a: Array, b: Array) -> Array:
+    return jnp.matmul(a, b, precision=_HI)
+
 
 def _newton_schulz_sqrtm(mat: Array, num_iters: int = 50, eps: float = 1e-12) -> Array:
     """Matrix square root of a PSD matrix via Newton–Schulz iteration.
 
     Replaces scipy ``sqrtm`` (reference ``image/fid.py:61-95``); converges
     quadratically for matrices with ``||I - A/||A||_F|| < 1`` which holds for
-    the PSD covariance products FID feeds it.
+    well-conditioned PSD covariance products (rank-deficient ones are handled
+    by the fallback ladder in :func:`_compute_fid`).
     """
     dim = mat.shape[0]
     norm = jnp.sqrt(jnp.sum(mat * mat)) + eps
@@ -29,11 +39,50 @@ def _newton_schulz_sqrtm(mat: Array, num_iters: int = 50, eps: float = 1e-12) ->
 
     def body(_, carry):
         y, z = carry
-        t = 0.5 * (3.0 * ident - z @ y)
-        return y @ t, t @ z
+        t = 0.5 * (3.0 * ident - _mm(z, y))
+        return _mm(y, t), _mm(t, z)
 
     y, z = jax.lax.fori_loop(0, num_iters, body, (y, z))
     return y * jnp.sqrt(norm)
+
+
+def _trace_sqrtm_psd_product(sigma1: Array, sigma2: Array) -> Array:
+    """Exact ``trace(sqrtm(sigma1 @ sigma2))`` for PSD factors via eigh.
+
+    ``sigma1 @ sigma2`` is similar to the PSD matrix
+    ``sqrtm(sigma1) @ sigma2 @ sqrtm(sigma1)``, so its eigenvalues are real
+    and non-negative; the trace of the square root is the sum of their square
+    roots. Unlike Newton–Schulz this is unconditionally stable in the forward
+    direction, but its *gradient* is undefined at repeated/zero eigenvalues
+    (eigh eigenvector JVPs divide by eigenvalue gaps) — callers that have the
+    centered feature matrices should prefer
+    :func:`_trace_sqrtm_from_centered`, whose gradients stay finite.
+    """
+    w1, v1 = jnp.linalg.eigh(sigma1)
+    s1h = _mm(v1 * jnp.sqrt(jnp.clip(w1, 0.0)), v1.T)
+    inner = _mm(_mm(s1h, sigma2), s1h)
+    ev = jnp.linalg.eigvalsh((inner + inner.T) / 2)
+    return jnp.sqrt(jnp.clip(ev, 0.0)).sum()
+
+
+def _trace_sqrtm_from_centered(xc: Array, yc: Array) -> Array:
+    """``trace(sqrtm(sigma1 @ sigma2))`` as a nuclear norm of centered features.
+
+    With ``sigma1 = xc.T @ xc / (n-1)`` and ``sigma2 = yc.T @ yc / (m-1)``,
+    the nonzero eigenvalues of ``sigma1 @ sigma2`` are (by cyclic
+    permutation) the eigenvalues of ``(xc @ yc.T)(xc @ yc.T).T / ((n-1)(m-1))``
+    — i.e. the squared singular values of ``xc @ yc.T``. Hence
+
+        trace(sqrtm(sigma1 @ sigma2)) = ||xc @ yc.T||_* / sqrt((n-1)(m-1)).
+
+    Exact for every rank (no square root of eigenvalues is ever formed — the
+    singular values *are* the square roots), and differentiable with finite
+    gradients even at rank deficiency, where the eigh formulation NaNs.
+    """
+    n, m = xc.shape[0], yc.shape[0]
+    cross = _mm(xc, yc.T)
+    sv = jnp.linalg.svd(cross, compute_uv=False)
+    return sv.sum() / jnp.sqrt(jnp.asarray((n - 1) * (m - 1), cross.dtype))
 
 
 def _mean_cov(features: Array) -> Tuple[Array, Array]:
@@ -41,11 +90,13 @@ def _mean_cov(features: Array) -> Tuple[Array, Array]:
     n = features.shape[0]
     mu = features.mean(axis=0)
     centered = features - mu
-    sigma = centered.T @ centered / (n - 1)
+    sigma = _mm(centered.T, centered) / (n - 1)
     return mu, sigma
 
 
-def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6) -> Array:
+def _compute_fid(
+    mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6, centered=None
+) -> Array:
     """Frechet distance between two Gaussians (reference ``image/fid.py:98-127``).
 
     Near-singular covariance products can carry tiny negative numerical
@@ -58,22 +109,46 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: floa
     offset = jnp.eye(sigma1.shape[0], dtype=sigma1.dtype) * eps
 
     # Validity needs more than finiteness: on ill-conditioned products the
-    # fp32 iteration can "converge" to finite garbage. Probe under
-    # stop_gradient (no backward is ever built through a bad iteration) and
-    # accept only if the residual ||S@S - A||/||A|| is small; otherwise run
-    # the diagonally-loaded fallback — selected via lax.cond so just one
-    # branch executes and differentiates.
-    prod = jax.lax.stop_gradient(sigma1 @ sigma2)
-    probe = _newton_schulz_sqrtm(prod)
-    prod_norm = jnp.sqrt(jnp.sum(prod * prod))
-    residual = jnp.sqrt(jnp.sum((probe @ probe - prod) ** 2)) / (prod_norm + 1e-30)
-    ok = jnp.isfinite(residual) & (residual < 1e-2)
+    # fp32 iteration can "converge" to finite garbage. Probe each candidate
+    # under stop_gradient (no backward is ever built through a bad iteration)
+    # and accept only if the residual ||S@S - A||/||A|| is small. The ladder:
+    # (1) Newton–Schulz on the raw product, (2) Newton–Schulz on
+    # diagonally-loaded covariances, (3) an exact terminal formulation that
+    # handles rank-deficient N < D covariances — the nuclear-norm identity on
+    # centered features when the caller provides them (finite gradients), the
+    # eigh trace otherwise. Branches are lax.cond lambdas so only the selected
+    # one executes and differentiates, and the loaded product is only formed
+    # when branch (1) fails.
+    def _ns_ok(prod: Array) -> Array:
+        prod = jax.lax.stop_gradient(prod)
+        probe = _newton_schulz_sqrtm(prod)
+        prod_norm = jnp.sqrt(jnp.sum(prod * prod))
+        residual = jnp.sqrt(jnp.sum((_mm(probe, probe) - prod) ** 2)) / (prod_norm + 1e-30)
+        return jnp.isfinite(residual) & (residual < 1e-2)
+
+    if centered is not None:
+        xc, yc = centered
+        # The (n, m) cross matrix must stay SVD-sized; past ~4x the feature
+        # dim the covariances are generically full-rank and the eigh terminal
+        # is as exact (shape choice is static, so this is a trace-time pick).
+        if min(xc.shape[0], yc.shape[0]) <= 4 * sigma1.shape[0]:
+            terminal = lambda: _trace_sqrtm_from_centered(xc, yc)
+        else:
+            terminal = lambda: _trace_sqrtm_psd_product(sigma1, sigma2)
+    else:
+        terminal = lambda: _trace_sqrtm_psd_product(sigma1, sigma2)
+
+    prod = _mm(sigma1, sigma2)
     tr_covmean = jax.lax.cond(
-        ok,
-        lambda: jnp.trace(_newton_schulz_sqrtm(sigma1 @ sigma2)),
-        lambda: jnp.trace(_newton_schulz_sqrtm((sigma1 + offset) @ (sigma2 + offset))),
+        _ns_ok(prod),
+        lambda: jnp.trace(_newton_schulz_sqrtm(prod)),
+        lambda: jax.lax.cond(
+            _ns_ok(_mm(sigma1 + offset, sigma2 + offset)),
+            lambda: jnp.trace(_newton_schulz_sqrtm(_mm(sigma1 + offset, sigma2 + offset))),
+            terminal,
+        ),
     )
-    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+    return jnp.sum(diff * diff) + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 
 def frechet_inception_distance_from_features(real_features: Array, fake_features: Array) -> Array:
@@ -82,7 +157,9 @@ def frechet_inception_distance_from_features(real_features: Array, fake_features
     fake_features = jnp.asarray(fake_features, real_features.dtype)
     mu1, sigma1 = _mean_cov(real_features)
     mu2, sigma2 = _mean_cov(fake_features)
-    return _compute_fid(mu1, sigma1, mu2, sigma2)
+    return _compute_fid(
+        mu1, sigma1, mu2, sigma2, centered=(real_features - mu1, fake_features - mu2)
+    )
 
 
 def _poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma=None, coef: float = 1.0) -> Array:

@@ -1,4 +1,5 @@
 """Image module metrics (reference ``src/torchmetrics/image/__init__.py``)."""
+from metrics_tpu.image.extractor import TinyImageEncoder, perceptual_distance  # noqa: F401
 from metrics_tpu.image.fid import FrechetInceptionDistance  # noqa: F401
 from metrics_tpu.image.inception import InceptionScore  # noqa: F401
 from metrics_tpu.image.kid import KernelInceptionDistance  # noqa: F401

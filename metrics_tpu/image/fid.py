@@ -78,7 +78,9 @@ class FrechetInceptionDistance(Metric):
             raise ValueError("More than one sample is required for both the real and fake distributed to compute FID")
         mu1, sigma1 = _mean_cov(real_features)
         mu2, sigma2 = _mean_cov(fake_features)
-        return _compute_fid(mu1, sigma1, mu2, sigma2)
+        return _compute_fid(
+            mu1, sigma1, mu2, sigma2, centered=(real_features - mu1, fake_features - mu2)
+        )
 
     def reset(self) -> None:
         """Reference ``image/fid.py:294-303``: optionally keep real features."""

@@ -349,3 +349,73 @@ def test_fid_ill_conditioned_features_vs_scipy():
     np.testing.assert_allclose(got, exact, atol=1e-4)
     grads = jax.grad(lambda a, b: fid_fn(a, b))(jnp.asarray(f1), jnp.asarray(f2))
     assert bool(jnp.all(jnp.isfinite(grads)))
+
+
+def test_bundled_encoder_end_to_end():
+    """The bundled TinyImageEncoder drives FID/KID/IS/LPIPS with no injected
+    network: uint8 images in, scores out, deterministic across instances."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.image import TinyImageEncoder, perceptual_distance
+
+    rng = np.random.default_rng(7)
+    real = rng.integers(0, 256, (48, 3, 32, 32), dtype=np.uint8)
+    same = rng.integers(0, 256, (48, 3, 32, 32), dtype=np.uint8)
+    shifted = np.clip(same.astype(np.int64) + 96, 0, 255).astype(np.uint8)
+
+    enc = TinyImageEncoder(feature_dim=32, seed=0)
+    feats = enc(jnp.asarray(real))
+    assert feats.shape == (48, 32)
+    # weights are a pure function of the seed -> bit-identical across instances
+    np.testing.assert_array_equal(np.asarray(feats), np.asarray(TinyImageEncoder(feature_dim=32, seed=0)(real)))
+    assert not np.allclose(np.asarray(feats), np.asarray(TinyImageEncoder(feature_dim=32, seed=1)(real)))
+
+    m = FrechetInceptionDistance(feature=enc)
+    m.update(real, real=True)
+    m.update(same, real=False)
+    fid_same = float(m.compute())
+    m2 = FrechetInceptionDistance(feature=enc)
+    m2.update(real, real=True)
+    m2.update(shifted, real=False)
+    fid_shifted = float(m2.compute())
+    assert fid_same >= 0 and fid_shifted > 2 * max(fid_same, 1e-3), (fid_same, fid_shifted)
+
+    np.random.seed(3)
+    kid = KernelInceptionDistance(feature=enc, subsets=10, subset_size=32)
+    kid.update(real, real=True)
+    kid.update(shifted, real=False)
+    kid_mean, _ = kid.compute()
+    assert np.isfinite(float(kid_mean))
+
+    is_m = InceptionScore(feature=enc)
+    is_m.update(real)
+    is_mean, _ = is_m.compute()
+    assert float(is_mean) >= 1.0 - 1e-5
+
+    dist = perceptual_distance(enc)
+    zero = np.asarray(dist(jnp.asarray(real, jnp.float32), jnp.asarray(real, jnp.float32)))
+    np.testing.assert_allclose(zero, np.zeros(48), atol=1e-6)
+    lp = LearnedPerceptualImagePatchSimilarity(net=dist)
+    lp.update(real.astype(np.float32), shifted.astype(np.float32))
+    assert float(lp.compute()) > 0
+
+
+def test_fid_rank_deficient_features_vs_scipy():
+    """N < D features make the covariances singular: both Newton-Schulz rungs
+    diverge and the nuclear-norm terminal (exact trace via singular values of
+    the centered cross matrix) must land on the scipy value with finite
+    gradients — the reference's scipy path is not differentiable here at all."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.image.fid import frechet_inception_distance_from_features as fid_fn
+
+    rng = np.random.default_rng(11)
+    f1 = rng.standard_normal((8, 32)).astype(np.float32)
+    f2 = (rng.standard_normal((8, 32)) + 0.4).astype(np.float32)
+    s1, s2 = np.cov(f1.T), np.cov(f2.T)
+    exact = ((f1.mean(0) - f2.mean(0)) ** 2).sum() + np.trace(s1 + s2 - 2 * scipy.linalg.sqrtm(s1 @ s2).real)
+    got = float(fid_fn(jnp.asarray(f1), jnp.asarray(f2)))
+    np.testing.assert_allclose(got, exact.real, rtol=1e-4, atol=1e-4)
+    grads = jax.grad(lambda a, b: fid_fn(a, b))(jnp.asarray(f1), jnp.asarray(f2))
+    assert bool(jnp.all(jnp.isfinite(grads))), "NaN gradient through the rank-deficient FID fallback"

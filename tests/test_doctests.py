@@ -9,9 +9,13 @@ import pytest
 
 import metrics_tpu
 
+def _walk_error(name):  # a subpackage that fails to import must fail the gate, not shrink it
+    raise ImportError(f"failed to import {name} while collecting doctest modules")
+
+
 _MODULES = sorted(
     info.name
-    for info in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu.")
+    for info in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu.", onerror=_walk_error)
     if not info.ispkg
 )
 
