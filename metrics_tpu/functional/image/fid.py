@@ -37,10 +37,22 @@ def _newton_schulz_sqrtm(mat: Array, num_iters: int = 50, eps: float = 1e-12) ->
     ident = jnp.eye(dim, dtype=mat.dtype)
     z = ident
 
+    # A diverging iteration must produce finite garbage, not NaN: the caller
+    # rejects it via the residual check, but NaN primals would poison the
+    # zero-cotangent backward pass of the *unselected* branch (0 * NaN = NaN
+    # leaks into the input gradients). On a converging iteration the iterates
+    # stay O(1), so the clamp is inactive and exactness is untouched; a
+    # diverging one is clamped well below fp32 overflow (1e6^2 * dim stays
+    # finite through every product below).
+    clamp = 1e6
+
     def body(_, carry):
         y, z = carry
         t = 0.5 * (3.0 * ident - _mm(z, y))
-        return _mm(y, t), _mm(t, z)
+        return (
+            jnp.clip(_mm(y, t), -clamp, clamp),
+            jnp.clip(_mm(t, z), -clamp, clamp),
+        )
 
     y, z = jax.lax.fori_loop(0, num_iters, body, (y, z))
     return y * jnp.sqrt(norm)
@@ -85,13 +97,18 @@ def _trace_sqrtm_from_centered(xc: Array, yc: Array) -> Array:
     return sv.sum() / jnp.sqrt(jnp.asarray((n - 1) * (m - 1), cross.dtype))
 
 
-def _mean_cov(features: Array) -> Tuple[Array, Array]:
-    """Feature mean and unbiased covariance."""
+def _mean_cov(features: Array) -> Tuple[Array, Array, Array]:
+    """Feature mean, unbiased covariance, and the centered features.
+
+    The centered matrix is returned so callers can hand it to
+    :func:`_compute_fid`'s terminal fallback without re-materializing the
+    ``O(N * D)`` subtraction this function already formed.
+    """
     n = features.shape[0]
     mu = features.mean(axis=0)
     centered = features - mu
     sigma = _mm(centered.T, centered) / (n - 1)
-    return mu, sigma
+    return mu, sigma, centered
 
 
 def _compute_fid(
@@ -109,44 +126,48 @@ def _compute_fid(
     offset = jnp.eye(sigma1.shape[0], dtype=sigma1.dtype) * eps
 
     # Validity needs more than finiteness: on ill-conditioned products the
-    # fp32 iteration can "converge" to finite garbage. Probe each candidate
-    # under stop_gradient (no backward is ever built through a bad iteration)
-    # and accept only if the residual ||S@S - A||/||A|| is small. The ladder:
-    # (1) Newton–Schulz on the raw product, (2) Newton–Schulz on
+    # fp32 iteration can "converge" to finite garbage, so each Newton–Schulz
+    # result is accepted only if its residual ||S@S - A||/||A|| is small
+    # (checked on stop_gradient values — no backward runs through the check).
+    # The ladder: (1) Newton–Schulz on the raw product, (2) Newton–Schulz on
     # diagonally-loaded covariances, (3) an exact terminal formulation that
     # handles rank-deficient N < D covariances — the nuclear-norm identity on
     # centered features when the caller provides them (finite gradients), the
-    # eigh trace otherwise. Branches are lax.cond lambdas so only the selected
-    # one executes and differentiates, and the loaded product is only formed
-    # when branch (1) fails.
-    def _ns_ok(prod: Array) -> Array:
-        prod = jax.lax.stop_gradient(prod)
-        probe = _newton_schulz_sqrtm(prod)
+    # eigh trace otherwise. Each iteration runs exactly once: the probed
+    # result is itself the branch value, and later rungs live inside
+    # lax.cond lambdas so they only execute when the earlier rung fails.
+    def _ns_residual_ok(sq: Array, prod: Array) -> Array:
+        sq, prod = jax.lax.stop_gradient((sq, prod))
         prod_norm = jnp.sqrt(jnp.sum(prod * prod))
-        residual = jnp.sqrt(jnp.sum((_mm(probe, probe) - prod) ** 2)) / (prod_norm + 1e-30)
+        residual = jnp.sqrt(jnp.sum((_mm(sq, sq) - prod) ** 2)) / (prod_norm + 1e-30)
         return jnp.isfinite(residual) & (residual < 1e-2)
 
     if centered is not None:
         xc, yc = centered
-        # The (n, m) cross matrix must stay SVD-sized; past ~4x the feature
-        # dim the covariances are generically full-rank and the eigh terminal
-        # is as exact (shape choice is static, so this is a trace-time pick).
-        if min(xc.shape[0], yc.shape[0]) <= 4 * sigma1.shape[0]:
+        # The (n, m) cross matrix must stay SVD-sized in *both* dimensions:
+        # past 16x the eigh terminal's d^2 footprint (e.g. a huge accumulated
+        # real set against a small fake batch) the eigh trace computes the
+        # same exact value in O(d^2) memory. Shapes are static, so this is a
+        # trace-time pick.
+        d = sigma1.shape[0]
+        if xc.shape[0] * yc.shape[0] <= 16 * d * d:
             terminal = lambda: _trace_sqrtm_from_centered(xc, yc)
         else:
             terminal = lambda: _trace_sqrtm_psd_product(sigma1, sigma2)
     else:
         terminal = lambda: _trace_sqrtm_psd_product(sigma1, sigma2)
 
+    def _loaded_rung():
+        loaded = _mm(sigma1 + offset, sigma2 + offset)
+        sq = _newton_schulz_sqrtm(loaded)
+        return jax.lax.cond(
+            _ns_residual_ok(sq, loaded), lambda: jnp.trace(sq), terminal
+        )
+
     prod = _mm(sigma1, sigma2)
+    sq1 = _newton_schulz_sqrtm(prod)
     tr_covmean = jax.lax.cond(
-        _ns_ok(prod),
-        lambda: jnp.trace(_newton_schulz_sqrtm(prod)),
-        lambda: jax.lax.cond(
-            _ns_ok(_mm(sigma1 + offset, sigma2 + offset)),
-            lambda: jnp.trace(_newton_schulz_sqrtm(_mm(sigma1 + offset, sigma2 + offset))),
-            terminal,
-        ),
+        _ns_residual_ok(sq1, prod), lambda: jnp.trace(sq1), _loaded_rung
     )
     return jnp.sum(diff * diff) + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
@@ -155,11 +176,9 @@ def frechet_inception_distance_from_features(real_features: Array, fake_features
     """FID from pre-extracted feature matrices ``(N, D)``."""
     real_features = jnp.asarray(real_features, jnp.float64 if jax.config.read("jax_enable_x64") else jnp.float32)
     fake_features = jnp.asarray(fake_features, real_features.dtype)
-    mu1, sigma1 = _mean_cov(real_features)
-    mu2, sigma2 = _mean_cov(fake_features)
-    return _compute_fid(
-        mu1, sigma1, mu2, sigma2, centered=(real_features - mu1, fake_features - mu2)
-    )
+    mu1, sigma1, xc = _mean_cov(real_features)
+    mu2, sigma2, yc = _mean_cov(fake_features)
+    return _compute_fid(mu1, sigma1, mu2, sigma2, centered=(xc, yc))
 
 
 def _poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma=None, coef: float = 1.0) -> Array:

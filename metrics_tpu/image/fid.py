@@ -76,11 +76,9 @@ class FrechetInceptionDistance(Metric):
         fake_features = dim_zero_cat(self.fake_features).astype(jnp.float32)
         if real_features.shape[0] < 2 or fake_features.shape[0] < 2:
             raise ValueError("More than one sample is required for both the real and fake distributed to compute FID")
-        mu1, sigma1 = _mean_cov(real_features)
-        mu2, sigma2 = _mean_cov(fake_features)
-        return _compute_fid(
-            mu1, sigma1, mu2, sigma2, centered=(real_features - mu1, fake_features - mu2)
-        )
+        mu1, sigma1, xc = _mean_cov(real_features)
+        mu2, sigma2, yc = _mean_cov(fake_features)
+        return _compute_fid(mu1, sigma1, mu2, sigma2, centered=(xc, yc))
 
     def reset(self) -> None:
         """Reference ``image/fid.py:294-303``: optionally keep real features."""

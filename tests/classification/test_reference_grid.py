@@ -1,0 +1,131 @@
+"""Full-grid classification parity against the importable reference.
+
+The reference's own suite derives its strength from heavy parametrization
+(551 test functions, e.g. ``test/unittests/classification/test_accuracy.py``);
+this module is the condensed analogue: every (input case x average x mdmc)
+cell of the stat-scores-backed family plus the confusion-matrix family is
+compared against the reference directly. Cells where *both* sides raise are
+counted as agreeing on rejection; a cell where only one side raises fails.
+"""
+import itertools
+import warnings
+
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as MF
+from tests.helpers import seed_all
+from tests.helpers.reference import import_reference
+
+seed_all(0)
+rng = np.random.default_rng(0)
+N, C, X = 60, 5, 7
+
+INPUTS = {
+    "binary_probs": (rng.random(N).astype(np.float32), rng.integers(0, 2, N)),
+    "binary_labels": (rng.integers(0, 2, N), rng.integers(0, 2, N)),
+    "multilabel_probs": (rng.random((N, C)).astype(np.float32), rng.integers(0, 2, (N, C))),
+    "multilabel_labels": (rng.integers(0, 2, (N, C)), rng.integers(0, 2, (N, C))),
+    "multiclass_probs": (
+        (lambda p: p / p.sum(-1, keepdims=True))(rng.random((N, C)).astype(np.float32)),
+        rng.integers(0, C, N),
+    ),
+    "multiclass_labels": (rng.integers(0, C, N), rng.integers(0, C, N)),
+    "mdmc_probs": (
+        (lambda p: p / p.sum(1, keepdims=True))(rng.random((N, C, X)).astype(np.float32)),
+        rng.integers(0, C, (N, X)),
+    ),
+    "mdmc_labels": (rng.integers(0, C, (N, X)), rng.integers(0, C, (N, X))),
+}
+
+AVGS = ["micro", "macro", "weighted", "none", "samples"]
+FNS = ["accuracy", "precision", "recall", "f1_score", "fbeta_score", "specificity"]
+
+
+def _run_cell(fn_name, iname, kwargs):
+    ref = import_reference()  # skips when absent; a successful import implies torch
+    import torch
+    preds, target = INPUTS[iname]
+    ours_fn = getattr(MF, fn_name)
+    ref_fn = getattr(ref.functional, fn_name)
+    tp, tt = torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        try:
+            want = ref_fn(tp, tt, **kwargs)
+            ref_err = None
+        except Exception as err:
+            want, ref_err = None, err
+        try:
+            got = ours_fn(preds, target, **kwargs)
+            our_err = None
+        except Exception as err:
+            got, our_err = None, err
+
+    if ref_err is not None and our_err is not None:
+        return "both_raise"
+    assert ref_err is None, f"reference raised but we did not: {ref_err}"
+    assert our_err is None, f"we raised but the reference did not: {our_err}"
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=2e-4, atol=2e-5)
+    return "ok"
+
+
+@pytest.mark.parametrize("iname", list(INPUTS))
+@pytest.mark.parametrize("fn_name", FNS)
+def test_statscores_family_grid(fn_name, iname):
+    """Sweep average x mdmc for one (metric, input-case) pair in one test
+    (one parametrized cell per pair keeps the suite fast while preserving
+    which pair failed)."""
+    nc = None if "binary" in iname else C
+    mdmc_opts = [None, "global", "samplewise"] if "mdmc" in iname else [None, "global"]
+    agreed = 0
+    for avg, mdmc in itertools.product(AVGS, mdmc_opts):
+        kw = {"average": avg, "mdmc_average": mdmc}
+        if nc:
+            kw["num_classes"] = nc
+        if fn_name == "fbeta_score":
+            kw["beta"] = 2.0
+        outcome = _run_cell(fn_name, iname, kw)
+        agreed += outcome == "ok"
+    assert agreed > 0, "every grid cell raised on both sides - grid is vacuous"
+
+
+@pytest.mark.parametrize("iname", list(INPUTS))
+def test_stat_scores_reduce_grid(iname):
+    nc = None if "binary" in iname else C
+    mdmc_opts = [None, "global", "samplewise"] if "mdmc" in iname else [None, "global"]
+    agreed = 0
+    for reduce, mdmc in itertools.product(["micro", "macro", "samples"], mdmc_opts):
+        kw = {"reduce": reduce, "mdmc_reduce": mdmc}
+        if nc:
+            kw["num_classes"] = nc
+        agreed += _run_cell("stat_scores", iname, kw) == "ok"
+    assert agreed > 0
+
+
+@pytest.mark.parametrize("iname", ["binary_probs", "multiclass_probs", "multiclass_labels", "multilabel_probs"])
+def test_confusion_family_grid(iname):
+    nc = 2 if "binary" in iname else C
+    for norm in [None, "true", "pred", "all"]:
+        assert _run_cell("confusion_matrix", iname, {"num_classes": nc, "normalize": norm}) == "ok"
+    for fn in ["matthews_corrcoef", "cohen_kappa", "jaccard_index"]:
+        assert _run_cell(fn, iname, {"num_classes": nc}) == "ok"
+
+
+def test_topk_subset_ignore_grid():
+    for k in [1, 2, 3]:
+        assert _run_cell("accuracy", "multiclass_probs", {"top_k": k, "num_classes": C}) == "ok"
+        assert _run_cell("precision", "multiclass_probs", {"top_k": k, "num_classes": C, "average": "macro"}) == "ok"
+    for sub in [True, False]:
+        assert _run_cell("accuracy", "mdmc_probs", {"subset_accuracy": sub, "num_classes": C, "mdmc_average": "global"}) == "ok"
+        assert _run_cell("accuracy", "multilabel_probs", {"subset_accuracy": sub}) == "ok"
+    for ii in [0, 2]:
+        assert _run_cell("accuracy", "multiclass_labels", {"ignore_index": ii, "num_classes": C}) == "ok"
+        assert _run_cell("precision", "multiclass_probs", {"ignore_index": ii, "num_classes": C, "average": "macro"}) == "ok"
+        assert _run_cell("accuracy", "mdmc_labels", {"ignore_index": ii, "num_classes": C, "mdmc_average": "global"}) == "ok"
+    for th in [0.3, 0.7]:
+        assert _run_cell("accuracy", "binary_probs", {"threshold": th}) == "ok"
+        assert _run_cell("f1_score", "multilabel_probs", {"threshold": th, "num_classes": C}) == "ok"
+    for iname in ["binary_probs", "multiclass_probs", "multiclass_labels"]:
+        assert _run_cell("dice", iname, {}) == "ok"
