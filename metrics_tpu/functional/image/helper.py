@@ -65,6 +65,77 @@ def _depthwise_conv(x: Array, kernel: Array) -> Array:
     )
 
 
+def _separable_factors(
+    kernel_size: Sequence[int], sigma: Sequence[float], gaussian: bool, dtype
+) -> Sequence[Array]:
+    """Per-dimension 1-d filter factors for the (always separable) SSIM/UQI
+    windows: gaussian = outer product of 1-d gaussians, uniform box = outer
+    product of 1-d boxes."""
+    if gaussian:
+        return [_gaussian(k, s, dtype)[0] for k, s in zip(kernel_size, sigma)]
+    return [jnp.ones((k,), dtype) / k for k in kernel_size]
+
+
+def _banded_filter_matrix(f: Array, size_in: int) -> Array:
+    """``(size_in, size_in - k + 1)`` banded matrix ``B[i, j] = f[i - j]``.
+
+    Right-multiplying a row of length ``size_in`` by ``B`` equals the
+    valid-mode correlation of the row with ``f`` — the 1-d filter becomes a
+    dense matmul.
+    """
+    k = f.shape[-1]
+    size_out = size_in - k + 1
+    i = jnp.arange(size_in)[:, None]
+    j = jnp.arange(size_out)[None, :]
+    d = i - j
+    return jnp.where((d >= 0) & (d < k), jnp.take(f, jnp.clip(d, 0, k - 1)), 0.0).astype(f.dtype)
+
+
+# past this spatial size the banded matmul's (size x size) extra FLOPs
+# outweigh the MXU advantage over the k-tap conv
+_BANDED_MAX_SIZE = 2048
+
+
+def _depthwise_conv_separable(x: Array, factors: Sequence[Array]) -> Array:
+    """Valid-mode depthwise filtering, one 1-d pass per spatial dim.
+
+    An 11x11 window as a full 2-d depthwise conv costs 121 taps/pixel and
+    lowers badly on TPU (grouped convolutions bypass the MXU). The window is
+    always an outer product here, so each dim is filtered independently —
+    and each 1-d pass is expressed as a dense **banded-matrix matmul** over
+    that axis, which XLA maps straight onto the MXU. For spatial sizes past
+    ``_BANDED_MAX_SIZE`` the O(size^2) matmul loses to the k-tap conv and
+    the pass falls back to ``conv_general_dilated``. Precision rationale as
+    in ``_depthwise_conv``.
+    """
+    channel = x.shape[1]
+    spatial = x.ndim - 2
+    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    out = x
+    for dim, f in enumerate(factors):
+        axis = 2 + dim
+        size_in = out.shape[axis]
+        if size_in <= _BANDED_MAX_SIZE:
+            band = _banded_filter_matrix(f, size_in)
+            moved = jnp.moveaxis(out, axis, -1)
+            filtered = jnp.matmul(moved, band, precision=jax.lax.Precision.HIGHEST)
+            out = jnp.moveaxis(filtered, -1, axis)
+        else:
+            kshape = [1] * spatial
+            kshape[dim] = f.shape[-1]
+            kernel = jnp.broadcast_to(f.reshape(kshape), (channel, 1, *kshape))
+            out = jax.lax.conv_general_dilated(
+                out,
+                kernel,
+                window_strides=(1,) * spatial,
+                padding="VALID",
+                dimension_numbers=dn,
+                feature_group_count=channel,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+    return out
+
+
 def _reflect_pad(x: Array, pads: Sequence[int]) -> Array:
     """Reflect-pad the trailing spatial dims of an NC... tensor."""
     pad_width = [(0, 0), (0, 0)] + [(p, p) for p in pads]

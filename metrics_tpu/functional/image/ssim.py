@@ -13,11 +13,9 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.image.helper import (
-    _depthwise_conv,
-    _gaussian_kernel_2d,
-    _gaussian_kernel_3d,
+    _depthwise_conv_separable,
     _reflect_pad,
-    _uniform_kernel,
+    _separable_factors,
 )
 from metrics_tpu.parallel.sync import reduce
 from metrics_tpu.utilities.checks import _check_same_shape
@@ -89,14 +87,10 @@ def _ssim_compute(
 
     if gaussian_kernel:
         pads = [(gs - 1) // 2 for gs in gauss_kernel_size]
-        kernel = (
-            _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
-            if is_3d
-            else _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
-        )
+        factors = _separable_factors(gauss_kernel_size, sigma, True, dtype)
     else:
         pads = [(ks - 1) // 2 for ks in kernel_size]
-        kernel = jnp.broadcast_to(_uniform_kernel(1, kernel_size, dtype), (channel, 1, *kernel_size))
+        factors = _separable_factors(kernel_size, sigma, False, dtype)
 
     preds_p = _reflect_pad(preds, pads)
     target_p = _reflect_pad(target, pads)
@@ -104,7 +98,7 @@ def _ssim_compute(
     input_list = jnp.concatenate(
         (preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p)
     )  # (5B, C, ...)
-    outputs = _depthwise_conv(input_list, kernel)
+    outputs = _depthwise_conv_separable(input_list, factors)
     b = preds.shape[0]
     mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
 

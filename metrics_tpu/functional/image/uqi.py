@@ -6,7 +6,7 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflect_pad
+from metrics_tpu.functional.image.helper import _depthwise_conv_separable, _reflect_pad, _separable_factors
 from metrics_tpu.parallel.sync import reduce
 from metrics_tpu.utilities.checks import _check_same_shape
 
@@ -54,7 +54,7 @@ def _uqi_compute(
     dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
     preds = preds.astype(dtype)
     target = target.astype(dtype)
-    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, dtype)
+    factors = _separable_factors(kernel_size, sigma, True, dtype)
     pad_h = (kernel_size[0] - 1) // 2
     pad_w = (kernel_size[1] - 1) // 2
 
@@ -62,7 +62,7 @@ def _uqi_compute(
     target_p = _reflect_pad(target, (pad_h, pad_w))
 
     input_list = jnp.concatenate((preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p))
-    outputs = _depthwise_conv(input_list, kernel)
+    outputs = _depthwise_conv_separable(input_list, factors)
     b = preds.shape[0]
     mu_pred, mu_target, e_pred_sq, e_target_sq, e_pred_target = (outputs[i * b : (i + 1) * b] for i in range(5))
 

@@ -170,10 +170,34 @@ def fused_sync(
 # --------------------------------------------------------------------------
 
 
+def _pad_gather_trim(array: Array, allgather: Any) -> List[Array]:
+    """The ragged-gather core: shape-gather, pad to the elementwise max,
+    gather payload, trim per-rank (reference ``utilities/distributed.py:128-151``).
+
+    ``allgather`` is the transport — ``multihost_utils.process_allgather`` in
+    production, injectable so the logic is testable without a real pod: it
+    must map a host/device array to a stacked ``(nproc, ...)`` array.
+    """
+    array = jnp.asarray(array)
+    # 1) gather shapes (the reference's collective #1, ``distributed.py:131``)
+    local_shape = np.array(array.shape, dtype=np.int64)
+    all_shapes = np.asarray(allgather(local_shape))  # (nproc, ndim)
+    max_shape = all_shapes.max(axis=0)
+    # 2) pad to elementwise max, gather payload, 3) trim per-rank
+    pad = [(0, int(m - s)) for s, m in zip(array.shape, max_shape)]
+    padded = jnp.pad(array, pad)
+    gathered = allgather(padded)  # (nproc, *max_shape)
+    out = []
+    for r in range(all_shapes.shape[0]):
+        sl = tuple(slice(0, int(d)) for d in all_shapes[r])
+        out.append(jnp.asarray(gathered[r])[sl])
+    return out
+
+
 def gather_all_arrays(array: Array, group: Any = None) -> List[Array]:
     """All-gather ``array`` from every process into a list, handling uneven
     leading dimensions — the analogue of reference
-    ``utilities/distributed.py:102-151`` (shape-gather, pad, gather, trim).
+    ``utilities/distributed.py:102-151``.
 
     Single-process: returns ``[array]`` (matching the reference's behavior at
     world_size 1).
@@ -182,21 +206,7 @@ def gather_all_arrays(array: Array, group: Any = None) -> List[Array]:
         return [jnp.asarray(array)]
     from jax.experimental import multihost_utils
 
-    array = jnp.asarray(array)
-    nproc = jax.process_count()
-    # 1) gather shapes (the reference's collective #1, ``distributed.py:131``)
-    local_shape = np.array(array.shape, dtype=np.int64)
-    all_shapes = np.asarray(multihost_utils.process_allgather(local_shape))  # (nproc, ndim)
-    max_shape = all_shapes.max(axis=0)
-    # 2) pad to elementwise max, gather payload, 3) trim per-rank
-    pad = [(0, int(m - s)) for s, m in zip(array.shape, max_shape)]
-    padded = jnp.pad(array, pad)
-    gathered = multihost_utils.process_allgather(padded)  # (nproc, *max_shape)
-    out = []
-    for r in range(nproc):
-        sl = tuple(slice(0, int(d)) for d in all_shapes[r])
-        out.append(jnp.asarray(gathered[r])[sl])
-    return out
+    return _pad_gather_trim(array, multihost_utils.process_allgather)
 
 
 # --------------------------------------------------------------------------

@@ -184,3 +184,63 @@ def test_fused_sync_mixed_dtypes_two_collectives():
     hlo = fn.lower(*states).compile().as_text()
     n_all_reduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
     assert n_all_reduce == 2, f"expected 2 all-reduces (one per dtype), got {n_all_reduce}"
+
+
+class _FakePodTransport:
+    """Simulated ``process_allgather``: each rank's call is recorded and the
+    stacked result across the configured ranks is returned — exercising the
+    pad-gather-trim logic without a real multi-host pod."""
+
+    def __init__(self, rank_arrays):
+        self.rank_arrays = rank_arrays  # what every OTHER rank contributes
+        self.calls = 0
+
+    def for_rank(self, r):
+        def allgather(x):
+            self.calls += 1
+            x = np.asarray(x)
+            if x.ndim == 1 and x.dtype == np.int64:  # the shape gather
+                return np.stack([np.array(a.shape, np.int64) for a in self.rank_arrays])
+            # the payload gather: every rank pads to the same max shape
+            max_shape = np.max([a.shape for a in self.rank_arrays], axis=0)
+            padded = []
+            for a in self.rank_arrays:
+                pad = [(0, int(m - s)) for s, m in zip(a.shape, max_shape)]
+                padded.append(np.pad(a, pad))
+            return np.stack(padded)
+
+        return allgather
+
+
+def test_pad_gather_trim_ragged_multihost():
+    """The multi-host ragged gather (regime 3): per-rank arrays of different
+    leading sizes come back exactly, pad bytes trimmed (the reference's
+    uneven-shape dance, ``utilities/distributed.py:128-151``)."""
+    from metrics_tpu.parallel.sync import _pad_gather_trim
+
+    rank_arrays = [
+        np.arange(5, dtype=np.float32),
+        np.arange(3, dtype=np.float32) + 100,
+        np.arange(8, dtype=np.float32) - 7,
+        np.zeros(0, dtype=np.float32),  # a rank with NO samples
+    ]
+    transport = _FakePodTransport(rank_arrays)
+    got = _pad_gather_trim(rank_arrays[0], transport.for_rank(0))
+    assert transport.calls == 2  # exactly one shape gather + one payload gather
+    assert len(got) == 4
+    for g, want in zip(got, rank_arrays):
+        np.testing.assert_array_equal(np.asarray(g), want)
+
+
+def test_pad_gather_trim_2d_uneven_both_dims():
+    from metrics_tpu.parallel.sync import _pad_gather_trim
+
+    rank_arrays = [
+        np.arange(6, dtype=np.int32).reshape(2, 3),
+        np.arange(12, dtype=np.int32).reshape(4, 3),
+        np.arange(2, dtype=np.int32).reshape(1, 2),
+    ]
+    transport = _FakePodTransport(rank_arrays)
+    got = _pad_gather_trim(rank_arrays[2], transport.for_rank(2))
+    for g, want in zip(got, rank_arrays):
+        np.testing.assert_array_equal(np.asarray(g), want)
