@@ -317,6 +317,12 @@ def main() -> None:
         consecutive_timeouts = 0
         if proc.returncode != 0:
             print(f"bench: phase {name} rc={proc.returncode}: {proc.stderr.strip()[-400:]}", file=sys.stderr)
+        else:
+            # phase bodies swallow their own exceptions and exit 0 — their
+            # "bench: ... failed" diagnostics live on stderr and must survive
+            for eline in proc.stderr.splitlines():
+                if eline.startswith("bench:"):
+                    print(eline, file=sys.stderr)
         for line in proc.stdout.splitlines():
             line = line.strip()
             if not line.startswith("{"):

@@ -22,20 +22,26 @@ class ShortTimeObjectiveIntelligibility(Metric):
     is_differentiable = False
     higher_is_better = True
 
-    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+    def __init__(
+        self, fs: int, extended: bool = False, use_device_implementation: bool = False, **kwargs: Any
+    ) -> None:
         super().__init__(**kwargs)
-        if not _PYSTOI_AVAILABLE:
+        if not _PYSTOI_AVAILABLE and not use_device_implementation:
             raise ModuleNotFoundError(
                 "ShortTimeObjectiveIntelligibility metric requires that the `pystoi` package is installed."
-                " Install it with `pip install pystoi`."
+                " Install it with `pip install pystoi`, or pass `use_device_implementation=True`"
+                " for the native JAX implementation."
             )
         self.fs = fs
         self.extended = extended
+        self.use_device_implementation = use_device_implementation
         self.add_state("sum_stoi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        stoi_batch = short_time_objective_intelligibility(
+            preds, target, self.fs, self.extended, self.use_device_implementation
+        )
         self.sum_stoi += stoi_batch.sum()
         self.total += stoi_batch.size
 

@@ -8,3 +8,4 @@ from metrics_tpu.functional.audio.sdr import (  # noqa: F401
 from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio  # noqa: F401
 from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality  # noqa: F401
 from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility  # noqa: F401
+from metrics_tpu.functional.audio.stoi_native import stoi_on_device  # noqa: F401

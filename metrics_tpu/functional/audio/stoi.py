@@ -16,7 +16,9 @@ Array = jax.Array
 __doctest_skip__ = ["short_time_objective_intelligibility"]
 
 
-def short_time_objective_intelligibility(preds: Array, target: Array, fs: int, extended: bool = False) -> Array:
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, use_device_implementation: bool = False
+) -> Array:
     """STOI score per clip (reference ``stoi.py:28-102``).
 
     Args:
@@ -24,11 +26,21 @@ def short_time_objective_intelligibility(preds: Array, target: Array, fs: int, e
         target: reference signal ``[..., time]``.
         fs: sampling frequency in Hz.
         extended: use the extended STOI variant.
+        use_device_implementation: score with the native JAX implementation
+            (``stoi_native.stoi_on_device``) — jittable spectral core,
+            differentiable, no ``pystoi`` dependency. Default False keeps
+            exact behavioral parity with the reference's pystoi wrapper.
     """
+    if use_device_implementation:
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        _check_same_shape(jnp.asarray(preds), jnp.asarray(target))
+        return stoi_on_device(preds, target, fs=fs, extended=extended)
     if not _PYSTOI_AVAILABLE:
         raise ModuleNotFoundError(
             "STOI metric requires that the `pystoi` package is installed."
-            " Install it with `pip install pystoi`."
+            " Install it with `pip install pystoi`, or pass"
+            " `use_device_implementation=True` for the native JAX implementation."
         )
     from pystoi import stoi as stoi_backend
 

@@ -1,9 +1,9 @@
 """Image kernel helpers (reference
 ``src/torchmetrics/functional/image/helper.py``, 122 LoC).
 
-Depthwise gaussian/uniform filtering is expressed as
-``lax.conv_general_dilated`` with ``feature_group_count=C`` — a native MXU
-convolution on TPU.
+Depthwise gaussian/uniform filtering runs as separable per-dimension
+passes, each a banded-matrix matmul on the MXU (see
+``_depthwise_conv_separable``).
 """
 from typing import Sequence
 
@@ -18,51 +18,6 @@ def _gaussian(kernel_size: int, sigma: float, dtype) -> Array:
     dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
     gauss = jnp.exp(-((dist / sigma) ** 2) / 2)
     return (gauss / gauss.sum())[None, :]  # (1, kernel_size)
-
-
-def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
-    """Depthwise 2-d gaussian kernel ``(C, 1, kh, kw)`` (reference ``helper.py:30-60``)."""
-    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
-    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
-    kernel = kernel_x.T @ kernel_y  # (kh, kw)
-    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
-
-
-def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype) -> Array:
-    """Depthwise 3-d gaussian kernel (reference ``helper.py:63-83``)."""
-    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
-    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
-    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype)
-    kernel_xy = kernel_x.T @ kernel_y  # (kh, kw)
-    kernel = kernel_xy[:, :, None] * kernel_z.reshape(1, 1, -1)
-    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
-
-
-def _uniform_kernel(channel: int, kernel_size: Sequence[int], dtype) -> Array:
-    """Depthwise uniform (box) kernel."""
-    kernel = jnp.ones(tuple(kernel_size), dtype) / jnp.prod(jnp.asarray(kernel_size, dtype))
-    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
-
-
-def _depthwise_conv(x: Array, kernel: Array) -> Array:
-    """Valid-mode depthwise convolution over NCHW / NCDHW inputs.
-
-    Runs at ``Precision.HIGHEST``: quality metrics (SSIM/UQI) are reported to
-    ~4 decimal places, and the TPU default bf16 conv accumulation introduces
-    ~1e-3 error in the filtered moments — visible in the final score.
-    """
-    channel = x.shape[1]
-    spatial = x.ndim - 2
-    dn = ("NCHW", "OIHW", "NCHW") if spatial == 2 else ("NCDHW", "OIDHW", "NCDHW")
-    return jax.lax.conv_general_dilated(
-        x,
-        kernel,
-        window_strides=(1,) * spatial,
-        padding="VALID",
-        dimension_numbers=dn,
-        feature_group_count=channel,
-        precision=jax.lax.Precision.HIGHEST,
-    )
 
 
 def _separable_factors(
@@ -105,8 +60,10 @@ def _depthwise_conv_separable(x: Array, factors: Sequence[Array]) -> Array:
     and each 1-d pass is expressed as a dense **banded-matrix matmul** over
     that axis, which XLA maps straight onto the MXU. For spatial sizes past
     ``_BANDED_MAX_SIZE`` the O(size^2) matmul loses to the k-tap conv and
-    the pass falls back to ``conv_general_dilated``. Precision rationale as
-    in ``_depthwise_conv``.
+    the pass falls back to ``conv_general_dilated``. Everything runs at
+    ``Precision.HIGHEST``: quality metrics (SSIM/UQI) are reported to ~4
+    decimal places and the TPU default bf16 accumulation introduces ~1e-3
+    error in the filtered moments — visible in the final score.
     """
     channel = x.shape[1]
     spatial = x.ndim - 2

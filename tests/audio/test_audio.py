@@ -245,3 +245,115 @@ def test_pesq_stoi_raise_without_backend():
             short_time_objective_intelligibility(p, p, 16000)
         with pytest.raises(ModuleNotFoundError, match="pystoi"):
             ShortTimeObjectiveIntelligibility(16000)
+
+
+class TestNativeSTOI:
+    """The on-device STOI implementation (no pystoi in this environment, so
+    the checks are algorithmic properties of the published spec plus
+    structural checks of the spectral front-end, not wrapper parity)."""
+
+    @staticmethod
+    def _speechlike(seconds=1.2, seed=0):
+        """Amplitude-modulated multi-tone with pauses - enough temporal
+        structure for band/segment statistics to be non-degenerate."""
+        rng = np.random.default_rng(seed)
+        t = np.arange(int(10_000 * seconds)) / 10_000
+        sig = sum(np.sin(2 * np.pi * f * t + rng.random() * 6.28) / (i + 1) for i, f in enumerate((220, 450, 910, 1800, 3600)))
+        envelope = 0.2 + 0.8 * (np.sin(2 * np.pi * 3.1 * t) > -0.4)  # syllable-ish gating
+        return (sig * envelope).astype(np.float32)
+
+    def test_third_octave_matrix_structure(self):
+        from metrics_tpu.functional.audio.stoi_native import third_octave_matrix
+
+        obm = third_octave_matrix()
+        assert obm.shape == (15, 257)
+        # published band centers: 150 * 2^(k/3); nearest-bin edges at cf/2^(1/6), cf*2^(1/6)
+        f = np.linspace(0, 10_000, 513)[:257]
+        for k in range(15):
+            bins = np.where(obm[k] > 0)[0]
+            assert bins.size > 0
+            cf = 150 * 2 ** (k / 3)
+            assert f[bins[0]] == pytest.approx(cf / 2 ** (1 / 6), rel=0.1)
+        # bands tile without overlap
+        assert (obm.sum(0) <= 1).all()
+
+    def test_identity_is_perfect(self):
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        x = self._speechlike()
+        assert float(stoi_on_device(x, x, fs=10_000)) == pytest.approx(1.0, abs=1e-6)
+        assert float(stoi_on_device(x, x, fs=10_000, extended=True)) == pytest.approx(1.0, abs=1e-4)
+
+    def test_monotone_in_noise(self):
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        rng = np.random.default_rng(3)
+        x = self._speechlike()
+        noise = rng.standard_normal(x.size).astype(np.float32)
+        scores = [float(stoi_on_device(x + s * noise, x, fs=10_000)) for s in (0.05, 0.3, 1.5)]
+        assert scores[0] > scores[1] > scores[2], scores
+        assert scores[0] > 0.8 and scores[2] < 0.5
+
+    def test_pred_scale_invariance(self):
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        rng = np.random.default_rng(4)
+        x = self._speechlike()
+        y = x + 0.3 * rng.standard_normal(x.size).astype(np.float32)
+        a = float(stoi_on_device(y, x, fs=10_000))
+        b = float(stoi_on_device(7.5 * y, x, fs=10_000))
+        assert a == pytest.approx(b, abs=1e-5)  # per-segment normalization
+
+    def test_vad_drops_silence(self):
+        """Padding the pair with silence must not change the score (the
+        silent frames are gated out)."""
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        rng = np.random.default_rng(5)
+        x = self._speechlike(seconds=0.8)
+        y = x + 0.2 * rng.standard_normal(x.size).astype(np.float32)
+        pad = np.zeros(4000, np.float32)
+        a = float(stoi_on_device(y, x, fs=10_000))
+        b = float(stoi_on_device(np.concatenate([pad, y, pad]), np.concatenate([pad, x, pad]), fs=10_000))
+        assert a == pytest.approx(b, abs=0.02)
+
+    def test_resampling_path(self):
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        x = self._speechlike()
+        x16 = np.interp(np.arange(0, x.size, 10 / 16), np.arange(x.size), x).astype(np.float32)
+        score = float(stoi_on_device(x16, x16, fs=16_000))
+        assert score == pytest.approx(1.0, abs=1e-4)
+
+    def test_differentiable_core(self):
+        import jax
+        import jax.numpy as jnp
+
+        from metrics_tpu.functional.audio.stoi_native import stoi_core
+
+        rng = np.random.default_rng(6)
+        x = self._speechlike(seconds=0.6)
+        y = x + 0.4 * rng.standard_normal(x.size).astype(np.float32)
+        grad = jax.grad(lambda p: stoi_core(jnp.asarray(x), p))(jnp.asarray(y))
+        assert grad.shape == y.shape
+        assert bool(jnp.all(jnp.isfinite(grad)))
+        assert float(jnp.abs(grad).max()) > 0
+
+    def test_batched_and_module(self):
+        from metrics_tpu import ShortTimeObjectiveIntelligibility
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        rng = np.random.default_rng(7)
+        x = np.stack([self._speechlike(seed=i) for i in range(3)])
+        y = x + 0.3 * rng.standard_normal(x.shape).astype(np.float32)
+        scores = np.asarray(stoi_on_device(y, x, fs=10_000))
+        assert scores.shape == (3,)
+        m = ShortTimeObjectiveIntelligibility(fs=10_000, use_device_implementation=True)
+        m.update(y, x)
+        assert float(m.compute()) == pytest.approx(float(scores.mean()), abs=1e-5)
+
+    def test_short_input_convention(self):
+        from metrics_tpu.functional.audio.stoi_native import stoi_on_device
+
+        x = np.random.default_rng(8).standard_normal(1000).astype(np.float32)
+        assert float(stoi_on_device(x, x, fs=10_000)) == pytest.approx(1e-5)
