@@ -252,11 +252,110 @@ def _phase_headline(jax, platform) -> None:
 # has been observed to hang mid-run (not just at init), and an in-process
 # hang can't be cancelled — isolation means a stall loses one line, never
 # the whole bench. Budgets are wall-clock seconds per phase.
+def _phase_vsref(jax, platform) -> None:
+    """Head-to-head wall-clock vs the reference implementation, same data.
+
+    The reference publishes no absolute numbers (SURVEY.md §6), so the honest
+    comparison is to run it: torch-CPU torchmetrics (its only execution mode
+    in this environment) against this framework end-to-end — host
+    preprocessing, transfers, and device compute included. Skipped silently
+    when the reference isn't importable.
+    """
+    _stamp("vsref start")
+    import numpy as np
+
+    try:
+        import sys as _sys
+        import types as _types
+
+        if "pkg_resources" not in _sys.modules:
+            try:
+                import pkg_resources  # noqa: F401
+            except ImportError:
+                shim = _types.ModuleType("pkg_resources")
+                shim.DistributionNotFound = type("DistributionNotFound", (Exception,), {})
+                shim.get_distribution = lambda name: _types.SimpleNamespace(version="0.0.0")
+                _sys.modules["pkg_resources"] = shim
+        _sys.path.insert(0, "/root/reference/src")
+        import torch
+        import torchmetrics.functional as RF
+    except Exception as err:  # pragma: no cover
+        print(f"bench: vsref skipped (reference not importable: {err})", file=sys.stderr)
+        return
+
+    # --- WER on 2048 sentence pairs: device wavefront DP vs host python DP
+    try:
+        from metrics_tpu.functional import word_error_rate
+
+        rng = np.random.default_rng(0)
+        vocab = [f"w{i}" for i in range(500)]
+        pairs = [
+            (
+                " ".join(rng.choice(vocab, rng.integers(5, 25))),
+                " ".join(rng.choice(vocab, rng.integers(5, 25))),
+            )
+            for _ in range(2048)
+        ]
+        preds = [p for p, _ in pairs]
+        target = [t for _, t in pairs]
+
+        ours = word_error_rate(preds, target)  # warm compile
+        t0 = time.perf_counter()
+        ours = float(word_error_rate(preds, target))
+        ours_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        theirs = float(RF.word_error_rate(preds, target))
+        ref_s = time.perf_counter() - t0
+        assert abs(ours - theirs) < 1e-4, (ours, theirs)
+        _emit(
+            "wer_2048_pairs_s",
+            round(ours_s, 4),
+            f"s end-to-end ({platform}); reference torch-cpu same data: {ref_s:.3f}s",
+            round(ref_s / ours_s, 2),
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: vsref wer failed: {err}", file=sys.stderr)
+
+    # --- SSIM 4x3x256x256: banded-MXU filtering vs torch-cpu conv
+    try:
+        import jax.numpy as jnp
+
+        from metrics_tpu.functional import structural_similarity_index_measure
+
+        rng = np.random.default_rng(1)
+        a = rng.random((4, 3, 256, 256)).astype(np.float32)
+        b = rng.random((4, 3, 256, 256)).astype(np.float32)
+        fn = jax.jit(lambda x, y: structural_similarity_index_measure(x, y, data_range=1.0))
+        ours = float(fn(jnp.asarray(a), jnp.asarray(b)))  # warm + value
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            v = fn(jnp.asarray(a), jnp.asarray(b))
+        float(v)
+        ours_s = (time.perf_counter() - t0) / iters
+        ta, tb = torch.from_numpy(a), torch.from_numpy(b)
+        theirs = float(RF.structural_similarity_index_measure(ta, tb, data_range=1.0))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            RF.structural_similarity_index_measure(ta, tb, data_range=1.0)
+        ref_s = (time.perf_counter() - t0) / iters
+        assert abs(ours - theirs) < 1e-3, (ours, theirs)
+        _emit(
+            "ssim_256_e2e_s",
+            round(ours_s, 4),
+            f"s end-to-end incl. h2d+fetch ({platform}); reference torch-cpu same data: {ref_s:.3f}s",
+            round(ref_s / ours_s, 2),
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: vsref ssim failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
     "ssim": (_phase_ssim, 150),
     "retrieval": (_phase_retrieval, 150),
+    "vsref": (_phase_vsref, 240),
     "sync": (_phase_sync, 150),
 }
 
