@@ -33,3 +33,18 @@ def test_module_doctests(module_name):
         result = runner.run(test)
         failures += result.failed
     assert failures == 0, f"{failures} doctest failure(s) in {module_name}"
+
+
+def test_readme_code_blocks_execute():
+    """Every ```python block in README.md must run as written (the analogue
+    of the reference's phmdoctest README gate, ci_test-full.yml:103)."""
+    import pathlib
+    import re
+
+    readme = pathlib.Path(__file__).resolve().parent.parent / "README.md"
+    blocks = re.findall(r"```python\n(.*?)```", readme.read_text(), re.S)
+    assert blocks, "README should contain python examples"
+    ns = {}
+    for block in blocks:
+        exec(compile(block, str(readme), "exec"), ns)  # noqa: S102
+    assert "results" in ns and set(ns["results"]) == {"Accuracy", "F1Score", "AUROC"}
