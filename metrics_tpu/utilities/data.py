@@ -102,19 +102,29 @@ def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
     return jnp.argmax(x, axis=argmax_dim)
 
 
+_BINCOUNT_ONEHOT_MAX = 64
+
+
 def _bincount(x: Array, minlength: int) -> Array:
     """Static-shape deterministic bincount (reference ``utilities/data.py:244-264``).
 
-    The reference needs a deterministic fallback loop on CUDA; on TPU we use a
-    one-hot sum, which XLA lowers to a single matmul/reduce — deterministic by
-    construction and MXU-friendly.
+    The reference needs a deterministic fallback loop on CUDA (atomics);
+    XLA's scatter-add has no atomics and is deterministic by construction,
+    at O(N) work. For tiny ranges the one-hot compare+reduce is kept — it
+    vectorizes better than a scatter of the same size — but it is O(N *
+    minlength), which at confusion-matrix scale (minlength = C^2, e.g.
+    10,000 for 100 segmentation classes) is ~1000x slower than the scatter
+    (measured: 9s vs 2ms per 1M elements at minlength=2500 on CPU).
 
     ``minlength`` is required (static shapes): the reference's dynamic
     ``minlength=None`` mode cannot exist under XLA.
     """
     x = jnp.asarray(x).reshape(-1)
-    oh = x[:, None] == jnp.arange(minlength, dtype=x.dtype)[None, :]
-    return oh.sum(axis=0).astype(jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32)
+    out_dtype = jnp.int64 if jax.config.read("jax_enable_x64") else jnp.int32
+    if minlength <= _BINCOUNT_ONEHOT_MAX:
+        oh = x[:, None] == jnp.arange(minlength, dtype=x.dtype)[None, :]
+        return oh.sum(axis=0).astype(out_dtype)
+    return jnp.zeros((minlength,), out_dtype).at[x].add(1, mode="drop")
 
 
 def _cumsum(x: Array, axis: int = 0) -> Array:

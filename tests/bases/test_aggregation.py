@@ -79,3 +79,17 @@ def test_reset_and_forward():
     metric.reset()
     metric.update(jnp.asarray([5.0]))
     assert np.asarray(metric.compute()) == pytest.approx(5.0)
+
+
+def test_bincount_both_paths_match_numpy():
+    """_bincount picks one-hot (tiny ranges) or scatter-add (large) — both
+    must match numpy, including out-of-range drops and empty input."""
+    from metrics_tpu.utilities.data import _BINCOUNT_ONEHOT_MAX, _bincount
+
+    rng = np.random.default_rng(0)
+    for minlength in (3, _BINCOUNT_ONEHOT_MAX, _BINCOUNT_ONEHOT_MAX + 1, 5000):
+        x = rng.integers(0, minlength, 10_000).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(_bincount(jnp.asarray(x), minlength)), np.bincount(x, minlength=minlength)
+        )
+    np.testing.assert_array_equal(np.asarray(_bincount(jnp.zeros((0,), jnp.int32), 7)), np.zeros(7))
