@@ -144,6 +144,77 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     return MetricDef(init=init, update=update, compute=compute, merge=merge)
 
 
+def bootstrap_functionalize(
+    metric: "Metric", num_bootstraps: int = 10, axis_name: Optional[str] = None
+) -> MetricDef:
+    """Vectorized bootstrap: ``num_bootstraps`` resampled replicas of a
+    metric as ONE set of pure functions over a stacked state.
+
+    The reference's :class:`BootStrapper` keeps N deep copies and updates
+    them in an eager Python loop (``wrappers/bootstrapping.py:49-155``);
+    here the replicas are a leading state axis and one ``vmap``-ped update —
+    N resamplings per batch in a single compiled graph (SURVEY.md §7).
+
+    Resampling is multinomial (sample-with-replacement to the same batch
+    size): the only strategy with a static shape, hence the only one that
+    can live under ``jit`` — the reference's poisson mode grows/shrinks the
+    batch per replica and remains eager-only.
+
+    ``update`` takes an explicit PRNG key as its first argument (idiomatic
+    JAX; the reference draws from torch's global generator):
+
+        bdef = bootstrap_functionalize(Accuracy(num_classes=3), 20)
+        state = bdef.init()
+        state = jax.jit(bdef.update)(state, key, preds, target)
+        out = bdef.compute(state)   # {"mean": ..., "std": ..., "raw": (20, ...)}
+
+    Positional update args are resampled along their leading axis; kwargs
+    pass through unchanged.
+    """
+    import jax.numpy as jnp
+
+    if not (isinstance(num_bootstraps, int) and num_bootstraps > 1):
+        raise ValueError("Expected argument `num_bootstraps` to be an integer larger than 1")
+    mdef = functionalize(metric, axis_name=axis_name)
+
+    def init() -> Dict[str, Any]:
+        base = mdef.init()
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(leaf[None], (num_bootstraps,) + leaf.shape), base
+        )
+
+    def update(state: Dict[str, Any], key: Any, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        if not args:
+            raise ValueError("bootstrap update needs at least one positional (batch) argument")
+        n = jnp.asarray(args[0]).shape[0]
+        for pos, a in enumerate(args[1:], 1):
+            if jnp.asarray(a).shape[0] != n:
+                # without this, the shared resample index would silently clamp
+                # into the shorter arg instead of surfacing the mismatch
+                raise ValueError(
+                    f"bootstrap update arg {pos} has leading dim {jnp.asarray(a).shape[0]}, expected {n}"
+                )
+        keys = jax.random.split(key, num_bootstraps)
+
+        def one(st, k):
+            idx = jax.random.choice(k, n, shape=(n,), replace=True)
+            resampled = tuple(jnp.asarray(a)[idx] for a in args)
+            return mdef.update(st, *resampled, **kwargs)
+
+        return jax.vmap(one)(state, keys)
+
+    def compute(state: Dict[str, Any]) -> Dict[str, Any]:
+        raw = jax.vmap(mdef.compute)(state)
+        mean = jax.tree_util.tree_map(lambda v: v.mean(axis=0), raw)
+        std = jax.tree_util.tree_map(lambda v: v.std(axis=0, ddof=1), raw)
+        return {"mean": mean, "std": std, "raw": raw}
+
+    def merge(state_a: Dict[str, Any], state_b: Dict[str, Any], **counts: Any) -> Dict[str, Any]:
+        return jax.vmap(lambda a, b: mdef.merge(a, b, **counts))(state_a, state_b)
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+
+
 def _functionalize_collection(collection: "MetricCollection", axis_name: Optional[str] = None) -> MetricDef:
     """Pure functions over a ``{metric_name: state}`` dict for a collection."""
     from metrics_tpu.parallel.sync import fused_sync

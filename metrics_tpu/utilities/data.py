@@ -3,8 +3,9 @@
 TPU-first redesigns of the helpers in reference
 ``src/torchmetrics/utilities/data.py``:
 
-- ``_bincount`` (reference ``:244-264``) is a one-hot ``segment_sum`` — static
-  shape, deterministic, XLA-friendly (no data-dependent fallback loop needed).
+- ``_bincount`` (reference ``:244-264``) — static shape, deterministic,
+  XLA-friendly: a one-hot reduce for tiny ranges, a deterministic
+  scatter-add past that (see ``_bincount``).
 - ``apply_to_collection`` (reference ``:160-207``) is replaced by
   ``jax.tree_util`` mapping where possible; a compatible shim is kept for the
   dict/namedtuple cases used by the sync layer.
@@ -124,7 +125,11 @@ def _bincount(x: Array, minlength: int) -> Array:
     if minlength <= _BINCOUNT_ONEHOT_MAX:
         oh = x[:, None] == jnp.arange(minlength, dtype=x.dtype)[None, :]
         return oh.sum(axis=0).astype(out_dtype)
-    return jnp.zeros((minlength,), out_dtype).at[x].add(1, mode="drop")
+    # out-of-range values must be dropped like the one-hot path drops them;
+    # a raw scatter would python-wrap negatives (x.at[-1] hits the last bin),
+    # so they are routed to an overflow bin that is sliced off
+    safe = jnp.where((x >= 0) & (x < minlength), x, minlength)
+    return jnp.zeros((minlength + 1,), out_dtype).at[safe].add(1)[:minlength]
 
 
 def _cumsum(x: Array, axis: int = 0) -> Array:

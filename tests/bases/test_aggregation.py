@@ -92,4 +92,9 @@ def test_bincount_both_paths_match_numpy():
         np.testing.assert_array_equal(
             np.asarray(_bincount(jnp.asarray(x), minlength)), np.bincount(x, minlength=minlength)
         )
+        # out-of-range values must be dropped, not clamped/wrapped, on BOTH paths
+        bad = np.concatenate([x, [-1, -7, minlength, minlength + 5]]).astype(np.int32)
+        np.testing.assert_array_equal(
+            np.asarray(_bincount(jnp.asarray(bad), minlength)), np.bincount(x, minlength=minlength)
+        )
     np.testing.assert_array_equal(np.asarray(_bincount(jnp.zeros((0,), jnp.int32), 7)), np.zeros(7))

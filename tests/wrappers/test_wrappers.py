@@ -1,8 +1,11 @@
 """Wrapper-metric behavior (analogue of reference
 ``test/unittests/wrappers/test_{bootstrapping,classwise,minmax,multioutput,
 tracker}.py``)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
+
+import metrics_tpu as mt
 from sklearn.metrics import accuracy_score, r2_score as sk_r2
 
 from metrics_tpu import (
@@ -148,3 +151,58 @@ def test_classwise_forward_returns_batch_value():
     # global state still accumulates both batches
     final = m.compute()
     np.testing.assert_allclose(np.asarray(final["accuracy_0"]), 0.5, atol=1e-6)
+
+
+class TestBootstrapFunctionalize:
+    """The vmapped functional bootstrap (SURVEY §7: replicas as a state
+    axis, not deep copies)."""
+
+    def test_mean_tracks_plain_metric(self):
+        import jax
+
+        K = 50
+        bdef = mt.bootstrap_functionalize(mt.Accuracy(num_classes=4), K)
+        rng = np.random.default_rng(0)
+        preds = rng.random((512, 4)).astype(np.float32)
+        target = rng.integers(0, 4, 512)
+        state = bdef.init()
+        state = jax.jit(bdef.update)(state, jax.random.PRNGKey(0), jnp.asarray(preds), jnp.asarray(target))
+        out = bdef.compute(state)
+        plain = mt.functional.accuracy(preds, target, num_classes=4)
+        assert out["raw"].shape == (K,)
+        assert float(out["std"]) > 0
+        # bootstrap mean concentrates around the point estimate
+        assert abs(float(out["mean"]) - float(plain)) < 4 * float(out["std"]) + 0.02
+
+    def test_key_determinism_and_independence(self):
+        import jax
+
+        bdef = mt.bootstrap_functionalize(mt.MeanSquaredError(), 8)
+        rng = np.random.default_rng(1)
+        a = jnp.asarray(rng.random(128), jnp.float32)
+        b = jnp.asarray(rng.random(128), jnp.float32)
+        s1 = bdef.update(bdef.init(), jax.random.PRNGKey(7), a, b)
+        s2 = bdef.update(bdef.init(), jax.random.PRNGKey(7), a, b)
+        s3 = bdef.update(bdef.init(), jax.random.PRNGKey(8), a, b)
+        np.testing.assert_array_equal(np.asarray(s1["sum_squared_error"]), np.asarray(s2["sum_squared_error"]))
+        assert not np.allclose(np.asarray(s1["sum_squared_error"]), np.asarray(s3["sum_squared_error"]))
+        # replicas resample differently from each other
+        assert np.unique(np.asarray(s1["sum_squared_error"])).size > 1
+
+    def test_multi_batch_accumulation_jitted(self):
+        import jax
+
+        bdef = mt.bootstrap_functionalize(mt.MeanMetric(nan_strategy="ignore"), 16)
+        step = jax.jit(bdef.update)
+        state = bdef.init()
+        key = jax.random.PRNGKey(3)
+        vals = np.random.default_rng(2).random((5, 64)).astype(np.float32)
+        for i in range(5):
+            key, sub = jax.random.split(key)
+            state = step(state, sub, jnp.asarray(vals[i]))
+        out = bdef.compute(state)
+        assert abs(float(out["mean"]) - vals.mean()) < 0.05
+
+    def test_rejects_bad_num(self):
+        with pytest.raises(ValueError, match="larger than 1"):
+            mt.bootstrap_functionalize(mt.MeanMetric(nan_strategy="ignore"), 1)
