@@ -1,0 +1,60 @@
+"""Metrics inside a jitted flax/optax training step.
+
+The pure-functional API keeps metric state in the training carry, so update
+runs fused with the model step — zero extra dispatches, one compiled graph.
+Run: ``python examples/train_with_metrics.py``
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import metrics_tpu as mt
+
+NUM_CLASSES, DIM, BATCH, STEPS = 5, 16, 64, 30
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(NUM_CLASSES)(nn.relu(nn.Dense(32)(x)))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((DIM, NUM_CLASSES)).astype(np.float32)
+    xs = rng.standard_normal((STEPS, BATCH, DIM)).astype(np.float32)
+    ys = (xs @ w_true).argmax(-1)
+
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(0), xs[0])
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    metrics = mt.functionalize(
+        mt.MetricCollection([mt.Accuracy(num_classes=NUM_CLASSES), mt.F1Score(num_classes=NUM_CLASSES)])
+    )
+
+    @jax.jit
+    def train_step(params, opt_state, mstate, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        mstate = metrics.update(mstate, jax.nn.softmax(logits), y)  # fused with the step
+        return optax.apply_updates(params, updates), opt_state, mstate, loss
+
+    mstate = metrics.init()
+    for i in range(STEPS):
+        params, opt_state, mstate, loss = train_step(params, opt_state, mstate, xs[i], ys[i])
+    epoch = {k: float(v) for k, v in metrics.compute(mstate).items()}
+    print({"loss": float(loss), **epoch})
+    assert epoch["Accuracy"] > 0.5
+    return epoch
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Every example in ``examples/`` must run as written (subprocess, CPU),
+the way a new user would run it."""
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = sorted((pathlib.Path(__file__).resolve().parents[2] / "examples").glob("*.py"))
+
+
+@pytest.mark.parametrize("script", _EXAMPLES, ids=lambda p: p.name)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # drop the environment's axon sitecustomize: examples must run on any box
+    env["PYTHONPATH"] = str(script.parents[1])
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True, timeout=600, env=env
+    )
+    assert proc.returncode == 0, f"{script.name} failed:\n{proc.stderr[-2000:]}"
+    assert proc.stdout.strip(), f"{script.name} printed nothing"
