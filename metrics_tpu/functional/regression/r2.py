@@ -4,7 +4,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.checks import _check_same_shape, _is_concrete
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -36,7 +36,10 @@ def _r2_score_compute(
     multioutput: str = "uniform_average",
 ) -> Array:
     """Reference ``r2.py:51-131``."""
-    if n_obs < 2:
+    # value check only when n_obs is concrete — under jit the caller keeps
+    # static responsibility for feeding >= 2 samples (trace-time bool on a
+    # tracer would crash the whole compiled graph)
+    if _is_concrete(jnp.asarray(n_obs)) and n_obs < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
     mean_obs = sum_obs / n_obs

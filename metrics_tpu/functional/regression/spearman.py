@@ -6,7 +6,7 @@ TPU-first: the reference ranks with a Python loop over repeated values
 ``rank_i = (#{x_j < x_i} + #{x_j <= x_i} + 1) / 2`` via sort + binary search —
 static shapes, fully jittable, O(N log N).
 """
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -16,14 +16,28 @@ from metrics_tpu.utilities.checks import _check_same_shape
 Array = jax.Array
 
 
-def _rank_data(data: Array) -> Array:
+def _rank_data(data: Array, mask: Optional[Array] = None) -> Array:
     """1-based ranks with ties assigned the mean of their rank span
     (reference ``spearman.py:35-52``): ``rank_i = (#{< x_i} + 1 + #{<= x_i})/2``
-    via sort + two binary searches — O(N log N), no N x N broadcast."""
+    via sort + two binary searches — O(N log N), no N x N broadcast.
+
+    With ``mask``, only True rows participate (the static-shape ring-buffer
+    form): invalid rows sort to +inf, and the ``<=`` count is capped at the
+    valid count so legitimate ``+inf`` data values don't absorb the
+    sentinel ties. Rank values at invalid rows are meaningless and must be
+    masked out by the caller.
+    """
     data = jnp.asarray(data)
-    sorted_data = jnp.sort(data)
-    lt = jnp.searchsorted(sorted_data, data, side="left")
-    le = jnp.searchsorted(sorted_data, data, side="right")
+    if mask is None:
+        sorted_data = jnp.sort(data)
+        lt = jnp.searchsorted(sorted_data, data, side="left")
+        le = jnp.searchsorted(sorted_data, data, side="right")
+    else:
+        sorted_data = jnp.sort(jnp.where(mask, data, jnp.inf))
+        lt = jnp.searchsorted(sorted_data, data, side="left")
+        le = jnp.minimum(
+            jnp.searchsorted(sorted_data, data, side="right"), mask.sum().astype(jnp.int32)
+        )
     return (lt + 1 + le).astype(jnp.result_type(data, jnp.float32)) / 2.0
 
 
@@ -44,20 +58,44 @@ def _spearman_corrcoef_update(preds: Array, target: Array) -> Tuple[Array, Array
     return preds, target
 
 
-def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
-    """Reference ``spearman.py:79-105``."""
-    preds = _rank_data(preds)
-    target = _rank_data(target)
+def _spearman_masked(preds: Array, target: Array, mask: Array, eps: float = 1e-6) -> Array:
+    """Spearman correlation of the masked rows of a :class:`CatBuffer` pair —
+    the static-shape, jittable form of :func:`_spearman_corrcoef_compute`.
 
-    preds_diff = preds - preds.mean()
-    target_diff = target - target.mean()
+    An empty buffer (no valid rows) yields NaN: under jit nothing can raise
+    on a traced count, so the undefined case is made explicit instead of
+    leaking through a 0/0 chain.
+    """
+    return _spearman_corrcoef_compute(
+        jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32), eps, mask=jnp.asarray(mask, bool)
+    )
 
-    cov = (preds_diff * target_diff).mean()
-    preds_std = jnp.sqrt((preds_diff * preds_diff).mean())
-    target_std = jnp.sqrt((target_diff * target_diff).mean())
 
-    corrcoef = cov / (preds_std * target_std + eps)
-    return jnp.clip(corrcoef, -1.0, 1.0)
+def _spearman_corrcoef_compute(
+    preds: Array, target: Array, eps: float = 1e-6, mask: Optional[Array] = None
+) -> Array:
+    """Reference ``spearman.py:79-105``; one weighted implementation serves
+    both the eager path (``mask=None`` — unit weights) and the ring-buffer
+    path, so tie policy / eps / clip can never drift between the modes."""
+    rp = _rank_data(preds, mask)
+    rt = _rank_data(target, mask)
+    w = jnp.ones_like(rp) if mask is None else mask.astype(rp.dtype)
+    n = w.sum()
+    n_safe = jnp.maximum(n, 1.0)
+
+    mean_p = (rp * w).sum() / n_safe
+    mean_t = (rt * w).sum() / n_safe
+    dp = (rp - mean_p) * w
+    dt = (rt - mean_t) * w
+
+    cov = (dp * dt).sum() / n_safe
+    std_p = jnp.sqrt((dp * dp).sum() / n_safe)
+    std_t = jnp.sqrt((dt * dt).sum() / n_safe)
+
+    corrcoef = jnp.clip(cov / (std_p * std_t + eps), -1.0, 1.0)
+    if mask is None:
+        return corrcoef
+    return jnp.where(n > 0, corrcoef, jnp.nan)
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:

@@ -1,36 +1,74 @@
 """``SpearmanCorrCoef`` module metric (reference
 ``src/torchmetrics/regression/spearman.py:25``).
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
-from metrics_tpu.functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from metrics_tpu.functional.regression.spearman import (
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+    _spearman_masked,
+)
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append
 
 Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
     """Spearman rank correlation over accumulated predictions
-    (reference ``spearman.py:25-84``); cat list states, ranking at compute."""
+    (reference ``spearman.py:25-84``).
+
+    Two accumulation modes (same design as :class:`~metrics_tpu.AUROC`):
+
+    - default: cat list states, ranking at compute (eager).
+    - ``capacity=N``: fixed-size :class:`CatBuffer` ring states — update,
+      compute (masked tie-averaged ranking), and cross-device sync are all
+      static-shape and fully jittable / ``functionalize``-able. Samples
+      past capacity are dropped.
+    """
 
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
 
-    def __init__(self, **kwargs: Any) -> None:
+    def __init__(self, capacity: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is not None:
+            self.add_state("preds", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        if self.capacity is not None:
+            preds = jnp.asarray(preds, jnp.float32)
+            target = jnp.asarray(target, jnp.float32)
+            if preds.shape != target.shape:
+                raise ValueError(
+                    f"Expected `preds` and `target` of the same shape, got {preds.shape} vs {target.shape}"
+                )
+            preds = preds.squeeze()
+            target = target.squeeze()
+            if preds.ndim > 1:
+                raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+            self.preds = cat_append(self.preds, jnp.atleast_1d(preds), valid)
+            self.target = cat_append(self.target, jnp.atleast_1d(target), valid)
+            return
+        if valid is not None:
+            raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")
         preds, target = _spearman_corrcoef_update(preds, target)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
+        if self.capacity is not None:
+            return _spearman_masked(self.preds.data, self.target.data, self.preds.mask)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _spearman_corrcoef_compute(preds, target)

@@ -1,0 +1,92 @@
+"""Module path vs pure-functional path: one sweep over every jittable
+metric family.
+
+``functionalize`` traces the SAME update/compute bodies with explicit
+state, so the two paths must agree exactly — this sweep pins that for a
+representative of every state pattern (sum scalars, (C,) vectors, confmat,
+moment merges, ring buffers, binned counters, aggregators).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from tests.helpers import seed_all
+
+seed_all(0)
+rng = np.random.default_rng(0)
+N, C = 96, 4
+
+PROBS = rng.random((2, N, C)).astype(np.float32)
+PROBS /= PROBS.sum(-1, keepdims=True)
+LABELS = rng.integers(0, C, (2, N))
+BIN_P = rng.random((2, N)).astype(np.float32)
+BIN_T = rng.integers(0, 2, (2, N))
+REG_A = rng.standard_normal((2, N)).astype(np.float32)
+REG_B = (REG_A + 0.3 * rng.standard_normal((2, N))).astype(np.float32)
+
+
+CASES = [
+    ("accuracy", lambda: mt.Accuracy(num_classes=C), PROBS, LABELS),
+    ("f1_macro", lambda: mt.F1Score(num_classes=C, average="macro"), PROBS, LABELS),
+    ("precision_weighted", lambda: mt.Precision(num_classes=C, average="weighted"), PROBS, LABELS),
+    ("specificity", lambda: mt.Specificity(num_classes=C, average="macro"), PROBS, LABELS),
+    ("statscores", lambda: mt.StatScores(reduce="macro", num_classes=C), PROBS, LABELS),
+    ("confusion", lambda: mt.ConfusionMatrix(num_classes=C), PROBS, LABELS),
+    ("cohen", lambda: mt.CohenKappa(num_classes=C), PROBS, LABELS),
+    ("matthews", lambda: mt.MatthewsCorrCoef(num_classes=C), PROBS, LABELS),
+    ("jaccard", lambda: mt.JaccardIndex(num_classes=C), PROBS, LABELS),
+    ("hamming", lambda: mt.HammingDistance(), PROBS, LABELS),
+    ("binned_ap", lambda: mt.BinnedAveragePrecision(num_classes=C, thresholds=50), PROBS, LABELS),
+    ("auroc_ring", lambda: mt.AUROC(capacity=2 * N), BIN_P, BIN_T),
+    ("kld", lambda: mt.KLDivergence(), PROBS, np.flip(PROBS, axis=-1).copy()),
+    ("mse", lambda: mt.MeanSquaredError(), REG_A, REG_B),
+    ("mae", lambda: mt.MeanAbsoluteError(), REG_A, REG_B),
+    ("pearson", lambda: mt.PearsonCorrCoef(), REG_A, REG_B),
+    ("spearman_ring", lambda: mt.SpearmanCorrCoef(capacity=2 * N), REG_A, REG_B),
+    ("explained_var", lambda: mt.ExplainedVariance(), REG_A, REG_B),
+    ("r2", lambda: mt.R2Score(), REG_A, REG_B),
+    ("tweedie", lambda: mt.TweedieDevianceScore(power=1.5), np.abs(REG_A) + 0.1, np.abs(REG_B) + 0.1),
+    ("mean_agg", lambda: mt.MeanMetric(nan_strategy="ignore"), REG_A, None),
+    ("max_agg", lambda: mt.MaxMetric(nan_strategy="ignore"), REG_A, None),
+    ("sum_agg", lambda: mt.SumMetric(nan_strategy="ignore"), REG_A, None),
+]
+
+
+@pytest.mark.parametrize("name, ctor, xs, ys", CASES, ids=[c[0] for c in CASES])
+def test_functional_matches_module(name, ctor, xs, ys):
+    module = ctor()
+    for i in range(xs.shape[0]):
+        module.update(*( (xs[i],) if ys is None else (xs[i], ys[i]) ))
+    want = module.compute()
+
+    mdef = mt.functionalize(ctor())
+    update = jax.jit(mdef.update)
+    state = mdef.init()
+    for i in range(xs.shape[0]):
+        args = (jnp.asarray(xs[i]),) if ys is None else (jnp.asarray(xs[i]), jnp.asarray(ys[i]))
+        state = update(state, *args)
+    got = jax.jit(mdef.compute)(state)
+
+    jax.tree_util.tree_map(
+        lambda g, w: np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-6),
+        got,
+        want,
+    )
+
+
+@pytest.mark.parametrize("name, ctor, xs, ys", CASES[:6], ids=[c[0] for c in CASES[:6]])
+def test_merge_matches_sequential(name, ctor, xs, ys):
+    """merge(update(s0, b0), update(s0, b1)) == update(update(s0, b0), b1)
+    for the associative state patterns."""
+    mdef = mt.functionalize(ctor())
+    a0 = (xs[0],) if ys is None else (xs[0], ys[0])
+    a1 = (xs[1],) if ys is None else (xs[1], ys[1])
+    seq = mdef.update(mdef.update(mdef.init(), *a0), *a1)
+    par = mdef.merge(mdef.update(mdef.init(), *a0), mdef.update(mdef.init(), *a1))
+    jax.tree_util.tree_map(
+        lambda g, w: np.testing.assert_allclose(np.asarray(g), np.asarray(w), rtol=1e-6),
+        mdef.compute(par),
+        mdef.compute(seq),
+    )
