@@ -15,7 +15,7 @@ from metrics_tpu.functional.classification.auroc import (
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.enums import AverageMethod, DataType
-from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append
+from metrics_tpu.utilities.ringbuffer import init_score_ring_states, score_ring_update
 
 Array = jax.Array
 
@@ -73,10 +73,7 @@ class AUROC(Metric):
                 raise ValueError("`average='micro'` is not supported together with `capacity` mode")
             if pos_label not in (None, 1):
                 raise ValueError("`pos_label` other than 1 is not supported together with `capacity` mode")
-            self.mode = DataType.MULTICLASS if num_classes and num_classes > 1 else DataType.BINARY
-            row = (num_classes,) if self.mode == DataType.MULTICLASS else ()
-            self.add_state("preds", default=CatBuffer.zeros(capacity, row, jnp.float32), dist_reduce_fx="cat")
-            self.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.int32), dist_reduce_fx="cat")
+            self.mode = init_score_ring_states(self, capacity, num_classes)
         else:
             self.mode: Optional[DataType] = None
             self.add_state("preds", default=[], dist_reduce_fx="cat")
@@ -90,14 +87,7 @@ class AUROC(Metric):
         equal-shaped blocks (e.g. a final partial batch per device).
         """
         if self.capacity is not None:
-            preds = jnp.asarray(preds)
-            target = jnp.asarray(target)
-            if self.mode == DataType.MULTICLASS and preds.ndim != 2:
-                raise ValueError("capacity-mode multiclass AUROC expects (N, C) scores")
-            if self.mode == DataType.BINARY and preds.ndim != 1:
-                raise ValueError("capacity-mode binary AUROC expects (N,) scores")
-            self.preds = cat_append(self.preds, preds, valid)
-            self.target = cat_append(self.target, target.astype(jnp.int32), valid)
+            score_ring_update(self, preds, target, valid, "AUROC")
             return
         if valid is not None:
             raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")

@@ -5,19 +5,33 @@ from typing import Any, List, Optional, Union
 
 import jax
 
+import jax.numpy as jnp
+
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
+    _binary_average_precision_masked,
+    _multiclass_average_precision_masked,
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.ringbuffer import init_score_ring_states, score_ring_update
 
 Array = jax.Array
 
 
 class AveragePrecision(Metric):
     """Average precision over accumulated predictions
-    (reference ``avg_precision.py:24-136``)."""
+    (reference ``avg_precision.py:24-136``).
+
+    Two accumulation modes (same design as :class:`~metrics_tpu.AUROC`):
+
+    - default: cat list states, step-integral of the PR curve at compute.
+    - ``capacity=N``: fixed-size :class:`CatBuffer` ring states — update,
+      compute (masked tie-grouped AP), and cross-device sync are all
+      static-shape and fully jittable / ``functionalize``-able.
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -28,6 +42,7 @@ class AveragePrecision(Metric):
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
         average: Optional[str] = "macro",
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -37,10 +52,23 @@ class AveragePrecision(Metric):
         if average not in allowed_average:
             raise ValueError(f"Expected argument `average` to be one of {allowed_average} but got {average}")
         self.average = average
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is not None:
+            if average == "micro":
+                raise ValueError("`average='micro'` is not supported together with `capacity` mode")
+            if pos_label not in (None, 1):
+                raise ValueError("`pos_label` other than 1 is not supported together with `capacity` mode")
+            self.mode = init_score_ring_states(self, capacity, num_classes)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        if self.capacity is not None:
+            score_ring_update(self, preds, target, valid, "AveragePrecision")
+            return
+        if valid is not None:
+            raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )
@@ -50,6 +78,12 @@ class AveragePrecision(Metric):
         self.pos_label = pos_label
 
     def compute(self) -> Union[Array, List[Array]]:
+        if self.capacity is not None:
+            if self.mode == DataType.MULTICLASS:
+                return _multiclass_average_precision_masked(
+                    self.preds.data, self.target.data, self.preds.mask, self.num_classes, self.average
+                )
+            return _binary_average_precision_masked(self.preds.data, self.target.data, self.preds.mask)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _average_precision_compute(preds, target, self.num_classes, self.pos_label, self.average)

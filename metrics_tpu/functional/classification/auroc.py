@@ -162,10 +162,12 @@ def _binary_auroc_masked(preds: Array, target: Array, mask: Array) -> Array:
     neg = mask & (target != 1)
     n_pos = jnp.sum(pos.astype(jnp.float32))
     n_neg = jnp.sum(neg.astype(jnp.float32))
-    # negatives sorted with padding pushed to +inf (never counted as "less")
+    # negatives sorted with padding pushed to +inf (never counted as "less");
+    # the <= count is capped at the true negative total so a legitimate +inf
+    # prediction doesn't absorb the padding sentinel as ties
     neg_sorted = jnp.sort(jnp.where(neg, preds, jnp.inf))
     less = jnp.searchsorted(neg_sorted, preds, side="left").astype(jnp.float32)
-    leq = jnp.searchsorted(neg_sorted, preds, side="right").astype(jnp.float32)
+    leq = jnp.minimum(jnp.searchsorted(neg_sorted, preds, side="right").astype(jnp.float32), n_neg)
     u = jnp.sum(jnp.where(pos, less + 0.5 * (leq - less), 0.0))
     return u / (n_pos * n_neg)
 

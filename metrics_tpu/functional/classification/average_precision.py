@@ -16,6 +16,86 @@ from metrics_tpu.utilities.data import _bincount
 Array = jax.Array
 
 
+def _binary_average_precision_masked(preds: Array, target: Array, mask: Array) -> Array:
+    """Average precision of the masked rows — static-shape and jittable,
+    for :class:`CatBuffer` ring states.
+
+    Same value as the PR-curve step integral on the valid rows
+    (reference ``average_precision.py:113-176`` / sklearn): scores sorted
+    descending, ties grouped per unique threshold, ``AP = sum over
+    threshold groups of precision_at_group_end * group_positive_mass /
+    n_pos``. No positives -> NaN (the eager path warns and NaNs too).
+    """
+    preds = jnp.asarray(preds, jnp.float32)
+    mask = jnp.asarray(mask, bool)
+    # binarize like the eager path (`target == pos_label`, pos_label fixed
+    # to 1 in capacity mode) — raw label values must not act as mass
+    rel = (mask & (jnp.asarray(target) == 1)).astype(jnp.float32)
+    score = jnp.where(mask, preds, -jnp.inf)
+
+    order = jnp.argsort(-score)  # descending; invalid rows sort last
+    s_sorted = score[order]
+    rel_sorted = rel[order]
+    valid_sorted = mask[order]
+
+    tps = jnp.cumsum(rel_sorted)
+    # denominator = number of VALID predictions at or above the threshold:
+    # valid -inf scores tie with the invalid-row fill and interleave with it
+    # in the sort, so the raw position index would overcount
+    kv = jnp.cumsum(valid_sorted.astype(jnp.float32))
+    precision = tps / jnp.maximum(kv, 1.0)
+    n_pos = rel_sorted.sum()
+    n_valid = valid_sorted.sum()
+
+    # last position of each tie group among the valid rows; the last valid
+    # row is always a boundary (its score can equal the -inf end sentinel)
+    next_s = jnp.concatenate([s_sorted[1:], jnp.full((1,), -jnp.inf, s_sorted.dtype)])
+    boundary = valid_sorted & ((s_sorted != next_s) | (kv == n_valid))
+
+    # positives inside each group = tps at this boundary minus tps at the
+    # previous one; tps is monotone, so a shifted cummax over
+    # boundary-marked tps recovers the previous boundary's value
+    marked = jnp.where(boundary, tps, 0.0)
+    prev = jnp.concatenate([jnp.zeros((1,)), jax.lax.cummax(marked)[:-1]])
+    group_pos = tps - prev
+
+    ap = jnp.sum(jnp.where(boundary, precision * group_pos, 0.0)) / jnp.maximum(n_pos, 1.0)
+    return jnp.where(n_pos > 0, ap, jnp.nan)
+
+
+def _multiclass_average_precision_masked(
+    preds: Array,
+    target: Array,
+    mask: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+) -> Union[Array, List[Array]]:
+    """One-vs-rest masked AP over a ``(cap, C)`` score buffer (micro is
+    rejected for multiclass input, as in the reference
+    ``average_precision.py:47``)."""
+    target = jnp.asarray(target)
+    if average == "micro":
+        raise ValueError("Cannot use `micro` average with multi-class input")
+    per_class = jax.vmap(
+        lambda c: _binary_average_precision_masked(preds[:, c], (target == c).astype(jnp.int32), mask)
+    )(jnp.arange(num_classes))
+    if average in (None, "none"):
+        return per_class
+    defined = ~jnp.isnan(per_class)
+    safe = jnp.where(defined, per_class, 0.0)
+    if average == "macro":
+        return jnp.sum(safe) / jnp.maximum(jnp.sum(defined.astype(jnp.float32)), 1.0)
+    if average == "weighted":
+        # one O(cap) bincount (invalid rows routed to an extra dropped bin)
+        # instead of a vmapped O(C * cap) comparison sweep
+        counts = _bincount(
+            jnp.where(jnp.asarray(mask), target, num_classes), minlength=num_classes + 1
+        )[:num_classes].astype(jnp.float32)
+        weights = jnp.where(defined, counts, 0.0)
+        return jnp.sum(safe * weights / jnp.maximum(jnp.sum(weights), 1.0))
+    raise ValueError(f"Average {average!r} is not supported in masked AP")
+
+
 def _average_precision_update(
     preds: Array,
     target: Array,

@@ -104,3 +104,35 @@ def cat_concat(a: CatBuffer, b: CatBuffer) -> CatBuffer:
         data=jnp.concatenate([a.data, b.data], axis=0),
         mask=jnp.concatenate([a.mask, b.mask], axis=0),
     )
+
+
+def init_score_ring_states(metric: Any, capacity: int, num_classes) -> "DataType":
+    """Register the standard (preds, target) ring-state pair for a
+    score-based curve metric in capacity mode and return its data mode.
+
+    Shared by :class:`~metrics_tpu.AUROC` and
+    :class:`~metrics_tpu.AveragePrecision` so capacity-mode semantics
+    (state shapes, binary-vs-one-vs-rest selection) can never drift
+    between them.
+    """
+    from metrics_tpu.utilities.enums import DataType
+
+    mode = DataType.MULTICLASS if num_classes and num_classes > 1 else DataType.BINARY
+    row = (num_classes,) if mode == DataType.MULTICLASS else ()
+    metric.add_state("preds", default=CatBuffer.zeros(capacity, row, jnp.float32), dist_reduce_fx="cat")
+    metric.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.int32), dist_reduce_fx="cat")
+    return mode
+
+
+def score_ring_update(metric: Any, preds: Array, target: Array, valid, metric_name: str) -> None:
+    """The shared capacity-mode update: shape validation + masked append."""
+    from metrics_tpu.utilities.enums import DataType
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if metric.mode == DataType.MULTICLASS and preds.ndim != 2:
+        raise ValueError(f"capacity-mode multiclass {metric_name} expects (N, C) scores")
+    if metric.mode == DataType.BINARY and preds.ndim != 1:
+        raise ValueError(f"capacity-mode binary {metric_name} expects (N,) scores")
+    metric.preds = cat_append(metric.preds, preds, valid)
+    metric.target = cat_append(metric.target, target.astype(jnp.int32), valid)

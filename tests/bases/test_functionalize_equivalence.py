@@ -40,6 +40,8 @@ CASES = [
     ("hamming", lambda: mt.HammingDistance(), PROBS, LABELS),
     ("binned_ap", lambda: mt.BinnedAveragePrecision(num_classes=C, thresholds=50), PROBS, LABELS),
     ("auroc_ring", lambda: mt.AUROC(capacity=2 * N), BIN_P, BIN_T),
+    ("ap_ring", lambda: mt.AveragePrecision(capacity=2 * N), BIN_P, BIN_T),
+    ("ap_ring_mc", lambda: mt.AveragePrecision(num_classes=C, capacity=2 * N), PROBS, LABELS),
     ("kld", lambda: mt.KLDivergence(), PROBS, np.flip(PROBS, axis=-1).copy()),
     ("mse", lambda: mt.MeanSquaredError(), REG_A, REG_B),
     ("mae", lambda: mt.MeanAbsoluteError(), REG_A, REG_B),

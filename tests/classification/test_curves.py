@@ -1,6 +1,7 @@
 """Curve-metric parity vs sklearn (analogue of reference
 ``test/unittests/classification/test_{auroc,roc,precision_recall_curve,
 average_precision,binned_precision_recall,auc}.py``)."""
+import jax.numpy as jnp
 import numpy as np
 import pytest
 from sklearn.metrics import average_precision_score as sk_ap
@@ -173,3 +174,97 @@ class TestBinned:
             if prec >= 0.5 - 1e-9:
                 best = max(best, rec)
         np.testing.assert_allclose(np.asarray(recall_at), best, atol=2e-2)
+
+
+def test_average_precision_capacity_mode():
+    """Ring-buffer AP (masked tie-grouped kernel) matches the eager path and
+    sklearn, jits, functionalizes, and takes ragged `valid` tails."""
+    import jax
+    from sklearn.metrics import average_precision_score
+
+    from metrics_tpu import functionalize
+
+    rng = np.random.default_rng(0)
+    p = np.round(rng.random(300), 2).astype(np.float32)  # ties
+    t = rng.integers(0, 2, 300)
+
+    eager = AveragePrecision()
+    eager.update(p, t)
+    want = float(eager.compute())
+    np.testing.assert_allclose(want, average_precision_score(t, p), atol=1e-5)
+
+    ring = AveragePrecision(capacity=512)
+    ring.update(p[:200], t[:200])
+    pad = np.zeros(100, np.float32)
+    ring.update(np.concatenate([p[200:], pad]), np.concatenate([t[200:], np.zeros(100, np.int64)]),
+                valid=np.arange(200) < 100)
+    np.testing.assert_allclose(float(ring.compute()), want, atol=1e-5)
+
+    mdef = functionalize(AveragePrecision(capacity=512))
+    state = jax.jit(mdef.update)(mdef.init(), jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(jax.jit(mdef.compute)(state)), want, atol=1e-5)
+
+
+def test_average_precision_capacity_multiclass_sharded():
+    """Capacity-mode multiclass AP under shard_map: per-device ring buffers
+    union over the mesh and match the single-device oracle."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu import functionalize
+
+    C, per_dev, ndev = 4, 16, 8
+    rng = np.random.default_rng(1)
+    n = per_dev * ndev
+    p = rng.random((n, C)).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    t = rng.integers(0, C, n)
+
+    single = AveragePrecision(num_classes=C, capacity=n)
+    single.update(p, t)
+    want = float(single.compute())
+
+    mdef = functionalize(AveragePrecision(num_classes=C, capacity=per_dev), axis_name="data")
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("data",))
+
+    def step(ps, ts):
+        return mdef.compute(mdef.update(mdef.init(), ps, ts))
+
+    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P()))(p, t)
+    np.testing.assert_allclose(float(out), want, rtol=1e-5)
+
+
+def test_capacity_kernels_inf_scores_and_nonbinary_targets():
+    """Masked-kernel edge cases: a valid -inf/+inf score must not merge with
+    the padding sentinels, and targets are binarized like the eager path."""
+    from metrics_tpu.functional.classification.auroc import _binary_auroc_masked
+    from metrics_tpu.functional.classification.average_precision import _binary_average_precision_masked
+
+    # valid -inf prediction: its positive still counts (eager: 0.8333)
+    p = jnp.asarray([0.9, 0.5, -np.inf])
+    t = jnp.asarray([1, 0, 1])
+    full = jnp.ones(3, bool)
+    eager = AveragePrecision()
+    eager.update(np.asarray([0.9, 0.5, -1e30]), np.asarray(t))  # proxy for -inf ordering
+    np.testing.assert_allclose(
+        float(_binary_average_precision_masked(p, t, full)), float(eager.compute()), atol=1e-6
+    )
+
+    # non-{0,1} targets binarize as `== 1`, never act as raw mass
+    p2 = jnp.asarray([0.1, 0.9, 0.8, 0.3, 0.6])
+    t2 = jnp.asarray([0, 2, 1, 0, 1])
+    ap = float(_binary_average_precision_masked(p2, t2, jnp.ones(5, bool)))
+    assert 0.0 <= ap <= 1.0
+    eager2 = AveragePrecision()
+    eager2.update(np.asarray(p2), (np.asarray(t2) == 1).astype(np.int64))
+    np.testing.assert_allclose(ap, float(eager2.compute()), atol=1e-6)
+
+    # +inf prediction in AUROC: padded +inf negatives must not count as ties
+    p3 = jnp.asarray([np.inf, 0.5, 0.2, 0.0])
+    t3 = jnp.asarray([1, 0, 1, 0])
+    mask3 = jnp.asarray([True, True, True, False])  # one padding row
+    got = float(_binary_auroc_masked(p3, t3, mask3))
+    from sklearn.metrics import roc_auc_score
+
+    want = roc_auc_score([1, 0, 1], [1e30, 0.5, 0.2])
+    np.testing.assert_allclose(got, want, atol=1e-6)
