@@ -333,3 +333,48 @@ def test_bertscore_module():
     out = m.compute()
     single = F.bert_score(PREDS, TARGETS_SINGLE, encoder=_fake_encoder)
     np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(single["f1"]), atol=1e-5)
+
+
+def test_bert_score_with_real_flax_transformer(tmp_path):
+    """End-to-end BERTScore through genuine HF machinery — a FlaxBertModel
+    (random init, no download) and a BertTokenizerFast built from a local
+    vocab file — proving the injected-encoder contract against the real
+    tokenizer/encoder shapes, not just the deterministic fake."""
+    import jax.numpy as jnp
+
+    transformers = pytest.importorskip("transformers")
+
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+             "the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "hello", "world"]
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(vocab))
+    tokenizer = transformers.BertTokenizerFast(vocab_file=str(vocab_file), do_lower_case=True)
+
+    config = transformers.BertConfig(
+        vocab_size=len(vocab), hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64, max_position_embeddings=64,
+    )
+    model = transformers.FlaxBertModel(config, seed=0)
+
+    def encoder(sentences):
+        batch = tokenizer(sentences, padding=True, truncation=True, max_length=32, return_tensors="np")
+        out = model(input_ids=batch["input_ids"], attention_mask=batch["attention_mask"])
+        return out.last_hidden_state, batch["attention_mask"], batch["input_ids"]
+
+    preds = ["the cat sat on the mat", "a dog ran fast"]
+    target = ["the cat sat on the mat", "hello world"]
+    res = F.bert_score(preds, target, encoder=encoder)
+
+    assert set(res) == {"precision", "recall", "f1"}
+    assert res["f1"].shape == (2,)
+    # identical sentences score (near-)perfect; unrelated ones lower
+    np.testing.assert_allclose(float(res["f1"][0]), 1.0, atol=1e-4)
+    assert float(res["f1"][1]) < float(res["f1"][0])
+
+    # idf weighting and baseline rescaling run through the same path
+    res_idf = F.bert_score(preds, target, encoder=encoder, idf=True)
+    assert np.isfinite(np.asarray(res_idf["f1"])).all()
+    res_rs = F.bert_score(preds, target, encoder=encoder, rescale_with_baseline=True, baseline=(0.3, 0.3, 0.3))
+    np.testing.assert_allclose(
+        np.asarray(res_rs["f1"]), (np.asarray(res["f1"]) - 0.3) / 0.7, atol=1e-5
+    )
