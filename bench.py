@@ -350,12 +350,43 @@ def _phase_vsref(jax, platform) -> None:
         print(f"bench: vsref ssim failed: {err}", file=sys.stderr)
 
 
+def _phase_detection(jax, platform) -> None:
+    """COCO mAP at scale: 100 images x 50 boxes, box IoU + greedy matching
+    on device (the reference's pycocotools-backed path cannot run here -
+    torchvision is absent - so this is a self-number, honestly labeled)."""
+    _stamp("detection start")
+    import numpy as np
+
+    try:
+        from metrics_tpu.detection import MeanAveragePrecision
+
+        rng = np.random.default_rng(0)
+        preds, tgts = [], []
+        for _ in range(100):
+            b = rng.random((50, 4)).astype(np.float32) * 200
+            boxes = np.stack([b[:, 0], b[:, 1], b[:, 0] + b[:, 2] / 4 + 5, b[:, 1] + b[:, 3] / 4 + 5], 1)
+            preds.append(dict(boxes=boxes, scores=rng.random(50).astype(np.float32), labels=rng.integers(0, 5, 50)))
+            tgts.append(dict(boxes=boxes + rng.normal(0, 3, boxes.shape).astype(np.float32), labels=rng.integers(0, 5, 50)))
+        m = MeanAveragePrecision()
+        t0 = time.perf_counter()
+        m.update(preds, tgts)
+        res = m.compute()
+        _emit(
+            "map_100img_50box_s",
+            round(time.perf_counter() - t0, 3),
+            f"s end-to-end (COCO mAP, 100 imgs x 50 boxes, 5 classes, {platform}); map={float(res['map']):.4f}",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: detection failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
     "ssim": (_phase_ssim, 150),
     "retrieval": (_phase_retrieval, 150),
     "vsref": (_phase_vsref, 240),
+    "detection": (_phase_detection, 120),
     "sync": (_phase_sync, 150),
 }
 
