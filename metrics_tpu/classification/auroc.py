@@ -15,7 +15,7 @@ from metrics_tpu.functional.classification.auroc import (
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.enums import AverageMethod, DataType
-from metrics_tpu.utilities.ringbuffer import init_score_ring_states, score_ring_update
+from metrics_tpu.utilities.ringbuffer import init_score_ring_states, reject_valid_kwarg, score_ring_update
 
 Array = jax.Array
 
@@ -71,9 +71,7 @@ class AUROC(Metric):
                 raise ValueError("`max_fpr` is not supported together with `capacity` (static-shape) mode")
             if average == AverageMethod.MICRO:
                 raise ValueError("`average='micro'` is not supported together with `capacity` mode")
-            if pos_label not in (None, 1):
-                raise ValueError("`pos_label` other than 1 is not supported together with `capacity` mode")
-            self.mode = init_score_ring_states(self, capacity, num_classes)
+            self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
         else:
             self.mode: Optional[DataType] = None
             self.add_state("preds", default=[], dist_reduce_fx="cat")
@@ -89,8 +87,7 @@ class AUROC(Metric):
         if self.capacity is not None:
             score_ring_update(self, preds, target, valid, "AUROC")
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")
+        reject_valid_kwarg(valid)
         preds, target, mode = _auroc_update(preds, target)
         self.preds.append(preds)
         self.target.append(target)

@@ -16,7 +16,7 @@ from metrics_tpu.functional.classification.average_precision import (
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.enums import DataType
-from metrics_tpu.utilities.ringbuffer import init_score_ring_states, score_ring_update
+from metrics_tpu.utilities.ringbuffer import init_score_ring_states, reject_valid_kwarg, score_ring_update
 
 Array = jax.Array
 
@@ -56,9 +56,7 @@ class AveragePrecision(Metric):
         if capacity is not None:
             if average == "micro":
                 raise ValueError("`average='micro'` is not supported together with `capacity` mode")
-            if pos_label not in (None, 1):
-                raise ValueError("`pos_label` other than 1 is not supported together with `capacity` mode")
-            self.mode = init_score_ring_states(self, capacity, num_classes)
+            self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -67,8 +65,7 @@ class AveragePrecision(Metric):
         if self.capacity is not None:
             score_ring_update(self, preds, target, valid, "AveragePrecision")
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")
+        reject_valid_kwarg(valid)
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average
         )

@@ -11,11 +11,15 @@ from typing import Any, List, Optional, Tuple, Union
 import jax
 
 from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_masked,
+    _multiclass_precision_recall_curve_masked,
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.ringbuffer import init_score_ring_states, reject_valid_kwarg, score_ring_update
 
 Array = jax.Array
 
@@ -32,16 +36,25 @@ class PrecisionRecallCurve(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is not None:
+            self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         """Reference ``precision_recall_curve.py:119-133``."""
+        if self.capacity is not None:
+            score_ring_update(self, preds, target, valid, "PrecisionRecallCurve")
+            return
+        reject_valid_kwarg(valid)
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label
         )
@@ -52,6 +65,12 @@ class PrecisionRecallCurve(Metric):
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         """Reference ``precision_recall_curve.py:135-144``."""
+        if self.capacity is not None:
+            if self.mode == DataType.MULTICLASS:
+                return _multiclass_precision_recall_curve_masked(
+                    self.preds.data, self.target.data, self.preds.mask, self.num_classes
+                )
+            return _binary_precision_recall_curve_masked(self.preds.data, self.target.data, self.preds.mask)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

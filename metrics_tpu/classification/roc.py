@@ -5,15 +5,28 @@ from typing import Any, List, Optional, Tuple, Union
 
 import jax
 
-from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_masked,
+    _multiclass_roc_masked,
+    _roc_compute,
+    _roc_update,
+)
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.ringbuffer import init_score_ring_states, reject_valid_kwarg, score_ring_update
 
 Array = jax.Array
 
 
 class ROC(Metric):
-    """Receiver operating characteristic (reference ``roc.py:26-143``)."""
+    """Receiver operating characteristic (reference ``roc.py:26-143``).
+
+    ``capacity=N`` switches to :class:`CatBuffer` ring states with a fully
+    jittable masked compute returning terminal-padded ``(cap + 1,)`` arrays
+    (stacked ``(C, cap + 1)`` one-vs-rest for multiclass) — trapezoidal
+    integration over the padded curve equals the exact eager curve.
+    """
 
     is_differentiable = False
     higher_is_better: Optional[bool] = None
@@ -23,15 +36,24 @@ class ROC(Metric):
         self,
         num_classes: Optional[int] = None,
         pos_label: Optional[int] = None,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.num_classes = num_classes
         self.pos_label = pos_label
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is not None:
+            self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        if self.capacity is not None:
+            score_ring_update(self, preds, target, valid, "ROC")
+            return
+        reject_valid_kwarg(valid)
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
         self.preds.append(preds)
         self.target.append(target)
@@ -39,6 +61,10 @@ class ROC(Metric):
         self.pos_label = pos_label
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        if self.capacity is not None:
+            if self.mode == DataType.MULTICLASS:
+                return _multiclass_roc_masked(self.preds.data, self.target.data, self.preds.mask, self.num_classes)
+            return _binary_roc_masked(self.preds.data, self.target.data, self.preds.mask)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _roc_compute(preds, target, self.num_classes, self.pos_label)

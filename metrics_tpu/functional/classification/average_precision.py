@@ -7,6 +7,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.classification.masked_common import masked_curve_prologue
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _precision_recall_curve_compute,
     _precision_recall_curve_update,
@@ -26,31 +27,9 @@ def _binary_average_precision_masked(preds: Array, target: Array, mask: Array) -
     threshold groups of precision_at_group_end * group_positive_mass /
     n_pos``. No positives -> NaN (the eager path warns and NaNs too).
     """
-    preds = jnp.asarray(preds, jnp.float32)
-    mask = jnp.asarray(mask, bool)
-    # binarize like the eager path (`target == pos_label`, pos_label fixed
-    # to 1 in capacity mode) — raw label values must not act as mass
-    rel = (mask & (jnp.asarray(target) == 1)).astype(jnp.float32)
-    score = jnp.where(mask, preds, -jnp.inf)
-
-    order = jnp.argsort(-score)  # descending; invalid rows sort last
-    s_sorted = score[order]
-    rel_sorted = rel[order]
-    valid_sorted = mask[order]
-
-    tps = jnp.cumsum(rel_sorted)
-    # denominator = number of VALID predictions at or above the threshold:
-    # valid -inf scores tie with the invalid-row fill and interleave with it
-    # in the sort, so the raw position index would overcount
-    kv = jnp.cumsum(valid_sorted.astype(jnp.float32))
-    precision = tps / jnp.maximum(kv, 1.0)
-    n_pos = rel_sorted.sum()
-    n_valid = valid_sorted.sum()
-
-    # last position of each tie group among the valid rows; the last valid
-    # row is always a boundary (its score can equal the -inf end sentinel)
-    next_s = jnp.concatenate([s_sorted[1:], jnp.full((1,), -jnp.inf, s_sorted.dtype)])
-    boundary = valid_sorted & ((s_sorted != next_s) | (kv == n_valid))
+    parts = masked_curve_prologue(preds, target, mask)
+    tps, boundary, n_pos = parts.tps, parts.boundary, parts.n_pos
+    precision = tps / jnp.maximum(parts.kv, 1.0)
 
     # positives inside each group = tps at this boundary minus tps at the
     # previous one; tps is monotone, so a shifted cummax over

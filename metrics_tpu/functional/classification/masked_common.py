@@ -1,0 +1,51 @@
+"""Shared prologue for the masked (CatBuffer ring-state) curve kernels.
+
+AUROC's rank statistic, average precision, ROC, and the PR curve all start
+from the same static-shape construction over a ``(cap,)`` score buffer;
+the subtle invariants live here exactly once:
+
+- invalid rows are filled with ``-inf`` so they sort last, but valid
+  ``-inf`` scores then tie with the fill — every count therefore comes
+  from the VALID cumsum (``kv``), never the raw position index;
+- targets binarize as ``== 1`` (capacity mode fixes ``pos_label`` to 1);
+- a tie group's boundary is its last valid row, and the last valid row
+  overall is always a boundary (its score can equal the ``-inf`` end
+  sentinel).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class MaskedCurveParts(NamedTuple):
+    s: Array  # scores, descending, invalid rows filled with -inf
+    rel: Array  # binarized positives in sorted order (float)
+    valid: Array  # validity in sorted order (bool)
+    tps: Array  # cumulative positives
+    kv: Array  # cumulative valid count
+    boundary: Array  # last valid row of each tie group
+    n_valid: Array
+    n_pos: Array
+
+
+def masked_curve_prologue(preds: Array, target: Array, mask: Array) -> MaskedCurveParts:
+    mask = jnp.asarray(mask, bool)
+    rel = (mask & (jnp.asarray(target) == 1)).astype(jnp.float32)
+    score = jnp.where(mask, jnp.asarray(preds, jnp.float32), -jnp.inf)
+
+    order = jnp.argsort(-score)
+    s = score[order]
+    r = rel[order]
+    v = mask[order]
+
+    tps = jnp.cumsum(r)
+    kv = jnp.cumsum(v.astype(jnp.float32))
+    n_valid = v.sum()
+    n_pos = r.sum()
+
+    next_s = jnp.concatenate([s[1:], jnp.full((1,), -jnp.inf, s.dtype)])
+    boundary = v & ((s != next_s) | (kv == n_valid))
+    return MaskedCurveParts(s, r, v, tps, kv, boundary, n_valid, n_pos)

@@ -12,6 +12,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.classification.masked_common import masked_curve_prologue
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -120,6 +121,60 @@ def _precision_recall_curve_compute_single_class(
     thresholds = thresholds[sl][::-1]
 
     return precision, recall, thresholds
+
+
+def _binary_precision_recall_curve_masked(
+    preds: Array, target: Array, mask: Array
+) -> Tuple[Array, Array, Array]:
+    """Exact binary PR curve over the masked rows — static shapes for
+    :class:`CatBuffer` ring states.
+
+    Matches the eager path's conventions: points at unique valid
+    thresholds, truncated at first full recall, ordered by decreasing
+    recall, with the terminal ``(precision=1, recall=0)`` appended.
+    ``precision``/``recall`` are ``(cap + 1,)`` (tail repeats the terminal
+    point — zero-width for any step integral); ``thresholds`` is ``(cap,)``
+    padded with its final (maximum) threshold.
+    """
+    cap = preds.shape[0]
+    parts = masked_curve_prologue(preds, target, mask)
+    s, tps, kv, boundary = parts.s, parts.tps, parts.kv, parts.boundary
+    n_pos = parts.n_pos
+
+    comp = jnp.argsort(~boundary, stable=True)
+    b_tps, b_kv, b_thr = tps[comp], kv[comp], s[comp]
+    n_b = boundary.sum()
+    i = jnp.arange(cap)
+
+    # keep boundaries up to (and including) the first that attains full
+    # recall: those whose preceding boundary had not yet reached n_pos
+    prev_tps = jnp.concatenate([jnp.zeros((1,)), b_tps[:-1]])
+    kept = (i < n_b) & (prev_tps < jnp.maximum(n_pos, 1.0))
+    m = kept.sum()
+
+    b_prec = b_tps / jnp.maximum(b_kv, 1.0)
+    b_rec = b_tps / jnp.maximum(n_pos, 1.0)
+
+    # reverse the kept prefix (recall decreasing), then the (1, 0) terminal
+    rev = jnp.clip(m - 1 - i, 0, cap - 1).astype(jnp.int32)
+    precision = jnp.where(i < m, jnp.take(b_prec, rev), 1.0)
+    recall = jnp.where(i < m, jnp.take(b_rec, rev), 0.0)
+    thresholds = jnp.where(i < m, jnp.take(b_thr, rev), jnp.take(b_thr, 0))
+    precision = jnp.concatenate([precision, jnp.ones((1,), jnp.float32)])
+    recall = jnp.concatenate([recall, jnp.zeros((1,), jnp.float32)])
+    return precision, recall, thresholds
+
+
+def _multiclass_precision_recall_curve_masked(
+    preds: Array, target: Array, mask: Array, num_classes: int
+) -> Tuple[Array, Array, Array]:
+    """One-vs-rest masked PR curves, stacked ``(C, ...)`` (static shapes
+    cannot carry per-class dynamic lengths)."""
+    return jax.vmap(
+        lambda c: _binary_precision_recall_curve_masked(
+            preds[:, c], (jnp.asarray(target) == c).astype(jnp.int32), mask
+        )
+    )(jnp.arange(num_classes))
 
 
 def _precision_recall_curve_compute_multi_class(

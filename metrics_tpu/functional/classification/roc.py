@@ -8,6 +8,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.functional.classification.masked_common import masked_curve_prologue
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _binary_clf_curve,
     _precision_recall_curve_update,
@@ -61,6 +62,54 @@ def _roc_compute_single_class(
         tpr = tps / tps[-1]
 
     return fpr, tpr, thresholds
+
+
+def _binary_roc_masked(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array, Array]:
+    """Exact binary ROC over the masked rows — static ``(cap + 1,)`` outputs
+    for :class:`CatBuffer` ring states.
+
+    Point ``0`` is the reference's leading ``(0, 0, max_threshold + 1)``;
+    the genuine curve points (one per unique valid threshold, descending)
+    are compacted to the front; the tail repeats the terminal point
+    ``(1, 1, min_threshold)``, so trapezoidal integration over the padded
+    arrays equals integration over the true curve (zero-width segments).
+    No negatives (or positives) zero out fpr (tpr) exactly like the eager
+    path's warning branch.
+    """
+    cap = preds.shape[0]
+    parts = masked_curve_prologue(preds, target, mask)
+    s, tps, boundary = parts.s, parts.tps, parts.boundary
+    fps = parts.kv - tps
+    n_pos = parts.n_pos
+    n_neg = parts.n_valid - n_pos
+
+    # compact the boundary rows to the front, preserving descending order
+    comp = jnp.argsort(~boundary, stable=True)
+    b_tps, b_fps, b_thr = tps[comp], fps[comp], s[comp]
+    n_b = boundary.sum()
+    i = jnp.arange(cap)
+
+    last_thr = jnp.take(b_thr, jnp.maximum(n_b - 1, 0).astype(jnp.int32))
+    tpr_body = jnp.where(i < n_b, b_tps, n_pos) / jnp.maximum(n_pos, 1.0)
+    fpr_body = jnp.where(i < n_b, b_fps, n_neg) / jnp.maximum(n_neg, 1.0)
+    thr_body = jnp.where(i < n_b, b_thr, last_thr)
+
+    zero = jnp.zeros((1,), jnp.float32)
+    fpr = jnp.concatenate([zero, fpr_body])
+    tpr = jnp.concatenate([zero, tpr_body])
+    thresholds = jnp.concatenate([jnp.take(b_thr, 0)[None] + 1, thr_body])
+    return fpr, tpr, thresholds
+
+
+def _multiclass_roc_masked(
+    preds: Array, target: Array, mask: Array, num_classes: int
+) -> Tuple[Array, Array, Array]:
+    """One-vs-rest masked ROC: stacked ``(C, cap + 1)`` arrays (static shapes
+    cannot carry per-class dynamic lengths, so capacity mode stacks what the
+    eager path returns as lists)."""
+    return jax.vmap(
+        lambda c: _binary_roc_masked(preds[:, c], (jnp.asarray(target) == c).astype(jnp.int32), mask)
+    )(jnp.arange(num_classes))
 
 
 def _roc_compute_multi_class(

@@ -106,22 +106,30 @@ def cat_concat(a: CatBuffer, b: CatBuffer) -> CatBuffer:
     )
 
 
-def init_score_ring_states(metric: Any, capacity: int, num_classes) -> "DataType":
+def init_score_ring_states(metric: Any, capacity: int, num_classes, pos_label=None) -> "DataType":
     """Register the standard (preds, target) ring-state pair for a
     score-based curve metric in capacity mode and return its data mode.
 
-    Shared by :class:`~metrics_tpu.AUROC` and
-    :class:`~metrics_tpu.AveragePrecision` so capacity-mode semantics
-    (state shapes, binary-vs-one-vs-rest selection) can never drift
-    between them.
+    Shared by the curve metrics (AUROC, AveragePrecision, ROC,
+    PrecisionRecallCurve) so capacity-mode semantics — state shapes,
+    binary-vs-one-vs-rest selection, the fixed ``pos_label=1`` contract —
+    can never drift between them.
     """
     from metrics_tpu.utilities.enums import DataType
 
+    if pos_label not in (None, 1):
+        raise ValueError("`pos_label` other than 1 is not supported together with `capacity` mode")
     mode = DataType.MULTICLASS if num_classes and num_classes > 1 else DataType.BINARY
     row = (num_classes,) if mode == DataType.MULTICLASS else ()
     metric.add_state("preds", default=CatBuffer.zeros(capacity, row, jnp.float32), dist_reduce_fx="cat")
     metric.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.int32), dist_reduce_fx="cat")
     return mode
+
+
+def reject_valid_kwarg(valid) -> None:
+    """Eager-mode guard: ``valid`` masks only exist in capacity mode."""
+    if valid is not None:
+        raise ValueError("`valid` masks are only supported in capacity (static-shape) mode")
 
 
 def score_ring_update(metric: Any, preds: Array, target: Array, valid, metric_name: str) -> None:

@@ -268,3 +268,64 @@ def test_capacity_kernels_inf_scores_and_nonbinary_targets():
 
     want = roc_auc_score([1, 0, 1], [1e30, 0.5, 0.2])
     np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_roc_and_prc_capacity_mode():
+    """Ring-buffer exact curves: terminal-padded static outputs agree with
+    the eager curves point-for-point, integrate identically, jit, and
+    functionalize."""
+    import jax
+
+    from metrics_tpu import PrecisionRecallCurve, functionalize
+
+    rng = np.random.default_rng(3)
+    n = 150
+    p = np.round(rng.random(n), 2).astype(np.float32)
+    t = rng.integers(0, 2, n)
+
+    fpr_e, tpr_e, thr_e = (np.asarray(x) for x in ROC().forward(p, t))
+    m = ROC(capacity=256)
+    m.update(p, t)
+    fpr_m, tpr_m, thr_m = (np.asarray(x) for x in m.compute())
+    k = len(fpr_e)
+    np.testing.assert_allclose(fpr_m[:k], fpr_e, atol=1e-6)
+    np.testing.assert_allclose(tpr_m[:k], tpr_e, atol=1e-6)
+    np.testing.assert_allclose(thr_m[:k], thr_e, atol=1e-6)
+    np.testing.assert_allclose(np.trapezoid(tpr_m, fpr_m), np.trapezoid(tpr_e, fpr_e), atol=1e-6)
+
+    prc = PrecisionRecallCurve(capacity=256)
+    prc.update(p, t)
+    pr_m, rc_m, th_m = (np.asarray(x) for x in prc.compute())
+    e = PrecisionRecallCurve()
+    e.update(p, t)
+    pr_e, rc_e, th_e = (np.asarray(x) for x in e.compute())
+    k = len(pr_e)
+    np.testing.assert_allclose(pr_m[:k], pr_e, atol=1e-6)
+    np.testing.assert_allclose(rc_m[:k], rc_e, atol=1e-6)
+    np.testing.assert_allclose(th_m[: len(th_e)], th_e, atol=1e-6)
+    assert np.all(pr_m[k:] == 1.0) and np.all(rc_m[k:] == 0.0)
+
+    # functionalize + jit round trip, binary and multiclass
+    mdef = functionalize(ROC(capacity=256))
+    state = jax.jit(mdef.update)(mdef.init(), jnp.asarray(p), jnp.asarray(t))
+    fpr_j, tpr_j, _ = jax.jit(mdef.compute)(state)
+    np.testing.assert_allclose(np.trapezoid(np.asarray(tpr_j), np.asarray(fpr_j)),
+                               np.trapezoid(tpr_e, fpr_e), atol=1e-6)
+
+    C = 3
+    mp = rng.random((n, C)).astype(np.float32)
+    mp /= mp.sum(1, keepdims=True)
+    mt = rng.integers(0, C, n)
+    mdef_mc = functionalize(ROC(num_classes=C, capacity=256))
+    st = jax.jit(mdef_mc.update)(mdef_mc.init(), jnp.asarray(mp), jnp.asarray(mt))
+    fpr_c, tpr_c, thr_c = jax.jit(mdef_mc.compute)(st)
+    assert fpr_c.shape == (C, 257)
+    eager_mc = ROC(num_classes=C)
+    eager_mc.update(mp, mt)
+    fpr_le, tpr_le, _ = eager_mc.compute()
+    for c in range(C):
+        np.testing.assert_allclose(
+            np.trapezoid(np.asarray(tpr_c[c]), np.asarray(fpr_c[c])),
+            np.trapezoid(np.asarray(tpr_le[c]), np.asarray(fpr_le[c])),
+            atol=1e-6,
+        )
