@@ -6,7 +6,7 @@ update stays a static-shape XLA graph. The ``error`` strategy needs a
 concrete value check and therefore runs eagerly (it is for debugging, not the
 hot path).
 """
-from typing import Any, Callable, Union
+from typing import Any, Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -122,15 +122,52 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (reference ``aggregation.py:246``)."""
+    """Concatenate all seen values (reference ``aggregation.py:246``).
 
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("cat", [], nan_strategy, **kwargs)
+    ``capacity=N`` switches to a :class:`CatBuffer` ring state: NaN
+    "removal" becomes mask invalidation (static shape), so update AND
+    compute are fully jittable with ``nan_strategy='ignore'`` or a float.
+    Capacity-mode ``compute`` returns the full ``(capacity,)`` buffer with
+    invalid slots set to NaN (the valid count is dynamic, so a compacted
+    result cannot have a static shape); filter with ``~jnp.isnan`` or use
+    the masked form directly.
+    """
 
-    # NaN *removal* changes the shape → host-side by nature, run eagerly
-    jittable_update = False
+    def __init__(
+        self, nan_strategy: Union[str, float] = "warn", capacity: Optional[int] = None, **kwargs: Any
+    ) -> None:
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        self.capacity = capacity
+        if capacity is not None:
+            super().__init__("cat", CatBuffer.zeros(capacity, (), jnp.float32), nan_strategy, **kwargs)
+        else:
+            super().__init__("cat", [], nan_strategy, **kwargs)
+            # NaN *removal* changes the shape → host-side by nature, eager
+            object.__setattr__(self, "jittable_update", False)
 
     def update(self, value: Union[float, Array]) -> None:
+        if self.capacity is not None:
+            from metrics_tpu.utilities.ringbuffer import cat_append
+
+            x = jnp.asarray(value, dtype=jnp.float32).reshape(-1)
+            nans = jnp.isnan(x)
+            if self.nan_strategy in ("error", "warn"):  # concrete by construction
+                import numpy as np
+
+                if np.asarray(nans).any():
+                    if self.nan_strategy == "error":
+                        raise RuntimeError("Encountered `nan` values in tensor")
+                    import warnings
+
+                    warnings.warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                self.value = cat_append(self.value, x, ~nans)
+            elif self.nan_strategy == "ignore":
+                self.value = cat_append(self.value, x, ~nans)
+            else:
+                self.value = cat_append(self.value, jnp.where(nans, float(self.nan_strategy), x))
+            return
+
         import warnings
 
         import numpy as np
@@ -150,6 +187,8 @@ class CatMetric(BaseAggregator):
             self.value.append(jnp.asarray(arr))
 
     def compute(self) -> Array:
+        if self.capacity is not None:
+            return jnp.where(self.value.mask, self.value.data, jnp.nan)
         if isinstance(self.value, list) and self.value:
             return dim_zero_cat(self.value)
         return self.value if not isinstance(self.value, list) else jnp.zeros(0)

@@ -98,3 +98,47 @@ def test_bincount_both_paths_match_numpy():
             np.asarray(_bincount(jnp.asarray(bad), minlength)), np.bincount(x, minlength=minlength)
         )
     np.testing.assert_array_equal(np.asarray(_bincount(jnp.zeros((0,), jnp.int32), 7)), np.zeros(7))
+
+
+def test_cat_metric_capacity_mode():
+    """Ring-buffer CatMetric: NaN handling via mask invalidation, jittable
+    with nan_strategy='ignore', eager compacted compute, traced NaN-padded
+    compute, and cross-device union."""
+    import jax
+
+    from metrics_tpu import CatMetric, functionalize
+
+    m = CatMetric(nan_strategy="ignore", capacity=16)
+    m.update([1.0, np.nan, 3.0])
+    m.update(5.0)
+    out = np.asarray(m.compute())
+    assert out.shape == (16,)
+    np.testing.assert_array_equal(out[~np.isnan(out)], [1.0, 3.0, 5.0])
+
+    # float imputation keeps every row valid
+    m2 = CatMetric(nan_strategy=7.5, capacity=8)
+    m2.update([1.0, np.nan])
+    out2 = np.asarray(m2.compute())
+    np.testing.assert_array_equal(out2[~np.isnan(out2)], [1.0, 7.5])
+
+    # functionalize + jit: static (capacity,) output, invalid slots NaN
+    mdef = functionalize(CatMetric(nan_strategy="ignore", capacity=8))
+    state = jax.jit(mdef.update)(mdef.init(), jnp.asarray([2.0, jnp.nan, 4.0]))
+    out = np.asarray(jax.jit(mdef.compute)(state))
+    assert out.shape == (8,)
+    np.testing.assert_array_equal(out[:3][~np.isnan(out[:3])], [2.0, 4.0])
+    assert np.isnan(out[3:]).all()
+
+    # sharded union over the mesh
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mdef_s = functionalize(CatMetric(nan_strategy="ignore", capacity=4), axis_name="data")
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    vals = np.arange(16, dtype=np.float32)
+
+    def step(v):
+        return mdef_s.compute(mdef_s.update(mdef_s.init(), v))
+
+    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(P("data"),), out_specs=P()))(vals)
+    got = np.asarray(out)
+    assert sorted(got[~np.isnan(got)].tolist()) == vals.tolist()
