@@ -300,12 +300,15 @@ def _phase_vsref(jax, platform) -> None:
         target = [t for _, t in pairs]
 
         ours = word_error_rate(preds, target)  # warm compile
-        t0 = time.perf_counter()
-        ours = float(word_error_rate(preds, target))
-        ours_s = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        theirs = float(RF.word_error_rate(preds, target))
-        ref_s = time.perf_counter() - t0
+        ours_s, ref_s = float("inf"), float("inf")
+        for _ in range(3):  # min filters scheduler noise on a loaded box
+            t0 = time.perf_counter()
+            ours = float(word_error_rate(preds, target))
+            ours_s = min(ours_s, time.perf_counter() - t0)
+        for _ in range(3):
+            t0 = time.perf_counter()
+            theirs = float(RF.word_error_rate(preds, target))
+            ref_s = min(ref_s, time.perf_counter() - t0)
         assert abs(ours - theirs) < 1e-4, (ours, theirs)
         _emit(
             "wer_2048_pairs_s",
