@@ -244,3 +244,53 @@ def test_pad_gather_trim_2d_uneven_both_dims():
     got = _pad_gather_trim(rank_arrays[2], transport.for_rank(2))
     for g, want in zip(got, rank_arrays):
         np.testing.assert_array_equal(np.asarray(g), want)
+
+
+def test_ring_curve_metrics_union_under_shard_map():
+    """Every new ring-state metric syncs its CatBuffer union over the mesh
+    and matches the single-device eager oracle: ROC (trapezoid area), PR
+    curve (step integral = AP), and Spearman."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    ndev, per_dev = 8, 16
+    n = ndev * per_dev
+    rng = np.random.default_rng(0)
+    p = np.round(rng.random(n), 2).astype(np.float32)
+    t = rng.integers(0, 2, n)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("data",))
+
+    def run(ctor):
+        mdef = mt.functionalize(ctor(), axis_name="data")
+
+        def step(ps, ts):
+            return mdef.compute(mdef.update(mdef.init(), ps, ts))
+
+        return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P()))(p, t)
+
+    # ROC: padded curve integrates to the eager AUC
+    fpr, tpr, _ = run(lambda: mt.ROC(capacity=per_dev))
+    fpr_e, tpr_e, _ = mt.functional.roc(p, t)
+    np.testing.assert_allclose(
+        np.trapezoid(np.asarray(tpr), np.asarray(fpr)),
+        np.trapezoid(np.asarray(tpr_e), np.asarray(fpr_e)),
+        atol=1e-6,
+    )
+
+    # PR curve: step integral equals eager average precision
+    prec, rec, _ = run(lambda: mt.PrecisionRecallCurve(capacity=per_dev))
+    ap_step = -np.sum(np.diff(np.asarray(rec)) * np.asarray(prec)[:-1])
+    np.testing.assert_allclose(ap_step, float(mt.functional.average_precision(p, t)), atol=1e-5)
+
+    # Spearman over a sharded continuous pair
+    a = rng.standard_normal(n).astype(np.float32)
+    b = (a + 0.5 * rng.standard_normal(n)).astype(np.float32)
+    mdef = mt.functionalize(mt.SpearmanCorrCoef(capacity=per_dev), axis_name="data")
+
+    def step_s(xs, ys):
+        return mdef.compute(mdef.update(mdef.init(), xs, ys))
+
+    got = jax.jit(jax.shard_map(step_s, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P()))(a, b)
+    np.testing.assert_allclose(float(got), float(mt.functional.spearman_corrcoef(a, b)), atol=1e-5)
