@@ -93,6 +93,7 @@ class Metric:
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
         sync_on_compute: bool = True,
+        on_overflow: str = "warn",
         **kwargs: Any,
     ) -> None:
         # kwargs popped like reference ``metric.py:91-109``
@@ -105,6 +106,9 @@ class Metric:
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
         self.sync_on_compute = sync_on_compute
+        if on_overflow not in ("warn", "error", "ignore"):
+            raise ValueError(f"`on_overflow` must be 'warn', 'error' or 'ignore', got {on_overflow!r}")
+        self.on_overflow = on_overflow
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
 
@@ -283,10 +287,50 @@ class Metric:
                 should_unsync=self._should_unsync,
             ):
                 value = self._compute_unsynced(*args, **kwargs)
+                # checked while synced: `dropped` is then the global (summed)
+                # count, so every rank takes the same warn/error branch
+                self._check_cat_overflow()
             self._computed = _squeeze_if_scalar(value)
             return self._computed
 
         return wrapped_func
+
+    @property
+    def dropped_count(self) -> Optional[int]:
+        """Rows dropped by capacity-bounded (``CatBuffer``) states.
+
+        The max over this metric's ring states (preds/target rings drop in
+        lockstep, so max = samples lost). ``0`` when nothing overflowed or no
+        ring states exist; ``None`` when states are traced (inside jit) and
+        the count cannot be concretized.
+        """
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        counts = []
+        for v in self._state.values():
+            if isinstance(v, CatBuffer) and v.dropped is not None:
+                try:
+                    counts.append(int(v.dropped))
+                except _TRACE_ERRORS:
+                    return None
+        return max(counts) if counts else 0
+
+    def _check_cat_overflow(self) -> None:
+        """Overflow is never silent: warn (default) or raise at compute when
+        a capacity-mode state dropped rows (``on_overflow='ignore'`` opts out)."""
+        if self.on_overflow == "ignore":
+            return
+        n = self.dropped_count
+        if not n:  # 0 = no overflow; None = traced (checked by the eager caller)
+            return
+        msg = (
+            f"{type(self).__name__}: {n} sample rows exceeded the configured `capacity` and were "
+            "dropped; the computed value ignores them. Increase `capacity`, use the binned variant, "
+            "or pass `on_overflow='ignore'` to silence this."
+        )
+        if self.on_overflow == "error":
+            raise MetricsTPUUserError(msg)
+        rank_zero_warn(msg, UserWarning)
 
     def _compute_unsynced(self, *args: Any, **kwargs: Any) -> Any:
         if self._can_jit_compute() and not args and not kwargs:
@@ -423,8 +467,11 @@ class Metric:
 
                 if isinstance(g, CatBuffer):
                     # fold the batch buffer's valid rows into the global ring
-                    # (capacity preserved; overflow rows drop, as in update)
-                    merged[name] = cat_append(g, b.data, valid=b.mask)
+                    # (capacity preserved; overflow rows drop-and-count, as
+                    # in update; the batch buffer's own drops carry over)
+                    m = cat_append(g, b.data, valid=b.mask)
+                    b_dropped = b.dropped if b.dropped is not None else jnp.zeros((), jnp.int32)
+                    merged[name] = CatBuffer(m.data, m.mask, m.dropped + b_dropped)
                 else:
                     merged[name] = list(g) + list(b)
             elif callable(reduce_fn):
@@ -466,7 +513,9 @@ class Metric:
                 group = self.process_group if process_group is None else process_group
                 data = jnp.concatenate(dist_sync_fn(value.data, group), axis=0)
                 mask = jnp.concatenate(dist_sync_fn(value.mask, group), axis=0)
-                self._state[attr] = CatBuffer(data=data, mask=mask)
+                local_dropped = value.dropped if value.dropped is not None else jnp.zeros((), jnp.int32)
+                dropped = sum(dist_sync_fn(local_dropped, group))
+                self._state[attr] = CatBuffer(data=data, mask=mask, dropped=dropped)
                 del input_dict[attr]
         if not input_dict:
             return

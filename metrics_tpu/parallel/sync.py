@@ -101,7 +101,9 @@ def sync_cat_buffer(buffer: Any, axis_name: str) -> Any:
 
     data = sync_leaf(buffer.data, "cat", axis_name)
     mask = sync_leaf(buffer.mask, "cat", axis_name)
-    return CatBuffer(data=data, mask=mask)
+    local_dropped = buffer.dropped if buffer.dropped is not None else jnp.zeros((), jnp.int32)
+    dropped = sync_leaf(local_dropped, "sum", axis_name)
+    return CatBuffer(data=data, mask=mask, dropped=dropped)
 
 
 def sync_state(state: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: str) -> Dict[str, Any]:

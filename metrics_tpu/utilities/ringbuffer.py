@@ -23,20 +23,41 @@ Array = jax.Array
 
 @jax.tree_util.register_pytree_node_class
 class CatBuffer:
-    """A fixed-capacity concat state: ``data (cap, *row)`` + ``mask (cap,)``."""
+    """A fixed-capacity concat state: ``data (cap, *row)`` + ``mask (cap,)``
+    + a ``dropped`` overflow counter (scalar int32).
 
-    __slots__ = ("data", "mask")
+    ``dropped`` counts rows that arrived after the buffer saturated. It is a
+    traced pytree child so it survives jit, forward-merge, cross-device sync
+    (summed), and serialization — overflow is observable, never silent.
+    """
 
-    def __init__(self, data: Array, mask: Array) -> None:
+    __slots__ = ("data", "mask", "dropped")
+
+    def __init__(self, data: Array, mask: Array, dropped: Array = None) -> None:
+        # Store leaves EXACTLY as given — tree_unflatten must be lossless for
+        # arbitrary leaf placeholders (orbax round-trips trees of None /
+        # SaveArgs through node classes); coercing here corrupts them.
+        # ``dropped=None`` (a hand-built ``(data, mask)`` pair) means "no
+        # overflow tracking"; the accessors below treat it as zero.
         self.data = data
         self.mask = mask
+        self.dropped = dropped
+
+    def __setstate__(self, state) -> None:
+        # slot-class pickles from before the `dropped` counter lack that slot;
+        # default it to None (= "no overflow tracking") instead of leaving it
+        # unset, so old checkpoints keep loading
+        slots = state[1] if isinstance(state, tuple) else state
+        self.data = slots.get("data")
+        self.mask = slots.get("mask")
+        self.dropped = slots.get("dropped")
 
     # pytree protocol ---------------------------------------------------
-    def tree_flatten(self) -> Tuple[Tuple[Array, Array], None]:
-        return (self.data, self.mask), None
+    def tree_flatten(self) -> Tuple[Tuple[Array, Array, Array], None]:
+        return (self.data, self.mask, self.dropped), None
 
     @classmethod
-    def tree_unflatten(cls, _aux: None, children: Tuple[Array, Array]) -> "CatBuffer":
+    def tree_unflatten(cls, _aux: None, children: Tuple[Array, Array, Array]) -> "CatBuffer":
         return cls(*children)
 
     # constructors ------------------------------------------------------
@@ -45,6 +66,7 @@ class CatBuffer:
         return cls(
             data=jnp.zeros((capacity, *row_shape), dtype),
             mask=jnp.zeros((capacity,), bool),
+            dropped=jnp.zeros((), jnp.int32),
         )
 
     # properties --------------------------------------------------------
@@ -71,8 +93,10 @@ def cat_append(buffer: CatBuffer, rows: Array, valid: Array = None) -> CatBuffer
     """Append ``rows`` (leading axis = batch) at the current fill level.
 
     Fully jittable: a scatter with ``mode='drop'`` — rows past capacity are
-    silently dropped and the mask saturates, keeping shapes static. (The
-    unbounded-list eager mode remains available for exact semantics.)
+    dropped and the mask saturates, keeping shapes static; every dropped row
+    increments ``buffer.dropped`` so overflow is observable (metrics warn or
+    raise at compute via ``Metric.on_overflow``). The unbounded-list eager
+    mode remains available for exact semantics.
 
     ``valid`` (optional bool ``(batch,)``) appends only the flagged rows,
     compacted — the ragged-shard case: devices in an SPMD step can each
@@ -87,22 +111,30 @@ def cat_append(buffer: CatBuffer, rows: Array, valid: Array = None) -> CatBuffer
     count = buffer.count()
     if valid is None:
         idx = count + jnp.arange(rows.shape[0])
+        n_new = jnp.asarray(rows.shape[0], jnp.int32)
     else:
         valid = jnp.asarray(valid, bool)
         # compact valid rows to consecutive slots; invalid rows scatter
         # out-of-bounds and are dropped
         idx = jnp.where(valid, count + jnp.cumsum(valid) - 1, buffer.capacity)
+        n_new = jnp.sum(valid.astype(jnp.int32))
+    overflow = jnp.maximum(count + n_new - buffer.capacity, 0)
+    prior = buffer.dropped if buffer.dropped is not None else jnp.zeros((), jnp.int32)
     return CatBuffer(
         data=buffer.data.at[idx].set(rows.astype(buffer.data.dtype), mode="drop"),
         mask=buffer.mask.at[idx].set(True, mode="drop"),
+        dropped=prior + overflow.astype(jnp.int32),
     )
 
 
 def cat_concat(a: CatBuffer, b: CatBuffer) -> CatBuffer:
     """Union of two buffers (capacity grows; used by merge/sync)."""
+    da = a.dropped if a.dropped is not None else jnp.zeros((), jnp.int32)
+    db = b.dropped if b.dropped is not None else jnp.zeros((), jnp.int32)
     return CatBuffer(
         data=jnp.concatenate([a.data, b.data], axis=0),
         mask=jnp.concatenate([a.mask, b.mask], axis=0),
+        dropped=da + db,
     )
 
 
