@@ -1,0 +1,113 @@
+"""Batched on-device COCO greedy matching (SURVEY.md §2.9 "vectorized IoU
+matching").
+
+The reference evaluates each (image, class, area-range) cell with a
+sequential Python loop over score-ranked detections
+(``src/torchmetrics/detection/mean_ap.py:537-616``, itself a transcription of
+``pycocotools.cocoeval.COCOeval.evaluateImg``). That loop is O(cells × dets)
+Python dispatches — minutes at COCO scale.
+
+Here the same greedy assignment is one compiled XLA program:
+
+- detections are score-sorted on the host once per cell;
+- a ``lax.scan`` walks the detection axis carrying a ``(T, G)`` taken-mask
+  (T = IoU thresholds, G = padded ground-truth cap), so the sequential data
+  dependence of greedy matching is preserved exactly;
+- everything else is vectorized: thresholds broadcast inside the scan step,
+  ``vmap`` over area ranges (which only change the ignore mask), ``vmap``
+  over cells (image × class pairs with content);
+- ragged cells ride static ``(D_cap, G_cap)`` pads with validity masks, so
+  one compilation serves a whole evaluation and the scan never sees a
+  data-dependent shape.
+
+Matching semantics follow pycocotools precisely:
+
+- a detection prefers the best still-unmatched, non-ignored ground truth
+  with IoU ≥ min(t, 1-1e-10); ties go to the later gt (the reference's
+  ``>=`` update rule);
+- only when no non-ignored gt qualifies may it match an (unmatched) ignored
+  gt, which in turn marks the detection ignored;
+- matched gts (ignored or not) become unavailable at that threshold.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# tier bonus for non-ignored gts: must exceed any IoU bit pattern
+# (bits(1.0f) = 0x3F800000) while keeping key sums < 2^31
+_TIER = 0x40000000
+
+
+def _match_one_cell(ious: Array, det_valid: Array, gt_valid: Array, gt_ignore: Array, thrs: Array):
+    """Greedy-match one padded cell.
+
+    The two-tier preference (best non-ignored gt first, ignored gts only as
+    fallback) is ONE integer argmax per scan step: IoUs are bitcast to int32
+    — order-preserving for non-negative floats — and non-ignored candidates
+    get a high tier bit, so ``argmax(key)`` picks the pycocotools winner
+    exactly, with no float-precision compromise. The threshold comparison
+    ``(D, T, G)`` is area-independent and hoisted out of the area vmap.
+
+    Args:
+        ious: ``(D, G)`` pairwise IoU, rows score-descending.
+        det_valid: ``(D,)`` bool — real (non-pad) detections.
+        gt_valid: ``(G,)`` bool — real (non-pad) ground truths.
+        gt_ignore: ``(G,)`` bool — gts outside the area range.
+        thrs: ``(T,)`` IoU thresholds.
+
+    Returns:
+        ``(T, D)`` det-matched bools and ``(T, D)`` matched-to-ignored-gt bools.
+    """
+    T = thrs.shape[0]
+    G = ious.shape[1]
+    thr_eff = jnp.minimum(thrs, 1.0 - 1e-10)  # pycocotools' min(t, 1-1e-10)
+    iou_bits = jax.lax.bitcast_convert_type(ious, jnp.int32)  # (D, G)
+    ok = ious[:, None, :] >= thr_eff[None, :, None]  # (D, T, G)
+    key_all = iou_bits + jnp.where(gt_ignore, 0, _TIER)[None, :]  # (D, G)
+    gcol = jnp.arange(G)
+
+    def step(taken: Array, inp):
+        ok_d, key_d, dvalid = inp  # (T, G), (G,), scalar bool
+        cand = ok_d & gt_valid[None, :] & ~taken  # (T, G)
+        key = jnp.where(cand, key_d, -1)
+        # last index attaining the max key (IoU ties -> later gt)
+        m = (G - 1) - jnp.argmax(key[:, ::-1], axis=1)  # (T,)
+        matched = (jnp.max(key, axis=1) >= 0) & dvalid
+        taken = taken | ((gcol[None, :] == m[:, None]) & matched[:, None])
+        return taken, (matched, matched & gt_ignore[m])
+
+    _, (matches, ig) = jax.lax.scan(step, jnp.zeros((T, G), bool), (ok, key_all, det_valid))
+    return matches.T, ig.T  # (D, T) -> (T, D)
+
+
+# vmap over area ranges (only gt_ignore varies), then over cells
+_match_areas = jax.vmap(_match_one_cell, in_axes=(None, None, None, 0, None))
+_match_cells_inner = jax.vmap(_match_areas, in_axes=(0, 0, 0, 0, None))
+
+
+@jax.jit
+def match_cells(ious: Array, det_valid: Array, gt_valid: Array, gt_ignores: Array, thrs: Array):
+    """Batched matcher: ``ious (N, D, G)``, ``det_valid (N, D)``,
+    ``gt_valid (N, G)``, ``gt_ignores (N, A, G)``, ``thrs (T,)`` →
+    ``matches (N, A, T, D)``, ``matched_to_ignored (N, A, T, D)``."""
+    return _match_cells_inner(ious, det_valid, gt_valid, gt_ignores, thrs)
+
+
+@jax.jit
+def batched_box_iou(det_boxes: Array, gt_boxes: Array) -> Array:
+    """``(N, D, 4)`` × ``(N, G, 4)`` → ``(N, D, G)`` per-cell IoU; zero-area
+    pads yield IoU 0 via ``box_iou``'s union guard."""
+    from metrics_tpu.detection.helpers import box_iou
+
+    return jax.vmap(box_iou)(det_boxes, gt_boxes)
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two ≥ max(n, 1) — pad caps to bounded shapes so the
+    jitted matcher compiles O(log) times across evaluations, not per eval."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()

@@ -3,15 +3,19 @@
 
 Architecture: the states are per-image ragged arrays gathered with the union
 (``dist_reduce_fx=None``) semantics, exactly like the reference's five list
-states (``mean_ap.py:339-343``). Box conversion and pairwise IoU are device
-jnp kernels (``detection/helpers.py``); the greedy per-image matching and the
-COCO accumulation are an explicit host boundary — the matching is a
-sequential loop over score-ranked detections (vectorized across IoU
-thresholds), which is the role the reference delegates to
-pycocotools-style Python/numpy (``mean_ap.py:537-616``).
+states (``mean_ap.py:339-343``). Unlike the reference — whose matching is a
+sequential Python loop per (image, class, area, detection)
+(``mean_ap.py:537-616``) — IoU computation AND greedy matching run on device
+as one batched XLA program (``detection/matcher.py``): cells padded to
+static caps, a ``lax.scan`` over score-ranked detections carrying the
+``(T, G)`` taken-mask, ``vmap`` over area ranges and cells. Only input
+canonicalization and the final precision/recall accumulation stay on the
+host.
 
-Improvement over the reference: ``iou_type="segm"`` needs no pycocotools —
-mask IoU is a dense intersection matmul over flattened masks.
+Improvements over the reference: ``iou_type="segm"`` needs no pycocotools —
+mask IoU is a dense intersection matmul over flattened masks — and matching
+cost is O(max dets per cell) compiled scan steps instead of O(total
+detections) interpreter iterations.
 """
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -137,7 +141,7 @@ class MeanAveragePrecision(Metric):
             return np.asarray(box_convert(jnp.asarray(boxes), in_fmt=self.box_format, out_fmt="xyxy"))
         return np.asarray(item["masks"]).astype(bool)
 
-    # ---- evaluation (host boundary) -------------------------------------
+    # ---- evaluation -----------------------------------------------------
 
     def _get_classes(self) -> List[int]:
         labels = list(self.detection_labels) + list(self.groundtruth_labels)
@@ -146,110 +150,120 @@ class MeanAveragePrecision(Metric):
         return sorted(np.unique(np.concatenate([np.asarray(la) for la in labels])).astype(int).tolist())
 
     def _area(self, items: np.ndarray) -> np.ndarray:
-        # numpy, not jnp: this runs inside the per-(image, class) host loop
-        # where a device dispatch per call would dominate compute() wall time
+        # host numpy: areas feed the accumulate stage and the ignore masks
         if self.iou_type == "bbox":
             return (items[:, 2] - items[:, 0]) * (items[:, 3] - items[:, 1])
         return items.reshape(items.shape[0], -1).sum(-1).astype(np.float64)
 
-    def _iou(self, det: np.ndarray, gt: np.ndarray) -> np.ndarray:
-        if self.iou_type == "bbox":
-            lt = np.maximum(det[:, None, :2], gt[None, :, :2])
-            rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
-            wh = np.clip(rb - lt, 0, None)
-            inter = wh[..., 0] * wh[..., 1]
-            union = self._area(det)[:, None] + self._area(gt)[None, :] - inter
-            return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
-        return _mask_iou(det, gt)
+    def _build_cells(self, class_ids: List[int], max_det: int) -> List[Dict[str, np.ndarray]]:
+        """One cell per (image, class-with-content): label-filter, stable
+        score-descending sort, cap at the largest max_det — the reference's
+        per-(image, class) prep (``mean_ap.py:722-729``). Area ranges only
+        change ignore masks downstream, so cells are area-independent."""
+        cls_index = {c: k for k, c in enumerate(class_ids)}
+        cells = []
+        for i in range(len(self.groundtruths)):
+            det_labels = np.asarray(self.detection_labels[i])
+            gt_labels = np.asarray(self.groundtruth_labels[i])
+            all_scores = np.asarray(self.detection_scores[i])
+            all_det = np.asarray(self.detections[i])
+            all_gt = np.asarray(self.groundtruths[i])
+            for c in sorted(set(det_labels.tolist()) | set(gt_labels.tolist())):
+                if c not in cls_index:
+                    continue
+                det_mask = det_labels == c
+                scores = all_scores[det_mask]
+                order = np.argsort(-scores, kind="stable")[:max_det]
+                det = all_det[det_mask][order]
+                gt = all_gt[gt_labels == c]
+                cells.append(
+                    {
+                        "cls": cls_index[c],
+                        "scores": scores[order],
+                        "det": det,
+                        "gt": gt,
+                        "det_areas": self._area(det) if det.shape[0] else np.zeros(0),
+                        "gt_areas": self._area(gt) if gt.shape[0] else np.zeros(0),
+                    }
+                )
+        return cells
 
-    def _prepare_image_class(self, idx: int, class_id: int, max_det: int) -> Optional[Dict[str, np.ndarray]]:
-        """Label-filter, score-sort, cap, and IoU once per (image, class) —
-        the reference's per-(image, class) ious cache (``mean_ap.py:722-729``);
-        area ranges only change the ignore masks downstream."""
-        gt_all = np.asarray(self.groundtruths[idx])
-        det_all = np.asarray(self.detections[idx])
-        gt_mask = np.asarray(self.groundtruth_labels[idx]) == class_id
-        det_mask = np.asarray(self.detection_labels[idx]) == class_id
-        if not gt_mask.any() and not det_mask.any():
-            return None
+    # matcher batch chunk: bounds device memory at COCO scale (a chunk of
+    # 1024 cells × 128 dets × G_cap IoUs) while amortizing one compilation
+    # across all chunks of an evaluation
+    _MATCH_CHUNK = 1024
 
-        # detections: score-descending (stable, matlab-style), capped
-        scores = np.asarray(self.detection_scores[idx])[det_mask]
-        dtind = np.argsort(-scores, kind="stable")[:max_det]
-        det = det_all[det_mask][dtind]
-        gt = gt_all[gt_mask]
-        nb_det, nb_gt = det.shape[0], gt.shape[0]
-        return {
-            "scores": scores[dtind],
-            "det_areas": self._area(det) if nb_det else np.zeros(0),
-            "gt_areas": self._area(gt) if nb_gt else np.zeros(0),
-            "ious": self._iou(det, gt) if nb_det and nb_gt else np.zeros((nb_det, nb_gt)),
-        }
+    def _match_all_cells(self, cells: List[Dict[str, np.ndarray]], area_ranges: np.ndarray) -> None:
+        """Run the device matcher over every cell, attaching per-cell
+        ``m (A, T, nd)`` match and ``ig (A, T, nd)`` matched-to-ignored
+        arrays.
 
-    def _evaluate_image(
-        self, entry: Optional[Dict[str, np.ndarray]], area_range: Tuple[int, int]
-    ) -> Optional[Dict[str, np.ndarray]]:
-        """Greedy matching for one (image, class, area-range) cell (reference
-        ``mean_ap.py:537-616``), vectorized over IoU thresholds."""
-        if entry is None:
-            return None
-        nb_thrs = len(self.iou_thresholds)
-        scores_sorted = entry["scores"]
-        nb_det = scores_sorted.shape[0]
-        nb_gt = entry["gt_areas"].shape[0]
+        Cells are bucketed by detection count (power-of-two caps): the greedy
+        scan's length is the detection axis, so a cell with 6 dets in a
+        128-cap batch would pay 128 sequential steps for 6 rows of work.
+        Bucketing keeps total scan work proportional to the real detection
+        count while bounding distinct compiled shapes to O(log max_det)."""
+        from metrics_tpu.detection.matcher import batched_box_iou, match_cells, next_pow2
 
-        if nb_gt == 0:
-            det_ig = (entry["det_areas"] < area_range[0]) | (entry["det_areas"] > area_range[1])
-            return {
-                "dtMatches": np.zeros((nb_thrs, nb_det), dtype=bool),
-                "dtScores": scores_sorted,
-                "gtIgnore": np.zeros(0, dtype=bool),
-                "dtIgnore": np.broadcast_to(det_ig[None, :], (nb_thrs, nb_det)).copy(),
-            }
+        nb_areas = area_ranges.shape[0]
+        thrs = jnp.asarray(self.iou_thresholds, jnp.float32)
 
-        # ground truths: ignored-last (stable)
-        ignore_area = (entry["gt_areas"] < area_range[0]) | (entry["gt_areas"] > area_range[1])
-        gtind = np.argsort(ignore_area.astype(np.uint8), kind="stable")
-        gt_ignore = ignore_area[gtind]
+        buckets: Dict[int, List[int]] = {}
+        for j, cell in enumerate(cells):
+            buckets.setdefault(max(next_pow2(cell["scores"].shape[0]), 8), []).append(j)
+            # single source for the gt area-ignore mask: matcher input here,
+            # npig accumulation in _calculate
+            cell["gt_ig"] = (
+                (cell["gt_areas"][None, :] < area_ranges[:, :1]) | (cell["gt_areas"][None, :] > area_ranges[:, 1:])
+                if cell["gt"].shape[0]
+                else np.zeros((nb_areas, 0), bool)
+            )
 
-        if nb_det == 0:
-            return {
-                "dtMatches": np.zeros((nb_thrs, 0), dtype=bool),
-                "dtScores": np.zeros(0),
-                "gtIgnore": gt_ignore,
-                "dtIgnore": np.zeros((nb_thrs, 0), dtype=bool),
-            }
-
-        ious = entry["ious"][:, gtind]  # rows score-sorted, cols ignored-last
-        thrs = np.asarray(self.iou_thresholds)
-        gt_matches = np.zeros((nb_thrs, nb_gt), dtype=bool)
-        det_matches = np.zeros((nb_thrs, nb_det), dtype=bool)
-        det_ignore = np.zeros((nb_thrs, nb_det), dtype=bool)
-
-        for d in range(nb_det):
-            # per threshold: best still-available, non-ignored gt
-            avail = ~(gt_matches | gt_ignore[None, :])  # (T, G)
-            cand = ious[d][None, :] * avail
-            m = cand.argmax(axis=1)  # (T,)
-            ok = cand[np.arange(nb_thrs), m] > thrs
-            det_ignore[ok, d] = gt_ignore[m[ok]]
-            det_matches[ok, d] = True
-            gt_matches[ok, m[ok]] = True
-
-        det_ig_area = (entry["det_areas"] < area_range[0]) | (entry["det_areas"] > area_range[1])
-        det_ignore |= (~det_matches) & det_ig_area[None, :]
-
-        return {
-            "dtMatches": det_matches,
-            "dtScores": scores_sorted,
-            "gtIgnore": gt_ignore,
-            "dtIgnore": det_ignore,
-        }
+        in_flight = []  # dispatch everything, fetch at the end: the device
+        # queue drains while the host pads the next chunk
+        for d_cap, idxs in sorted(buckets.items()):
+            g_cap = next_pow2(max(cells[j]["gt"].shape[0] for j in idxs))
+            chunk = min(self._MATCH_CHUNK, next_pow2(len(idxs)))
+            for start in range(0, len(idxs), chunk):
+                batch = idxs[start : start + chunk]
+                det_valid = np.zeros((chunk, d_cap), bool)
+                gt_valid = np.zeros((chunk, g_cap), bool)
+                gt_ig = np.zeros((chunk, nb_areas, g_cap), bool)
+                if self.iou_type == "bbox":
+                    det_boxes = np.zeros((chunk, d_cap, 4), np.float32)
+                    gt_boxes = np.zeros((chunk, g_cap, 4), np.float32)
+                else:
+                    ious = np.zeros((chunk, d_cap, g_cap), np.float32)
+                for k, j in enumerate(batch):
+                    cell = cells[j]
+                    nd, ng = cell["scores"].shape[0], cell["gt"].shape[0]
+                    det_valid[k, :nd] = True
+                    gt_valid[k, :ng] = True
+                    if ng:
+                        gt_ig[k, :, :ng] = cell["gt_ig"]
+                    if self.iou_type == "bbox":
+                        det_boxes[k, :nd] = cell["det"]
+                        gt_boxes[k, :ng] = cell["gt"]
+                    elif nd and ng:
+                        ious[k, :nd, :ng] = _mask_iou(cell["det"], cell["gt"])
+                if self.iou_type == "bbox":
+                    ious_dev = batched_box_iou(jnp.asarray(det_boxes), jnp.asarray(gt_boxes))
+                else:
+                    ious_dev = jnp.asarray(ious)
+                m, ig = match_cells(
+                    ious_dev, jnp.asarray(det_valid), jnp.asarray(gt_valid), jnp.asarray(gt_ig), thrs
+                )
+                in_flight.append((batch, m, ig))
+        for batch, m, ig in in_flight:
+            m, ig = np.asarray(m), np.asarray(ig)
+            for k, j in enumerate(batch):
+                nd = cells[j]["scores"].shape[0]
+                cells[j]["m"] = m[k, :, :, :nd]
+                cells[j]["ig"] = ig[k, :, :, :nd].copy()  # |= area-ignore below
 
     def _calculate(self, class_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
-        """Accumulate precision/recall over all (class, area, max_det) cells
-        (reference ``mean_ap.py:711-870``)."""
-        nb_imgs = len(self.groundtruths)
+        """Device-matched precision/recall accumulation over all
+        (class, area, max_det) cells (reference ``mean_ap.py:711-870``)."""
         nb_thrs = len(self.iou_thresholds)
         nb_rec = len(self.rec_thresholds)
         nb_cls = len(class_ids)
@@ -257,27 +271,47 @@ class MeanAveragePrecision(Metric):
         nb_mdets = len(self.max_detection_thresholds)
         max_det = self.max_detection_thresholds[-1]
         rec_thrs = np.asarray(self.rec_thresholds)
+        area_ranges = np.asarray(list(self.bbox_area_ranges.values()), np.float64)
 
         precision = -np.ones((nb_thrs, nb_rec, nb_cls, nb_areas, nb_mdets))
         recall = -np.ones((nb_thrs, nb_cls, nb_areas, nb_mdets))
 
-        for idx_cls, class_id in enumerate(class_ids):
-            entries = [self._prepare_image_class(i, class_id, max_det) for i in range(nb_imgs)]
-            for idx_area, area_rng in enumerate(self.bbox_area_ranges.values()):
-                evals = [self._evaluate_image(e, area_rng) for e in entries]
-                evals = [e for e in evals if e is not None]
-                if not evals:
+        cells = self._build_cells(class_ids, max_det)
+        if not cells:
+            return precision, recall
+        self._match_all_cells(cells, area_ranges)  # attaches cell["m"]/["ig"]
+
+        # host-side ignore completion: unmatched dets outside the area range
+        # (reference ``mean_ap.py:607-611``)
+        for cell in cells:
+            nd = cell["scores"].shape[0]
+            if nd:
+                da = cell["det_areas"]
+                out = (da[None, :] < area_ranges[:, :1]) | (da[None, :] > area_ranges[:, 1:])  # (A, nd)
+                cell["ig"] |= ~cell["m"] & out[:, None, :]
+
+        by_class: List[List[int]] = [[] for _ in range(nb_cls)]
+        for j, cell in enumerate(cells):
+            by_class[cell["cls"]].append(j)
+
+        for idx_cls in range(nb_cls):
+            cell_ids = by_class[idx_cls]
+            if not cell_ids:
+                continue
+            for idx_area in range(nb_areas):
+                npig = int(sum((~cells[j]["gt_ig"][idx_area]).sum() for j in cell_ids))
+                if npig == 0:
                     continue
                 for idx_mdet, mdet in enumerate(self.max_detection_thresholds):
-                    det_scores = np.concatenate([e["dtScores"][:mdet] for e in evals])
+                    keep = [min(cells[j]["scores"].shape[0], mdet) for j in cell_ids]
+                    det_scores = np.concatenate([cells[j]["scores"][:k] for j, k in zip(cell_ids, keep)])
                     inds = np.argsort(-det_scores, kind="stable")
-                    det_scores_sorted = det_scores[inds]
-                    det_matches = np.concatenate([e["dtMatches"][:, :mdet] for e in evals], axis=1)[:, inds]
-                    det_ignore = np.concatenate([e["dtIgnore"][:, :mdet] for e in evals], axis=1)[:, inds]
-                    gt_ignore = np.concatenate([e["gtIgnore"] for e in evals])
-                    npig = int((~gt_ignore).sum())
-                    if npig == 0:
-                        continue
+                    det_matches = np.concatenate(
+                        [cells[j]["m"][idx_area, :, :k] for j, k in zip(cell_ids, keep)], axis=1
+                    )[:, inds]
+                    det_ignore = np.concatenate(
+                        [cells[j]["ig"][idx_area, :, :k] for j, k in zip(cell_ids, keep)], axis=1
+                    )[:, inds]
                     tps = det_matches & ~det_ignore
                     fps = ~det_matches & ~det_ignore
                     tp_sum = tps.cumsum(axis=1).astype(np.float64)

@@ -1,0 +1,115 @@
+"""Device matcher semantics (``metrics_tpu/detection/matcher.py``) against a
+transparent Python transcription of pycocotools' greedy assignment
+(reference ``src/torchmetrics/detection/mean_ap.py:537-616`` delegates the
+same role to ``COCOeval.evaluateImg``).
+
+The brute-force oracle makes the two-tier rule explicit: a detection takes
+the best still-free NON-ignored gt with IoU ≥ min(t, 1-1e-10) (ties → later
+gt), and may fall back to an ignored gt only when no non-ignored one
+qualifies. Random trials use coarse-grid IoUs so exact ties actually occur.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.detection.matcher import _match_one_cell, batched_box_iou, match_cells, next_pow2
+
+
+def _oracle(ious, det_valid, gt_valid, gt_ignore, thrs):
+    T, (D, G) = len(thrs), ious.shape
+    thr_eff = np.minimum(thrs, 1 - 1e-10)
+    taken = np.zeros((T, G), bool)
+    matches = np.zeros((T, D), bool)
+    ig = np.zeros((T, D), bool)
+    for d in range(D):
+        for t in range(T):
+            best, mi = -1.0, -1
+            for tier in (False, True):
+                if mi >= 0 and tier:
+                    break  # non-ignored match in hand: never fall to tier 2
+                for g in range(G):
+                    if not gt_valid[g] or taken[t, g] or bool(gt_ignore[g]) != tier:
+                        continue
+                    if ious[d, g] >= thr_eff[t] and ious[d, g] >= best:
+                        best, mi = ious[d, g], g  # >= : ties go to the later gt
+            if mi >= 0 and det_valid[d]:
+                matches[t, d] = True
+                ig[t, d] = gt_ignore[mi]
+                taken[t, mi] = True
+    return matches, ig
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_matcher_matches_oracle_tie_heavy(seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(4):
+        D, G = int(rng.integers(1, 9)), int(rng.integers(1, 9))
+        ious = (rng.integers(0, 8, (D, G)) / 8.0).astype(np.float32)  # exact ties
+        dv = rng.random(D) < 0.8
+        gv = rng.random(G) < 0.8
+        gi = rng.random(G) < 0.4
+        thrs = np.array([0.0, 0.25, 0.5, 0.75, 1.0], np.float32)
+        em, ei = _oracle(ious, dv, gv, gi, thrs)
+        gm, gig = _match_one_cell(jnp.asarray(ious), jnp.asarray(dv), jnp.asarray(gv), jnp.asarray(gi), jnp.asarray(thrs))
+        np.testing.assert_array_equal(np.asarray(gm), em)
+        np.testing.assert_array_equal(np.asarray(gig), ei)
+
+
+def test_ignored_gt_fallback():
+    """A det whose only overlap is an ignored gt matches it and is flagged
+    ignored — the case a non-tiered matcher silently turns into an FP."""
+    ious = jnp.asarray([[0.9]], jnp.float32)
+    m, ig = _match_one_cell(
+        ious, jnp.ones(1, bool), jnp.ones(1, bool), jnp.ones(1, bool), jnp.asarray([0.5], jnp.float32)
+    )
+    assert bool(m[0, 0]) and bool(ig[0, 0])
+
+
+def test_non_ignored_preferred_over_higher_iou_ignored():
+    """Tier 1 wins even when an ignored gt has strictly higher IoU."""
+    ious = jnp.asarray([[0.6, 0.95]], jnp.float32)  # gt0 plain, gt1 ignored
+    gt_ignore = jnp.asarray([False, True])
+    m, ig = _match_one_cell(
+        ious, jnp.ones(1, bool), jnp.ones(2, bool), gt_ignore, jnp.asarray([0.5], jnp.float32)
+    )
+    assert bool(m[0, 0]) and not bool(ig[0, 0])
+
+
+def test_taken_gt_unavailable():
+    """Greedy order: the higher-scored det takes the gt; the second det at
+    the same IoU finds it taken and goes unmatched."""
+    ious = jnp.asarray([[0.8], [0.8]], jnp.float32)
+    m, _ = _match_one_cell(
+        ious, jnp.ones(2, bool), jnp.ones(1, bool), jnp.zeros(1, bool), jnp.asarray([0.5], jnp.float32)
+    )
+    assert bool(m[0, 0]) and not bool(m[0, 1])
+
+
+def test_padding_is_inert():
+    """Invalid det/gt rows must neither match nor block real rows."""
+    ious = jnp.asarray([[0.9, 0.9], [0.9, 0.9]], jnp.float32)
+    dv = jnp.asarray([True, False])
+    gv = jnp.asarray([True, False])
+    m, ig = _match_one_cell(ious, dv, gv, jnp.zeros(2, bool), jnp.asarray([0.5], jnp.float32))
+    assert bool(m[0, 0]) and not bool(m[0, 1])
+    assert not np.asarray(ig).any()
+
+
+def test_batched_shapes_and_box_iou():
+    boxes_d = jnp.asarray([[[0.0, 0.0, 10.0, 10.0]]])
+    boxes_g = jnp.asarray([[[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]])
+    ious = batched_box_iou(boxes_d, boxes_g)
+    np.testing.assert_allclose(np.asarray(ious), [[[1.0, 0.0]]], atol=1e-6)
+    m, ig = match_cells(
+        ious,
+        jnp.ones((1, 1), bool),
+        jnp.ones((1, 2), bool),
+        jnp.zeros((1, 3, 2), bool),
+        jnp.asarray([0.5, 0.99], jnp.float32),
+    )
+    assert m.shape == (1, 3, 2, 1) and ig.shape == (1, 3, 2, 1)
+    assert np.asarray(m).all()  # IoU 1.0 matches at both thresholds, all areas
+
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (0, 1, 2, 3, 8, 9, 100)] == [1, 1, 2, 4, 8, 16, 128]
