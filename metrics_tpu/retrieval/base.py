@@ -49,13 +49,29 @@ def _bucket_rows(
 
 class RetrievalMetric(Metric, ABC):
     """Group predictions by query id and average a per-query metric
-    (reference ``retrieval/base.py:27-146``)."""
+    (reference ``retrieval/base.py:27-146``).
+
+    Two accumulation modes:
+
+    - default: unbounded ``cat`` list states + the bucketed-vmap eager
+      compute below (the reference's contract, any query-id values);
+    - ``capacity=N``: :class:`CatBuffer` ring states and a fully jittable
+      static-shape compute — sort-by-query + one ``(num_queries,
+      max_docs_per_query)`` scatter + the same masked row kernels — so
+      ``functionalize(RetrievalMAP(capacity=N, num_queries=Q))`` lives
+      inside compiled steps and under ``shard_map``, like the curve
+      metrics. Requires query ids in ``[0, num_queries)``; docs beyond
+      ``max_docs_per_query`` for one query are dropped from compute;
+      ``empty_target_action='error'`` is unsupported (cannot raise under
+      jit).
+    """
 
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
 
-    # list states + data-dependent grouping → eager execution
+    # list states + data-dependent grouping → eager execution (capacity
+    # mode flips these to True per instance)
     jittable_update = False
     jittable_compute = False
 
@@ -63,6 +79,9 @@ class RetrievalMetric(Metric, ABC):
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        capacity: Optional[int] = None,
+        num_queries: Optional[int] = None,
+        max_docs_per_query: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -77,16 +96,42 @@ class RetrievalMetric(Metric, ABC):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
         self.ignore_index = ignore_index
 
-        # dist_reduce_fx=None: sync gathers the union of all ranks' samples
-        # without reduction (reference ``base.py:93-95``)
-        self.add_state("indexes", default=[], dist_reduce_fx=None)
-        self.add_state("preds", default=[], dist_reduce_fx=None)
-        self.add_state("target", default=[], dist_reduce_fx=None)
+        self.capacity = capacity
+        if capacity is not None:
+            from metrics_tpu.utilities.ringbuffer import CatBuffer
 
-    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+            if not (isinstance(num_queries, int) and num_queries > 0):
+                raise ValueError("capacity mode requires `num_queries` (a static bound on query ids)")
+            if empty_target_action == "error":
+                raise ValueError("`empty_target_action='error'` is not supported in capacity (compiled) mode")
+            self.num_queries = num_queries
+            # default L = capacity is the only always-correct bound, but the
+            # compute materializes (num_queries, L) matrices — pass a tight
+            # max_docs_per_query for large capacities or the scatter layout
+            # costs Q*capacity elements regardless of actual fill
+            self.max_docs_per_query = max_docs_per_query if max_docs_per_query is not None else capacity
+            self.jittable_update = True
+            self.jittable_compute = True
+            self.add_state("indexes", default=CatBuffer.zeros(capacity, (), jnp.int32), dist_reduce_fx="cat")
+            self.add_state("preds", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
+        else:
+            # dist_reduce_fx=None: sync gathers the union of all ranks'
+            # samples without reduction (reference ``base.py:93-95``)
+            self.add_state("indexes", default=[], dist_reduce_fx=None)
+            self.add_state("preds", default=[], dist_reduce_fx=None)
+            self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array, valid: Optional[Array] = None) -> None:
         """Reference ``base.py:98-109``."""
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
+        if self.capacity is not None:
+            self._update_capacity(preds, target, indexes, valid)
+            return
+        from metrics_tpu.utilities.ringbuffer import reject_valid_kwarg
+
+        reject_valid_kwarg(valid)
         indexes, preds, target = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
         )
@@ -94,13 +139,74 @@ class RetrievalMetric(Metric, ABC):
         self.preds.append(preds)
         self.target.append(target)
 
+    def _update_capacity(self, preds: Array, target: Array, indexes: Array, valid: Optional[Array]) -> None:
+        """Trace-safe append: shape/dtype checks only; ``ignore_index``
+        filtering becomes part of the validity mask instead of a
+        dynamic-shape boolean filter."""
+        from metrics_tpu.utilities.ringbuffer import cat_append
+
+        indexes = jnp.asarray(indexes).reshape(-1)
+        preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+        target = jnp.asarray(target).reshape(-1)
+        if not (indexes.shape == preds.shape == target.shape):
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        if not jnp.issubdtype(indexes.dtype, jnp.integer):
+            raise ValueError("`indexes` must be a tensor of long integers")
+        keep = jnp.ones(indexes.shape, bool) if valid is None else jnp.asarray(valid, bool).reshape(-1)
+        if self.ignore_index is not None:
+            keep = keep & (target != self.ignore_index)
+        # out-of-contract ids drop instead of wasting ring slots (negative
+        # ids would otherwise WRAP in the compute scatter — see below)
+        keep = keep & (indexes >= 0) & (indexes < self.num_queries)
+        self.indexes = cat_append(self.indexes, indexes.astype(jnp.int32), keep)
+        self.preds = cat_append(self.preds, preds, keep)
+        self.target = cat_append(self.target, target.astype(jnp.float32), keep)
+
     def compute(self) -> Array:
         """Vectorized equivalent of reference ``base.py:110-139``."""
+        if self.capacity is not None:
+            return self._compute_capacity()
         indexes = np.asarray(dim_zero_cat(self.indexes))
         preds = np.asarray(dim_zero_cat(self.preds))
         target = np.asarray(dim_zero_cat(self.target))
         values = self._per_query_values(indexes, preds, target)
         return values.mean() if values.size else jnp.asarray(0.0)
+
+    def _compute_capacity(self) -> Array:
+        """Static-shape grouped compute: sort rows by query id (invalid rows
+        to a sentinel), derive each row's rank within its query from the
+        sorted array itself (``i - searchsorted(idx, idx_i)``), scatter into
+        a dense ``(Q, L)`` layout, and vmap the same masked row kernel the
+        eager path uses. Fully jittable: shapes depend only on ``capacity``,
+        ``num_queries`` and ``max_docs_per_query``."""
+        q, l = self.num_queries, self.max_docs_per_query
+        idx_buf, pred_buf, tgt_buf = self.indexes, self.preds, self.target
+        n = idx_buf.capacity
+        valid = idx_buf.mask
+        # sentinel also guards ids outside [0, q): scatter mode='drop' only
+        # drops out-of-bounds-HIGH indices — a negative id would wrap to
+        # query q-1 and corrupt it (update() already filters these; states
+        # merged/restored from elsewhere get the same protection here)
+        idx = jnp.where(valid & (idx_buf.data >= 0) & (idx_buf.data < q), idx_buf.data, q)
+        order = jnp.argsort(idx, stable=True)
+        idx_s = idx[order]
+        p_s = pred_buf.data[order]
+        t_s = tgt_buf.data[order]
+        pos = jnp.arange(n) - jnp.searchsorted(idx_s, idx_s, side="left")
+        # rows with idx == q (invalid) or pos >= l scatter out of bounds
+        pmat = jnp.zeros((q, l), p_s.dtype).at[idx_s, pos].set(p_s, mode="drop")
+        tmat = jnp.zeros((q, l), t_s.dtype).at[idx_s, pos].set(t_s, mode="drop")
+        mask = jnp.zeros((q, l), bool).at[idx_s, pos].set(True, mode="drop")
+
+        values = jax.vmap(self._row_metric)(pmat, tmat, mask)
+        pos_counts = jnp.sum((tmat > 0) & mask, axis=1)
+        neg_counts = jnp.sum(mask, axis=1) - pos_counts
+        present = jnp.any(mask, axis=1)
+        empty = self._query_is_empty(pos_counts, neg_counts)
+        fill = 1.0 if self.empty_target_action == "pos" else 0.0
+        values = jnp.where(empty | ~present, fill, values)  # also clears NaNs
+        include = present if self.empty_target_action in ("pos", "neg") else present & ~empty
+        return jnp.sum(values * include) / jnp.maximum(jnp.sum(include), 1)
 
     def _query_is_empty(self, pos_counts: np.ndarray, neg_counts: np.ndarray) -> np.ndarray:
         """Which queries hit the degenerate case (no positives by default;

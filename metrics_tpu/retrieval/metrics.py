@@ -186,6 +186,8 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         **kwargs: Any,
     ) -> None:
         super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if self.capacity is not None:
+            raise ValueError("`capacity` mode is not supported for curve-valued retrieval metrics")
         if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
             raise ValueError("`max_k` has to be a positive integer or None")
         if not isinstance(adaptive_k, bool):
