@@ -5,12 +5,20 @@ weighting (Zhang et al., ICLR 2020). The matching math — normalize, masked
 ``bpd,brd->bpr`` similarity, row/column max, IDF-weighted sum — is one
 jittable XLA kernel (``_bert_score_from_embeddings``).
 
-Encoder contract (same as FID's injected extractor, ``image/fid.py``): this
-environment has no network, so no pretrained weights are bundled. The
+Encoder contract (same as FID's injected extractor, ``image/fid.py``): the
 ``encoder`` callable maps a list of sentences to
 ``(embeddings (N, L, D), attention_mask (N, L), input_ids (N, L))``; any HF
 flax/torch model with local weights wraps in a few lines. Alternatively pass
 precomputed dicts with those keys.
+
+When no encoder is given, a bundled :class:`HashTextEncoder` is used so the
+surface works out of the box — a deterministic CRC32-hash-vocab tokenizer
+with a fixed random embedding table and light neighbor mixing. **It is NOT a
+pretrained language model**: scores are self-consistent (identical text
+scores 1.0, related text scores higher than unrelated) but are not
+comparable to published BERTScore numbers. Inject a real encoder for
+calibrated scores; the reference downloads RoBERTa weights instead
+(``functional/text/bert.py:29,551-552``), which this offline build cannot.
 """
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -75,6 +83,87 @@ def _bert_score_from_embeddings(
     return precision, recall, f1
 
 
+class HashTextEncoder:
+    """Bundled offline encoder satisfying BERTScore's encoder contract.
+
+    Deterministic end to end: sentences are word/punctuation tokenized,
+    token ids come from CRC32 hashing into a fixed vocab, embeddings from a
+    seeded random table, and a light fixed neighbor-mixing pass
+    (``0.6·tok + 0.25·prev + 0.15·next``) gives tokens context sensitivity
+    so reorderings and substitutions move the score. Two processes with the
+    same seed produce bit-identical embeddings — safe for distributed
+    accumulation.
+
+    **Calibration caveat (read this):** this is a structural stand-in, not a
+    language model. Scores are meaningful relatively (identity = 1.0,
+    related > unrelated) but NOT comparable to published BERTScore values
+    computed with pretrained transformers.
+    """
+
+    _CLS, _SEP, _RESERVED = 1, 2, 3
+
+    def __init__(self, dim: int = 128, vocab_size: int = 1 << 15, seed: int = 0) -> None:
+        rng = np.random.default_rng(seed)
+        self.table = rng.standard_normal((vocab_size, dim), dtype=np.float32)
+        self.vocab_size = vocab_size
+        self.dim = dim
+
+    @staticmethod
+    def _tokenize(sentence: str) -> List[str]:
+        import re
+
+        return re.findall(r"\w+|[^\w\s]", sentence.lower())
+
+    def _token_id(self, token: str) -> int:
+        import zlib
+
+        return self._RESERVED + zlib.crc32(token.encode("utf-8")) % (self.vocab_size - self._RESERVED)
+
+    def __call__(self, sentences: List[str]) -> _EncoderOutput:
+        rows = [[self._CLS] + [self._token_id(t) for t in self._tokenize(s)] + [self._SEP] for s in sentences]
+        length = max((len(r) for r in rows), default=0)
+        if length == 0:
+            return (
+                np.zeros((0, 0, self.dim), np.float32),
+                np.zeros((0, 0), np.int64),
+                np.zeros((0, 0), np.int64),
+            )
+        ids = np.zeros((len(rows), length), np.int64)
+        mask = np.zeros((len(rows), length), np.int64)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r
+            mask[i, : len(r)] = 1
+        emb = self.table[ids] * mask[..., None]
+        prev_tok = np.roll(emb, 1, axis=1)
+        prev_tok[:, 0] = 0
+        next_tok = np.roll(emb, -1, axis=1)
+        next_tok[:, -1] = 0
+        emb = 0.6 * emb + 0.25 * prev_tok + 0.15 * next_tok
+        return emb.astype(np.float32), mask, ids
+
+
+_DEFAULT_ENCODER: Optional[HashTextEncoder] = None
+_DEFAULT_ENCODER_WARNED = False
+
+
+def _default_encoder() -> HashTextEncoder:
+    global _DEFAULT_ENCODER, _DEFAULT_ENCODER_WARNED
+    if _DEFAULT_ENCODER is None:
+        _DEFAULT_ENCODER = HashTextEncoder()
+    if not _DEFAULT_ENCODER_WARNED:
+        from metrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(
+            "BERTScore is using the bundled HashTextEncoder (deterministic hash-vocab embeddings), "
+            "not a pretrained language model: scores are self-consistent but NOT comparable to "
+            "published BERTScore numbers. Pass `encoder=` wrapping a local HF model for calibrated "
+            "scores.",
+            UserWarning,
+        )
+        _DEFAULT_ENCODER_WARNED = True
+    return _DEFAULT_ENCODER
+
+
 def _encode(
     text: Union[Sequence[str], Dict[str, Any]],
     encoder: Optional[Callable[[List[str]], _EncoderOutput]],
@@ -86,11 +175,7 @@ def _encode(
         ids = np.asarray(text.get("input_ids", np.zeros(mask.shape, np.int64)))
         return emb, mask, ids
     if encoder is None:
-        raise ValueError(
-            "BERTScore needs an `encoder` callable (or precomputed embedding dicts): this build "
-            "bundles no pretrained weights. Wrap any local HF model as "
-            "`encoder(sentences) -> (embeddings, attention_mask, input_ids)`."
-        )
+        encoder = _default_encoder()
     emb, mask, ids = encoder(list(text))
     return (
         np.asarray(emb, np.float32)[:, :max_length],
@@ -120,11 +205,24 @@ def bert_score(
     ``baseline`` (three floats: precision/recall/f1 baselines) enables the
     original implementation's rescaling ``(x - b) / (1 - b)`` without a
     baseline-file download.
+
+    Example (bundled HashTextEncoder — see the module docstring's
+    calibration caveat; inject ``encoder=`` for published-comparable
+    scores):
+        >>> import warnings
+        >>> with warnings.catch_warnings():
+        ...     warnings.simplefilter("ignore")
+        ...     score = bert_score(["the cat is on the mat"], ["the cat is on the mat"])
+        >>> round(float(score["f1"][0]), 2)
+        1.0
     """
     pred_emb, pred_mask, pred_ids = _encode(preds, encoder, max_length)
     target_emb, target_mask, target_ids = _encode(target, encoder, max_length)
     if pred_emb.shape[0] != target_emb.shape[0]:
         raise ValueError("Expected the same number of predicted and reference sentences.")
+    if pred_emb.shape[0] == 0:
+        empty = jnp.zeros((0,), jnp.float32)
+        return {"precision": empty, "recall": empty, "f1": empty}
 
     length = max(pred_emb.shape[1], target_emb.shape[1])
     pred_emb, pred_mask, pred_ids = (_pad_to(a, length) for a in (pred_emb, pred_mask, pred_ids))

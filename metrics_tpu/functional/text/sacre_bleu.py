@@ -14,7 +14,7 @@ from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_up
 
 Array = jax.Array
 
-AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char", "ja-mecab")
 
 # CJK unicode ranges (sacrebleu's zh tokenizer spec).
 _CJK_RANGES = (
@@ -105,12 +105,89 @@ def _tokenize_char(line: str) -> str:
     return " ".join(line.strip())
 
 
+# ja-mecab: sacrebleu's Japanese tokenizer (reference vendors it via the
+# ``mecab-python3`` wheel, ``functional/text/sacre_bleu.py`` tokenizer
+# table). When MeCab is importable we match sacrebleu exactly
+# (``MeCab.Tagger('-Owakati')`` morphological split); otherwise a
+# deterministic pure-Python fallback segments on Japanese script
+# boundaries — kanji / hiragana / katakana / latin runs, punctuation
+# isolated — so Japanese SacreBLEU is *available* everywhere (fallback
+# token boundaries approximate, not identical to, MeCab's morphemes).
+
+_HIRAGANA = ("ぁ", "ゟ")
+_KATAKANA = ("゠", "ヿ")  # includes the prolonged-sound mark
+_KANJI_RANGES = (("一", "鿿"), ("㐀", "䶿"), ("豈", "﫿"))
+
+_MECAB_TAGGER: Union[None, bool, object] = None
+
+
+def _ja_char_class(char: str) -> str:
+    if _HIRAGANA[0] <= char <= _HIRAGANA[1]:
+        return "hira"
+    if _KATAKANA[0] <= char <= _KATAKANA[1]:
+        return "kata"
+    if any(lo <= char <= hi for lo, hi in _KANJI_RANGES):
+        return "kanji"
+    if char.isspace():
+        return "space"
+    if char.isalnum():
+        return "word"
+    return "punct"
+
+
+def _segment_ja_fallback(line: str) -> str:
+    tokens, run, prev = [], "", None
+    for char in line.strip():
+        cls = _ja_char_class(char)
+        if cls == "space":
+            if run:
+                tokens.append(run)
+                run = ""
+            prev = None
+            continue
+        if cls == "punct":
+            if run:
+                tokens.append(run)
+                run = ""
+            tokens.append(char)
+            prev = None
+            continue
+        if cls != prev and run:
+            tokens.append(run)
+            run = ""
+        run += char
+        prev = cls
+    if run:
+        tokens.append(run)
+    return " ".join(tokens)
+
+
+def _tokenize_ja_mecab(line: str) -> str:
+    global _MECAB_TAGGER
+    if _MECAB_TAGGER is None:
+        try:
+            import MeCab
+
+            try:
+                import ipadic
+
+                _MECAB_TAGGER = MeCab.Tagger(ipadic.MECAB_ARGS + " -Owakati")
+            except ImportError:
+                _MECAB_TAGGER = MeCab.Tagger("-Owakati")
+        except Exception:
+            _MECAB_TAGGER = False
+    if _MECAB_TAGGER:
+        return _MECAB_TAGGER.parse(line.strip()).strip()
+    return _segment_ja_fallback(line)
+
+
 _TOKENIZERS = {
     "none": lambda line: line,
     "13a": _tokenize_13a,
     "zh": _tokenize_zh,
     "intl": _tokenize_intl,
     "char": _tokenize_char,
+    "ja-mecab": _tokenize_ja_mecab,
 }
 
 

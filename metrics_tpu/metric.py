@@ -262,9 +262,9 @@ class Metric:
 
         The reference's ``compute_on_cpu`` (``metric.py:91,396-406``) moves
         list states to CPU after each update so unbounded concat states don't
-        exhaust accelerator memory. Here entries become numpy arrays on the
-        host; the final ``compute`` still runs through XLA on the default
-        device (divergence: the reference computes on CPU too).
+        exhaust accelerator memory. Entries become host numpy arrays here,
+        and the final compute runs on the CPU backend too
+        (:meth:`_compute_on_cpu_device`).
         """
         for name, value in self._state.items():
             if isinstance(value, list):
@@ -333,6 +333,8 @@ class Metric:
         rank_zero_warn(msg, UserWarning)
 
     def _compute_unsynced(self, *args: Any, **kwargs: Any) -> Any:
+        if self.compute_on_cpu:
+            return self._compute_on_cpu_device(*args, **kwargs)
         if self._can_jit_compute() and not args and not kwargs:
             if self._compute_jit is None:
                 self._compute_jit = self._make_compute_jit()
@@ -341,6 +343,25 @@ class Metric:
             except _TRACE_ERRORS:
                 object.__setattr__(self, "jittable_compute", False)
         return self._original_compute(*args, **kwargs)
+
+    def _compute_on_cpu_device(self, *args: Any, **kwargs: Any) -> Any:
+        """The reference's full ``compute_on_cpu`` contract
+        (``metric.py:91,396-406``): not just state offload — the final
+        compute itself runs on the host CPU backend, so a gathered cat state
+        larger than accelerator memory still computes. Every state leaf is
+        pulled to host, then the eager compute executes under the CPU
+        default device; the result is CPU-resident."""
+        cpu = jax.devices("cpu")[0]
+
+        def to_host(v: Any) -> Any:
+            # tree_map handles lists and CatBuffers alike
+            return jax.tree_util.tree_map(
+                lambda leaf: np.asarray(leaf) if isinstance(leaf, jax.Array) else leaf, v
+            )
+
+        object.__setattr__(self, "_state", {k: to_host(v) for k, v in self._state.items()})
+        with jax.default_device(cpu):
+            return self._original_compute(*args, **kwargs)
 
     # ------------------------------------------------------------------
     # forward protocol (reference ``metric.py:220-346``)

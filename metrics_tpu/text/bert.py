@@ -27,7 +27,15 @@ Array = jax.Array
 
 
 class BERTScore(Metric):
-    """Accumulating BERTScore with an injected encoder (no bundled weights)."""
+    """Accumulating BERTScore.
+
+    With ``encoder=None`` the bundled :class:`~metrics_tpu.functional.text.
+    bert.HashTextEncoder` runs — deterministic hash-vocab embeddings, NOT a
+    pretrained language model: scores are self-consistent (identity = 1.0,
+    related > unrelated) but not comparable to published BERTScore numbers,
+    and a warning says so once. Inject ``encoder=`` wrapping a local HF
+    model for calibrated scores.
+    """
 
     is_differentiable = False
     higher_is_better = True

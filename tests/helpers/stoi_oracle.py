@@ -1,0 +1,118 @@
+"""Float64 numpy STOI oracle, written directly from the published algorithm
+(Taal, Hendriks, Heusdens, Jensen, IEEE TASLP 2011; extended variant Jensen &
+Taal 2016) with pystoi's documented conventions (10 kHz, 256/512 STFT, 15
+third-octave bands from 150 Hz, 30-frame segments, -15 dB clipping, 40 dB
+VAD) — the same spec the reference's wrapped backend implements
+(``/root/reference/src/torchmetrics/functional/audio/stoi.py:1-102``).
+
+This is the numerical pin for ``metrics_tpu/functional/audio/stoi_native.py``
+(VERDICT r3 missing #6): an independent host implementation in float64, so
+the device version's structure AND precision are both under test.
+"""
+import numpy as np
+
+FS = 10_000
+N_FRAME = 256
+NFFT = 512
+NUM_BANDS = 15
+MIN_FREQ = 150.0
+SEG_LEN = 30
+BETA = -15.0
+DYN_RANGE = 40.0
+EPS = np.finfo(np.float64).eps
+
+
+def _hann(framelen):
+    return np.hanning(framelen + 2)[1:-1]
+
+
+def _third_octave_matrix():
+    f = np.linspace(0, FS, NFFT + 1)[: NFFT // 2 + 1]
+    k = np.arange(NUM_BANDS, dtype=np.float64)
+    cf = (2.0 ** (k / 3.0)) * MIN_FREQ
+    lo_f = cf / (2.0 ** (1.0 / 6.0))
+    hi_f = cf * (2.0 ** (1.0 / 6.0))
+    obm = np.zeros((NUM_BANDS, f.size))
+    for i in range(NUM_BANDS):
+        lo = int(np.argmin((f - lo_f[i]) ** 2))
+        hi = int(np.argmin((f - hi_f[i]) ** 2))
+        obm[i, lo:hi] = 1.0
+    return obm
+
+
+def remove_silent_frames(x, y, dyn_range=DYN_RANGE, framelen=N_FRAME, hop=N_FRAME // 2):
+    w = _hann(framelen)
+    starts = list(range(0, max(len(x) - framelen + 1, 0), hop))
+    if not starts:
+        return np.zeros(0), np.zeros(0)
+    xf = np.stack([w * x[i : i + framelen] for i in starts])
+    yf = np.stack([w * y[i : i + framelen] for i in starts])
+    energies = 20.0 * np.log10(np.linalg.norm(xf, axis=1) + EPS)
+    mask = energies > energies.max() - dyn_range
+    xf, yf = xf[mask], yf[mask]
+    n = xf.shape[0]
+    out_len = (n - 1) * hop + framelen if n else 0
+    xs = np.zeros(out_len)
+    ys = np.zeros(out_len)
+    for i in range(n):
+        xs[i * hop : i * hop + framelen] += xf[i]
+        ys[i * hop : i * hop + framelen] += yf[i]
+    return xs, ys
+
+
+def _band_spectrogram(sig, obm):
+    hop = N_FRAME // 2
+    n_frames = (len(sig) - N_FRAME) // hop + 1
+    w = _hann(N_FRAME)
+    frames = np.stack([w * sig[i * hop : i * hop + N_FRAME] for i in range(n_frames)])
+    power = np.abs(np.fft.rfft(frames, NFFT, axis=-1)) ** 2
+    return np.sqrt(power @ obm.T + np.finfo(np.float32).eps).T  # (bands, frames)
+
+
+def _segments(bands):
+    n_segs = bands.shape[1] - SEG_LEN + 1
+    return np.stack([bands[:, m : m + SEG_LEN] for m in range(n_segs)])  # (M, J, N)
+
+
+def stoi_oracle(target, preds, fs=FS, extended=False, vad=True):
+    """Score one clip pair; mirrors the published algorithm end to end."""
+    x = np.asarray(target, np.float64)
+    y = np.asarray(preds, np.float64)
+    if fs != FS:
+        from scipy.signal import resample_poly
+
+        g = int(np.gcd(int(fs), FS))
+        x = resample_poly(x, FS // g, fs // g)
+        y = resample_poly(y, FS // g, fs // g)
+    if vad:
+        x, y = remove_silent_frames(x, y)
+    n_frames = (len(x) - N_FRAME) // (N_FRAME // 2) + 1 if len(x) >= N_FRAME else 0
+    if n_frames < SEG_LEN:
+        return 1e-5
+    obm = _third_octave_matrix()
+    xb = _band_spectrogram(x, obm)
+    yb = _band_spectrogram(y, obm)
+    xs, ys = _segments(xb), _segments(yb)
+
+    if extended:
+
+        def rowcol(s):
+            s = s - s.mean(-1, keepdims=True)
+            s = s / (np.linalg.norm(s, axis=-1, keepdims=True) + np.finfo(np.float32).eps)
+            s = s - s.mean(-2, keepdims=True)
+            return s / (np.linalg.norm(s, axis=-2, keepdims=True) + np.finfo(np.float32).eps)
+
+        xn, yn = rowcol(xs), rowcol(ys)
+        return float((xn * yn).sum(axis=(-2, -1)).mean() / SEG_LEN)
+
+    norm_x = np.linalg.norm(xs, axis=-1, keepdims=True)
+    norm_y = np.linalg.norm(ys, axis=-1, keepdims=True)
+    y_n = ys * (norm_x / (norm_y + np.finfo(np.float32).eps))
+    clip = 10.0 ** (-BETA / 20.0)
+    y_c = np.minimum(y_n, xs * (1.0 + clip))
+    xm = xs - xs.mean(-1, keepdims=True)
+    ym = y_c - y_c.mean(-1, keepdims=True)
+    corr = (xm * ym).sum(-1) / (
+        np.linalg.norm(xm, axis=-1) * np.linalg.norm(ym, axis=-1) + np.finfo(np.float32).eps
+    )
+    return float(corr.mean())

@@ -80,7 +80,7 @@ class MetricTester:
         (analogue of ``testers.py:111-250``)."""
         metric_args = metric_args or {}
         atol = atol or self.atol
-        metric = metric_class(**metric_args)
+        metric = metric_class(dist_sync_on_step=dist_sync_on_step, **metric_args)
 
         # pickling (reference ``testers.py:175-176``)
         pickled_metric = pickle.dumps(metric)
