@@ -168,18 +168,33 @@ class MeanAveragePrecision(Metric):
             all_scores = np.asarray(self.detection_scores[i])
             all_det = np.asarray(self.detections[i])
             all_gt = np.asarray(self.groundtruths[i])
-            for c in sorted(set(det_labels.tolist()) | set(gt_labels.tolist())):
+            # one lexsort groups dets by label with scores descending inside
+            # each group (stable, matching per-class argsort(-scores)) —
+            # per-class work becomes slicing instead of full-array masking
+            det_order = np.lexsort((-all_scores, det_labels))
+            det_sorted_labels = det_labels[det_order]
+            det_uniq, det_starts = np.unique(det_sorted_labels, return_index=True)
+            det_slices = {
+                int(c): det_order[s:e]
+                for c, s, e in zip(det_uniq, det_starts, np.append(det_starts[1:], det_sorted_labels.size))
+            }
+            gt_order = np.argsort(gt_labels, kind="stable")
+            gt_sorted_labels = gt_labels[gt_order]
+            gt_uniq, gt_starts = np.unique(gt_sorted_labels, return_index=True)
+            gt_slices = {
+                int(c): gt_order[s:e]
+                for c, s, e in zip(gt_uniq, gt_starts, np.append(gt_starts[1:], gt_sorted_labels.size))
+            }
+            for c in sorted(det_slices.keys() | gt_slices.keys()):
                 if c not in cls_index:
                     continue
-                det_mask = det_labels == c
-                scores = all_scores[det_mask]
-                order = np.argsort(-scores, kind="stable")[:max_det]
-                det = all_det[det_mask][order]
-                gt = all_gt[gt_labels == c]
+                dsel = det_slices.get(c, np.zeros(0, np.int64))[:max_det]
+                det = all_det[dsel]
+                gt = all_gt[gt_slices.get(c, np.zeros(0, np.int64))]
                 cells.append(
                     {
                         "cls": cls_index[c],
-                        "scores": scores[order],
+                        "scores": all_scores[dsel],
                         "det": det,
                         "gt": gt,
                         "det_areas": self._area(det) if det.shape[0] else np.zeros(0),
@@ -298,36 +313,44 @@ class MeanAveragePrecision(Metric):
             cell_ids = by_class[idx_cls]
             if not cell_ids:
                 continue
+            # concat + sort ONCE per class: the per-mdet subset of a
+            # score-sorted concat is selected by a positional mask, and the
+            # per-area match arrays concat once instead of once per mdet
+            det_scores_all = np.concatenate([cells[j]["scores"] for j in cell_ids])
+            cell_pos = np.concatenate([np.arange(cells[j]["scores"].shape[0]) for j in cell_ids])
+            order = np.argsort(-det_scores_all, kind="stable")
+            pos_sorted = cell_pos[order]
+            m_all = {
+                a: np.concatenate([cells[j]["m"][a] for j in cell_ids], axis=1)[:, order]
+                for a in range(nb_areas)
+            }
+            ig_all = {
+                a: np.concatenate([cells[j]["ig"][a] for j in cell_ids], axis=1)[:, order]
+                for a in range(nb_areas)
+            }
             for idx_area in range(nb_areas):
                 npig = int(sum((~cells[j]["gt_ig"][idx_area]).sum() for j in cell_ids))
                 if npig == 0:
                     continue
                 for idx_mdet, mdet in enumerate(self.max_detection_thresholds):
-                    keep = [min(cells[j]["scores"].shape[0], mdet) for j in cell_ids]
-                    det_scores = np.concatenate([cells[j]["scores"][:k] for j, k in zip(cell_ids, keep)])
-                    inds = np.argsort(-det_scores, kind="stable")
-                    det_matches = np.concatenate(
-                        [cells[j]["m"][idx_area, :, :k] for j, k in zip(cell_ids, keep)], axis=1
-                    )[:, inds]
-                    det_ignore = np.concatenate(
-                        [cells[j]["ig"][idx_area, :, :k] for j, k in zip(cell_ids, keep)], axis=1
-                    )[:, inds]
+                    keep = pos_sorted < mdet
+                    det_matches = m_all[idx_area][:, keep]
+                    det_ignore = ig_all[idx_area][:, keep]
                     tps = det_matches & ~det_ignore
                     fps = ~det_matches & ~det_ignore
                     tp_sum = tps.cumsum(axis=1).astype(np.float64)
                     fp_sum = fps.cumsum(axis=1).astype(np.float64)
+                    nd = tp_sum.shape[1]
+                    rc = tp_sum / npig
+                    pr = tp_sum / (fp_sum + tp_sum + np.finfo(np.float64).eps)
+                    recall[:, idx_cls, idx_area, idx_mdet] = rc[:, -1] if nd else 0.0
+                    # precision envelope: non-increasing from the right
+                    pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
                     for idx_thr in range(nb_thrs):
-                        tp, fp = tp_sum[idx_thr], fp_sum[idx_thr]
-                        nd = tp.shape[0]
-                        rc = tp / npig
-                        pr = tp / (fp + tp + np.finfo(np.float64).eps)
-                        recall[idx_thr, idx_cls, idx_area, idx_mdet] = rc[-1] if nd else 0.0
-                        # precision envelope: non-increasing from the right
-                        pr = np.maximum.accumulate(pr[::-1])[::-1]
-                        inds_r = np.searchsorted(rc, rec_thrs, side="left")
+                        inds_r = np.searchsorted(rc[idx_thr], rec_thrs, side="left")
                         num_inds = int(inds_r.argmax()) if inds_r.max() >= nd else nb_rec
                         prec = np.zeros(nb_rec)
-                        prec[:num_inds] = pr[inds_r[:num_inds]]
+                        prec[:num_inds] = pr[idx_thr][inds_r[:num_inds]]
                         precision[idx_thr, :, idx_cls, idx_area, idx_mdet] = prec
 
         return precision, recall

@@ -133,7 +133,7 @@ class HashTextEncoder:
         for i, r in enumerate(rows):
             ids[i, : len(r)] = r
             mask[i, : len(r)] = 1
-        emb = self.table[ids] * mask[..., None]
+        emb = self.table[ids] * mask[..., None].astype(np.float32)
         prev_tok = np.roll(emb, 1, axis=1)
         prev_tok[:, 0] = 0
         next_tok = np.roll(emb, -1, axis=1)

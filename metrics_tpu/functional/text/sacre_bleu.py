@@ -176,6 +176,15 @@ def _tokenize_ja_mecab(line: str) -> str:
                 _MECAB_TAGGER = MeCab.Tagger("-Owakati")
         except Exception:
             _MECAB_TAGGER = False
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "ja-mecab tokenizer: MeCab is not installed; falling back to approximate "
+                "script-boundary segmentation. Scores are deterministic here but will DIFFER from "
+                "environments where MeCab is available — install `mecab-python3` for sacrebleu-"
+                "identical Japanese tokenization.",
+                UserWarning,
+            )
     if _MECAB_TAGGER:
         return _MECAB_TAGGER.parse(line.strip()).strip()
     return _segment_ja_fallback(line)
