@@ -320,22 +320,16 @@ class MeanAveragePrecision(Metric):
             cell_pos = np.concatenate([np.arange(cells[j]["scores"].shape[0]) for j in cell_ids])
             order = np.argsort(-det_scores_all, kind="stable")
             pos_sorted = cell_pos[order]
-            m_all = {
-                a: np.concatenate([cells[j]["m"][a] for j in cell_ids], axis=1)[:, order]
-                for a in range(nb_areas)
-            }
-            ig_all = {
-                a: np.concatenate([cells[j]["ig"][a] for j in cell_ids], axis=1)[:, order]
-                for a in range(nb_areas)
-            }
             for idx_area in range(nb_areas):
                 npig = int(sum((~cells[j]["gt_ig"][idx_area]).sum() for j in cell_ids))
                 if npig == 0:
-                    continue
+                    continue  # before the concat work — empty areas stay free
+                m_area = np.concatenate([cells[j]["m"][idx_area] for j in cell_ids], axis=1)[:, order]
+                ig_area = np.concatenate([cells[j]["ig"][idx_area] for j in cell_ids], axis=1)[:, order]
                 for idx_mdet, mdet in enumerate(self.max_detection_thresholds):
                     keep = pos_sorted < mdet
-                    det_matches = m_all[idx_area][:, keep]
-                    det_ignore = ig_all[idx_area][:, keep]
+                    det_matches = m_area[:, keep]
+                    det_ignore = ig_area[:, keep]
                     tps = det_matches & ~det_ignore
                     fps = ~det_matches & ~det_ignore
                     tp_sum = tps.cumsum(axis=1).astype(np.float64)

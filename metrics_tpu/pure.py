@@ -62,6 +62,8 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     if isinstance(metric, MetricCollection):
         return _functionalize_collection(metric, axis_name)
     assert isinstance(metric, Metric)
+    if list(metric._child_metrics()) and getattr(metric, "_wrapper_trace_safe", False):
+        return _functionalize_wrapper(metric, axis_name)
     if any(isinstance(d, list) for d in metric._defaults.values()):
         raise ValueError(
             f"{type(metric).__name__} has unbounded list ('cat') states and cannot be functionalized; "
@@ -120,26 +122,7 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
                 f"{type(metric).__name__} has 'mean'-reduced state; merge() needs count_a/count_b "
                 "(the number of updates folded into each side) to combine correctly."
             )
-        from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_concat
-
-        merged: Dict[str, Any] = {}
-        for name, fx in reductions.items():
-            a, b = state_a[name], state_b[name]
-            if isinstance(a, CatBuffer):
-                merged[name] = cat_concat(a, b)
-            elif fx == "sum":
-                merged[name] = a + b
-            elif fx == "mean":
-                merged[name] = (a * count_a + b * count_b) / (count_a + count_b)
-            elif fx == "max":
-                merged[name] = jax.numpy.maximum(a, b)
-            elif fx == "min":
-                merged[name] = jax.numpy.minimum(a, b)
-            elif callable(fx):
-                merged[name] = fx(jax.numpy.stack([a, b]))
-            else:
-                raise ValueError(f"State {name!r} with reduction {fx!r} has no pure merge rule.")
-        return merged
+        return _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, type(metric).__name__)
 
     return MetricDef(init=init, update=update, compute=compute, merge=merge)
 
@@ -215,13 +198,130 @@ def bootstrap_functionalize(
     return MetricDef(init=init, update=update, compute=compute, merge=merge)
 
 
+def _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, owner_name):
+    """Shared pure merge rule keyed by each state's reduction tag."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_concat
+
+    merged: Dict[str, Any] = {}
+    for name, fx in reductions.items():
+        a, b = state_a[name], state_b[name]
+        if isinstance(a, CatBuffer):
+            merged[name] = cat_concat(a, b)
+        elif fx == "sum":
+            merged[name] = a + b
+        elif fx == "mean":
+            if count_a is None or count_b is None:
+                raise ValueError(
+                    f"{owner_name} has 'mean'-reduced state; merge() needs count_a/count_b "
+                    "(the number of updates folded into each side) to combine correctly."
+                )
+            merged[name] = (a * count_a + b * count_b) / (count_a + count_b)
+        elif fx == "max":
+            merged[name] = jnp.maximum(a, b)
+        elif fx == "min":
+            merged[name] = jnp.minimum(a, b)
+        elif callable(fx):
+            merged[name] = fx(jnp.stack([a, b]))
+        else:
+            raise ValueError(f"State {name!r} with reduction {fx!r} has no pure merge rule.")
+    return merged
+
+
+def _collect_metrics(metric: "Metric"):
+    """Depth-first flatten of a wrapper's metric tree (self first)."""
+    out = [metric]
+    for child in metric._child_metrics():
+        out.extend(_collect_metrics(child))
+    return out
+
+
+def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -> MetricDef:
+    """Pure functions for a trace-safe wrapper (``_wrapper_trace_safe``).
+
+    Wrappers hold their accumulation in child metrics, so the explicit state
+    is a list of per-node state dicts (wrapper first, children depth-first).
+    ``update``/``compute`` swap every node's state in, run the wrapper's own
+    (delegating) body, and read the tree back — children's compute caches are
+    cleared on exit so no tracer leaks into later eager use of the template.
+    """
+    metrics = _collect_metrics(wrapper)
+
+    for m in metrics:
+        if any(isinstance(d, list) for d in m._defaults.values()):
+            raise ValueError(
+                f"{type(m).__name__} (inside {type(wrapper).__name__}) has unbounded list ('cat') "
+                "states; construct it with capacity=N to functionalize the wrapper."
+            )
+        if m is not wrapper and not (m.jittable_update and m.jittable_compute):
+            raise ValueError(
+                f"{type(m).__name__} (inside {type(wrapper).__name__}) is not trace-safe; the "
+                "wrapper cannot be functionalized around it."
+            )
+
+    def _swap(states):
+        prev = [m.__dict__["_state"] for m in metrics]
+        for m, s in zip(metrics, states):
+            object.__setattr__(m, "_state", dict(s))
+            # drop any compute cache from prior eager use of the template —
+            # the child's wrapped compute would otherwise return the stale
+            # cached value instead of computing from the swapped-in state
+            m._computed = None
+        return prev
+
+    def _restore(prev):
+        for m, s in zip(metrics, prev):
+            object.__setattr__(m, "_state", s)
+            m._computed = None  # a child's compute cache may hold a tracer
+
+    def init():
+        return [dict(m._defaults) for m in metrics]
+
+    def update(states, *args: Any, **kwargs: Any):
+        prev = _swap(states)
+        try:
+            wrapper._original_update(*args, **kwargs)
+            return [dict(m.__dict__["_state"]) for m in metrics]
+        finally:
+            _restore(prev)
+
+    def compute(states):
+        if axis_name is not None:
+            states = [sync_state(s, dict(m._reductions), axis_name) for m, s in zip(metrics, states)]
+        prev = _swap(states)
+        try:
+            return wrapper._original_compute()
+        finally:
+            _restore(prev)
+
+    def merge(states_a, states_b, count_a: Optional[float] = None, count_b: Optional[float] = None):
+        return [
+            _merge_by_reduction(dict(m._reductions), a, b, count_a, count_b, type(m).__name__)
+            for m, a, b in zip(metrics, states_a, states_b)
+        ]
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+
+
 def _functionalize_collection(collection: "MetricCollection", axis_name: Optional[str] = None) -> MetricDef:
     """Pure functions over a ``{metric_name: state}`` dict for a collection."""
     from metrics_tpu.parallel.sync import fused_sync
     from metrics_tpu.utilities.data import _flatten_dict
 
     members = list(collection.items(keep_base=True, copy_state=False))
-    mdefs = {name: functionalize(m) for name, m in members}
+    # trace-safe wrappers carry a list-of-dicts state and sync through their
+    # own compute (built WITH axis_name); plain metrics fuse into the
+    # single-collective sync below
+    wrapper_names = {
+        name
+        for name, m in members
+        if list(m._child_metrics()) and getattr(m, "_wrapper_trace_safe", False)
+    }
+    mdefs = {
+        name: (_functionalize_wrapper(m, axis_name) if name in wrapper_names else functionalize(m))
+        for name, m in members
+    }
     reductions = {name: dict(m._reductions) for name, m in members}
 
     def init() -> Dict[str, Any]:
@@ -235,9 +335,10 @@ def _functionalize_collection(collection: "MetricCollection", axis_name: Optiona
 
     def compute(state: Dict[str, Any]) -> Dict[str, Any]:
         if axis_name is not None:
-            ordered = [state[name] for name, _ in members]
-            synced = fused_sync(ordered, [reductions[name] for name, _ in members], axis_name)
-            state = {name: s for (name, _), s in zip(members, synced)}
+            fused = [(name, m) for name, m in members if name not in wrapper_names]
+            ordered = [state[name] for name, _ in fused]
+            synced = fused_sync(ordered, [reductions[name] for name, _ in fused], axis_name)
+            state = {**state, **{name: s for (name, _), s in zip(fused, synced)}}
         res = {name: mdefs[name].compute(state[name]) for name, _ in members}
         res = _flatten_dict(res)
         return {collection._set_name(k): v for k, v in res.items()}

@@ -16,6 +16,8 @@ class ClasswiseWrapper(Metric):
 
     jittable_update = False
     jittable_compute = False
+    # pure delegate body: functionalize() can swap child state and trace it
+    _wrapper_trace_safe = True
 
     def __init__(self, metric: Metric, labels: Optional[List[str]] = None) -> None:
         super().__init__()

@@ -52,6 +52,9 @@ class MultioutputWrapper(Metric):
         self.output_dim = output_dim
         self.remove_nans = remove_nans
         self.squeeze_outputs = squeeze_outputs
+        # NaN-row removal is a dynamic-shape filter; without it the body is a
+        # pure column-split delegate and functionalize() can trace it
+        self._wrapper_trace_safe = not remove_nans
 
     def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[list, dict]]:
         """Reference ``multioutput.py:98-117``."""

@@ -1,6 +1,7 @@
 """Wrapper-metric behavior (analogue of reference
 ``test/unittests/wrappers/test_{bootstrapping,classwise,minmax,multioutput,
 tracker}.py``)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -206,3 +207,164 @@ class TestBootstrapFunctionalize:
     def test_rejects_bad_num(self):
         with pytest.raises(ValueError, match="larger than 1"):
             mt.bootstrap_functionalize(mt.MeanMetric(nan_strategy="ignore"), 1)
+
+
+class TestWrapperFunctionalize:
+    """Trace-safe wrappers compile: functionalize() swaps the whole metric
+    tree's state (wrapper + children depth-first), so ClasswiseWrapper and
+    MultioutputWrapper(remove_nans=False) run under jit and shard_map —
+    wrapper-under-shard_map coverage the reference cannot express."""
+
+    def test_classwise_jit_parity(self):
+        rng = np.random.default_rng(0)
+        p = rng.random((60, 3)).astype(np.float32)
+        t = rng.integers(0, 3, 60)
+        mdef = mt.functionalize(mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None), labels=["a", "b", "c"]))
+        s = jax.jit(mdef.update)(mdef.init(), jnp.asarray(p), jnp.asarray(t))
+        out = jax.jit(mdef.compute)(s)
+        ref = mt.Accuracy(num_classes=3, average=None)
+        ref.update(jnp.asarray(p), jnp.asarray(t))
+        np.testing.assert_allclose(
+            np.asarray([out["accuracy_a"], out["accuracy_b"], out["accuracy_c"]]),
+            np.asarray(ref.compute()), atol=1e-6,
+        )
+
+    def test_template_unaffected_after_trace(self):
+        """Tracing the pure functions must not leak tracers into the
+        template's compute cache; eager use still works afterwards."""
+        w = mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None))
+        mdef = mt.functionalize(w)
+        rng = np.random.default_rng(1)
+        p = rng.random((30, 3)).astype(np.float32)
+        t = rng.integers(0, 3, 30)
+        jax.jit(mdef.update)(mdef.init(), jnp.asarray(p), jnp.asarray(t))
+        w.update(jnp.asarray(p), jnp.asarray(t))
+        vals = w.compute()
+        assert all(np.isfinite(float(v)) for v in vals.values())
+
+    def test_multioutput_jit_parity(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((40, 2)).astype(np.float32)
+        b = rng.random((40, 2)).astype(np.float32)
+        mo = mt.functionalize(mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=2, remove_nans=False))
+        s = jax.jit(mo.update)(mo.init(), jnp.asarray(a), jnp.asarray(b))
+        out = jax.jit(mo.compute)(s)
+        np.testing.assert_allclose(np.asarray(out).ravel(), ((a - b) ** 2).mean(0), rtol=1e-5)
+
+    def test_remove_nans_stays_eager(self):
+        with pytest.raises(ValueError, match="not trace-safe"):
+            mt.functionalize(mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=2))
+
+    def test_minmax_stays_eager(self):
+        # MinMax mutates state at compute (reference semantics) — inherently impure
+        with pytest.raises(ValueError, match="not trace-safe"):
+            mt.functionalize(mt.MinMaxMetric(mt.Accuracy(num_classes=3)))
+
+    def test_classwise_shard_map_union(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.default_rng(3)
+        ndev = jax.device_count()
+        pd = rng.random((ndev, 30, 3)).astype(np.float32)
+        td = rng.integers(0, 3, (ndev, 30))
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        md = mt.functionalize(mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None)), axis_name="data")
+
+        def per_dev(p, t):
+            s = md.init()
+            s = jax.tree_util.tree_map(lambda x: jax.lax.pcast(x, ("data",), to="varying"), s)
+            s = md.update(s, p[0], t[0])
+            return md.compute(s)
+
+        fn = jax.shard_map(per_dev, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+        out = jax.jit(fn)(jnp.asarray(pd), jnp.asarray(td))
+        ref = mt.Accuracy(num_classes=3, average=None)
+        ref.update(jnp.asarray(pd.reshape(-1, 3)), jnp.asarray(td.reshape(-1)))
+        got = np.asarray([out[f"accuracy_{i}"] for i in range(3)])
+        np.testing.assert_allclose(got, np.asarray(ref.compute()), atol=1e-6)
+
+    def test_multioutput_shard_map_union(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.default_rng(4)
+        ndev = jax.device_count()
+        a = rng.random((ndev, 20, 2)).astype(np.float32)
+        b = rng.random((ndev, 20, 2)).astype(np.float32)
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        mo = mt.functionalize(
+            mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=2, remove_nans=False), axis_name="data"
+        )
+
+        def per_dev(x, y):
+            s = mo.init()
+            s = jax.tree_util.tree_map(lambda v: jax.lax.pcast(v, ("data",), to="varying"), s)
+            s = mo.update(s, x[0], y[0])
+            return mo.compute(s)
+
+        fn = jax.shard_map(per_dev, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+        out = jax.jit(fn)(jnp.asarray(a), jnp.asarray(b))
+        exp = ((a.reshape(-1, 2) - b.reshape(-1, 2)) ** 2).mean(0)
+        np.testing.assert_allclose(np.asarray(out).ravel(), exp, rtol=1e-5)
+
+    def test_merge(self):
+        rng = np.random.default_rng(5)
+        p1 = rng.random((30, 3)).astype(np.float32); t1 = rng.integers(0, 3, 30)
+        p2 = rng.random((25, 3)).astype(np.float32); t2 = rng.integers(0, 3, 25)
+        md = mt.functionalize(mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None)))
+        a = md.update(md.init(), jnp.asarray(p1), jnp.asarray(t1))
+        b = md.update(md.init(), jnp.asarray(p2), jnp.asarray(t2))
+        out = md.compute(md.merge(a, b))
+        ref = mt.Accuracy(num_classes=3, average=None)
+        ref.update(jnp.asarray(np.concatenate([p1, p2])), jnp.asarray(np.concatenate([t1, t2])))
+        got = np.asarray([out[f"accuracy_{i}"] for i in range(3)])
+        np.testing.assert_allclose(got, np.asarray(ref.compute()), atol=1e-6)
+
+    def test_functional_compute_ignores_eager_cache(self):
+        """Eager use of the template must not leak its compute cache into
+        the functional path (regression: child._computed short-circuit)."""
+        rng = np.random.default_rng(6)
+        w = mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None))
+        mdef = mt.functionalize(w)
+        p1 = rng.random((30, 3)).astype(np.float32); t1 = rng.integers(0, 3, 30)
+        p2 = rng.random((30, 3)).astype(np.float32); t2 = rng.integers(0, 3, 30)
+        w.update(jnp.asarray(p1), jnp.asarray(t1))
+        w.compute()  # populates the child's eager cache
+        s = mdef.update(mdef.init(), jnp.asarray(p2), jnp.asarray(t2))
+        out = mdef.compute(s)
+        ref = mt.Accuracy(num_classes=3, average=None)
+        ref.update(jnp.asarray(p2), jnp.asarray(t2))
+        np.testing.assert_allclose(
+            np.asarray([out[f"accuracy_{i}"] for i in range(3)]), np.asarray(ref.compute()), atol=1e-6
+        )
+
+    def test_collection_with_wrapper_shard_map(self):
+        """A MetricCollection containing a trace-safe wrapper: plain members
+        sync via the fused collective, the wrapper syncs via its own path."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.default_rng(7)
+        ndev = jax.device_count()
+        pd = rng.random((ndev, 30, 3)).astype(np.float32)
+        td = rng.integers(0, 3, (ndev, 30))
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        coll = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=3), "cw": mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None))}
+        )
+        cd = mt.functionalize(coll, axis_name="data")
+
+        def per_dev(p, t):
+            s = cd.init()
+            s = jax.tree_util.tree_map(lambda x: jax.lax.pcast(x, ("data",), to="varying"), s)
+            s = cd.update(s, p[0], t[0])
+            return cd.compute(s)
+
+        fn = jax.shard_map(per_dev, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+        out = jax.jit(fn)(jnp.asarray(pd), jnp.asarray(td))
+        ref_a = mt.Accuracy(num_classes=3)
+        ref_a.update(jnp.asarray(pd.reshape(-1, 3)), jnp.asarray(td.reshape(-1)))
+        np.testing.assert_allclose(float(out["acc"]), float(ref_a.compute()), atol=1e-6)
+        ref_c = mt.Accuracy(num_classes=3, average=None)
+        ref_c.update(jnp.asarray(pd.reshape(-1, 3)), jnp.asarray(td.reshape(-1)))
+        np.testing.assert_allclose(
+            np.asarray([out[f"accuracy_{i}"] for i in range(3)]), np.asarray(ref_c.compute()), atol=1e-6
+        )
