@@ -203,6 +203,42 @@ def _phase_retrieval(jax, platform) -> None:
     except Exception as err:  # pragma: no cover
         print(f"bench: retrieval_100k failed: {err}", file=sys.stderr)
 
+    # capacity mode: the fully compiled sort+scatter grouped compute that can
+    # live inside a jitted step (list mode above is the eager/bucketed path)
+    try:
+        import jax.numpy as jnp
+
+        from metrics_tpu import RetrievalMAP, functionalize
+
+        nq_c, docs_c = 10_000, 262_144
+        idx_c = np.sort(rng.integers(0, nq_c, docs_c)).astype(np.int32)
+        preds_c = rng.random(docs_c).astype(np.float32)
+        target_c = (rng.random(docs_c) < 0.2).astype(np.float32)
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        mdef = functionalize(RetrievalMAP(capacity=docs_c, num_queries=nq_c, max_docs_per_query=64))
+        state = mdef.update(mdef.init(), jnp.asarray(preds_c), jnp.asarray(target_c), indexes=jnp.asarray(idx_c))
+
+        def cap_iter(acc):
+            # tie preds AND indexes to the carry so XLA can neither hoist the
+            # compute out of the timing loop nor constant-fold the sort/
+            # scatter layout stage (the carry contribution is zero at runtime)
+            s = dict(state)
+            pb, ib = s["preds"], s["indexes"]
+            zero_i = (acc * 1e-30).astype(ib.data.dtype)
+            s["preds"] = CatBuffer(pb.data + acc * 1e-30, pb.mask, pb.dropped)
+            s["indexes"] = CatBuffer(ib.data + zero_i, ib.mask, ib.dropped)
+            return acc + mdef.compute(s)
+
+        ms = _device_loop_ms(jax, cap_iter, jnp.asarray(0.0), 8 if platform == "tpu" else 3)
+        _emit(
+            "retrieval_map_capacity_compiled_ms",
+            round(ms, 3),
+            f"ms/compute (compiled capacity mode, {nq_c} queries x {docs_c} docs, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: retrieval capacity failed: {err}", file=sys.stderr)
+
 
 def _phase_sync(jax, platform) -> None:
     """Fused-collection sync us on a virtual 8-device CPU mesh.

@@ -62,7 +62,7 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     if isinstance(metric, MetricCollection):
         return _functionalize_collection(metric, axis_name)
     assert isinstance(metric, Metric)
-    if list(metric._child_metrics()) and getattr(metric, "_wrapper_trace_safe", False):
+    if _is_trace_safe_wrapper(metric):
         return _functionalize_wrapper(metric, axis_name)
     if any(isinstance(d, list) for d in metric._defaults.values()):
         raise ValueError(
@@ -229,6 +229,11 @@ def _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, owner_na
     return merged
 
 
+def _is_trace_safe_wrapper(metric: "Metric") -> bool:
+    """A wrapper whose body is a pure delegate (``_wrapper_trace_safe``)."""
+    return bool(list(metric._child_metrics())) and getattr(metric, "_wrapper_trace_safe", False)
+
+
 def _collect_metrics(metric: "Metric"):
     """Depth-first flatten of a wrapper's metric tree (self first)."""
     out = [metric]
@@ -243,9 +248,12 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
     Wrappers hold their accumulation in child metrics, so the explicit state
     is a list of per-node state dicts (wrapper first, children depth-first).
     ``update``/``compute`` swap every node's state in, run the wrapper's own
-    (delegating) body, and read the tree back — children's compute caches are
-    cleared on exit so no tracer leaks into later eager use of the template.
+    (delegating) body, and read the tree back — compute caches, update
+    counters, and sync flags are saved/restored around the swap so neither
+    tracers nor counter drift leak into later eager use of the template.
     """
+    from metrics_tpu.parallel.sync import fused_sync
+
     metrics = _collect_metrics(wrapper)
 
     for m in metrics:
@@ -254,25 +262,43 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
                 f"{type(m).__name__} (inside {type(wrapper).__name__}) has unbounded list ('cat') "
                 "states; construct it with capacity=N to functionalize the wrapper."
             )
-        if m is not wrapper and not (m.jittable_update and m.jittable_compute):
+        if (
+            m is not wrapper
+            and not _is_trace_safe_wrapper(m)  # nested trace-safe wrappers are fine
+            and not (m.jittable_update and m.jittable_compute)
+        ):
             raise ValueError(
                 f"{type(m).__name__} (inside {type(wrapper).__name__}) is not trace-safe; the "
                 "wrapper cannot be functionalized around it."
             )
 
     def _swap(states):
-        prev = [m.__dict__["_state"] for m in metrics]
+        prev = [
+            (m.__dict__["_state"], m._update_count, m._update_called, m._to_sync)
+            for m in metrics
+        ]
         for m, s in zip(metrics, states):
             object.__setattr__(m, "_state", dict(s))
             # drop any compute cache from prior eager use of the template —
             # the child's wrapped compute would otherwise return the stale
             # cached value instead of computing from the swapped-in state
             m._computed = None
+            # the delegating body calls the child's PUBLIC compute; explicit
+            # collectives (axis_name) already synced, so the child must not
+            # run its own process-level gather on swapped (possibly traced)
+            # state
+            m._to_sync = False
+            # state arrives explicitly — the "compute before update" warning
+            # would be spurious here
+            m._update_called = True
         return prev
 
     def _restore(prev):
-        for m, s in zip(metrics, prev):
-            object.__setattr__(m, "_state", s)
+        for m, (state, count, called, to_sync) in zip(metrics, prev):
+            object.__setattr__(m, "_state", state)
+            m._update_count = count
+            m._update_called = called
+            m._to_sync = to_sync
             m._computed = None  # a child's compute cache may hold a tracer
 
     def init():
@@ -288,7 +314,8 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
 
     def compute(states):
         if axis_name is not None:
-            states = [sync_state(s, dict(m._reductions), axis_name) for m, s in zip(metrics, states)]
+            synced = fused_sync(states, [dict(m._reductions) for m in metrics], axis_name)
+            states = synced
         prev = _swap(states)
         try:
             return wrapper._original_compute()
@@ -313,11 +340,7 @@ def _functionalize_collection(collection: "MetricCollection", axis_name: Optiona
     # trace-safe wrappers carry a list-of-dicts state and sync through their
     # own compute (built WITH axis_name); plain metrics fuse into the
     # single-collective sync below
-    wrapper_names = {
-        name
-        for name, m in members
-        if list(m._child_metrics()) and getattr(m, "_wrapper_trace_safe", False)
-    }
+    wrapper_names = {name for name, m in members if _is_trace_safe_wrapper(m)}
     mdefs = {
         name: (_functionalize_wrapper(m, axis_name) if name in wrapper_names else functionalize(m))
         for name, m in members

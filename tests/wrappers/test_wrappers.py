@@ -368,3 +368,32 @@ class TestWrapperFunctionalize:
         np.testing.assert_allclose(
             np.asarray([out[f"accuracy_{i}"] for i in range(3)]), np.asarray(ref_c.compute()), atol=1e-6
         )
+
+    def test_nested_trace_safe_wrappers(self):
+        """Classwise over Multioutput: the depth-first tree swap handles
+        wrapper-in-wrapper nesting."""
+        rng = np.random.default_rng(8)
+        a = rng.random((20, 2)).astype(np.float32)
+        b = rng.random((20, 2)).astype(np.float32)
+        nd = mt.functionalize(
+            mt.ClasswiseWrapper(mt.MultioutputWrapper(mt.MeanSquaredError(), num_outputs=2, remove_nans=False))
+        )
+        s = jax.jit(nd.update)(nd.init(), jnp.asarray(a), jnp.asarray(b))
+        out = jax.jit(nd.compute)(s)
+        exp = ((a - b) ** 2).mean(0)
+        got = np.sort(np.asarray([np.asarray(v).ravel()[0] for v in out.values()]))
+        np.testing.assert_allclose(got, np.sort(exp), rtol=1e-5)
+
+    def test_template_counters_unchanged_by_functional_use(self):
+        """Functional update/compute must not drift the template's update
+        counters (they feed forward()'s mean-merge arithmetic)."""
+        rng = np.random.default_rng(9)
+        w = mt.ClasswiseWrapper(mt.Accuracy(num_classes=3, average=None))
+        child = w.metric
+        md = mt.functionalize(w)
+        p = rng.random((30, 3)).astype(np.float32)
+        t = rng.integers(0, 3, 30)
+        s = md.update(md.init(), jnp.asarray(p), jnp.asarray(t))
+        md.compute(s)
+        assert child._update_count == 0 and not child._update_called
+        assert w._update_count == 0 and not w._update_called
