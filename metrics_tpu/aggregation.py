@@ -131,6 +131,15 @@ class CatMetric(BaseAggregator):
     invalid slots set to NaN (the valid count is dynamic, so a compacted
     result cannot have a static shape); filter with ``~jnp.isnan`` or use
     the masked form directly.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0]))
+        >>> metric.update(jnp.asarray([3.0]))
+        >>> print(metric.compute())
+        [1. 2. 3.]
     """
 
     def __init__(
@@ -195,7 +204,16 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (reference ``aggregation.py:296-364``)."""
+    """Weighted running mean (reference ``aggregation.py:296-364``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> float(metric.compute())
+        2.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.asarray(0.0, dtype=jnp.float32), nan_strategy, **kwargs)

@@ -27,6 +27,16 @@ class Accuracy(StatScores):
     The input mode (binary / multiclass / multilabel / mdmc) is resolved from
     static shape+dtype info, so it is fixed at trace time and the whole update
     compiles to one XLA graph.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = Accuracy(num_classes=4)
+        >>> round(float(metric(preds, target)), 4)
+        0.25
     """
 
     is_differentiable = False

@@ -34,6 +34,15 @@ class AUROC(Metric):
       the trapezoidal ROC area). This is the form that lives inside a
       compiled training step / ``functionalize``. Samples past capacity
       are dropped.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.2, 0.8, 0.6, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> metric = AUROC()
+        >>> round(float(metric(preds, target)), 4)
+        1.0
     """
 
     is_differentiable = False

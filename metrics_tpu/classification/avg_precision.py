@@ -31,6 +31,15 @@ class AveragePrecision(Metric):
     - ``capacity=N``: fixed-size :class:`CatBuffer` ring states — update,
       compute (masked tie-grouped AP), and cross-device sync are all
       static-shape and fully jittable / ``functionalize``-able.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> preds = jnp.asarray([0.2, 0.8, 0.6, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> metric = AveragePrecision()
+        >>> round(float(metric(preds, target)), 4)
+        1.0
     """
 
     is_differentiable = False

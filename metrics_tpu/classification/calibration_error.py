@@ -20,6 +20,15 @@ class CalibrationError(Metric):
     at compute (exact parity with the reference). For a constant-memory
     in-graph variant, bin at update time instead (the counts are sum states) —
     see ``BinnedPrecisionRecallCurve`` for the pattern.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> metric = CalibrationError(n_bins=3)
+        >>> conf = jnp.asarray([0.9, 0.6, 0.3, 0.8])
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> round(float(metric(conf, target)), 4)
+        0.35
     """
 
     is_differentiable = False

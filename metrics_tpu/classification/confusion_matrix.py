@@ -14,7 +14,21 @@ Array = jax.Array
 
 class ConfusionMatrix(Metric):
     """Confusion matrix with static ``(C, C)`` / ``(C, 2, 2)`` sum state
-    (reference ``confusion_matrix.py:25-134``)."""
+    (reference ``confusion_matrix.py:25-134``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = ConfusionMatrix(num_classes=4)
+        >>> print(metric(preds, target))
+        [[1 0 0 0]
+         [0 0 1 0]
+         [0 0 0 1]
+         [0 1 0 0]]
+    """
 
     is_differentiable = False
     higher_is_better: Optional[bool] = None

@@ -12,7 +12,18 @@ Array = jax.Array
 
 
 class Dice(StatScores):
-    """Dice = 2*TP / (2*TP + FP + FN) (reference ``dice.py:26-167``)."""
+    """Dice = 2*TP / (2*TP + FP + FN) (reference ``dice.py:26-167``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Dice
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = Dice(num_classes=4, average='micro')
+        >>> round(float(metric(preds, target)), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -13,7 +13,18 @@ Array = jax.Array
 
 
 class HammingDistance(Metric):
-    """Average Hamming loss (reference ``hamming.py:24-93``)."""
+    """Average Hamming loss (reference ``hamming.py:24-93``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = HammingDistance()
+        >>> round(float(metric(preds, target)), 4)
+        0.375
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -13,7 +13,18 @@ Array = jax.Array
 
 class JaccardIndex(ConfusionMatrix):
     """Jaccard index (IoU) over an accumulated confusion matrix
-    (reference ``jaccard.py:24-113``)."""
+    (reference ``jaccard.py:24-113``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import JaccardIndex
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = JaccardIndex(num_classes=4)
+        >>> round(float(metric(preds, target)), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -18,6 +18,15 @@ class KLDivergence(Metric):
 
     State is a scalar sum for mean/sum reductions and a ``cat`` list for
     ``reduction='none'`` (reference ``:77-82``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> metric = KLDivergence()
+        >>> p = jnp.asarray([[0.5, 0.5]])
+        >>> q = jnp.asarray([[0.25, 0.75]])
+        >>> round(float(metric(p, q)), 4)
+        0.1438
     """
 
     is_differentiable = True

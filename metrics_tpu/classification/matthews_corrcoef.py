@@ -17,7 +17,18 @@ Array = jax.Array
 
 class MatthewsCorrCoef(Metric):
     """Matthews correlation coefficient over an accumulated confusion matrix
-    (reference ``matthews_corrcoef.py:24-95``)."""
+    (reference ``matthews_corrcoef.py:24-95``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrCoef
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = MatthewsCorrCoef(num_classes=4)
+        >>> round(float(metric(preds, target)), 4)
+        0.0
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -12,7 +12,18 @@ Array = jax.Array
 
 
 class Precision(StatScores):
-    """Precision = TP / (TP + FP) (reference ``precision_recall.py:23-158``)."""
+    """Precision = TP / (TP + FP) (reference ``precision_recall.py:23-158``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = Precision(num_classes=4, average='macro')
+        >>> round(float(metric(preds, target)), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -52,7 +63,18 @@ class Precision(StatScores):
 
 
 class Recall(StatScores):
-    """Recall = TP / (TP + FN) (reference ``precision_recall.py:162-297``)."""
+    """Recall = TP / (TP + FN) (reference ``precision_recall.py:162-297``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = Recall(num_classes=4, average='macro')
+        >>> round(float(metric(preds, target)), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = True

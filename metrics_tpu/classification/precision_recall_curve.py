@@ -26,7 +26,20 @@ Array = jax.Array
 
 class PrecisionRecallCurve(Metric):
     """Exact precision-recall pairs per threshold
-    (reference ``precision_recall_curve.py:28-144``)."""
+    (reference ``precision_recall_curve.py:28-144``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PrecisionRecallCurve
+        >>> preds = jnp.asarray([0.2, 0.8, 0.6, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> metric = PrecisionRecallCurve()
+        >>> precision, recall, thresholds = metric(preds, target)
+        >>> print(precision)
+        [1. 1. 1.]
+        >>> print(recall)
+        [1.  0.5 0. ]
+    """
 
     is_differentiable = False
     higher_is_better: Optional[bool] = None

@@ -26,6 +26,18 @@ class ROC(Metric):
     jittable masked compute returning terminal-padded ``(cap + 1,)`` arrays
     (stacked ``(C, cap + 1)`` one-vs-rest for multiclass) — trapezoidal
     integration over the padded curve equals the exact eager curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ROC
+        >>> preds = jnp.asarray([0.2, 0.8, 0.6, 0.4])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> metric = ROC()
+        >>> fpr, tpr, thresholds = metric(preds, target)
+        >>> print(fpr)
+        [0.  0.  0.  0.5 1. ]
+        >>> print(tpr)
+        [0.  0.5 1.  1.  1. ]
     """
 
     is_differentiable = False

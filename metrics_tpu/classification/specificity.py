@@ -12,7 +12,18 @@ Array = jax.Array
 
 
 class Specificity(StatScores):
-    """Specificity = TN / (TN + FP) (reference ``specificity.py:24-161``)."""
+    """Specificity = TN / (TN + FP) (reference ``specificity.py:24-161``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = Specificity(num_classes=4, average='macro')
+        >>> round(float(metric(preds, target)), 4)
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True

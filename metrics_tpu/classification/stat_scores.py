@@ -20,6 +20,16 @@ class StatScores(Metric):
     for global reductions (``()`` for micro, ``(C,)`` for macro — the
     TPU-friendly static form), and ``cat`` lists when per-sample statistics
     must be kept (``reduce='samples'`` / ``mdmc_reduce='samplewise'``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = StatScores(reduce='micro')
+        >>> print(metric(preds, target))
+        [1 3 9 3 4]
     """
 
     is_differentiable = False

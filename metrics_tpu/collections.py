@@ -41,6 +41,14 @@ class MetricCollection:
         ...                             Recall(num_classes=3, average='macro')])
         >>> sorted(metrics(preds, target).items())
         [('Accuracy', Array(0.125, dtype=float32)), ('Precision', Array(0.06666667, dtype=float32)), ('Recall', Array(0.11111112, dtype=float32))]
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError, MeanSquaredError, MetricCollection
+        >>> coll = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+        >>> out = coll(jnp.asarray([2.5, 0.0]), jnp.asarray([3.0, -0.5]))
+        >>> {k: round(float(v), 4) for k, v in sorted(out.items())}
+        {'MeanAbsoluteError': 0.5, 'MeanSquaredError': 0.25}
     """
 
     def __init__(

@@ -33,7 +33,17 @@ Array = jax.Array
 
 
 class MeanSquaredError(Metric):
-    """MSE / RMSE (reference ``regression/mse.py:22``)."""
+    """MSE / RMSE (reference ``regression/mse.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanSquaredError()
+        >>> round(float(metric(preds, target)), 4)
+        0.375
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -58,7 +68,17 @@ class MeanSquaredError(Metric):
 
 
 class MeanAbsoluteError(Metric):
-    """MAE (reference ``regression/mae.py:22``)."""
+    """MAE (reference ``regression/mae.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanAbsoluteError()
+        >>> round(float(metric(preds, target)), 4)
+        0.5
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -79,7 +99,17 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredLogError(Metric):
-    """MSLE (reference ``regression/log_mse.py:22``)."""
+    """MSLE (reference ``regression/log_mse.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredLogError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanSquaredLogError()
+        >>> round(float(metric(preds, target)), 4)
+        0.128
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -100,7 +130,17 @@ class MeanSquaredLogError(Metric):
 
 
 class MeanAbsolutePercentageError(Metric):
-    """MAPE (reference ``regression/mape.py:22``)."""
+    """MAPE (reference ``regression/mape.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanAbsolutePercentageError()
+        >>> round(float(metric(preds, target)), 4)
+        0.3274
+    """
 
     is_differentiable = True
     higher_is_better = False

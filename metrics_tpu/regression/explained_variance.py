@@ -17,7 +17,17 @@ Array = jax.Array
 
 class ExplainedVariance(Metric):
     """Explained variance with moment-sum states (reference
-    ``explained_variance.py:24-106``)."""
+    ``explained_variance.py:24-106``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ExplainedVariance
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = ExplainedVariance()
+        >>> round(float(metric(preds, target)), 4)
+        0.9572
+    """
 
     is_differentiable = True
     higher_is_better = True

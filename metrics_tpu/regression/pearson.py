@@ -54,7 +54,17 @@ class PearsonCorrCoef(Metric):
     """Pearson correlation with streaming moment states
     (reference ``pearson.py:66-150``). States use ``dist_reduce_fx=None`` —
     sync stacks the per-device moments and ``compute`` merges them with the
-    pairwise aggregation above."""
+    pairwise aggregation above.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrCoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = PearsonCorrCoef()
+        >>> round(float(metric(preds, target)), 4)
+        0.9849
+    """
 
     is_differentiable = True
     higher_is_better = None

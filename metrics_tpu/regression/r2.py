@@ -11,7 +11,17 @@ Array = jax.Array
 
 
 class R2Score(Metric):
-    """R² with sum states (reference ``r2.py:25-169``)."""
+    """R² with sum states (reference ``r2.py:25-169``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = R2Score()
+        >>> round(float(metric(preds, target)), 4)
+        0.9486
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -29,6 +29,15 @@ class SpearmanCorrCoef(Metric):
       compute (masked tie-averaged ranking), and cross-device sync are all
       static-shape and fully jittable / ``functionalize``-able. Samples
       past capacity are dropped.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = SpearmanCorrCoef()
+        >>> round(float(metric(preds, target)), 4)
+        1.0
     """
 
     is_differentiable = False

@@ -26,7 +26,17 @@ Array = jax.Array
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision (reference ``retrieval/average_precision.py:24``)."""
+    """Mean average precision (reference ``retrieval/average_precision.py:24``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> metric = RetrievalMAP()
+        >>> metric.update(jnp.asarray([0.8, 0.4, 0.9, 0.2]), jnp.asarray([1, 0, 0, 1]),
+        ...               indexes=jnp.asarray([0, 0, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:
         return _masked_average_precision(preds, target, mask)

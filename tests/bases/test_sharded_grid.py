@@ -1,0 +1,123 @@
+"""Sharded-parity grid: one sweep of ``run_sharded_metric_test`` across
+every domain's sum/moment-state metrics (VERDICT r3 weak #6 — per-metric
+sharded coverage was thin outside classification).
+
+Each metric accumulates per-device shards of the batch stream inside
+``shard_map`` on the 8-device mesh and must agree with its sklearn/numpy
+oracle computed on the full unsharded stream.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as sk
+
+import metrics_tpu as mt
+from tests.helpers import seed_all
+from tests.helpers.testers import MetricTester
+
+seed_all(77)
+N_BATCHES, BATCH = 4, 48
+NUM_CLASSES = 4
+
+PROBS = np.random.rand(N_BATCHES, BATCH, NUM_CLASSES).astype(np.float32)
+PROBS /= PROBS.sum(-1, keepdims=True)
+LABELS = np.random.randint(0, NUM_CLASSES, (N_BATCHES, BATCH))
+REG_P = np.random.rand(N_BATCHES, BATCH).astype(np.float32) + 0.1
+REG_T = (REG_P + 0.3 * np.random.randn(N_BATCHES, BATCH)).astype(np.float32) + 0.5
+
+
+def _flat_cls(fn):
+    return lambda p, t: fn(t.reshape(-1), p.reshape(-1, NUM_CLASSES).argmax(-1))
+
+
+CLS_GRID = [
+    (
+        mt.Specificity,
+        dict(num_classes=NUM_CLASSES, average="macro"),
+        lambda p, t: np.mean(
+            [
+                sk.recall_score(
+                    (t.reshape(-1) != c).astype(int), (p.reshape(-1, NUM_CLASSES).argmax(-1) != c).astype(int)
+                )
+                for c in range(NUM_CLASSES)
+            ]
+        ),
+    ),
+    (
+        mt.FBetaScore,
+        dict(num_classes=NUM_CLASSES, beta=0.5, average="macro"),
+        _flat_cls(lambda t, yp: sk.fbeta_score(t, yp, beta=0.5, average="macro")),
+    ),
+    (mt.CohenKappa, dict(num_classes=NUM_CLASSES), _flat_cls(sk.cohen_kappa_score)),
+    (mt.MatthewsCorrCoef, dict(num_classes=NUM_CLASSES), _flat_cls(sk.matthews_corrcoef)),
+    (
+        mt.HammingDistance,
+        {},
+        # reference semantics: fraction of wrong LABEL POSITIONS over the
+        # one-hot encoding — each wrong sample flips 2 of C positions
+        lambda p, t: np.mean(p.reshape(-1, NUM_CLASSES).argmax(-1) != t.reshape(-1)) * 2 / NUM_CLASSES,
+    ),
+    (
+        mt.Dice,
+        dict(num_classes=NUM_CLASSES, average="micro"),
+        _flat_cls(lambda t, yp: sk.f1_score(t, yp, average="micro")),
+    ),
+]
+
+
+@pytest.mark.parametrize("cls,args,oracle", CLS_GRID, ids=lambda x: getattr(x, "__name__", ""))
+def test_classification_sharded(cls, args, oracle):
+    MetricTester().run_sharded_metric_test(PROBS, LABELS, cls, oracle, metric_args=args, atol=1e-5)
+
+
+REG_GRID = [
+    (mt.MeanAbsoluteError, {}, lambda p, t: np.abs(p - t).mean()),
+    (
+        mt.MeanSquaredLogError,
+        {},
+        lambda p, t: np.mean((np.log1p(p.reshape(-1)) - np.log1p(t.reshape(-1))) ** 2),
+    ),
+    (mt.R2Score, {}, lambda p, t: sk.r2_score(t.reshape(-1), p.reshape(-1))),
+    (
+        mt.ExplainedVariance,
+        {},
+        lambda p, t: sk.explained_variance_score(t.reshape(-1), p.reshape(-1)),
+    ),
+    (
+        mt.PearsonCorrCoef,
+        {},
+        lambda p, t: np.corrcoef(p.reshape(-1), t.reshape(-1))[0, 1],
+    ),
+]
+
+
+@pytest.mark.parametrize("cls,args,oracle", REG_GRID, ids=lambda x: getattr(x, "__name__", ""))
+def test_regression_sharded(cls, args, oracle):
+    MetricTester().run_sharded_metric_test(REG_P, REG_T, cls, oracle, metric_args=args, atol=1e-4)
+
+
+def test_kldivergence_sharded():
+    p = np.random.rand(N_BATCHES, BATCH, 6).astype(np.float32)
+    p /= p.sum(-1, keepdims=True)
+    q = np.random.rand(N_BATCHES, BATCH, 6).astype(np.float32)
+    q /= q.sum(-1, keepdims=True)
+
+    def oracle(pp, qq):
+        pp, qq = pp.reshape(-1, 6), qq.reshape(-1, 6)
+        return np.mean(np.sum(pp * np.log(pp / qq), axis=-1))
+
+    MetricTester().run_sharded_metric_test(p, q, mt.KLDivergence, oracle, atol=1e-5)
+
+
+def test_statscores_sharded():
+    def oracle(p, t):
+        yp = p.reshape(-1, NUM_CLASSES).argmax(-1)
+        tt = t.reshape(-1)
+        tp = int((yp == tt).sum())
+        total = tt.size * 1  # micro: per-sample single-label
+        fp = total - tp
+        return np.asarray([tp, fp, (NUM_CLASSES - 1) * total - fp, fp, total])
+
+    MetricTester().run_sharded_metric_test(
+        PROBS, LABELS, mt.StatScores, oracle, metric_args=dict(reduce="micro"), atol=0
+    )
