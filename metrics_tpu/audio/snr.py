@@ -11,7 +11,18 @@ Array = jax.Array
 
 
 class SignalNoiseRatio(Metric):
-    """Average SNR over all seen clips (reference ``audio/snr.py:22-94``)."""
+    """Average SNR over all seen clips (reference ``audio/snr.py:22-94``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SignalNoiseRatio
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        16.1805
+    """
 
     full_state_update = False
     is_differentiable = True

@@ -14,7 +14,17 @@ Array = jax.Array
 
 
 class PeakSignalNoiseRatio(Metric):
-    """PSNR (reference ``image/psnr.py:25-140``)."""
+    """PSNR (reference ``image/psnr.py:25-140``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PeakSignalNoiseRatio
+        >>> imgs = jnp.ones((1, 1, 16, 16)) * 0.5
+        >>> metric = PeakSignalNoiseRatio(data_range=1.0)
+        >>> metric.update(imgs, imgs * 0.9)
+        >>> round(float(metric.compute()), 4)
+        26.0206
+    """
 
     is_differentiable = True
     higher_is_better = True

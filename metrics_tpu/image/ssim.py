@@ -13,7 +13,17 @@ Array = jax.Array
 
 
 class StructuralSimilarityIndexMeasure(Metric):
-    """SSIM over accumulated image batches (reference ``image/ssim.py:25-131``)."""
+    """SSIM over accumulated image batches (reference ``image/ssim.py:25-131``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StructuralSimilarityIndexMeasure
+        >>> imgs = jnp.ones((1, 1, 16, 16)) * 0.5
+        >>> metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> metric.update(imgs, imgs)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True

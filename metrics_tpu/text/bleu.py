@@ -16,6 +16,13 @@ class BLEUScore(Metric):
     State is four tiny ``sum``-reduced count tensors — the n-gram counting
     itself is host work (strings), so updates run eagerly; sync and the final
     formula are device math.
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> metric = BLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        1.0
     """
 
     is_differentiable = False

@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class CharErrorRate(Metric):
-    """Character error rate over accumulated transcript pairs."""
+    """Character error rate over accumulated transcript pairs.
+
+    Example:
+        >>> from metrics_tpu import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> metric.update(["abcd"], ["abce"])
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -18,7 +18,15 @@ from metrics_tpu.metric import Metric
 
 
 class ROUGEScore(Metric):
-    """Corpus ROUGE over accumulated (pred, references) pairs."""
+    """Corpus ROUGE over accumulated (pred, references) pairs.
+
+    Example:
+        >>> from metrics_tpu import ROUGEScore
+        >>> metric = ROUGEScore()
+        >>> out = metric(["the cat sat"], ["the cat sat down"])
+        >>> round(float(out["rouge1_fmeasure"]), 4)
+        0.8571
+    """
 
     is_differentiable = False
     higher_is_better = True

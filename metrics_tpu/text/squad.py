@@ -17,7 +17,17 @@ Array = jax.Array
 
 
 class SQuAD(Metric):
-    """SQuAD exact-match / F1 with three scalar ``sum`` states."""
+    """SQuAD exact-match / F1 with three scalar ``sum`` states.
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> metric = SQuAD()
+        >>> preds = [{"prediction_text": "the cat", "id": "1"}]
+        >>> target = [{"answers": {"text": ["the cat"], "answer_start": [0]}, "id": "1"}]
+        >>> out = metric(preds, target)
+        >>> float(out["exact_match"]), float(out["f1"])
+        (100.0, 100.0)
+    """
 
     is_differentiable = False
     higher_is_better = True

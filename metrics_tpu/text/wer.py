@@ -16,6 +16,13 @@ class WordErrorRate(Metric):
     Update takes strings (host tokenization → device wavefront DP), so the
     update itself is not jit-staged; the two scalar ``sum`` states still sync
     with a single fused collective.
+
+    Example:
+        >>> from metrics_tpu import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(["the cat sat"], ["the cat sat down"])
+        >>> round(float(metric.compute()), 4)
+        0.25
     """
 
     is_differentiable = False

@@ -12,7 +12,16 @@ Array = jax.Array
 class MinMaxMetric(Metric):
     """Track the running min/max of a wrapped metric's compute value
     (reference ``minmax.py:23-110``; min/max are plain attributes updated at
-    compute time, not registered states — matching ``minmax.py:54-88``)."""
+    compute time, not registered states — matching ``minmax.py:54-88``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MinMaxMetric
+        >>> metric = MinMaxMetric(MeanSquaredError())
+        >>> metric.update(jnp.asarray([1.0]), jnp.asarray([2.0]))
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'max': 1.0, 'min': 1.0, 'raw': 1.0}
+    """
 
     jittable_update = False
     jittable_compute = False
