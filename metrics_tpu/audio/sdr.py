@@ -45,7 +45,17 @@ class SignalDistortionRatio(Metric):
 
 
 class ScaleInvariantSignalDistortionRatio(Metric):
-    """Average SI-SDR (reference ``audio/sdr.py:131-187``)."""
+    """Average SI-SDR (reference ``audio/sdr.py:131-187``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalDistortionRatio
+        >>> metric = ScaleInvariantSignalDistortionRatio()
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> round(float(metric(preds, target)), 4)
+        18.403
+    """
 
     full_state_update = False
     is_differentiable = True

@@ -44,7 +44,17 @@ class SignalNoiseRatio(Metric):
 
 
 class ScaleInvariantSignalNoiseRatio(Metric):
-    """Average SI-SNR (reference ``audio/snr.py:97-158``)."""
+    """Average SI-SNR (reference ``audio/snr.py:97-158``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalNoiseRatio
+        >>> metric = ScaleInvariantSignalNoiseRatio()
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> round(float(metric(preds, target)), 4)
+        15.0918
+    """
 
     full_state_update = False
     is_differentiable = True

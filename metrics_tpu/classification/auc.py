@@ -13,7 +13,15 @@ Array = jax.Array
 
 
 class AUC(Metric):
-    """Area under any (x, y) curve (reference ``auc.py:24-78``)."""
+    """Area under any (x, y) curve (reference ``auc.py:24-78``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> metric = AUC(reorder=True)
+        >>> round(float(metric(jnp.asarray([0.0, 0.5, 1.0]), jnp.asarray([0.0, 0.5, 1.0]))), 4)
+        0.5
+    """
 
     is_differentiable = False
     higher_is_better: bool = None

@@ -13,7 +13,15 @@ Array = jax.Array
 
 
 class HingeLoss(Metric):
-    """Mean hinge loss (reference ``hinge.py:25-124``)."""
+    """Mean hinge loss (reference ``hinge.py:25-124``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HingeLoss
+        >>> metric = HingeLoss()
+        >>> round(float(metric(jnp.asarray([0.5, -0.5]), jnp.asarray([1, 0]))), 4)
+        0.5
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -45,7 +45,17 @@ class _RankingBase(Metric):
 
 class CoverageError(_RankingBase):
     """How far down the ranking to go to cover all true labels
-    (reference ``ranking.py:24-77``)."""
+    (reference ``ranking.py:24-77``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CoverageError
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.1, 0.9, 0.3]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> metric = CoverageError()
+        >>> round(float(metric(preds, target)), 4)
+        1.5
+    """
 
     higher_is_better = False
 
@@ -59,7 +69,17 @@ class CoverageError(_RankingBase):
 
 class LabelRankingAveragePrecision(_RankingBase):
     """Average fraction of correctly-ordered relevant labels
-    (reference ``ranking.py:80-135``)."""
+    (reference ``ranking.py:80-135``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingAveragePrecision
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.1, 0.9, 0.3]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> metric = LabelRankingAveragePrecision()
+        >>> round(float(metric(preds, target)), 4)
+        1.0
+    """
 
     higher_is_better = True
 
@@ -73,7 +93,17 @@ class LabelRankingAveragePrecision(_RankingBase):
 
 class LabelRankingLoss(_RankingBase):
     """Average number of incorrectly-ordered label pairs
-    (reference ``ranking.py:138-195``)."""
+    (reference ``ranking.py:138-195``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LabelRankingLoss
+        >>> preds = jnp.asarray([[0.8, 0.2, 0.6], [0.1, 0.9, 0.3]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 1, 0]])
+        >>> metric = LabelRankingLoss()
+        >>> round(float(metric(preds, target)), 4)
+        0.0
+    """
 
     higher_is_better = False
 

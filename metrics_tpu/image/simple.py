@@ -19,7 +19,18 @@ Array = jax.Array
 
 
 class UniversalImageQualityIndex(Metric):
-    """UQI (reference ``image/uqi.py:24-104``)."""
+    """UQI (reference ``image/uqi.py:24-104``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import UniversalImageQualityIndex
+        >>> imgs = jnp.asarray(np.linspace(0, 1, 3 * 16 * 16, dtype=np.float32).reshape(1, 3, 16, 16))
+        >>> metric = UniversalImageQualityIndex()
+        >>> metric.update(imgs, imgs)
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -83,7 +94,17 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
 
 
 class SpectralAngleMapper(Metric):
-    """SAM (reference ``image/sam.py:24-102``)."""
+    """SAM (reference ``image/sam.py:24-102``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpectralAngleMapper
+        >>> imgs = jnp.ones((1, 3, 16, 16)) * 0.5
+        >>> metric = SpectralAngleMapper()
+        >>> metric.update(imgs, imgs)
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
 
     is_differentiable = True
     higher_is_better = False

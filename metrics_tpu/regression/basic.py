@@ -161,7 +161,15 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
-    """SMAPE (reference ``regression/symmetric_mape.py:22``)."""
+    """SMAPE (reference ``regression/symmetric_mape.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> round(float(metric(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4)
+        0.5788
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -182,7 +190,15 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
-    """WMAPE (reference ``regression/wmape.py:22``)."""
+    """WMAPE (reference ``regression/wmape.py:22``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import WeightedMeanAbsolutePercentageError
+        >>> metric = WeightedMeanAbsolutePercentageError()
+        >>> round(float(metric(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4)
+        0.16
+    """
 
     is_differentiable = True
     higher_is_better = False

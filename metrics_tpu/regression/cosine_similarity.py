@@ -17,7 +17,15 @@ Array = jax.Array
 
 class CosineSimilarity(Metric):
     """Cosine similarity over accumulated rows (reference
-    ``cosine_similarity.py:22-77``)."""
+    ``cosine_similarity.py:22-77``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> metric = CosineSimilarity(reduction='mean')
+        >>> round(float(metric(jnp.asarray([[1.0, 2.0, 3.0]]), jnp.asarray([[2.0, 4.0, 6.0]]))), 4)
+        1.0
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -16,7 +16,15 @@ Array = jax.Array
 
 
 class TweedieDevianceScore(Metric):
-    """Tweedie deviance (reference ``tweedie_deviance.py:24-104``)."""
+    """Tweedie deviance (reference ``tweedie_deviance.py:24-104``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TweedieDevianceScore
+        >>> metric = TweedieDevianceScore()
+        >>> round(float(metric(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4)
+        0.375
+    """
 
     is_differentiable = True
     higher_is_better = False

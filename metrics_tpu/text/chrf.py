@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class CHRFScore(Metric):
-    """Corpus chrF/chrF++ with six per-order ``sum`` count states."""
+    """Corpus chrF/chrF++ with six per-order ``sum`` count states.
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> metric = CHRFScore()
+        >>> metric.update(["the cat"], [["the cat"]])
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True

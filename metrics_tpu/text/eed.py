@@ -16,7 +16,15 @@ Array = jax.Array
 
 
 class ExtendedEditDistance(Metric):
-    """Corpus EED over accumulated (preds, references) pairs."""
+    """Corpus EED over accumulated (preds, references) pairs.
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> metric = ExtendedEditDistance()
+        >>> metric.update(["the cat sat"], [["the cat sat down"]])
+        >>> round(float(metric.compute()), 4)
+        0.3434
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class MatchErrorRate(Metric):
-    """Match error rate over accumulated transcript pairs."""
+    """Match error rate over accumulated transcript pairs.
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> metric.update(["the cat sat"], ["the cat sat down"])
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = False

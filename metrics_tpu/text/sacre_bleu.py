@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class SacreBLEUScore(BLEUScore):
-    """BLEU with the standardized sacrebleu tokenization pipeline."""
+    """BLEU with the standardized sacrebleu tokenization pipeline.
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> metric = SacreBLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
 
     def __init__(
         self,

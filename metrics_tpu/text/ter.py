@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class TranslationEditRate(Metric):
-    """Corpus TER with two scalar ``sum`` states (edits, reference length)."""
+    """Corpus TER with two scalar ``sum`` states (edits, reference length).
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> metric = TranslationEditRate()
+        >>> metric.update(["the cat sat"], [["the cat sat down"]])
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = False

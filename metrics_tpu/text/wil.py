@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class WordInfoLost(Metric):
-    """Word information lost over accumulated transcript pairs."""
+    """Word information lost over accumulated transcript pairs.
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> metric = WordInfoLost()
+        >>> metric.update(["the cat sat"], ["the cat sat down"])
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -11,7 +11,15 @@ Array = jax.Array
 
 
 class WordInfoPreserved(Metric):
-    """Word information preserved over accumulated transcript pairs."""
+    """Word information preserved over accumulated transcript pairs.
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> metric = WordInfoPreserved()
+        >>> metric.update(["the cat sat"], ["the cat sat down"])
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True
