@@ -406,14 +406,20 @@ def _phase_detection(jax, platform) -> None:
             boxes = np.stack([b[:, 0], b[:, 1], b[:, 0] + b[:, 2] / 4 + 5, b[:, 1] + b[:, 3] / 4 + 5], 1)
             preds.append(dict(boxes=boxes, scores=rng.random(50).astype(np.float32), labels=rng.integers(0, 5, 50)))
             tgts.append(dict(boxes=boxes + rng.normal(0, 3, boxes.shape).astype(np.float32), labels=rng.integers(0, 5, 50)))
-        m = MeanAveragePrecision()
-        t0 = time.perf_counter()
-        m.update(preds, tgts)
-        res = m.compute()
+        warm = MeanAveragePrecision()  # compile the matcher shapes once,
+        warm.update(preds, tgts)  # like every other phase's warm pass
+        warm.compute()
+        best = float("inf")
+        for _ in range(3):
+            m = MeanAveragePrecision()
+            t0 = time.perf_counter()
+            m.update(preds, tgts)
+            res = m.compute()
+            best = min(best, time.perf_counter() - t0)
         _emit(
             "map_100img_50box_s",
-            round(time.perf_counter() - t0, 3),
-            f"s end-to-end (COCO mAP, 100 imgs x 50 boxes, 5 classes, {platform}); map={float(res['map']):.4f}",
+            round(best, 3),
+            f"s end-to-end warm (COCO mAP, 100 imgs x 50 boxes, 5 classes, {platform}); map={float(res['map']):.4f}",
         )
     except Exception as err:  # pragma: no cover
         print(f"bench: detection failed: {err}", file=sys.stderr)

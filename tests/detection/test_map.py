@@ -211,3 +211,25 @@ def test_map_box_format_xywh():
     )
     result = metric.compute()
     np.testing.assert_allclose(float(result["map"]), 0.6, atol=1e-4)
+
+
+def test_map_segm_mixed_resolutions():
+    """Images of different mask resolutions in one accumulation: per-cell
+    host IoU + padded device matching must compose (the padded cells only
+    carry (D, G) IoU matrices, never raw masks)."""
+    m_small = np.zeros((1, 8, 8), bool)
+    m_small[0, :4, :4] = True
+    m_big = np.zeros((1, 32, 32), bool)
+    m_big[0, :16, :16] = True
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(
+        [dict(masks=m_small, scores=np.array([0.9], np.float32), labels=np.array([0]))],
+        [dict(masks=m_small.copy(), labels=np.array([0]))],
+    )
+    metric.update(
+        [dict(masks=m_big, scores=np.array([0.8], np.float32), labels=np.array([0]))],
+        [dict(masks=m_big.copy(), labels=np.array([0]))],
+    )
+    result = metric.compute()
+    np.testing.assert_allclose(float(result["map"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(result["mar_100"]), 1.0, atol=1e-6)
