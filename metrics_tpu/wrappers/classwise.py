@@ -12,7 +12,18 @@ Array = jax.Array
 
 class ClasswiseWrapper(Metric):
     """Unroll a per-class result tensor into a labeled dict
-    (reference ``classwise.py:8-73``)."""
+    (reference ``classwise.py:8-73``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, ClasswiseWrapper
+        >>> metric = ClasswiseWrapper(Accuracy(num_classes=3, average=None), labels=["cat", "dog", "bird"])
+        >>> preds = jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1], [0.1, 0.1, 0.8]])
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 2) for k, v in sorted(metric.compute().items())}
+        {'accuracy_bird': 0.0, 'accuracy_cat': 1.0, 'accuracy_dog': 0.5}
+    """
 
     jittable_update = False
     jittable_compute = False

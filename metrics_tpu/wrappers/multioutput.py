@@ -33,6 +33,14 @@ class MultioutputWrapper(Metric):
 
     NaN-row removal is data-dependent (dynamic shapes) and therefore runs
     eagerly, like every wrapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MultioutputWrapper
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> metric.update(jnp.asarray([[1.0, 2.0]]), jnp.asarray([[1.0, 4.0]]))
+        >>> [round(float(v), 2) for v in metric.compute()]
+        [0.0, 4.0]
     """
 
     is_differentiable = False

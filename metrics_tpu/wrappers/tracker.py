@@ -15,7 +15,18 @@ Array = jax.Array
 class MetricTracker:
     """Track a metric (or collection) over time steps
     (reference ``tracker.py:26-213``); a plain list of copies instead of the
-    reference's ``ModuleList``."""
+    reference's ``ModuleList``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError, MetricTracker
+        >>> tracker = MetricTracker(MeanSquaredError(), maximize=False)
+        >>> for preds, target in [([1.0], [2.0]), ([1.0], [1.5])]:
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray(preds), jnp.asarray(target))
+        >>> round(float(tracker.best_metric()), 4)
+        0.25
+    """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
         if not isinstance(metric, (Metric, MetricCollection)):
