@@ -15,6 +15,16 @@ class PermutationInvariantTraining(Metric):
 
     Extra ``**kwargs`` not consumed by the base ``Metric`` are forwarded to
     ``metric_func`` on every update, mirroring the reference's kwarg split.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import metrics_tpu.functional as F
+        >>> preds = jnp.asarray([[[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]])   # (batch, spk, time)
+        >>> target = jnp.asarray([[[4.0, 5.0, 6.0], [1.0, 2.0, 3.0]]])  # speakers swapped
+        >>> best, perm = F.permutation_invariant_training(
+        ...     preds, target, F.scale_invariant_signal_distortion_ratio, "max")
+        >>> print(perm)
+        [[1 0]]
     """
 
     full_state_update = False

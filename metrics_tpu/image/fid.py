@@ -28,7 +28,21 @@ Array = jax.Array
 
 
 class FrechetInceptionDistance(Metric):
-    """FID over real/fake feature distributions (reference ``image/fid.py:128-313``)."""
+    """FID over real/fake feature distributions (reference ``image/fid.py:128-313``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import FrechetInceptionDistance
+        >>> from metrics_tpu.image.extractor import TinyImageEncoder
+        >>> rng = np.random.default_rng(0)
+        >>> fid = FrechetInceptionDistance(feature=TinyImageEncoder(feature_dim=64))
+        >>> imgs = jnp.asarray((rng.random((16, 3, 32, 32)) * 255).astype(np.uint8))
+        >>> fid.update(imgs, real=True)
+        >>> fid.update(imgs, real=False)
+        >>> round(float(fid.compute()), 4)  # identical distributions
+        0.0
+    """
 
     is_differentiable = False
     higher_is_better = False

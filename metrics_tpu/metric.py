@@ -834,7 +834,16 @@ def _neg(x: Array) -> Array:
 
 
 class CompositionalMetric(Metric):
-    """Lazy arithmetic composition of metrics (reference ``metric.py:845-953``)."""
+    """Lazy arithmetic composition of metrics (reference ``metric.py:845-953``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError, MeanSquaredError
+        >>> combined = MeanSquaredError() + MeanAbsoluteError()
+        >>> combined.update(jnp.asarray([2.5, 0.0]), jnp.asarray([3.0, -0.5]))
+        >>> round(float(combined.compute()), 4)
+        0.75
+    """
 
     # children manage their own compilation; tracing through their wrapped
     # compute would cache tracers
