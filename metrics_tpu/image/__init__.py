@@ -15,3 +15,20 @@ from metrics_tpu.image.ssim import (  # noqa: F401
     MultiScaleStructuralSimilarityIndexMeasure,
     StructuralSimilarityIndexMeasure,
 )
+
+_NET_EXPORTS = (
+    "InceptionV3Extractor",
+    "LPIPSNet",
+    "load_inception_torch_state_dict",
+    "load_lpips_torch_state_dict",
+)
+
+
+def __getattr__(name: str):
+    # lazy: the real extractor architectures import flax.linen (see
+    # metrics_tpu/nets/__init__.py)
+    if name in _NET_EXPORTS:
+        import metrics_tpu.nets as nets
+
+        return getattr(nets, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

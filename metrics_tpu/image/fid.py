@@ -3,11 +3,14 @@
 
 Divergence from the reference, by necessity and design: the reference
 downloads a pretrained InceptionV3 through ``torch_fidelity``
-(``image/fid.py:28-59``) — network access this environment does not have,
-and a torch dependency the TPU build avoids. Here ``feature`` is either
+(``image/fid.py:28-59``) — network access this environment does not have.
+Here ``feature`` is either
 
-- a **callable** ``images -> (N, D) features`` (e.g. a flax InceptionV3 or
-  any jittable embedding model), or
+- a **callable** ``images -> (N, D) features``. The reference-equivalent
+  path is :class:`metrics_tpu.nets.InceptionV3Extractor` — the real flax
+  FID InceptionV3, accepting a torchvision/pytorch-fid checkpoint via
+  ``weights=`` for published-scale numbers:
+  ``FrechetInceptionDistance(feature=InceptionV3Extractor(2048, weights=ckpt))``
 - an **int** feature dimension, in which case ``update`` expects
   pre-extracted feature matrices directly.
 
@@ -15,20 +18,58 @@ The FID math itself is fully on-device, including the Newton–Schulz matrix
 square root that replaces the reference's CPU scipy ``sqrtm``
 (``image/fid.py:61-95``).
 """
-from typing import Any, Callable, Union
+from typing import Any, Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.image.fid import _compute_fid, _mean_cov
+from metrics_tpu.functional.image.fid import _compute_fid, _mean_cov, _mean_cov_masked
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 
 Array = jax.Array
 
 
+def _append_real_fake(metric: Any, features: Array, real, valid: Optional[Array]) -> None:
+    """The shared capacity-mode append for real/fake feature rings (FID and
+    KID): ``real`` may be traced — it routes rows branchlessly via the
+    append masks."""
+    is_real = jnp.asarray(real, bool)
+    v = jnp.ones(features.shape[0], bool) if valid is None else jnp.asarray(valid, bool)
+    metric.real_features = cat_append(metric.real_features, features, v & is_real)
+    metric.fake_features = cat_append(metric.fake_features, features, v & ~is_real)
+
+
+def _feature_dim_of(feature: Union[int, Callable], capacity_owner: str) -> int:
+    """The static feature width a CatBuffer state needs at construction."""
+    if isinstance(feature, int):
+        return feature
+    dim = getattr(feature, "feature_dim", None)
+    if not isinstance(dim, int):
+        raise ValueError(
+            f"{capacity_owner}(capacity=...) needs a static feature width: pass `feature` as an "
+            "int (pre-extracted features) or an extractor exposing an integer `.feature_dim` "
+            "(InceptionV3Extractor and TinyImageEncoder both do)."
+        )
+    return dim
+
+
 class FrechetInceptionDistance(Metric):
     """FID over real/fake feature distributions (reference ``image/fid.py:128-313``).
+
+    Two accumulation modes:
+
+    - default: features accumulate in unbounded lists (the reference's
+      pattern, ``image/fid.py:243-244``); eager update/compute.
+    - ``capacity=N``: fixed ``(N, D)`` :class:`CatBuffer` ring states —
+      update is **branchless** (``real`` may be a traced bool; it routes
+      rows via the append mask), compute is the masked mean/cov + on-device
+      Newton–Schulz FID, and the whole metric is jittable, shardable and
+      ``functionalize``-able. Features past capacity are dropped
+      (observable via ``dropped`` / ``on_overflow``). With fewer than two
+      valid samples on either side the result is NaN (the eager mode's
+      ``ValueError`` cannot be raised from compiled code).
 
     Example:
         >>> import jax.numpy as jnp
@@ -48,14 +89,19 @@ class FrechetInceptionDistance(Metric):
     higher_is_better = False
     full_state_update = False
 
-    # list states + user-supplied extractor → eager
+    # list states + user-supplied extractor → eager (capacity mode flips
+    # these per-instance)
     jittable_update = False
     jittable_compute = False
+
+    # real/fake rings fill independently → overflow counts add up
+    _independent_ring_drops = True
 
     def __init__(
         self,
         feature: Union[int, Callable] = 2048,
         reset_real_features: bool = True,
+        capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -69,16 +115,36 @@ class FrechetInceptionDistance(Metric):
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features
+        self.capacity = capacity
 
-        self.add_state("real_features", default=[], dist_reduce_fx=None)
-        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+        if capacity is not None:
+            dim = _feature_dim_of(feature, "FrechetInceptionDistance")
+            self.add_state(
+                "real_features", default=CatBuffer.zeros(capacity, (dim,), jnp.float32), dist_reduce_fx="cat"
+            )
+            self.add_state(
+                "fake_features", default=CatBuffer.zeros(capacity, (dim,), jnp.float32), dist_reduce_fx="cat"
+            )
+            object.__setattr__(self, "jittable_update", True)
+            object.__setattr__(self, "jittable_compute", True)
+        else:
+            self.add_state("real_features", default=[], dist_reduce_fx=None)
+            self.add_state("fake_features", default=[], dist_reduce_fx=None)
 
-    def update(self, imgs: Array, real: bool) -> None:
+    def update(self, imgs: Array, real: bool, valid: Optional[Array] = None) -> None:
         """Extract (or pass through) features and append to the matching
-        distribution (reference ``image/fid.py:259-270``)."""
+        distribution (reference ``image/fid.py:259-270``).
+
+        In capacity mode ``real`` may be a traced bool (it becomes the
+        append mask — no Python branch), and ``valid`` (bool ``(N,)``)
+        optionally masks rows for ragged SPMD batches."""
         features = self.extractor(imgs) if self.extractor is not None else jnp.asarray(imgs)
         if features.ndim != 2:
             raise ValueError(f"Expected extracted features to be 2d (N, D), got shape {features.shape}")
+        if self.capacity is not None:
+            _append_real_fake(self, features, real, valid)
+            return
+        reject_valid_kwarg(valid)
         if real:
             self.real_features.append(features)
         else:
@@ -86,6 +152,10 @@ class FrechetInceptionDistance(Metric):
 
     def compute(self) -> Array:
         """Reference ``image/fid.py:272-292``."""
+        if self.capacity is not None:
+            mu1, sigma1, _ = _mean_cov_masked(self.real_features.data, self.real_features.mask)
+            mu2, sigma2, _ = _mean_cov_masked(self.fake_features.data, self.fake_features.mask)
+            return _compute_fid(mu1, sigma1, mu2, sigma2)
         real_features = dim_zero_cat(self.real_features).astype(jnp.float32)
         fake_features = dim_zero_cat(self.fake_features).astype(jnp.float32)
         if real_features.shape[0] < 2 or fake_features.shape[0] < 2:

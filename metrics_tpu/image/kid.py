@@ -2,8 +2,9 @@
 ``src/torchmetrics/image/kid.py:67``).
 
 Same feature-extractor contract as :class:`FrechetInceptionDistance` (a
-callable or pre-extracted features — no bundled torch inception; see
-``metrics_tpu/image/fid.py``).
+callable or pre-extracted features; the reference-equivalent path is
+``feature=metrics_tpu.nets.InceptionV3Extractor(2048, weights=ckpt)`` —
+see ``metrics_tpu/image/fid.py``).
 """
 from typing import Any, Callable, Optional, Tuple, Union
 
@@ -14,12 +15,29 @@ import numpy as np
 from metrics_tpu.functional.image.fid import _poly_mmd
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, reject_valid_kwarg
 
 Array = jax.Array
 
 
 class KernelInceptionDistance(Metric):
-    """Polynomial-kernel MMD over feature subsets (reference ``image/kid.py:67-254``)."""
+    """Polynomial-kernel MMD over feature subsets (reference ``image/kid.py:67-254``).
+
+    Two accumulation modes:
+
+    - default: feature lists + host ``np.random`` subset permutations (the
+      reference's pattern, ``image/kid.py:222-247``).
+    - ``capacity=N``: fixed ``(N, D)`` :class:`CatBuffer` ring states and a
+      fully in-graph compute — subsets are drawn by masked top-k over
+      per-row uniform scores (a jittable without-replacement sample of the
+      valid rows), vmapped over ``subsets`` PRNG keys derived
+      deterministically from ``seed`` and the current fill counts. Update
+      is branchless (``real`` may be traced; see
+      :class:`FrechetInceptionDistance`). Requires at least ``subset_size``
+      valid rows per side — compiled code cannot raise, so undersized
+      buffers produce garbage subsets; keep the eager mode if you need the
+      reference's ``ValueError``.
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -27,6 +45,9 @@ class KernelInceptionDistance(Metric):
 
     jittable_update = False
     jittable_compute = False
+
+    # real/fake rings fill independently → overflow counts add up
+    _independent_ring_drops = True
 
     def __init__(
         self,
@@ -37,6 +58,8 @@ class KernelInceptionDistance(Metric):
         gamma: Optional[float] = None,
         coef: float = 1.0,
         reset_real_features: bool = True,
+        capacity: Optional[int] = None,
+        seed: int = 0,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -65,22 +88,71 @@ class KernelInceptionDistance(Metric):
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features
+        self.capacity = capacity
+        self.seed = seed
 
-        self.add_state("real_features", default=[], dist_reduce_fx=None)
-        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+        if capacity is not None:
+            from metrics_tpu.image.fid import _feature_dim_of
 
-    def update(self, imgs: Array, real: bool) -> None:
-        """Reference ``image/kid.py:209-220``."""
+            if capacity < subset_size:
+                raise ValueError(
+                    "Argument `capacity` must be at least `subset_size` — a saturated buffer "
+                    "could otherwise never hold a full subset"
+                )
+            dim = _feature_dim_of(feature, "KernelInceptionDistance")
+            self.add_state(
+                "real_features", default=CatBuffer.zeros(capacity, (dim,), jnp.float32), dist_reduce_fx="cat"
+            )
+            self.add_state(
+                "fake_features", default=CatBuffer.zeros(capacity, (dim,), jnp.float32), dist_reduce_fx="cat"
+            )
+            object.__setattr__(self, "jittable_update", True)
+            object.__setattr__(self, "jittable_compute", True)
+        else:
+            self.add_state("real_features", default=[], dist_reduce_fx=None)
+            self.add_state("fake_features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool, valid: Optional[Array] = None) -> None:
+        """Reference ``image/kid.py:209-220``. Capacity mode: ``real`` may be
+        traced (branchless mask routing); ``valid`` masks ragged rows."""
         features = self.extractor(imgs) if self.extractor is not None else jnp.asarray(imgs)
         if features.ndim != 2:
             raise ValueError(f"Expected extracted features to be 2d (N, D), got shape {features.shape}")
+        if self.capacity is not None:
+            from metrics_tpu.image.fid import _append_real_fake
+
+            _append_real_fake(self, features, real, valid)
+            return
+        reject_valid_kwarg(valid)
         if real:
             self.real_features.append(features)
         else:
             self.fake_features.append(features)
 
+    def _compute_capacity(self) -> Tuple[Array, Array]:
+        """In-graph KID: vmapped masked-subset MMD over deterministic keys."""
+        real, fake = self.real_features, self.fake_features
+        base = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.seed), real.count()), fake.count()
+        )
+
+        def one_subset(key: Array) -> Array:
+            kr, kf = jax.random.split(key)
+            # uniform scores, invalid rows sunk to -inf → top_k picks a
+            # uniform without-replacement sample of the valid rows
+            sr = jnp.where(real.mask, jax.random.uniform(kr, (real.capacity,)), -jnp.inf)
+            sf = jnp.where(fake.mask, jax.random.uniform(kf, (fake.capacity,)), -jnp.inf)
+            _, ir = jax.lax.top_k(sr, self.subset_size)
+            _, if_ = jax.lax.top_k(sf, self.subset_size)
+            return _poly_mmd(real.data[ir], fake.data[if_], self.degree, self.gamma, self.coef)
+
+        scores = jax.vmap(one_subset)(jax.random.split(base, self.subsets))
+        return scores.mean(), scores.std(ddof=1)
+
     def compute(self) -> Tuple[Array, Array]:
         """KID mean/std over random subsets (reference ``image/kid.py:222-247``)."""
+        if self.capacity is not None:
+            return self._compute_capacity()
         real_features = dim_zero_cat(self.real_features)
         fake_features = dim_zero_cat(self.fake_features)
 

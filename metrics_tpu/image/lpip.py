@@ -2,19 +2,19 @@
 ``src/torchmetrics/image/lpip.py``).
 
 The reference wraps the ``lpips`` package's pretrained AlexNet/VGG
-(``image/lpip.py`` with the ``_LPIPS_AVAILABLE`` gate) — pretrained weights
-this environment cannot download. The perceptual network is therefore
-injectable: pass ``net`` as a callable ``(img1, img2) -> (N,) distances``
-(e.g. a flax feature network composed with the LPIPS distance). With no
-``net`` the metric falls back to the bundled
-``perceptual_distance(TinyImageEncoder())`` — the exact LPIPS recipe
-(per-stage channel-normalized squared feature differences) over a
-deterministic random-weight CNN. **Calibration caveat:** the bundled
-distance is structurally LPIPS but carries no learned perceptual
-calibration; values are self-consistent (0 for identical images, larger for
-more-different images) yet not comparable to published AlexNet/VGG LPIPS
-numbers. The metric machinery (state accumulation, reductions,
-normalization) matches the reference either way.
+(``image/lpip.py`` with the ``_LPIPS_AVAILABLE`` gate). The TPU build runs
+the same computation through the flax LPIPS stack in
+:mod:`metrics_tpu.nets.lpips_net` — the real AlexNet/VGG16 architecture
+with the lpips scaling layer and lin heads, key-compatible with the torch
+checkpoints. Construction mirrors the reference: ``net_type='alex'|'vgg'``
+selects the backbone; pass ``weights=`` (torchvision backbone and/or lpips
+lin checkpoints) for calibrated, published-scale values. Without weights
+the stack initializes deterministically and warns — structurally LPIPS,
+uncalibrated numbers.
+
+A custom callable ``(img1, img2) -> (N,) distances`` can still be injected
+via ``net=`` (e.g. the cheap ``perceptual_distance(TinyImageEncoder())``
+for tests — explicitly opting in to the toy encoder).
 """
 from typing import Any, Callable, Optional
 
@@ -25,67 +25,32 @@ from metrics_tpu.metric import Metric
 
 Array = jax.Array
 
-_DEFAULT_NET = None
-_DEFAULT_NET_WARNED = False
+# default (weightless) LPIPSNet instances are deterministic per net_type —
+# share one across metric instances so repeated construction doesn't re-pay
+# the flax init + jit wrapper
+_DEFAULT_NETS: dict = {}
 
 
-class _BundledLPIPSNet:
-    """Bundled LPIPS distance: TinyImageEncoder stages + the LPIPS recipe.
+def _default_lpips_net(net_type: str):
+    if net_type not in _DEFAULT_NETS:
+        from metrics_tpu.nets import LPIPSNet
 
-    The encoder normalizes ``2·x/data_range − 1``; LPIPS inputs arrive in
-    ``[-1, 1]``, so this wrapper shifts them to ``[0, 1]`` with
-    ``data_range=1`` — the two maps compose to the identity. A module-level
-    class (not a closure) so default-constructed metrics stay picklable;
-    the encoder is rebuilt deterministically on unpickle.
-    """
-
-    def __init__(self) -> None:
-        self._build()
-
-    def _build(self) -> None:
-        from metrics_tpu.image.extractor import TinyImageEncoder, perceptual_distance
-
-        self._base = perceptual_distance(TinyImageEncoder(data_range=1.0))
-
-    def __call__(self, img1: Array, img2: Array) -> Array:
-        return self._base((img1 + 1.0) * 0.5, (img2 + 1.0) * 0.5)
-
-    def __getstate__(self) -> dict:
-        return {}  # weights are seed-deterministic; rebuild on load
-
-    def __setstate__(self, _state: dict) -> None:
-        self._build()
-
-
-def _default_perceptual_net() -> Callable:
-    global _DEFAULT_NET, _DEFAULT_NET_WARNED
-    if _DEFAULT_NET is None:
-        _DEFAULT_NET = _BundledLPIPSNet()
-    if not _DEFAULT_NET_WARNED:
-        from metrics_tpu.utilities.prints import rank_zero_warn
-
-        rank_zero_warn(
-            "LPIPS is using the bundled TinyImageEncoder perceptual distance (deterministic random "
-            "weights), not pretrained AlexNet/VGG: distances are self-consistent but NOT comparable "
-            "to published LPIPS values. Pass `net=` for a calibrated perceptual network.",
-            UserWarning,
-        )
-        _DEFAULT_NET_WARNED = True
-    return _DEFAULT_NET
+        _DEFAULT_NETS[net_type] = LPIPSNet(net_type=net_type)
+    return _DEFAULT_NETS[net_type]
 
 
 class LearnedPerceptualImagePatchSimilarity(Metric):
-    """LPIPS over an injected (or bundled-default) perceptual distance
-    network (reference ``image/lpip.py:34-142``).
+    """LPIPS over the flax AlexNet/VGG stack — or an injected distance
+    callable (reference ``image/lpip.py:34-142``).
 
-    Example (bundled TinyImageEncoder distance — see the module docstring's
-    calibration caveat; pass ``net=`` for a calibrated network):
+    Example (real AlexNet LPIPS architecture, uncalibrated random init —
+    pass ``weights=`` for published-scale values):
         >>> import warnings
         >>> import jax.numpy as jnp
         >>> with warnings.catch_warnings():
         ...     warnings.simplefilter("ignore")
-        ...     lpips = LearnedPerceptualImagePatchSimilarity()
-        >>> imgs = jnp.zeros((2, 3, 32, 32))
+        ...     lpips = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+        >>> imgs = jnp.zeros((2, 3, 64, 64))
         >>> lpips.update(imgs, imgs)
         >>> float(lpips.compute())
         0.0
@@ -100,18 +65,30 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
 
     def __init__(
         self,
+        net_type: str = "alex",
         net: Optional[Callable] = None,
+        weights: Any = None,
         reduction: str = "mean",
         normalize: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if net is None:
-            net = _default_perceptual_net()
+            valid_net_type = ("alex", "vgg")
+            if net_type not in valid_net_type:
+                raise ValueError(
+                    f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}."
+                )
+            if weights is None:
+                net = _default_lpips_net(net_type)
+            else:
+                from metrics_tpu.nets import LPIPSNet
+
+                net = LPIPSNet(net_type=net_type, weights=weights)
         elif not callable(net):
             raise ValueError(
-                "Argument `net` must be a callable `(img1, img2) -> distances` or None for the bundled"
-                " TinyImageEncoder perceptual distance."
+                "Argument `net` must be a callable `(img1, img2) -> distances` or None for the"
+                " flax AlexNet/VGG LPIPS stack."
             )
         self.net = net
 

@@ -1,0 +1,34 @@
+"""Real feature-extractor architectures for the embedding metrics.
+
+The reference's FID/KID/IS load a pretrained InceptionV3 through
+``torch_fidelity`` (reference ``src/torchmetrics/image/fid.py:28-59``) and
+LPIPS loads AlexNet/VGG through the ``lpips`` package (reference
+``src/torchmetrics/image/lpip.py:23-60``). This package provides the
+TPU-native equivalents: flax implementations of those exact architectures,
+key-compatible with the torch checkpoints, so a user holding the real
+pretrained weights (torchvision ``inception_v3``, the pytorch-fid
+``pt_inception`` port, torchvision ``alexnet``/``vgg16``, or an ``lpips``
+package checkpoint) can load them with ``load_torch_state_dict`` and get
+reference-scale numbers on TPU.
+
+Without weights the networks construct with deterministic random
+initialization and a loud calibration warning — the architecture is real,
+only the calibration is missing.
+"""
+_INCEPTION = ("InceptionV3", "InceptionV3Extractor", "load_inception_torch_state_dict")
+_LPIPS = ("AlexNetFeatures", "VGG16Features", "LPIPSNet", "load_lpips_torch_state_dict")
+
+__all__ = [*_INCEPTION, *_LPIPS]
+
+
+def __getattr__(name: str):
+    # PEP 562 lazy re-exports: the architectures pull in flax.linen, which
+    # plain `import metrics_tpu` (classification/regression users) should
+    # never pay for — nor require flax to be installed at all.
+    if name in _INCEPTION:
+        import metrics_tpu.nets.inception_v3 as mod
+    elif name in _LPIPS:
+        import metrics_tpu.nets.lpips_net as mod
+    else:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(mod, name)

@@ -1,0 +1,82 @@
+"""Torch state-dict → flax variables conversion helpers.
+
+Shared by the InceptionV3 and LPIPS backbones. Torch checkpoints store
+convolutions as ``(O, I, kH, kW)`` and linears as ``(out, in)``; flax uses
+``(kH, kW, I, O)`` conv kernels and ``(in, out)`` dense kernels. BatchNorm
+splits across two flax collections: affine ``scale``/``bias`` in ``params``
+and ``mean``/``var`` running stats in ``batch_stats``.
+"""
+from typing import Any, Dict, Mapping, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "as_numpy_state_dict",
+    "conv_kernel",
+    "dense_kernel",
+    "set_nested",
+]
+
+
+def as_numpy_state_dict(path_or_dict: Any) -> Dict[str, np.ndarray]:
+    """Accept a mapping of arrays/tensors or a path to a ``torch.save`` file
+    and return a flat ``{key: np.ndarray}`` dict.
+
+    Torch is imported lazily and only when needed (a plain dict of numpy
+    arrays never touches torch), so the loaders work in torch-free
+    environments as long as the caller provides arrays.
+    """
+    if isinstance(path_or_dict, (str, bytes)) or hasattr(path_or_dict, "__fspath__"):
+        import torch
+
+        raw = torch.load(path_or_dict, map_location="cpu", weights_only=True)
+        if isinstance(raw, dict) and "state_dict" in raw and isinstance(raw["state_dict"], dict):
+            raw = raw["state_dict"]
+    elif isinstance(path_or_dict, Mapping):
+        raw = path_or_dict
+    else:
+        raise TypeError(
+            f"Expected a state-dict mapping or a checkpoint path, got {type(path_or_dict).__name__}"
+        )
+
+    out: Dict[str, np.ndarray] = {}
+    for key, value in raw.items():
+        if hasattr(value, "detach"):  # torch.Tensor without importing torch
+            value = value.detach().cpu().numpy()
+        out[str(key)] = np.asarray(value)
+    return out
+
+
+def conv_kernel(weight: np.ndarray) -> jnp.ndarray:
+    """Torch ``(O, I, kH, kW)`` conv weight → flax ``(kH, kW, I, O)`` kernel."""
+    if weight.ndim != 4:
+        raise ValueError(f"Expected a 4d conv weight, got shape {weight.shape}")
+    return jnp.asarray(np.transpose(weight, (2, 3, 1, 0)))
+
+
+def dense_kernel(weight: np.ndarray) -> jnp.ndarray:
+    """Torch ``(out, in)`` linear weight → flax ``(in, out)`` dense kernel."""
+    if weight.ndim != 2:
+        raise ValueError(f"Expected a 2d linear weight, got shape {weight.shape}")
+    return jnp.asarray(np.transpose(weight, (1, 0)))
+
+
+def set_nested(tree: Dict[str, Any], path: Tuple[str, ...], value: jnp.ndarray) -> None:
+    """Insert ``value`` at a nested ``path`` in a plain-dict variables tree,
+    verifying the leaf exists with the same shape (catches key typos and
+    architecture mismatches at load time instead of at first apply)."""
+    node = tree
+    for part in path[:-1]:
+        if part not in node:
+            raise KeyError(f"No such module path {'/'.join(path)} in the flax variables tree")
+        node = node[part]
+    leaf = path[-1]
+    if leaf not in node:
+        raise KeyError(f"No such parameter {'/'.join(path)} in the flax variables tree")
+    if tuple(node[leaf].shape) != tuple(value.shape):
+        raise ValueError(
+            f"Shape mismatch at {'/'.join(path)}: checkpoint {tuple(value.shape)} vs "
+            f"model {tuple(node[leaf].shape)}"
+        )
+    node[leaf] = value.astype(node[leaf].dtype)

@@ -1,0 +1,420 @@
+"""Flax InceptionV3, key-compatible with the torch checkpoints the
+reference ecosystem uses for FID/KID/IS.
+
+The reference wraps ``torch_fidelity``'s ``FeatureExtractorInceptionV3``
+(reference ``src/torchmetrics/image/fid.py:28-59``) whose graph is the
+InceptionV3 of torchvision with the pytorch-fid pooling tweaks, exposing
+feature taps at widths 64 / 192 / 768 / 2048 (reference
+``image/fid.py:159-163`` validates ``feature`` against exactly that set).
+This module re-implements that architecture in flax/linen:
+
+- module names mirror the torch attribute names (``Conv2d_1a_3x3`` …
+  ``Mixed_7c``, ``fc``) so :func:`load_inception_torch_state_dict` maps a
+  torchvision ``inception_v3`` / pytorch-fid ``pt_inception`` state dict
+  onto the flax variables mechanically;
+- ``variant="fid"`` applies the pytorch-fid deviations from torchvision —
+  average pools with ``count_include_pad=False`` in the A/C/E blocks and a
+  **max** pool branch in ``Mixed_7c`` — matching the TF-ported FID weights;
+  ``variant="torchvision"`` matches stock torchvision for ImageNet
+  checkpoints;
+- compute runs in NHWC (the TPU-native conv layout; the MXU sees the convs
+  as batched GEMMs) with an NCHW transpose at entry, inference-only
+  BatchNorm (``use_running_average=True``).
+
+No pretrained weights ship with this environment (zero egress); without a
+checkpoint the extractor initializes deterministically from a seed and
+warns that values are uncalibrated. The architecture contract is the
+deliverable: real weights, wherever obtained, drop in via
+``load_torch_state_dict`` and produce reference-scale numbers.
+"""
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from metrics_tpu.nets._torch_convert import as_numpy_state_dict, conv_kernel, dense_kernel, set_nested
+
+Array = jax.Array
+
+__all__ = ["InceptionV3", "InceptionV3Extractor", "load_inception_torch_state_dict", "VALID_FEATURES"]
+
+#: Feature widths the reference accepts (reference ``image/fid.py:159-163``).
+VALID_FEATURES = (64, 192, 768, 2048)
+
+
+def _max_pool(x: Array, window: int, stride: int, pad: int = 0) -> Array:
+    pads = ((pad, pad), (pad, pad))
+    return nn.max_pool(x, (window, window), strides=(stride, stride), padding=pads)
+
+
+def _avg_pool(x: Array, window: int, stride: int, pad: int, count_include_pad: bool) -> Array:
+    """Average pool matching torch's two padding-count conventions.
+
+    torchvision blocks use ``count_include_pad=True`` (divide by the full
+    window area); the pytorch-fid variant divides by the number of valid
+    (non-padding) elements only.
+    """
+    dims = (1, window, window, 1)
+    strides = (1, stride, stride, 1)
+    pads = ((0, 0), (pad, pad), (pad, pad), (0, 0))
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+    if count_include_pad:
+        return summed / float(window * window)
+    ones = jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None]
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+    return summed / counts
+
+
+class BasicConv2d(nn.Module):
+    """Conv(bias=False) + BatchNorm(eps=1e-3) + ReLU — torchvision's
+    ``BasicConv2d`` building block, run with running stats (inference)."""
+
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        x = nn.Conv(
+            self.features,
+            self.kernel,
+            strides=self.strides,
+            padding=(self.padding, self.padding) if isinstance(self.padding, int) else tuple((p, p) for p in self.padding),
+            use_bias=False,
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, name="bn")(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    fid_variant: bool = False
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(64, (1, 1), name="branch1x1")(x)
+        b5 = BasicConv2d(48, (1, 1), name="branch5x5_1")(x)
+        b5 = BasicConv2d(64, (5, 5), padding=(2, 2), name="branch5x5_2")(b5)
+        b3 = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+        b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(b3)
+        b3 = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_3")(b3)
+        bp = _avg_pool(x, 3, 1, 1, count_include_pad=not self.fid_variant)
+        bp = BasicConv2d(self.pool_features, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = BasicConv2d(384, (3, 3), strides=(2, 2), name="branch3x3")(x)
+        bd = BasicConv2d(64, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv2d(96, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+        bd = BasicConv2d(96, (3, 3), strides=(2, 2), name="branch3x3dbl_3")(bd)
+        bp = _max_pool(x, 3, 2)
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    channels_7x7: int
+    fid_variant: bool = False
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c7 = self.channels_7x7
+        b1 = BasicConv2d(192, (1, 1), name="branch1x1")(x)
+        b7 = BasicConv2d(c7, (1, 1), name="branch7x7_1")(x)
+        b7 = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7_2")(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=(3, 0), name="branch7x7_3")(b7)
+        bd = BasicConv2d(c7, (1, 1), name="branch7x7dbl_1")(x)
+        bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_2")(bd)
+        bd = BasicConv2d(c7, (1, 7), padding=(0, 3), name="branch7x7dbl_3")(bd)
+        bd = BasicConv2d(c7, (7, 1), padding=(3, 0), name="branch7x7dbl_4")(bd)
+        bd = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7dbl_5")(bd)
+        bp = _avg_pool(x, 3, 1, 1, count_include_pad=not self.fid_variant)
+        bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b3 = BasicConv2d(192, (1, 1), name="branch3x3_1")(x)
+        b3 = BasicConv2d(320, (3, 3), strides=(2, 2), name="branch3x3_2")(b3)
+        b7 = BasicConv2d(192, (1, 1), name="branch7x7x3_1")(x)
+        b7 = BasicConv2d(192, (1, 7), padding=(0, 3), name="branch7x7x3_2")(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=(3, 0), name="branch7x7x3_3")(b7)
+        b7 = BasicConv2d(192, (3, 3), strides=(2, 2), name="branch7x7x3_4")(b7)
+        bp = _max_pool(x, 3, 2)
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """``pool`` selects the branch_pool op: torchvision uses average
+    everywhere; the FID variant's ``Mixed_7c`` uses max (pytorch-fid's
+    ``FIDInceptionE_2``)."""
+
+    pool: str = "avg"  # "avg" | "avg_nopad" | "max"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b1 = BasicConv2d(320, (1, 1), name="branch1x1")(x)
+        b3 = BasicConv2d(384, (1, 1), name="branch3x3_1")(x)
+        b3a = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3_2a")(b3)
+        b3b = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3_2b")(b3)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        bd = BasicConv2d(448, (1, 1), name="branch3x3dbl_1")(x)
+        bd = BasicConv2d(384, (3, 3), padding=(1, 1), name="branch3x3dbl_2")(bd)
+        bda = BasicConv2d(384, (1, 3), padding=(0, 1), name="branch3x3dbl_3a")(bd)
+        bdb = BasicConv2d(384, (3, 1), padding=(1, 0), name="branch3x3dbl_3b")(bd)
+        bd = jnp.concatenate([bda, bdb], axis=-1)
+        if self.pool == "max":
+            bp = _max_pool(x, 3, 1, pad=1)
+        else:
+            bp = _avg_pool(x, 3, 1, 1, count_include_pad=(self.pool == "avg"))
+        bp = BasicConv2d(192, (1, 1), name="branch_pool")(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """InceptionV3 feature trunk with the reference's four tap points.
+
+    ``__call__`` takes NCHW float images already normalized to ``[-1, 1]``
+    (use :class:`InceptionV3Extractor` for uint8 plumbing) and returns a
+    ``{width: features}`` dict for the requested taps plus ``"logits"``
+    when ``num_classes`` is set and 2048 is computed.
+
+    Args:
+        variant: ``"fid"`` (pytorch-fid pooling, TF-ported FID weights) or
+            ``"torchvision"`` (stock ImageNet checkpoints).
+        num_classes: adds the final ``fc`` layer (1000 for the stock
+            checkpoints, 1008 for the TF-ported FID weights) so those
+            checkpoint keys have a home and the IS logits tap exists.
+    """
+
+    variant: str = "fid"
+    num_classes: Optional[int] = 1000
+
+    @nn.compact
+    def __call__(self, x: Array, features: Sequence[int] = (2048,)) -> Dict[Union[int, str], Array]:
+        if self.variant not in ("fid", "torchvision"):
+            raise ValueError(f"Unknown InceptionV3 variant {self.variant!r}")
+        fid = self.variant == "fid"
+        for f in features:
+            if f not in VALID_FEATURES:
+                raise ValueError(f"Feature tap {f} not in {VALID_FEATURES}")
+        want = set(features)
+        deepest = max(want)
+        taps: Dict[Union[int, str], Array] = {}
+
+        x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU conv layout)
+        x = BasicConv2d(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
+        x = BasicConv2d(32, (3, 3), name="Conv2d_2a_3x3")(x)
+        x = BasicConv2d(64, (3, 3), padding=(1, 1), name="Conv2d_2b_3x3")(x)
+        x = _max_pool(x, 3, 2)
+        if 64 in want:
+            taps[64] = x.mean(axis=(1, 2))
+        if deepest == 64:
+            return taps
+
+        x = BasicConv2d(80, (1, 1), name="Conv2d_3b_1x1")(x)
+        x = BasicConv2d(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = _max_pool(x, 3, 2)
+        if 192 in want:
+            taps[192] = x.mean(axis=(1, 2))
+        if deepest == 192:
+            return taps
+
+        x = InceptionA(32, fid_variant=fid, name="Mixed_5b")(x)
+        x = InceptionA(64, fid_variant=fid, name="Mixed_5c")(x)
+        x = InceptionA(64, fid_variant=fid, name="Mixed_5d")(x)
+        x = InceptionB(name="Mixed_6a")(x)
+        x = InceptionC(128, fid_variant=fid, name="Mixed_6b")(x)
+        x = InceptionC(160, fid_variant=fid, name="Mixed_6c")(x)
+        x = InceptionC(160, fid_variant=fid, name="Mixed_6d")(x)
+        x = InceptionC(192, fid_variant=fid, name="Mixed_6e")(x)
+        if 768 in want:
+            taps[768] = x.mean(axis=(1, 2))
+        if deepest == 768:
+            return taps
+
+        x = InceptionD(name="Mixed_7a")(x)
+        x = InceptionE(pool="avg_nopad" if fid else "avg", name="Mixed_7b")(x)
+        x = InceptionE(pool="max" if fid else "avg", name="Mixed_7c")(x)
+        pooled = x.mean(axis=(1, 2))  # adaptive avg pool to (N, 2048)
+        if 2048 in want:
+            taps[2048] = pooled
+        if self.num_classes:
+            taps["logits"] = nn.Dense(self.num_classes, name="fc")(pooled)
+        return taps
+
+
+def load_inception_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) -> Dict[str, Any]:
+    """Map a torch InceptionV3 state dict (torchvision ``inception_v3`` or
+    the pytorch-fid ``pt_inception`` port — both use the same key naming)
+    onto a flax variables tree from ``InceptionV3.init``.
+
+    ``AuxLogits.*`` keys (train-time head, unused at inference — the
+    reference never runs it either) and ``num_batches_tracked`` counters
+    are skipped. Returns a new variables dict; raises on unknown keys or
+    shape mismatches so silent architecture drift is impossible.
+    """
+    state = as_numpy_state_dict(path_or_dict)
+    new_vars = _to_mutable(variables)
+    for key, value in state.items():
+        if key.startswith("AuxLogits.") or key.endswith("num_batches_tracked"):
+            continue
+        parts = key.split(".")
+        module_path, leaf = tuple(parts[:-1]), parts[-1]
+        if leaf == "weight" and parts[-2] == "conv":
+            set_nested(new_vars["params"], module_path + ("kernel",), conv_kernel(value))
+        elif parts[-2] == "bn":
+            if leaf == "weight":
+                set_nested(new_vars["params"], module_path + ("scale",), jnp.asarray(value))
+            elif leaf == "bias":
+                set_nested(new_vars["params"], module_path + ("bias",), jnp.asarray(value))
+            elif leaf == "running_mean":
+                set_nested(new_vars["batch_stats"], module_path + ("mean",), jnp.asarray(value))
+            elif leaf == "running_var":
+                set_nested(new_vars["batch_stats"], module_path + ("var",), jnp.asarray(value))
+            else:
+                raise KeyError(f"Unrecognized InceptionV3 checkpoint key: {key}")
+        elif parts[0] == "fc":
+            if "params" in new_vars and "fc" in new_vars["params"]:
+                if leaf == "weight":
+                    set_nested(new_vars["params"], ("fc", "kernel"), dense_kernel(value))
+                elif leaf == "bias":
+                    set_nested(new_vars["params"], ("fc", "bias"), jnp.asarray(value))
+                else:
+                    raise KeyError(f"Unrecognized InceptionV3 checkpoint key: {key}")
+            # else: model built with num_classes=None; classifier weights are irrelevant
+        else:
+            raise KeyError(f"Unrecognized InceptionV3 checkpoint key: {key}")
+    return new_vars
+
+
+def _to_mutable(tree: Any) -> Any:
+    """Rebuild a (possibly frozen) variables tree as plain nested dicts."""
+    if hasattr(tree, "items"):
+        return {k: _to_mutable(v) for k, v in tree.items()}
+    return tree
+
+
+class InceptionV3Extractor:
+    """The ``images -> (N, D)`` extractor contract over :class:`InceptionV3`,
+    drop-in for ``FrechetInceptionDistance(feature=...)``,
+    ``KernelInceptionDistance`` and ``InceptionScore``.
+
+    Mirrors the reference's ``NoTrainInceptionV3`` preprocessing (reference
+    ``image/fid.py:41-59`` via torch_fidelity): uint8 ``[0, 255]`` NCHW
+    input, bilinear resize to 299×299, scale to ``[-1, 1]``, then the
+    selected feature tap.
+
+    Args:
+        feature: tap width, one of ``(64, 192, 768, 2048)`` — the
+            reference's valid set — or ``"logits"`` (for InceptionScore).
+        weights: optional torch state dict / checkpoint path
+            (torchvision ``inception_v3`` or pytorch-fid ``pt_inception``
+            naming) loaded via :func:`load_inception_torch_state_dict`.
+            Without it, weights are a deterministic random init and a
+            calibration warning is emitted: the geometry is real InceptionV3
+            but values are not comparable to published FID/KID/IS tables.
+        variant: ``"fid"`` or ``"torchvision"`` pooling behavior.
+        resize: bilinear-resize inputs to 299×299 first (the reference
+            always does; disable for pre-sized inputs or cheap tests).
+        seed: PRNG seed for the no-weights init.
+    """
+
+    def __init__(
+        self,
+        feature: Union[int, str] = 2048,
+        weights: Any = None,
+        variant: str = "fid",
+        resize: bool = True,
+        seed: int = 0,
+    ) -> None:
+        if feature != "logits" and feature not in VALID_FEATURES:
+            raise ValueError(
+                f"Integer `feature` must be one of {VALID_FEATURES}, got {feature}"
+            )
+        self.feature = feature
+        self.variant = variant
+        self.resize = resize
+        self.seed = seed
+        num_classes = 1008 if variant == "fid" else 1000
+        self.module = InceptionV3(variant=variant, num_classes=num_classes)
+        shape = (1, 3, 299, 299) if resize else (1, 3, 96, 96)
+        self.variables = self.module.init(jax.random.PRNGKey(seed), jnp.zeros(shape, jnp.float32))
+        self.calibrated = weights is not None
+        if weights is not None:
+            self.variables = load_inception_torch_state_dict(self.variables, weights)
+        else:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "InceptionV3Extractor constructed without pretrained weights: the architecture is "
+                "the real FID InceptionV3 but the init is random, so FID/KID/IS values are NOT "
+                "comparable to published tables. Pass `weights=` (a torchvision inception_v3 or "
+                "pytorch-fid pt_inception state dict / checkpoint path) for calibrated numbers.",
+                UserWarning,
+            )
+        tap = "logits" if feature == "logits" else feature
+        taps = (2048,) if feature == "logits" else (feature,)
+
+        def _extract(variables: Dict[str, Any], imgs: Array) -> Array:
+            x = imgs.astype(jnp.float32)
+            if self.resize:
+                n, c = x.shape[0], x.shape[1]
+                x = jax.image.resize(x, (n, c, 299, 299), method="bilinear")
+            x = x / 127.5 - 1.0
+            return self.module.apply(variables, x, features=taps)[tap]
+
+        self._extract = jax.jit(_extract)
+
+    @property
+    def feature_dim(self) -> int:
+        if self.feature == "logits":
+            return self.module.num_classes or 1000
+        return int(self.feature)
+
+    def __call__(self, imgs: Any) -> Array:
+        imgs = jnp.asarray(imgs)
+        if imgs.ndim != 4 or imgs.shape[1] != 3:
+            raise ValueError(f"Expected images of shape (N, 3, H, W), got {imgs.shape}")
+        return self._extract(self.variables, imgs)
+
+    def load_torch_state_dict(self, path_or_dict: Any) -> "InceptionV3Extractor":
+        """Load real torch weights in place; returns self for chaining."""
+        self.variables = load_inception_torch_state_dict(self.variables, path_or_dict)
+        self.calibrated = True
+        return self
+
+    # Deterministic-rebuild pickling: weights are either seed-derived or
+    # torch-loaded; ship the arrays only when calibrated.
+    def __getstate__(self) -> dict:
+        state = {
+            "feature": self.feature,
+            "variant": self.variant,
+            "resize": self.resize,
+            "seed": self.seed,
+            "calibrated": self.calibrated,
+        }
+        if self.calibrated:
+            state["variables"] = jax.device_get(self.variables)
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        import warnings
+
+        calibrated = state.pop("calibrated", False)
+        variables = state.pop("variables", None)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self.__init__(**state)
+        if calibrated and variables is not None:
+            self.variables = jax.tree_util.tree_map(jnp.asarray, variables)
+            self.calibrated = True

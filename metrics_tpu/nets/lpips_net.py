@@ -1,0 +1,267 @@
+"""Flax LPIPS: AlexNet/VGG16 feature stacks + learned linear heads,
+key-compatible with the torch checkpoints the reference uses.
+
+The reference's ``LearnedPerceptualImagePatchSimilarity`` wraps the
+``lpips`` package (reference ``src/torchmetrics/image/lpip.py:23-60``),
+which composes a torchvision backbone (AlexNet or VGG16 ``features``) with
+per-layer 1×1 "lin" heads trained on perceptual judgements. This module
+re-implements that exact computation in flax:
+
+- backbone convs are named ``conv<N>`` after their torchvision
+  ``features.<N>`` index, so torchvision ``alexnet``/``vgg16`` state dicts
+  map mechanically; the ``lpips`` package's ``net.slice<K>.<N>.*`` aliases
+  (index-preserving slices) are translated to the same names;
+- lin heads accept the ``lpips`` checkpoint keys ``lin<K>.model.1.weight``
+  (shape ``(1, C, 1, 1)``);
+- the distance is the LPIPS recipe verbatim: input scaling layer
+  (shift/scale constants from the ``lpips`` package), channel-unit-
+  normalized tap activations, squared differences, lin-weighted channel
+  sum, spatial mean, layer sum.
+
+Without checkpoints the net constructs with deterministic random weights
+and warns: structurally LPIPS, but uncalibrated to published tables.
+"""
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.nets._torch_convert import as_numpy_state_dict, conv_kernel, set_nested
+
+Array = jax.Array
+
+__all__ = ["AlexNetFeatures", "VGG16Features", "LPIPSNet", "load_lpips_torch_state_dict"]
+
+# (torchvision features index, out_channels, kernel, stride, padding, tap_after)
+_ALEX_CONVS = (
+    (0, 64, 11, 4, 2, True),
+    (3, 192, 5, 1, 2, True),
+    (6, 384, 3, 1, 1, True),
+    (8, 256, 3, 1, 1, True),
+    (10, 256, 3, 1, 1, True),
+)
+# maxpool(k3, s2) sits before torchvision indices 3 and 6
+_ALEX_POOL_BEFORE = (3, 6)
+
+_VGG_CONVS = (
+    (0, 64, 3, 1, 1, False),
+    (2, 64, 3, 1, 1, True),
+    (5, 128, 3, 1, 1, False),
+    (7, 128, 3, 1, 1, True),
+    (10, 256, 3, 1, 1, False),
+    (12, 256, 3, 1, 1, False),
+    (14, 256, 3, 1, 1, True),
+    (17, 512, 3, 1, 1, False),
+    (19, 512, 3, 1, 1, False),
+    (21, 512, 3, 1, 1, True),
+    (24, 512, 3, 1, 1, False),
+    (26, 512, 3, 1, 1, False),
+    (28, 512, 3, 1, 1, True),
+)
+# maxpool(k2, s2) sits before torchvision indices 5, 10, 17, 24
+_VGG_POOL_BEFORE = (5, 10, 17, 24)
+
+#: per-tap channel widths (the lpips package's ``chns``)
+LPIPS_CHANNELS = {"alex": (64, 192, 384, 256, 256), "vgg": (64, 128, 256, 512, 512)}
+
+# lpips ScalingLayer constants (lpips/lpips.py) — ImageNet mean/std re-expressed
+# for [-1, 1] inputs.
+_SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], np.float32)
+
+
+class _TorchvisionFeatures(nn.Module):
+    """Shared NHWC conv-stack runner over a torchvision ``features`` spec."""
+
+    convs: Tuple[Tuple[int, int, int, int, int, bool], ...]
+    pool_before: Tuple[int, ...]
+    pool_window: int
+    pool_stride: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        for idx, cout, k, s, p, tap in self.convs:
+            if idx in self.pool_before:
+                x = nn.max_pool(
+                    x, (self.pool_window, self.pool_window),
+                    strides=(self.pool_stride, self.pool_stride),
+                )
+            x = nn.Conv(
+                cout, (k, k), strides=(s, s), padding=((p, p), (p, p)), name=f"conv{idx}"
+            )(x)
+            x = nn.relu(x)
+            if tap:
+                taps.append(x)
+        return tuple(taps)
+
+
+class AlexNetFeatures(_TorchvisionFeatures):
+    """torchvision AlexNet ``features`` returning the 5 LPIPS relu taps."""
+
+    convs: Tuple = _ALEX_CONVS
+    pool_before: Tuple = _ALEX_POOL_BEFORE
+    pool_window: int = 3
+    pool_stride: int = 2
+
+
+class VGG16Features(_TorchvisionFeatures):
+    """torchvision VGG16 ``features`` returning relu{1_2,2_2,3_3,4_3,5_3}."""
+
+    convs: Tuple = _VGG_CONVS
+    pool_before: Tuple = _VGG_POOL_BEFORE
+    pool_window: int = 2
+    pool_stride: int = 2
+
+
+class _LPIPSModule(nn.Module):
+    """Full LPIPS graph: scaling layer → backbone taps → normalized squared
+    diffs → lin heads → spatial mean → layer sum."""
+
+    net_type: str = "alex"
+
+    @nn.compact
+    def __call__(self, img0: Array, img1: Array) -> Array:
+        backbone = {"alex": AlexNetFeatures, "vgg": VGG16Features}[self.net_type](name="net")
+        shift = jnp.asarray(_SHIFT)[None, :, None, None]
+        scale = jnp.asarray(_SCALE)[None, :, None, None]
+
+        def prep(x: Array) -> Array:
+            x = (x.astype(jnp.float32) - shift) / scale
+            return jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC
+
+        taps0 = backbone(prep(img0))
+        taps1 = backbone(prep(img1))
+        total = jnp.zeros(img0.shape[0], jnp.float32)
+        for k, (f0, f1) in enumerate(zip(taps0, taps1)):
+            # lpips normalize_tensor: x / (||x||_2 + eps), eps outside the sqrt
+            n0 = f0 / (jnp.sqrt(jnp.sum(f0 * f0, axis=-1, keepdims=True)) + 1e-10)
+            n1 = f1 / (jnp.sqrt(jnp.sum(f1 * f1, axis=-1, keepdims=True)) + 1e-10)
+            diff = (n0 - n1) ** 2
+            lin = self.param(
+                f"lin{k}",
+                lambda key, shape: jax.random.uniform(key, shape, jnp.float32, 0.0, 1.0),
+                (diff.shape[-1],),
+            )
+            total = total + (diff * lin[None, None, None, :]).sum(axis=-1).mean(axis=(1, 2))
+        return total
+
+
+def load_lpips_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) -> Dict[str, Any]:
+    """Load torch weights into an ``_LPIPSModule`` variables tree.
+
+    Accepts, in any combination (call repeatedly to layer checkpoints):
+
+    - torchvision backbone dicts: ``features.<N>.{weight,bias}``
+      (``classifier.*`` keys are skipped);
+    - ``lpips``-package full model dicts: ``net.slice<K>.<N>.{weight,bias}``
+      (translated to ``features.<N>``) and ``lin<K>.model.1.weight`` /
+      ``lins.<K>.model.1.weight`` heads.
+    """
+    state = as_numpy_state_dict(path_or_dict)
+    new_vars = _to_mutable(variables)
+    for key, value in state.items():
+        parts = key.split(".")
+        if parts[0] == "classifier" or key.endswith("num_batches_tracked"):
+            continue
+        if parts[0].startswith("net") and len(parts) >= 2 and parts[1].startswith("slice"):
+            parts = ["features", *parts[2:]]  # net.sliceK.N.* -> features.N.*
+        if parts[0] == "features":
+            idx, leaf = parts[1], parts[-1]
+            if leaf == "weight":
+                set_nested(new_vars["params"], ("net", f"conv{idx}", "kernel"), conv_kernel(value))
+            elif leaf == "bias":
+                set_nested(new_vars["params"], ("net", f"conv{idx}", "bias"), jnp.asarray(value))
+            else:
+                raise KeyError(f"Unrecognized LPIPS checkpoint key: {key}")
+        elif parts[0] == "lins" or parts[0].startswith("lin"):
+            name = f"lin{parts[1]}" if parts[0] == "lins" else parts[0]
+            set_nested(new_vars["params"], (name,), jnp.asarray(value).reshape(-1))
+        elif parts[0] == "scaling_layer" or parts[-1] in ("shift", "scale"):
+            continue  # scaling constants; baked into the module
+        else:
+            raise KeyError(f"Unrecognized LPIPS checkpoint key: {key}")
+    return new_vars
+
+
+def _to_mutable(tree: Any) -> Any:
+    if hasattr(tree, "items"):
+        return {k: _to_mutable(v) for k, v in tree.items()}
+    return tree
+
+
+class LPIPSNet:
+    """Callable ``(img0, img1) -> (N,)`` LPIPS distance — drop-in ``net=``
+    for :class:`~metrics_tpu.image.lpip.LearnedPerceptualImagePatchSimilarity`.
+
+    Inputs are NCHW floats in ``[-1, 1]`` (the metric's contract; its
+    ``normalize=True`` maps ``[0, 1]`` inputs here).
+
+    Args:
+        net_type: ``"alex"`` (the lpips default, reference
+            ``image/lpip.py:87``) or ``"vgg"``.
+        weights: optional checkpoint(s) for
+            :func:`load_lpips_torch_state_dict` — a single dict/path or a
+            sequence layered in order (e.g. torchvision backbone, then the
+            lpips lin heads).
+        seed: PRNG seed for the no-weights deterministic init.
+    """
+
+    def __init__(self, net_type: str = "alex", weights: Any = None, seed: int = 0) -> None:
+        if net_type not in ("alex", "vgg"):
+            raise ValueError(f"Argument `net_type` must be 'alex' or 'vgg', got {net_type!r}")
+        self.net_type = net_type
+        self.seed = seed
+        self.module = _LPIPSModule(net_type=net_type)
+        dummy = jnp.zeros((1, 3, 64, 64), jnp.float32)
+        self.variables = self.module.init(jax.random.PRNGKey(seed), dummy, dummy)
+        self.calibrated = weights is not None
+        if weights is not None:
+            if isinstance(weights, (list, tuple)):
+                for ckpt in weights:
+                    self.variables = load_lpips_torch_state_dict(self.variables, ckpt)
+            else:
+                self.variables = load_lpips_torch_state_dict(self.variables, weights)
+        else:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"LPIPSNet('{net_type}') constructed without pretrained weights: the architecture "
+                "is the real LPIPS stack but backbone and lin heads are random init, so distances "
+                "are NOT comparable to published LPIPS values. Pass `weights=` (torchvision "
+                "backbone and/or lpips lin checkpoints) for calibrated numbers.",
+                UserWarning,
+            )
+        self._dist = jax.jit(self.module.apply)
+
+    def __call__(self, img0: Any, img1: Any) -> Array:
+        img0 = jnp.asarray(img0)
+        img1 = jnp.asarray(img1)
+        if img0.ndim != 4 or img0.shape[1] != 3:
+            raise ValueError(f"Expected images of shape (N, 3, H, W), got {img0.shape}")
+        return self._dist(self.variables, img0, img1)
+
+    def load_torch_state_dict(self, path_or_dict: Any) -> "LPIPSNet":
+        self.variables = load_lpips_torch_state_dict(self.variables, path_or_dict)
+        self.calibrated = True
+        return self
+
+    def __getstate__(self) -> dict:
+        state = {"net_type": self.net_type, "seed": self.seed, "calibrated": self.calibrated}
+        if self.calibrated:
+            state["variables"] = jax.device_get(self.variables)
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        import warnings
+
+        calibrated = state.pop("calibrated", False)
+        variables = state.pop("variables", None)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            self.__init__(net_type=state["net_type"], seed=state["seed"])
+        if calibrated and variables is not None:
+            self.variables = jax.tree_util.tree_map(jnp.asarray, variables)
+            self.calibrated = True
