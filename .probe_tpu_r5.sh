@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-5 standing TPU probe: try the axon tunnel every ~150s, log every
+# attempt with a timestamp to .tpu_probe_log_r5, exit 0 the moment it answers.
+LOG=/root/repo/.tpu_probe_log_r5
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if OUT=$(timeout 90 python -c "import jax; ds = jax.devices(); assert ds[0].platform != 'cpu', ds; print('TPU UP:', ds)" 2>&1); then
+    echo "$TS UP $OUT" >> "$LOG"
+    exit 0
+  else
+    echo "$TS DOWN (timeout-or-error)" >> "$LOG"
+  fi
+  sleep 150
+done

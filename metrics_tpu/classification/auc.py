@@ -1,19 +1,30 @@
 """``AUC`` module metric (reference
 ``src/torchmetrics/classification/auc.py:24``).
 """
-from typing import Any
+from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_compute_masked, _auc_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 
 Array = jax.Array
 
 
 class AUC(Metric):
     """Area under any (x, y) curve (reference ``auc.py:24-78``).
+
+    Two accumulation modes:
+
+    - default: x/y accumulate in ``cat`` list states; compute runs the
+      dense trapezoid on the concatenation.
+    - ``capacity=N``: fixed-size :class:`CatBuffer` ring states — update,
+      compute and sync are static-shape and fully jittable (the masked
+      trapezoid kernel). Points past capacity are dropped (observable via
+      the ``dropped`` counter / ``on_overflow``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -27,18 +38,32 @@ class AUC(Metric):
     higher_is_better: bool = None
     full_state_update = False
 
-    def __init__(self, reorder: bool = False, **kwargs: Any) -> None:
+    def __init__(self, reorder: bool = False, capacity: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.reorder = reorder
-        self.add_state("x", default=[], dist_reduce_fx="cat")
-        self.add_state("y", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is not None:
+            self.add_state("x", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
+            self.add_state("y", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
+        else:
+            self.add_state("x", default=[], dist_reduce_fx="cat")
+            self.add_state("y", default=[], dist_reduce_fx="cat")
 
-    def update(self, x: Array, y: Array) -> None:
-        x, y = _auc_update(x, y)
+    def update(self, x: Array, y: Array, valid: Optional[Array] = None) -> None:
+        """``valid`` (bool ``(N,)``) is accepted in capacity mode only — the
+        ragged-SPMD-batch contract shared with the other CatBuffer metrics."""
+        x, y = _auc_update(jnp.asarray(x), jnp.asarray(y))
+        if self.capacity is not None:
+            self.x = cat_append(self.x, x, valid)
+            self.y = cat_append(self.y, y, valid)
+            return
+        reject_valid_kwarg(valid)
         self.x.append(x)
         self.y.append(y)
 
     def compute(self) -> Array:
+        if self.capacity is not None:
+            return _auc_compute_masked(self.x.data, self.y.data, self.x.mask, reorder=self.reorder)
         x = dim_zero_cat(self.x)
         y = dim_zero_cat(self.y)
         return _auc_compute(x, y, reorder=self.reorder)

@@ -1,14 +1,20 @@
 """``CalibrationError`` module metric (reference
 ``src/torchmetrics/classification/calibration_error.py``, 107 LoC).
 """
-from typing import Any, List
+from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.functional.classification.calibration_error import _ce_compute, _ce_update
+from metrics_tpu.functional.classification.calibration_error import (
+    _ce_bin_update,
+    _ce_compute,
+    _ce_compute_from_bins,
+    _ce_update,
+)
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import reject_valid_kwarg
 
 Array = jax.Array
 
@@ -16,10 +22,19 @@ Array = jax.Array
 class CalibrationError(Metric):
     """Top-label calibration error (reference ``calibration_error.py:24-107``).
 
-    Confidences/accuracies accumulate in ``cat`` list states; binning happens
-    at compute (exact parity with the reference). For a constant-memory
-    in-graph variant, bin at update time instead (the counts are sum states) —
-    see ``BinnedPrecisionRecallCurve`` for the pattern.
+    Two accumulation modes:
+
+    - default: confidences/accuracies accumulate in ``cat`` list states
+      (the reference's pattern, ``calibration_error.py:49-50``); binning
+      happens at compute.
+    - ``binned=True``: static ``(n_bins,)`` count/confidence/accuracy SUM
+      counters updated in-graph. Because ``_ce_compute`` only ever consumes
+      per-bin sums, this is **exactly** equal to the cat-list result (same
+      ``searchsorted`` binning) while being constant-memory, fully
+      jittable/functionalizable, and shardable — the formulation this
+      framework prefers on TPU (SURVEY.md §7 "binned/streaming
+      formulations"). Unlike the CatBuffer capacity modes there is no
+      sample cap and nothing is ever dropped.
 
     Example:
         >>> import jax.numpy as jnp
@@ -29,6 +44,10 @@ class CalibrationError(Metric):
         >>> target = jnp.asarray([1, 1, 0, 0])
         >>> round(float(metric(conf, target)), 4)
         0.35
+        >>> binned = CalibrationError(n_bins=3, binned=True)
+        >>> binned.update(conf, target)
+        >>> round(float(binned.compute()), 4)  # identical to the list mode
+        0.35
     """
 
     is_differentiable = False
@@ -37,7 +56,7 @@ class CalibrationError(Metric):
 
     DISTANCES = {"l1", "l2", "max"}
 
-    def __init__(self, n_bins: int = 15, norm: str = "l1", **kwargs: Any) -> None:
+    def __init__(self, n_bins: int = 15, norm: str = "l1", binned: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if norm not in self.DISTANCES:
             raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
@@ -45,16 +64,34 @@ class CalibrationError(Metric):
             raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
         self.n_bins = n_bins
         self.norm = norm
+        self.binned = bool(binned)
         self.bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
-        self.add_state("confidences", default=[], dist_reduce_fx="cat")
-        self.add_state("accuracies", default=[], dist_reduce_fx="cat")
+        if self.binned:
+            zeros = jnp.zeros((n_bins,), jnp.float32)
+            self.add_state("bin_count", default=zeros, dist_reduce_fx="sum")
+            self.add_state("bin_conf", default=zeros, dist_reduce_fx="sum")
+            self.add_state("bin_acc", default=zeros, dist_reduce_fx="sum")
+        else:
+            self.add_state("confidences", default=[], dist_reduce_fx="cat")
+            self.add_state("accuracies", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """``valid`` (bool ``(N,)``) is accepted in binned mode only — the
+        ragged-SPMD-batch contract shared with the CatBuffer metrics."""
         confidences, accuracies = _ce_update(preds, target)
+        if self.binned:
+            count, conf, acc = _ce_bin_update(confidences, accuracies, self.n_bins, valid)
+            self.bin_count += count
+            self.bin_conf += conf
+            self.bin_acc += acc
+            return
+        reject_valid_kwarg(valid)
         self.confidences.append(confidences)
         self.accuracies.append(accuracies)
 
     def compute(self) -> Array:
+        if self.binned:
+            return _ce_compute_from_bins(self.bin_count, self.bin_conf, self.bin_acc, norm=self.norm)
         confidences = dim_zero_cat(self.confidences)
         accuracies = dim_zero_cat(self.accuracies)
         return _ce_compute(confidences, accuracies, self.bin_boundaries, norm=self.norm)

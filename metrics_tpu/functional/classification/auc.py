@@ -24,6 +24,36 @@ def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
     return x, y
 
 
+def _auc_compute_masked(x: Array, y: Array, mask: Array, reorder: bool = False) -> Array:
+    """Trapezoidal AUC over the rows where ``mask`` is True — the
+    static-shape (CatBuffer) form of ``_auc_compute``.
+
+    Invalid rows are compacted to the tail by a stable argsort (on ``x``
+    when ``reorder``, else on insertion position), and trapezoid segments
+    touching an invalid endpoint contribute zero — identical to running the
+    dense kernel on just the valid rows, but with fixed shapes so the whole
+    thing jits/shards.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    mask = jnp.asarray(mask, bool)
+    n = x.shape[0]
+    if reorder:
+        key = jnp.where(mask, x, jnp.inf)
+    else:
+        key = jnp.where(mask, jnp.arange(n, dtype=jnp.float32), jnp.inf)
+    order = jnp.argsort(key, stable=True)
+    x_s, y_s, m_s = x[order], y[order], mask[order]
+    valid_pair = m_s[:-1] & m_s[1:]
+    dx = jnp.where(valid_pair, jnp.diff(x_s), 0.0)
+    area = jnp.sum(jnp.where(valid_pair, (y_s[:-1] + y_s[1:]) * dx / 2.0, 0.0))
+    if reorder:
+        return area
+    # direction check on the valid pairs only (invalid dx is 0 → neutral)
+    sign = jnp.where(jnp.all(dx >= 0), 1.0, jnp.where(jnp.all(dx <= 0), -1.0, jnp.nan))
+    return area * sign
+
+
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
     """Area under the curve via the trapezoidal rule (reference ``auc.py:112-133``).
 

@@ -18,24 +18,6 @@ from metrics_tpu.utilities.enums import DataType
 Array = jax.Array
 
 
-def _binning_bucketize(
-    confidences: Array, accuracies: Array, bin_boundaries: Array
-) -> Tuple[Array, Array, Array]:
-    """Per-bin mean accuracy/confidence/population (reference ``:51-80``)."""
-    n_bins = bin_boundaries.shape[0] - 1
-    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="left") - 1, 0, n_bins - 1)
-
-    count_bin = jax.ops.segment_sum(jnp.ones_like(confidences), indices, num_segments=n_bins)
-    conf_bin = jax.ops.segment_sum(confidences, indices, num_segments=n_bins)
-    acc_bin = jax.ops.segment_sum(accuracies, indices, num_segments=n_bins)
-
-    safe = jnp.where(count_bin == 0, 1.0, count_bin)
-    conf_bin = jnp.where(count_bin == 0, 0.0, conf_bin / safe)
-    acc_bin = jnp.where(count_bin == 0, 0.0, acc_bin / safe)
-    prop_bin = count_bin / count_bin.sum()
-    return acc_bin, conf_bin, prop_bin
-
-
 def _ce_compute(
     confidences: Array,
     accuracies: Array,
@@ -43,20 +25,63 @@ def _ce_compute(
     norm: str = "l1",
     debias: bool = False,
 ) -> Array:
-    """Reference ``calibration_error.py:83-126``."""
+    """Reference ``calibration_error.py:83-126`` — bins the samples and
+    delegates to :func:`_ce_compute_from_bins` (one copy of the CE math)."""
+    n_bins = bin_boundaries.shape[0] - 1
+    count, conf_sum, acc_sum = _ce_bin_update(
+        confidences, accuracies, n_bins, boundaries=bin_boundaries
+    )
+    return _ce_compute_from_bins(count, conf_sum, acc_sum, norm=norm, debias=debias)
+
+
+def _ce_bin_update(
+    confidences: Array, accuracies: Array, n_bins: int, valid: Array = None, boundaries: Array = None
+) -> Tuple[Array, Array, Array]:
+    """Fold a batch of (confidence, accuracy) pairs into static ``(n_bins,)``
+    count/confidence-sum/accuracy-sum counters.
+
+    The binned formulation of the reference's cat-list accumulation
+    (``calibration_error.py:49-50``): since ``_ce_compute`` only ever needs
+    per-bin sums, the counters are EXACT — not an approximation — while
+    being constant-memory, jittable, and shardable (all three are plain
+    ``sum`` states). Both the cat-list path (:func:`_ce_compute`) and the
+    binned metric state flow through this one binning, so their indexing
+    can never diverge.
+
+    ``valid`` optionally masks rows (the SPMD ragged-batch contract shared
+    with the CatBuffer metrics).
+    """
+    if boundaries is None:
+        boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    indices = jnp.clip(jnp.searchsorted(boundaries, confidences, side="left") - 1, 0, n_bins - 1)
+    weight = jnp.ones_like(confidences) if valid is None else jnp.asarray(valid, confidences.dtype)
+    count = jax.ops.segment_sum(weight, indices, num_segments=n_bins)
+    conf = jax.ops.segment_sum(confidences * weight, indices, num_segments=n_bins)
+    acc = jax.ops.segment_sum(accuracies * weight, indices, num_segments=n_bins)
+    return count, conf, acc
+
+
+def _ce_compute_from_bins(
+    count_bin: Array, conf_sum_bin: Array, acc_sum_bin: Array, norm: str = "l1", debias: bool = False
+) -> Array:
+    """The CE math from pre-accumulated per-bin sums (reference
+    ``calibration_error.py:83-126``) — the single copy both the cat-list
+    path (via :func:`_ce_compute`) and the binned metric state consume."""
     if norm not in {"l1", "l2", "max"}:
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
-
-    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
-
+    safe = jnp.where(count_bin == 0, 1.0, count_bin)
+    conf_bin = jnp.where(count_bin == 0, 0.0, conf_sum_bin / safe)
+    acc_bin = jnp.where(count_bin == 0, 0.0, acc_sum_bin / safe)
+    prop_bin = count_bin / count_bin.sum()
     if norm == "l1":
         return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
     if norm == "max":
         return jnp.max(jnp.abs(acc_bin - conf_bin))
-    # l2
     ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
     if debias:
-        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * accuracies.shape[0] - 1)
+        # reference ``:109-112``: Nadeau-style bias correction on the l2 term
+        n_total = count_bin.sum()
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * n_total - 1)
         ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
     return jnp.where(ce > 0, jnp.sqrt(jnp.clip(ce, 0)), 0.0)
 

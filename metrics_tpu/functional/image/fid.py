@@ -111,6 +111,20 @@ def _mean_cov(features: Array) -> Tuple[Array, Array, Array]:
     return mu, sigma, centered
 
 
+def _mean_cov_masked(features: Array, mask: Array) -> Tuple[Array, Array, Array]:
+    """Masked feature mean and unbiased covariance — the static-shape
+    (CatBuffer) form of :func:`_mean_cov`: invalid rows are zero-weight, so
+    the whole thing jits over a fixed ``(capacity, D)`` buffer.
+
+    Also returns the effective sample count (traced)."""
+    w = jnp.asarray(mask, features.dtype)[:, None]
+    n = w.sum()
+    mu = (features * w).sum(axis=0) / n
+    centered = (features - mu) * w  # invalid rows contribute nothing
+    sigma = _mm(centered.T, centered) / (n - 1)
+    return mu, sigma, n
+
+
 def _compute_fid(
     mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: float = 1e-6, centered=None
 ) -> Array:

@@ -86,6 +86,12 @@ class Metric:
     jittable_update: bool = True
     jittable_compute: bool = True
 
+    # how this metric's CatBuffer ring states overflow together: False =
+    # paired rings filled in lockstep (preds/target — dropped rows are the
+    # SAME samples, count once via max); True = rings filled independently
+    # (FID/KID real vs fake — drops add up)
+    _independent_ring_drops: bool = False
+
     def __init__(
         self,
         compute_on_cpu: bool = False,
@@ -299,10 +305,14 @@ class Metric:
     def dropped_count(self) -> Optional[int]:
         """Rows dropped by capacity-bounded (``CatBuffer``) states.
 
-        The max over this metric's ring states (preds/target rings drop in
-        lockstep, so max = samples lost). ``0`` when nothing overflowed or no
-        ring states exist; ``None`` when states are traced (inside jit) and
-        the count cannot be concretized.
+        The max over this metric's ring states when they fill in lockstep
+        (preds/target rings drop the same samples — max = samples lost), the
+        SUM when the class declares ``_independent_ring_drops`` (FID/KID
+        real vs fake rings overflow separately). ``0`` when nothing
+        overflowed or no ring states exist; ``None`` when states are traced
+        (inside jit) and the count cannot be concretized — use
+        ``MetricDef.dropped`` from :func:`metrics_tpu.functionalize` for the
+        in-graph signal.
         """
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
@@ -313,7 +323,9 @@ class Metric:
                     counts.append(int(v.dropped))
                 except _TRACE_ERRORS:
                     return None
-        return max(counts) if counts else 0
+        if not counts:
+            return 0
+        return sum(counts) if self._independent_ring_drops else max(counts)
 
     def _check_cat_overflow(self) -> None:
         """Overflow is never silent: warn (default) or raise at compute when

@@ -29,12 +29,43 @@ from metrics_tpu.parallel.sync import sync_state
 
 
 class MetricDef(NamedTuple):
-    """Pure functions over an explicit state pytree."""
+    """Pure functions over an explicit state pytree.
+
+    ``dropped(state)`` is the traced overflow signal: the number of sample
+    rows lost to capacity-bounded (:class:`CatBuffer`) states, as an int32
+    scalar that lives INSIDE the compiled graph — the form of
+    ``Metric.dropped_count`` (which returns ``None`` under trace) that
+    jitted/``shard_map`` users can actually consume. Under ``axis_name`` it
+    is ``psum``-med, so every shard sees the global count. Always callable;
+    returns 0 for metrics with no ring states.
+    """
 
     init: Callable[[], Dict[str, Any]]
     update: Callable[..., Dict[str, Any]]
     compute: Callable[[Dict[str, Any]], Any]
     merge: Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]
+    dropped: Callable[[Dict[str, Any]], Any] = None
+
+
+def _dropped_in_state(state: Dict[str, Any], independent: bool = False) -> Any:
+    """Rows dropped across one metric's ring states — the same rule as
+    ``Metric.dropped_count``: max for lockstep-paired rings (preds/target
+    drop the same samples), sum when the metric declares
+    ``_independent_ring_drops`` (FID/KID real vs fake)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+    total = jnp.zeros((), jnp.int32)
+    for v in state.values():
+        if isinstance(v, CatBuffer) and v.dropped is not None:
+            d = jnp.asarray(v.dropped, jnp.int32)
+            total = total + d if independent else jnp.maximum(total, d)
+    return total
+
+
+def _psum_if(axis_name: Optional[str], value: Any) -> Any:
+    return jax.lax.psum(value, axis_name) if axis_name is not None else value
 
 
 def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDef:
@@ -124,7 +155,10 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
             )
         return _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, type(metric).__name__)
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+    def dropped(state: Dict[str, Any]) -> Any:
+        return _psum_if(axis_name, _dropped_in_state(state, metric._independent_ring_drops))
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
 
 
 def bootstrap_functionalize(
@@ -195,7 +229,11 @@ def bootstrap_functionalize(
     def merge(state_a: Dict[str, Any], state_b: Dict[str, Any], **counts: Any) -> Dict[str, Any]:
         return jax.vmap(lambda a, b: mdef.merge(a, b, **counts))(state_a, state_b)
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+    def dropped(state: Dict[str, Any]) -> Any:
+        # replicas resample the same batch volume; report the worst replica
+        return jax.vmap(mdef.dropped)(state).max()
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
 
 
 def _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, owner_name):
@@ -328,7 +366,15 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
             for m, a, b in zip(metrics, states_a, states_b)
         ]
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+    def dropped(states):
+        import jax.numpy as jnp
+
+        total = jnp.zeros((), jnp.int32)
+        for m, s in zip(metrics, states):  # distinct metrics drop independently
+            total = total + _dropped_in_state(s, m._independent_ring_drops)
+        return _psum_if(axis_name, total)
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
 
 
 def _functionalize_collection(collection: "MetricCollection", axis_name: Optional[str] = None) -> MetricDef:
@@ -369,4 +415,19 @@ def _functionalize_collection(collection: "MetricCollection", axis_name: Optiona
     def merge(state_a: Dict[str, Any], state_b: Dict[str, Any], **counts: Any) -> Dict[str, Any]:
         return {name: mdefs[name].merge(state_a[name], state_b[name], **counts) for name, _ in members}
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge)
+    def dropped(state: Dict[str, Any]) -> Any:
+        import jax.numpy as jnp
+
+        # count straight off the state (not via member defs: wrapper members
+        # were built WITH axis_name and would psum a second time)
+        total = jnp.zeros((), jnp.int32)
+        for name, m in members:
+            s = state[name]
+            if name in wrapper_names:  # list of per-node state dicts
+                for node, node_state in zip(_collect_metrics(m), s):
+                    total = total + _dropped_in_state(node_state, node._independent_ring_drops)
+            else:
+                total = total + _dropped_in_state(s, m._independent_ring_drops)
+        return _psum_if(axis_name, total)
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)

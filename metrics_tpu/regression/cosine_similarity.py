@@ -4,6 +4,7 @@
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.cosine_similarity import (
     _cosine_similarity_compute,
@@ -11,6 +12,7 @@ from metrics_tpu.functional.regression.cosine_similarity import (
 )
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 
 Array = jax.Array
 
@@ -19,11 +21,31 @@ class CosineSimilarity(Metric):
     """Cosine similarity over accumulated rows (reference
     ``cosine_similarity.py:22-77``).
 
+    Two accumulation modes:
+
+    - default: raw preds/target rows accumulate in ``cat`` list states (the
+      reference's pattern, ``cosine_similarity.py:40-41``).
+    - ``capacity=N``: static-shape, fully jittable/shardable state. For
+      ``reduction='sum'|'mean'`` the state is a **moment sum** — per-row
+      similarities fold into two scalar ``sum`` states, which is EXACT for
+      any number of samples (nothing is dropped; ``capacity`` only bounds
+      the ``'none'``/``None`` per-row output, which uses a
+      :class:`CatBuffer` of per-row similarities and drops past capacity
+      with an observable ``dropped`` counter). In ``'none'`` mode compute
+      returns the full ``(capacity,)`` buffer with **NaN** padding at
+      unfilled slots — static shapes cannot carry the true row count, and
+      NaN makes accidental reductions over padding loud. Use eager mode
+      (no ``capacity``) for the reference's exact ``(N,)`` output.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import CosineSimilarity
         >>> metric = CosineSimilarity(reduction='mean')
         >>> round(float(metric(jnp.asarray([[1.0, 2.0, 3.0]]), jnp.asarray([[2.0, 4.0, 6.0]]))), 4)
+        1.0
+        >>> streaming = CosineSimilarity(reduction='mean', capacity=8)
+        >>> streaming.update(jnp.asarray([[1.0, 2.0, 3.0]]), jnp.asarray([[2.0, 4.0, 6.0]]))
+        >>> round(float(streaming.compute()), 4)
         1.0
     """
 
@@ -31,21 +53,66 @@ class CosineSimilarity(Metric):
     higher_is_better = True
     full_state_update = False
 
-    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+    def __init__(
+        self, reduction: Optional[str] = "sum", capacity: Optional[int] = None, **kwargs: Any
+    ) -> None:
         super().__init__(**kwargs)
         allowed_reduction = ("sum", "mean", "none", None)
         if reduction not in allowed_reduction:
             raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
         self.reduction = reduction
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.capacity = capacity
+        if capacity is not None:
+            if reduction in ("sum", "mean"):
+                self.add_state("sum_sim", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.add_state("n_total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            else:
+                self.add_state(
+                    "sims", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat"
+                )
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Array, target: Array) -> None:
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """``valid`` (bool ``(N,)``) is accepted in capacity mode only — the
+        ragged-SPMD-batch contract shared with the CatBuffer metrics."""
         preds, target = _cosine_similarity_update(preds, target)
+        if self.capacity is not None:
+            sims = _cosine_similarity_compute(preds, target, "none")
+            if valid is not None:
+                # zero-padded invalid rows give 0/0 = NaN similarities;
+                # select them out BEFORE weighting (NaN * 0 is NaN, so a
+                # multiplicative mask would poison the sums)
+                sims = jnp.where(jnp.asarray(valid, bool), sims, 0.0)
+            if self.reduction in ("sum", "mean"):
+                if valid is None:
+                    self.sum_sim += sims.sum()
+                    self.n_total += jnp.asarray(sims.shape[0], jnp.float32)
+                else:
+                    self.sum_sim += sims.sum()
+                    self.n_total += jnp.asarray(valid, jnp.float32).sum()
+            else:
+                self.sims = cat_append(self.sims, sims, valid)
+            return
+        reject_valid_kwarg(valid)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
+        if self.capacity is not None:
+            if self.reduction == "sum":
+                return self.sum_sim
+            if self.reduction == "mean":
+                return self.sum_sim / self.n_total
+            # 'none': the static-shape contract is uniform across eager,
+            # auto-jit and functionalize — the full (capacity,) buffer with
+            # NaN padding at unfilled slots. NaN is unambiguous (a genuine
+            # cosine similarity is never NaN here) and makes accidental
+            # reductions over padding loud. Exact (N,) row output = eager
+            # mode (no capacity); the raw rows remain reachable via
+            # `metric._state['sims'].values()`.
+            return jnp.where(self.sims.mask, self.sims.data, jnp.nan)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _cosine_similarity_compute(preds, target, self.reduction)

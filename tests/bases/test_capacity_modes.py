@@ -1,0 +1,322 @@
+"""Exact-vs-capacity equivalence for the round-5 static-shape modes:
+CalibrationError (binned counters), CosineSimilarity (moment sums / sim
+ring), AUC (x/y ring), FID and KID (feature rings).
+
+Every test drives the SAME data through the reference-shaped eager mode and
+the static-shape mode and asserts agreement — at random fill levels, under
+overflow where dropping is the documented semantic, and through
+``functionalize`` + ``jit``.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.pure import functionalize
+
+rng = np.random.default_rng(42)
+
+
+# ---------------------------------------------------------------- calibration
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("n_per_batch", [7, 33])
+def test_calibration_binned_equals_list(norm, n_per_batch):
+    exact = mt.CalibrationError(n_bins=10, norm=norm)
+    binned = mt.CalibrationError(n_bins=10, norm=norm, binned=True)
+    for _ in range(3):
+        conf = rng.random(n_per_batch).astype(np.float32)
+        target = rng.integers(0, 2, n_per_batch)
+        exact.update(jnp.asarray(conf), jnp.asarray(target))
+        binned.update(jnp.asarray(conf), jnp.asarray(target))
+    np.testing.assert_allclose(float(exact.compute()), float(binned.compute()), atol=1e-6)
+
+
+def test_calibration_binned_multiclass_and_valid_mask():
+    probs = rng.random((20, 5)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    labels = rng.integers(0, 5, 20)
+    valid = rng.random(20) > 0.3
+
+    exact = mt.CalibrationError(n_bins=8)
+    exact.update(jnp.asarray(probs[valid]), jnp.asarray(labels[valid]))
+    binned = mt.CalibrationError(n_bins=8, binned=True)
+    binned.update(jnp.asarray(probs), jnp.asarray(labels), valid=jnp.asarray(valid))
+    np.testing.assert_allclose(float(exact.compute()), float(binned.compute()), atol=1e-6)
+
+
+def test_calibration_binned_functionalize_jit():
+    mdef = functionalize(mt.CalibrationError(n_bins=6, binned=True))
+    state = mdef.init()
+    conf = jnp.asarray(rng.random(16).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 2, 16))
+    state = jax.jit(mdef.update)(state, conf, target)
+    got = jax.jit(mdef.compute)(state)
+
+    eager = mt.CalibrationError(n_bins=6)
+    eager.update(conf, target)
+    np.testing.assert_allclose(float(got), float(eager.compute()), atol=1e-6)
+
+
+# ------------------------------------------------------------------- cosine
+@pytest.mark.parametrize("reduction", ["sum", "mean"])
+def test_cosine_moment_mode_exact_at_any_volume(reduction):
+    """sum/mean capacity mode is moment sums — exact regardless of volume
+    (capacity does not bound it)."""
+    exact = mt.CosineSimilarity(reduction=reduction)
+    cap = mt.CosineSimilarity(reduction=reduction, capacity=4)  # tiny; irrelevant
+    for _ in range(5):
+        a = rng.standard_normal((11, 6)).astype(np.float32)
+        b = rng.standard_normal((11, 6)).astype(np.float32)
+        exact.update(jnp.asarray(a), jnp.asarray(b))
+        cap.update(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(float(exact.compute()), float(cap.compute()), rtol=1e-5)
+
+
+def test_cosine_none_ring_matches_prefix_and_counts_drops():
+    exact = mt.CosineSimilarity(reduction="none")
+    ring = mt.CosineSimilarity(reduction="none", capacity=16, on_overflow="ignore")
+    batches = [
+        (rng.standard_normal((10, 4)).astype(np.float32), rng.standard_normal((10, 4)).astype(np.float32))
+        for _ in range(3)
+    ]
+    for a, b in batches:
+        exact.update(jnp.asarray(a), jnp.asarray(b))
+        ring.update(jnp.asarray(a), jnp.asarray(b))
+    dense = np.asarray(exact.compute())
+    buf = ring._state["sims"]
+    np.testing.assert_allclose(np.asarray(buf.values()), dense[:16], rtol=1e-5)
+    assert int(buf.dropped) == 30 - 16
+
+
+def test_cosine_masked_zero_rows_do_not_poison_sums():
+    """Zero-padded invalid rows have 0/0 = NaN similarity; the valid mask
+    must select them out BEFORE weighting (NaN * 0 is NaN) — and that must
+    hold in the EAGER path too, not just after XLA simplification."""
+    p = np.zeros((2, 3), np.float32)
+    p[0] = [1, 2, 3]
+    t = np.zeros((2, 3), np.float32)
+    t[0] = [2, 4, 6]
+    m = mt.CosineSimilarity(reduction="mean", capacity=8)
+    # _original_update = the raw eager body, bypassing the auto-jit wrapper
+    m._original_update(jnp.asarray(p), jnp.asarray(t), valid=jnp.asarray([True, False]))
+    object.__setattr__(m, "_update_called", True)
+    v = float(m.compute())
+    assert not np.isnan(v) and abs(v - 1.0) < 1e-6
+
+    # 'none' capacity contract: (capacity,) with NaN padding, uniformly
+    m2 = mt.CosineSimilarity(reduction="none", capacity=4)
+    m2.update(jnp.asarray(p[:1]), jnp.asarray(t[:1]))
+    out = np.asarray(m2.compute())
+    assert out.shape == (4,) and np.isnan(out[1:]).all() and abs(out[0] - 1.0) < 1e-6
+
+
+def test_cosine_valid_mask_and_functionalize():
+    a = rng.standard_normal((12, 5)).astype(np.float32)
+    b = rng.standard_normal((12, 5)).astype(np.float32)
+    valid = rng.random(12) > 0.4
+
+    exact = mt.CosineSimilarity(reduction="mean")
+    exact.update(jnp.asarray(a[valid]), jnp.asarray(b[valid]))
+
+    mdef = functionalize(mt.CosineSimilarity(reduction="mean", capacity=8))
+    state = mdef.init()
+    state = jax.jit(mdef.update)(state, jnp.asarray(a), jnp.asarray(b), valid=jnp.asarray(valid))
+    np.testing.assert_allclose(float(jax.jit(mdef.compute)(state)), float(exact.compute()), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------- auc
+@pytest.mark.parametrize("reorder", [True, False])
+def test_auc_capacity_matches_exact(reorder):
+    xs = np.sort(rng.random(24).astype(np.float32)) if not reorder else rng.random(24).astype(np.float32)
+    ys = rng.random(24).astype(np.float32)
+
+    exact = mt.AUC(reorder=reorder)
+    ring = mt.AUC(reorder=reorder, capacity=32)
+    for lo in range(0, 24, 8):
+        exact.update(jnp.asarray(xs[lo : lo + 8]), jnp.asarray(ys[lo : lo + 8]))
+        ring.update(jnp.asarray(xs[lo : lo + 8]), jnp.asarray(ys[lo : lo + 8]))
+    np.testing.assert_allclose(float(exact.compute()), float(ring.compute()), rtol=1e-5)
+
+
+def test_auc_capacity_drop_semantics_and_functionalize():
+    xs = rng.random(20).astype(np.float32)
+    ys = rng.random(20).astype(np.float32)
+    # ring keeps the first 12 points only
+    exact = mt.AUC(reorder=True)
+    exact.update(jnp.asarray(xs[:12]), jnp.asarray(ys[:12]))
+
+    mdef = functionalize(mt.AUC(reorder=True, capacity=12, on_overflow="ignore"))
+    state = mdef.init()
+    state = jax.jit(mdef.update)(state, jnp.asarray(xs), jnp.asarray(ys))
+    np.testing.assert_allclose(float(jax.jit(mdef.compute)(state)), float(exact.compute()), rtol=1e-5)
+    assert int(state["x"].dropped) == 8
+
+
+# ---------------------------------------------------------------------- fid
+def test_fid_capacity_matches_exact():
+    d = 12
+    real = rng.standard_normal((40, d)).astype(np.float32)
+    fake = (rng.standard_normal((40, d)) + 0.5).astype(np.float32)
+
+    exact = mt.FrechetInceptionDistance(feature=d)
+    ring = mt.FrechetInceptionDistance(feature=d, capacity=64)
+    for lo in range(0, 40, 20):
+        exact.update(jnp.asarray(real[lo : lo + 20]), real=True)
+        exact.update(jnp.asarray(fake[lo : lo + 20]), real=False)
+        ring.update(jnp.asarray(real[lo : lo + 20]), real=True)
+        ring.update(jnp.asarray(fake[lo : lo + 20]), real=False)
+    np.testing.assert_allclose(float(exact.compute()), float(ring.compute()), rtol=1e-3, atol=1e-4)
+
+
+def test_fid_capacity_traced_real_flag_and_jit():
+    """``real`` routes via the append mask — traceable as a jit argument."""
+    d = 8
+    feats = rng.standard_normal((30, d)).astype(np.float32)
+
+    mdef = functionalize(mt.FrechetInceptionDistance(feature=d, capacity=32))
+    state = mdef.init()
+    update = jax.jit(mdef.update)
+    state = update(state, jnp.asarray(feats[:15]), jnp.asarray(True))
+    state = update(state, jnp.asarray(feats[15:]), jnp.asarray(False))
+    got = float(jax.jit(mdef.compute)(state))
+
+    exact = mt.FrechetInceptionDistance(feature=d)
+    exact.update(jnp.asarray(feats[:15]), real=True)
+    exact.update(jnp.asarray(feats[15:]), real=False)
+    np.testing.assert_allclose(got, float(exact.compute()), rtol=1e-3, atol=1e-4)
+
+
+def test_fid_capacity_with_extractor():
+    from metrics_tpu.image.extractor import TinyImageEncoder
+
+    enc = TinyImageEncoder(feature_dim=16)
+    exact = mt.FrechetInceptionDistance(feature=enc)
+    ring = mt.FrechetInceptionDistance(feature=enc, capacity=32)
+    imgs_r = (rng.random((10, 3, 32, 32)) * 255).astype(np.uint8)
+    imgs_f = (rng.random((10, 3, 32, 32)) * 255).astype(np.uint8)
+    for m in (exact, ring):
+        m.update(jnp.asarray(imgs_r), real=True)
+        m.update(jnp.asarray(imgs_f), real=False)
+    np.testing.assert_allclose(float(exact.compute()), float(ring.compute()), rtol=1e-3, atol=1e-4)
+
+
+def test_fid_capacity_requires_feature_dim():
+    with pytest.raises(ValueError, match="feature_dim"):
+        mt.FrechetInceptionDistance(feature=lambda x: x, capacity=8)
+
+
+# ---------------------------------------------------------------------- kid
+def test_kid_capacity_full_subset_equals_exact():
+    """With subset_size == n every subset is the whole set (MMD is
+    permutation-invariant), so capacity mode must equal the exact mode."""
+    d, n = 10, 24
+    real = rng.standard_normal((n, d)).astype(np.float32)
+    fake = (rng.standard_normal((n, d)) + 0.3).astype(np.float32)
+
+    exact = mt.KernelInceptionDistance(feature=d, subsets=4, subset_size=n)
+    ring = mt.KernelInceptionDistance(feature=d, subsets=4, subset_size=n, capacity=n)
+    for m in (exact, ring):
+        m.update(jnp.asarray(real), real=True)
+        m.update(jnp.asarray(fake), real=False)
+    e_mean, e_std = exact.compute()
+    r_mean, r_std = ring.compute()
+    np.testing.assert_allclose(float(e_mean), float(r_mean), rtol=1e-4)
+    np.testing.assert_allclose(float(e_std), 0.0, atol=1e-6)
+    np.testing.assert_allclose(float(r_std), 0.0, atol=1e-6)
+
+
+def test_kid_capacity_subsets_sane_and_jittable():
+    d, n = 6, 40
+    feats = rng.standard_normal((n, d)).astype(np.float32)
+
+    mdef = functionalize(mt.KernelInceptionDistance(feature=d, subsets=8, subset_size=10, capacity=n))
+    state = mdef.init()
+    update = jax.jit(mdef.update)
+    state = update(state, jnp.asarray(feats), jnp.asarray(True))
+    state = update(state, jnp.asarray(feats + 0.01), jnp.asarray(False))
+    mean, std = jax.jit(mdef.compute)(state)
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+    # discriminativity: a clearly shifted fake distribution scores higher
+    far_state = mdef.init()
+    far_state = update(far_state, jnp.asarray(feats), jnp.asarray(True))
+    far_state = update(far_state, jnp.asarray(feats + 2.0), jnp.asarray(False))
+    far_mean, _ = jax.jit(mdef.compute)(far_state)
+    assert float(far_mean) > float(mean)
+
+
+def test_kid_capacity_validates_capacity_vs_subset_size():
+    with pytest.raises(ValueError, match="capacity"):
+        mt.KernelInceptionDistance(feature=4, subset_size=16, capacity=8)
+
+
+# ------------------------------------------------------- traced overflow sig
+def test_metricdef_dropped_traced_scalar():
+    """MetricDef.dropped is the in-graph form of Metric.dropped_count (which
+    is None under trace): an int32 scalar consumable inside jit."""
+    mdef = functionalize(mt.AUROC(capacity=8, on_overflow="ignore"))
+
+    @jax.jit
+    def step(state, p, t):
+        state = mdef.update(state, p, t)
+        return state, mdef.dropped(state)
+
+    state = mdef.init()
+    p = jnp.asarray(rng.random(6).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 2, 6))
+    state, d0 = step(state, p, t)
+    assert int(d0) == 0
+    state, d1 = step(state, p, t)  # 12 rows into capacity 8
+    assert int(d1) == 4
+
+    # a metric with no ring states reports 0
+    plain = functionalize(mt.Accuracy(num_classes=3))
+    assert int(plain.dropped(plain.init())) == 0
+
+
+def test_fid_dropped_sums_independent_rings():
+    """FID's real/fake rings overflow separately — the overflow signal sums
+    them (paired preds/target rings max instead)."""
+    d = 4
+    m = mt.FrechetInceptionDistance(feature=d, capacity=8, on_overflow="ignore")
+    m.update(jnp.asarray(rng.standard_normal((12, d)).astype(np.float32)), real=True)   # 4 dropped
+    m.update(jnp.asarray(rng.standard_normal((20, d)).astype(np.float32)), real=False)  # 12 dropped
+    assert m.dropped_count == 16
+
+    mdef = functionalize(mt.FrechetInceptionDistance(feature=d, capacity=8, on_overflow="ignore"))
+    state = mdef.init()
+    state = mdef.update(state, jnp.asarray(rng.standard_normal((12, d)).astype(np.float32)), True)
+    state = mdef.update(state, jnp.asarray(rng.standard_normal((20, d)).astype(np.float32)), False)
+    assert int(jax.jit(mdef.dropped)(state)) == 16
+
+
+def test_metricdef_dropped_collection_and_shard_map():
+    """Collection dropped() sums members and psums once across the mesh —
+    every shard sees the same global count."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    coll = mt.MetricCollection(
+        {
+            "auroc": mt.AUROC(capacity=4, on_overflow="ignore"),
+            "acc": mt.Accuracy(),
+        }
+    )
+    mdef = functionalize(coll, axis_name="data")
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+
+    per_dev = 6  # 6 rows into capacity 4 -> 2 dropped per shard
+    preds = rng.random((n_dev * per_dev,)).astype(np.float32)
+    target = rng.integers(0, 2, n_dev * per_dev)
+
+    def shard_fn(p, t):
+        state = mdef.init()
+        state = mdef.update(state, p, t)
+        return mdef.dropped(state)
+
+    dropped = jax.jit(
+        shard_map(shard_fn, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P())
+    )(jnp.asarray(preds), jnp.asarray(target))
+    assert int(dropped) == 2 * n_dev
