@@ -106,6 +106,29 @@ def batched_box_iou(det_boxes: Array, gt_boxes: Array) -> Array:
     return jax.vmap(box_iou)(det_boxes, gt_boxes)
 
 
+@jax.jit
+def batched_mask_iou(det_masks: Array, gt_masks: Array) -> Array:
+    """``(N, D, HW)`` × ``(N, G, HW)`` flattened binary masks →
+    ``(N, D, G)`` per-cell mask IoU, on device.
+
+    The intersection is one batched GEMM (``einsum`` over the flattened
+    pixel axis — MXU work on TPU), unions come from the same row sums, and
+    zero padding is free: padded pixels and padded rows contribute nothing
+    to either, and all-zero pads hit the ``union > 0`` guard. Mixed
+    resolutions batch together by flatten-padding each cell's masks to the
+    common ``HW`` cap. Replaces the reference's pycocotools C mask routines
+    (``src/torchmetrics/detection/mean_ap.py:127-140``) with device math
+    (SURVEY.md §2.9).
+
+    Counts are exact in float32 for masks up to 2^24 pixels.
+    """
+    d = det_masks.astype(jnp.float32)
+    g = gt_masks.astype(jnp.float32)
+    inter = jnp.einsum("ndh,ngh->ndg", d, g)
+    union = d.sum(-1)[:, :, None] + g.sum(-1)[:, None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1.0), 0.0)
+
+
 def next_pow2(n: int) -> int:
     """Smallest power of two ≥ max(n, 1) — pad caps to bounded shapes so the
     jitted matcher compiles O(log) times across evaluations, not per eval."""

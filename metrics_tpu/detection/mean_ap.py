@@ -13,9 +13,11 @@ canonicalization and the final precision/recall accumulation stay on the
 host.
 
 Improvements over the reference: ``iou_type="segm"`` needs no pycocotools —
-mask IoU is a dense intersection matmul over flattened masks — and matching
-cost is O(max dets per cell) compiled scan steps instead of O(total
-detections) interpreter iterations.
+mask IoU runs on device as one batched GEMM over flatten-padded masks
+(``matcher.batched_mask_iou``; mixed resolutions pad to a per-bucket pixel
+cap under a device-memory budget) — and matching cost is O(max dets per
+cell) compiled scan steps instead of O(total detections) interpreter
+iterations.
 """
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -61,15 +63,6 @@ def _fix_empty_boxes(boxes: np.ndarray) -> np.ndarray:
     if boxes.size == 0:
         return boxes.reshape(0, 4).astype(np.float32)
     return boxes
-
-
-def _mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
-    """Pairwise mask IoU ``(D, G)`` from dense ``(N, H, W)`` bool masks."""
-    d = det.reshape(det.shape[0], -1).astype(np.float32)
-    g = gt.reshape(gt.shape[0], -1).astype(np.float32)
-    inter = d @ g.T
-    union = d.sum(1)[:, None] + g.sum(1)[None, :] - inter
-    return np.where(union > 0, inter / np.maximum(union, 1), 0.0)
 
 
 class MeanAveragePrecision(Metric):
@@ -207,6 +200,8 @@ class MeanAveragePrecision(Metric):
     # 1024 cells × 128 dets × G_cap IoUs) while amortizing one compilation
     # across all chunks of an evaluation
     _MATCH_CHUNK = 1024
+    # padded (det + gt) flattened-mask bytes allowed per segm matcher batch
+    _MASK_BYTES_BUDGET = 1 << 28  # 256 MB
 
     def _match_all_cells(self, cells: List[Dict[str, np.ndarray]], area_ranges: np.ndarray) -> None:
         """Run the device matcher over every cell, attaching per-cell
@@ -218,7 +213,12 @@ class MeanAveragePrecision(Metric):
         128-cap batch would pay 128 sequential steps for 6 rows of work.
         Bucketing keeps total scan work proportional to the real detection
         count while bounding distinct compiled shapes to O(log max_det)."""
-        from metrics_tpu.detection.matcher import batched_box_iou, match_cells, next_pow2
+        from metrics_tpu.detection.matcher import (
+            batched_box_iou,
+            batched_mask_iou,
+            match_cells,
+            next_pow2,
+        )
 
         nb_areas = area_ranges.shape[0]
         thrs = jnp.asarray(self.iou_thresholds, jnp.float32)
@@ -239,6 +239,22 @@ class MeanAveragePrecision(Metric):
         for d_cap, idxs in sorted(buckets.items()):
             g_cap = next_pow2(max(cells[j]["gt"].shape[0] for j in idxs))
             chunk = min(self._MATCH_CHUNK, next_pow2(len(idxs)))
+            if self.iou_type == "segm":
+                # one flattened-pixel cap per bucket (compile caching), and a
+                # batch size bounded so the padded mask tensors stay within
+                # the device-memory budget
+                hw_cap = next_pow2(
+                    max(
+                        int(np.prod(c.shape[1:]))
+                        for j in idxs
+                        for c in (cells[j]["det"], cells[j]["gt"])
+                        if c.shape[0]
+                    )
+                    if any(cells[j]["det"].shape[0] or cells[j]["gt"].shape[0] for j in idxs)
+                    else 1
+                )
+                per_cell_bytes = (d_cap + g_cap) * hw_cap * 4
+                chunk = min(chunk, max(1, next_pow2(self._MASK_BYTES_BUDGET // per_cell_bytes + 1) // 2))
             for start in range(0, len(idxs), chunk):
                 batch = idxs[start : start + chunk]
                 det_valid = np.zeros((chunk, d_cap), bool)
@@ -248,7 +264,8 @@ class MeanAveragePrecision(Metric):
                     det_boxes = np.zeros((chunk, d_cap, 4), np.float32)
                     gt_boxes = np.zeros((chunk, g_cap, 4), np.float32)
                 else:
-                    ious = np.zeros((chunk, d_cap, g_cap), np.float32)
+                    det_masks = np.zeros((chunk, d_cap, hw_cap), np.uint8)
+                    gt_masks = np.zeros((chunk, g_cap, hw_cap), np.uint8)
                 for k, j in enumerate(batch):
                     cell = cells[j]
                     nd, ng = cell["scores"].shape[0], cell["gt"].shape[0]
@@ -259,12 +276,21 @@ class MeanAveragePrecision(Metric):
                     if self.iou_type == "bbox":
                         det_boxes[k, :nd] = cell["det"]
                         gt_boxes[k, :ng] = cell["gt"]
-                    elif nd and ng:
-                        ious[k, :nd, :ng] = _mask_iou(cell["det"], cell["gt"])
+                    else:
+                        # flatten-pad: each cell fills its own H*W prefix;
+                        # zero pixels are IoU-neutral (see batched_mask_iou)
+                        if nd:
+                            det_masks[k, :nd, : int(np.prod(cell["det"].shape[1:]))] = cell[
+                                "det"
+                            ].reshape(nd, -1)
+                        if ng:
+                            gt_masks[k, :ng, : int(np.prod(cell["gt"].shape[1:]))] = cell[
+                                "gt"
+                            ].reshape(ng, -1)
                 if self.iou_type == "bbox":
                     ious_dev = batched_box_iou(jnp.asarray(det_boxes), jnp.asarray(gt_boxes))
                 else:
-                    ious_dev = jnp.asarray(ious)
+                    ious_dev = batched_mask_iou(jnp.asarray(det_masks), jnp.asarray(gt_masks))
                 m, ig = match_cells(
                     ious_dev, jnp.asarray(det_valid), jnp.asarray(gt_valid), jnp.asarray(gt_ig), thrs
                 )
