@@ -121,6 +121,7 @@ class Metric:
         self._update_count = 0
         self._update_called = False
         self._computed: Any = None
+        self._forward_cache: Any = None
         self._is_synced = False
         self._cache: Optional[Dict[str, Any]] = None
         self._to_sync = True
@@ -383,10 +384,15 @@ class Metric:
         return self.forward(*args, **kwargs)
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
-        """Accumulate into global state AND return the batch-local value."""
+        """Accumulate into global state AND return the batch-local value.
+        The batch value is kept in ``_forward_cache`` (reference
+        ``metric.py:238``; Lightning reads it) until the next ``reset``."""
         if self.full_state_update or self.dist_sync_on_step:
-            return self._forward_full_state_update(*args, **kwargs)
-        return self._forward_reduce_state_update(*args, **kwargs)
+            batch_val = self._forward_full_state_update(*args, **kwargs)
+        else:
+            batch_val = self._forward_reduce_state_update(*args, **kwargs)
+        self._forward_cache = batch_val
+        return batch_val
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Two update calls; batch value from a fresh state (reference ``metric.py:241-280``).
@@ -670,6 +676,7 @@ class Metric:
         self._update_count = 0
         self._update_called = False
         self._computed = None
+        self._forward_cache = None
         self._restore_defaults()
         self._cache = None
         self._is_synced = False
