@@ -64,3 +64,212 @@ def test_differential_fuzz_vs_reference(seed):
             ml_t = (rng.random((n, c)) < 0.4).astype(np.int64)
             cmp("ml_accuracy", F.accuracy(jnp.asarray(ml_p), jnp.asarray(ml_t)), RF.accuracy(torch.from_numpy(ml_p), torch.from_numpy(ml_t)))
             cmp("ml_hamming", F.hamming_distance(jnp.asarray(ml_p), jnp.asarray(ml_t)), RF.hamming_distance(torch.from_numpy(ml_p), torch.from_numpy(ml_t)))
+
+
+@pytest.mark.parametrize("seed", [7, 41, 83])
+def test_differential_fuzz_regression_pairwise(seed):
+    """Random-shape regression + pairwise kernels vs the reference
+    (VERDICT r4 #6: fuzz beyond classification)."""
+    RF = import_reference().functional
+    torch = _torch()
+    rng = np.random.default_rng(seed)
+
+    def cmp(name, ours, theirs, atol=1e-4):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), atol=atol, equal_nan=True, err_msg=name)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(3):
+            n, d = int(rng.integers(4, 50)), int(rng.integers(2, 6))
+            x = rng.standard_normal((n, d)).astype(np.float32)
+            y = (x + 0.3 * rng.standard_normal((n, d))).astype(np.float32)
+            jx, jy = jnp.asarray(x), jnp.asarray(y)
+            tx, ty = torch.from_numpy(x), torch.from_numpy(y)
+            cmp("mse", F.mean_squared_error(jx, jy), RF.mean_squared_error(tx, ty))
+            cmp("mae", F.mean_absolute_error(jx, jy), RF.mean_absolute_error(tx, ty))
+            cmp("cosine_mean", F.cosine_similarity(jx, jy, "mean"), RF.cosine_similarity(tx, ty, "mean"))
+            cmp("r2", F.r2_score(jx.reshape(-1), jy.reshape(-1)), RF.r2_score(tx.reshape(-1), ty.reshape(-1)))
+            cmp(
+                "explained_variance_multi",
+                F.explained_variance(jx, jy, multioutput="raw_values"),
+                RF.explained_variance(tx, ty, multioutput="raw_values"),
+            )
+
+            pos_x = np.abs(x.reshape(-1)) + 0.1
+            pos_y = np.abs(y.reshape(-1)) + 0.1
+            cmp(
+                "msle",
+                F.mean_squared_log_error(jnp.asarray(pos_x), jnp.asarray(pos_y)),
+                RF.mean_squared_log_error(torch.from_numpy(pos_x), torch.from_numpy(pos_y)),
+            )
+            cmp(
+                "mape",
+                F.mean_absolute_percentage_error(jnp.asarray(pos_x), jnp.asarray(pos_y)),
+                RF.mean_absolute_percentage_error(torch.from_numpy(pos_x), torch.from_numpy(pos_y)),
+            )
+            cmp(
+                "smape",
+                F.symmetric_mean_absolute_percentage_error(jnp.asarray(pos_x), jnp.asarray(pos_y)),
+                RF.symmetric_mean_absolute_percentage_error(torch.from_numpy(pos_x), torch.from_numpy(pos_y)),
+            )
+            cmp(
+                "wmape",
+                F.weighted_mean_absolute_percentage_error(jnp.asarray(pos_x), jnp.asarray(pos_y)),
+                RF.weighted_mean_absolute_percentage_error(torch.from_numpy(pos_x), torch.from_numpy(pos_y)),
+            )
+            cmp(
+                "tweedie",
+                F.tweedie_deviance_score(jnp.asarray(pos_x), jnp.asarray(pos_y), power=1.5),
+                RF.tweedie_deviance_score(torch.from_numpy(pos_x), torch.from_numpy(pos_y), power=1.5),
+            )
+
+            m = int(rng.integers(2, 8))
+            b = rng.standard_normal((m, d)).astype(np.float32)
+            jb, tb = jnp.asarray(b), torch.from_numpy(b)
+            # the reference's v0.10 pairwise_cosine_similarity MUTATES its
+            # inputs in place (`x /= norm` in
+            # functional/pairwise/cosine.py) — and torch.from_numpy + CPU
+            # jnp.asarray both alias the same numpy buffer, so it must get
+            # private copies or it corrupts every later comparison. (Found
+            # by this fuzz test; the jax side is immutable by construction.)
+            cmp(
+                "pw_cosine",
+                F.pairwise_cosine_similarity(jx, jb),
+                RF.pairwise_cosine_similarity(torch.from_numpy(x.copy()), torch.from_numpy(b.copy())),
+            )
+            cmp("pw_euclid", F.pairwise_euclidean_distance(jx, jb), RF.pairwise_euclidean_distance(tx, tb), atol=1e-3)
+            cmp("pw_linear", F.pairwise_linear_similarity(jx, jb), RF.pairwise_linear_similarity(tx, tb), atol=1e-3)
+            cmp("pw_manhattan", F.pairwise_manhattan_distance(jx, jb), RF.pairwise_manhattan_distance(tx, tb), atol=1e-3)
+
+
+@pytest.mark.parametrize("seed", [13, 59])
+def test_differential_fuzz_aggregation_modules(seed):
+    """Random data + NaN injection through the aggregation modules vs the
+    reference's (module-level: the reference has no functional analogue)."""
+    ref = import_reference()
+    torch = _torch()
+    import metrics_tpu as mt
+
+    rng = np.random.default_rng(seed)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for strategy in ("ignore", 0.0):
+            pairs = [
+                (mt.MeanMetric(nan_strategy=strategy), ref.MeanMetric(nan_strategy=strategy)),
+                (mt.SumMetric(nan_strategy=strategy), ref.SumMetric(nan_strategy=strategy)),
+                (mt.MaxMetric(nan_strategy=strategy), ref.MaxMetric(nan_strategy=strategy)),
+                (mt.MinMetric(nan_strategy=strategy), ref.MinMetric(nan_strategy=strategy)),
+                (mt.CatMetric(nan_strategy=strategy), ref.CatMetric(nan_strategy=strategy)),
+            ]
+            for _ in range(4):
+                batch = rng.standard_normal(int(rng.integers(3, 20))).astype(np.float32)
+                batch[rng.random(batch.shape[0]) < 0.2] = np.nan
+                for ours, theirs in pairs:
+                    ours.update(jnp.asarray(batch))
+                    theirs.update(torch.from_numpy(batch))
+            for ours, theirs in pairs:
+                np.testing.assert_allclose(
+                    np.asarray(ours.compute()).reshape(-1),
+                    np.asarray(theirs.compute()).reshape(-1),
+                    atol=1e-5,
+                    err_msg=f"{type(ours).__name__} nan={strategy}",
+                )
+
+
+@pytest.mark.parametrize("seed", [17, 71])
+def test_differential_fuzz_retrieval_ragged(seed):
+    """Random ragged query groups through the retrieval MODULES vs the
+    reference's — the grouping path (get_group_indexes vs the segment-sum
+    rewrite), not just the per-query kernels."""
+    ref = import_reference()
+    torch = _torch()
+    import metrics_tpu as mt
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(40, 120))
+    num_queries = int(rng.integers(3, 9))
+    indexes = rng.integers(0, num_queries, n)
+    preds = rng.random(n).astype(np.float32)
+    target = (rng.random(n) < 0.4).astype(np.int64)
+    # every query gets at least one positive so empty_target_action never fires
+    for q in range(num_queries):
+        sel = np.where(indexes == q)[0]
+        if sel.size and not target[sel].any():
+            target[sel[0]] = 1
+
+    ji, jp, jt = jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target)
+    ti, tp, tt = torch.from_numpy(indexes), torch.from_numpy(preds), torch.from_numpy(target)
+
+    cases = [
+        ("map", mt.RetrievalMAP(), ref.RetrievalMAP()),
+        ("mrr", mt.RetrievalMRR(), ref.RetrievalMRR()),
+        ("p@3", mt.RetrievalPrecision(k=3), ref.RetrievalPrecision(k=3)),
+        ("r@3", mt.RetrievalRecall(k=3), ref.RetrievalRecall(k=3)),
+        ("ndcg@5", mt.RetrievalNormalizedDCG(k=5), ref.RetrievalNormalizedDCG(k=5)),
+        ("hit@3", mt.RetrievalHitRate(k=3), ref.RetrievalHitRate(k=3)),
+        ("fallout@3", mt.RetrievalFallOut(k=3), ref.RetrievalFallOut(k=3)),
+        ("rprec", mt.RetrievalRPrecision(), ref.RetrievalRPrecision()),
+    ]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for name, ours, theirs in cases:
+            # split the stream into random batches to exercise accumulation
+            cut = int(rng.integers(1, n - 1))
+            ours.update(jp[:cut], jt[:cut], indexes=ji[:cut])
+            ours.update(jp[cut:], jt[cut:], indexes=ji[cut:])
+            theirs.update(tp[:cut], tt[:cut], indexes=ti[:cut])
+            theirs.update(tp[cut:], tt[cut:], indexes=ti[cut:])
+            np.testing.assert_allclose(
+                float(ours.compute()), float(theirs.compute()), atol=1e-5, err_msg=name
+            )
+
+
+@pytest.mark.parametrize("seed", [23, 67, 101])
+def test_fuzz_exact_vs_capacity_under_random_fill(seed):
+    """Exact (cat-list) vs capacity (CatBuffer) modes at random fill levels,
+    including overflow, where capacity-mode must equal exact-mode run on
+    the kept prefix (VERDICT r4 #6 tail)."""
+    import metrics_tpu as mt
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(10, 80))
+    cap = int(rng.integers(8, 100))
+    kept = min(n, cap)
+
+    preds = rng.random(n).astype(np.float32)
+    target = (rng.random(n) < 0.5).astype(np.int64)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for name, exact_ctor, cap_ctor in [
+            ("auroc", lambda: mt.AUROC(), lambda: mt.AUROC(capacity=cap, on_overflow="ignore")),
+            (
+                "avg_precision",
+                lambda: mt.AveragePrecision(),
+                lambda: mt.AveragePrecision(capacity=cap, on_overflow="ignore"),
+            ),
+            (
+                "spearman",
+                lambda: mt.SpearmanCorrCoef(),
+                lambda: mt.SpearmanCorrCoef(capacity=cap, on_overflow="ignore"),
+            ),
+            ("auc", lambda: mt.AUC(reorder=True), lambda: mt.AUC(reorder=True, capacity=cap, on_overflow="ignore")),
+        ]:
+            exact = exact_ctor()
+            ring = cap_ctor()
+            if name == "spearman":
+                second = (preds + 0.3 * rng.random(n)).astype(np.float32)
+                exact.update(jnp.asarray(preds[:kept]), jnp.asarray(second[:kept]))
+                ring.update(jnp.asarray(preds), jnp.asarray(second))
+            elif name == "auc":
+                ys = rng.random(n).astype(np.float32)
+                exact.update(jnp.asarray(preds[:kept]), jnp.asarray(ys[:kept]))
+                ring.update(jnp.asarray(preds), jnp.asarray(ys))
+            else:
+                exact.update(jnp.asarray(preds[:kept]), jnp.asarray(target[:kept]))
+                ring.update(jnp.asarray(preds), jnp.asarray(target))
+            np.testing.assert_allclose(
+                float(exact.compute()), float(ring.compute()), atol=1e-5, err_msg=f"{name} n={n} cap={cap}"
+            )
+            dropped = ring.dropped_count
+            assert dropped == max(0, n - cap), f"{name}: dropped {dropped}, expected {max(0, n - cap)}"
