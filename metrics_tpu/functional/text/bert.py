@@ -7,9 +7,11 @@ jittable XLA kernel (``_bert_score_from_embeddings``).
 
 Encoder contract (same as FID's injected extractor, ``image/fid.py``): the
 ``encoder`` callable maps a list of sentences to
-``(embeddings (N, L, D), attention_mask (N, L), input_ids (N, L))``; any HF
-flax/torch model with local weights wraps in a few lines. Alternatively pass
-precomputed dicts with those keys.
+``(embeddings (N, L, D), attention_mask (N, L), input_ids (N, L))``. The
+real-architecture path is :class:`metrics_tpu.nets.BertEncoder` — a flax
+BERT key-compatible with HF ``BertModel`` checkpoints
+(``BertEncoder(tokenizer, weights=hf_state_dict)`` gives published-scale
+scores). Alternatively pass precomputed dicts with those keys.
 
 When no encoder is given, a bundled :class:`HashTextEncoder` is used so the
 surface works out of the box — a deterministic CRC32-hash-vocab tokenizer

