@@ -2,9 +2,11 @@
 ``src/torchmetrics/image/inception.py``).
 
 Same feature-extractor contract as :class:`FrechetInceptionDistance`: pass a
-callable ``images -> (N, num_classes) logits`` or feed logits directly.
+callable ``images -> (N, num_classes) logits`` or feed logits directly
+(the real-architecture path is
+``metrics_tpu.nets.InceptionV3Extractor(feature="logits", weights=ckpt)``).
 """
-from typing import Any, Callable, Tuple, Union
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -12,13 +14,27 @@ import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 
 Array = jax.Array
 
 
 class InceptionScore(Metric):
     """IS = exp(E_x KL(p(y|x) || p(y))) over feature splits
-    (reference ``image/inception.py:24-163``)."""
+    (reference ``image/inception.py:24-163``).
+
+    Two accumulation modes:
+
+    - default: logits accumulate in an unbounded list; compute shuffles on
+      the host (the reference's ``np.random`` pattern) and splits into
+      ``splits`` chunks.
+    - ``capacity=N``: a fixed ``(N, C)`` :class:`CatBuffer` ring and a
+      fully in-graph compute — the shuffle is a masked random ranking on a
+      deterministic fold-in key, and valid rows deal round-robin into
+      ``splits`` groups (random equal-size partition, the static-shape
+      form of the reference's chunking) scored by segment means. Jittable,
+      shardable, ``functionalize``-able.
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -31,6 +47,8 @@ class InceptionScore(Metric):
         self,
         feature: Union[int, str, Callable] = "logits_unbiased",
         splits: int = 10,
+        capacity: Optional[int] = None,
+        seed: int = 0,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -43,17 +61,61 @@ class InceptionScore(Metric):
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Integer input to argument `splits` expected to be larger than 0")
         self.splits = splits
-        self.add_state("features", default=[], dist_reduce_fx=None)
+        self.capacity = capacity
+        self.seed = seed
+        if capacity is not None:
+            from metrics_tpu.image.fid import _feature_dim_of
 
-    def update(self, imgs: Array) -> None:
-        """Reference ``image/inception.py:125-133``."""
+            dim = _feature_dim_of(feature, "InceptionScore")
+            self.add_state(
+                "features", default=CatBuffer.zeros(capacity, (dim,), jnp.float32), dist_reduce_fx="cat"
+            )
+            object.__setattr__(self, "jittable_update", True)
+            object.__setattr__(self, "jittable_compute", True)
+        else:
+            self.add_state("features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, valid: Optional[Array] = None) -> None:
+        """Reference ``image/inception.py:125-133``. ``valid`` masks ragged
+        rows in capacity mode."""
         features = self.extractor(imgs) if self.extractor is not None else jnp.asarray(imgs)
         if features.ndim != 2:
             raise ValueError(f"Expected extracted features to be 2d (N, C) logits, got shape {features.shape}")
+        if self.capacity is not None:
+            self.features = cat_append(self.features, features, valid)
+            return
+        reject_valid_kwarg(valid)
         self.features.append(features)
+
+    def _compute_capacity(self) -> Tuple[Array, Array]:
+        """In-graph IS over the ring: random round-robin split assignment +
+        segment-mean marginals."""
+        buf = self.features
+        mask = buf.mask
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), buf.count())
+        # random rank among valid rows (invalid rows sink to the end)
+        scores = jnp.where(mask, jax.random.uniform(key, (buf.capacity,)), jnp.inf)
+        order = jnp.argsort(scores)
+        rank = jnp.argsort(order)  # row -> shuffled position
+        split_id = jnp.where(mask, rank % self.splits, self.splits)
+
+        prob = jax.nn.softmax(buf.data, axis=1)
+        log_prob = jax.nn.log_softmax(buf.data, axis=1)
+        w = mask.astype(jnp.float32)[:, None]
+        # per-split marginal p(y): segment mean over the split's rows
+        seg_prob = jax.ops.segment_sum(prob * w, split_id, num_segments=self.splits + 1)
+        seg_count = jax.ops.segment_sum(w[:, 0], split_id, num_segments=self.splits + 1)
+        mean_prob = seg_prob[: self.splits] / jnp.maximum(seg_count[: self.splits], 1.0)[:, None]
+        # per-row KL against its split's marginal, segment-meaned
+        row_kl = (prob * (log_prob - jnp.log(mean_prob)[split_id.clip(0, self.splits - 1)])).sum(axis=1)
+        seg_kl = jax.ops.segment_sum(row_kl * w[:, 0], split_id, num_segments=self.splits + 1)
+        kl_arr = jnp.exp(seg_kl[: self.splits] / jnp.maximum(seg_count[: self.splits], 1.0))
+        return kl_arr.mean(), kl_arr.std(ddof=1)
 
     def compute(self) -> Tuple[Array, Array]:
         """Reference ``image/inception.py:135-156``."""
+        if self.capacity is not None:
+            return self._compute_capacity()
         features = dim_zero_cat(self.features)
         # random permutation of the features (reference shuffles by default)
         idx = np.random.permutation(features.shape[0])

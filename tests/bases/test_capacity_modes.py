@@ -251,6 +251,39 @@ def test_kid_capacity_validates_capacity_vs_subset_size():
         mt.KernelInceptionDistance(feature=4, subset_size=16, capacity=8)
 
 
+def test_inception_score_capacity_single_split_equals_exact():
+    """With splits=1 the split partition is the whole set and IS is
+    permutation-invariant, so capacity mode must equal the exact mode."""
+    c, n = 7, 30
+    logits = rng.standard_normal((n, c)).astype(np.float32)
+    exact = mt.InceptionScore(feature=c, splits=1)
+    ring = mt.InceptionScore(feature=c, splits=1, capacity=n)
+    exact.update(jnp.asarray(logits))
+    ring.update(jnp.asarray(logits))
+    e_mean, _ = exact.compute()
+    r_mean, _ = ring.compute()
+    np.testing.assert_allclose(float(e_mean), float(r_mean), rtol=1e-5)
+
+
+def test_inception_score_capacity_multisplit_jittable():
+    c, n = 5, 40
+    logits = rng.standard_normal((n, c)).astype(np.float32)
+    mdef = functionalize(mt.InceptionScore(feature=c, splits=4, capacity=64))
+    state = mdef.init()
+    state = jax.jit(mdef.update)(state, jnp.asarray(logits))
+    mean, std = jax.jit(mdef.compute)(state)
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+    # IS of any distribution is within [1, num_classes]
+    assert 1.0 - 1e-5 <= float(mean) <= c + 1e-5
+
+    # statistical agreement with the exact mode at same splits (different
+    # shuffles -> tolerance, not equality)
+    exact = mt.InceptionScore(feature=c, splits=4)
+    exact.update(jnp.asarray(logits))
+    e_mean, _ = exact.compute()
+    np.testing.assert_allclose(float(mean), float(e_mean), rtol=0.1)
+
+
 # ------------------------------------------------------- traced overflow sig
 def test_metricdef_dropped_traced_scalar():
     """MetricDef.dropped is the in-graph form of Metric.dropped_count (which
