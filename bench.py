@@ -473,11 +473,13 @@ def _load_history() -> dict:
 
 
 def _platform_key(unit: str) -> str:
+    # tpu/axon first: the vsref unit strings always mention "torch-cpu" for
+    # the reference side, so a cpu-first match would misfile TPU runs
     u = unit.lower()
-    if "cpu" in u:
-        return "cpu"
     if "tpu" in u or "axon" in u:
         return "tpu"
+    if "cpu" in u:
+        return "cpu"
     return "other"
 
 

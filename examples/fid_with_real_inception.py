@@ -36,7 +36,7 @@ try:
     import sys
     from pathlib import Path
 
-    sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "tests"))
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
     from tests.helpers.torch_nets import TorchInceptionV3
 
     extractor.load_torch_state_dict(TorchInceptionV3(variant="fid").state_dict())

@@ -109,8 +109,19 @@ class InceptionScore(Metric):
         # per-row KL against its split's marginal, segment-meaned
         row_kl = (prob * (log_prob - jnp.log(mean_prob)[split_id.clip(0, self.splits - 1)])).sum(axis=1)
         seg_kl = jax.ops.segment_sum(row_kl * w[:, 0], split_id, num_segments=self.splits + 1)
-        kl_arr = jnp.exp(seg_kl[: self.splits] / jnp.maximum(seg_count[: self.splits], 1.0))
-        return kl_arr.mean(), kl_arr.std(ddof=1)
+        counts = seg_count[: self.splits]
+        kl_arr = jnp.exp(seg_kl[: self.splits] / jnp.maximum(counts, 1.0))
+        # fewer valid rows than splits leaves empty splits (exp(0) = 1.0
+        # fabrications); reduce over the NON-EMPTY splits only so the two
+        # modes agree whenever the reference mode is well-defined
+        nonempty = (counts > 0).astype(jnp.float32)
+        n_used = jnp.maximum(nonempty.sum(), 1.0)
+        mean = (kl_arr * nonempty).sum() / n_used
+        var = ((kl_arr - mean) ** 2 * nonempty).sum() / jnp.maximum(n_used - 1.0, 1.0)
+        std = jnp.where(n_used > 1, jnp.sqrt(var), jnp.nan)
+        # an empty ring has no score at all
+        mean = jnp.where(nonempty.sum() > 0, mean, jnp.nan)
+        return mean, std
 
     def compute(self) -> Tuple[Array, Array]:
         """Reference ``image/inception.py:135-156``."""

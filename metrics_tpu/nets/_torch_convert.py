@@ -16,7 +16,16 @@ __all__ = [
     "conv_kernel",
     "dense_kernel",
     "set_nested",
+    "to_mutable",
 ]
+
+
+def to_mutable(tree: Any) -> Any:
+    """Rebuild a (possibly frozen) flax variables tree as plain nested
+    dicts, so ``set_nested`` can write into it."""
+    if hasattr(tree, "items"):
+        return {k: to_mutable(v) for k, v in tree.items()}
+    return tree
 
 
 def as_numpy_state_dict(path_or_dict: Any) -> Dict[str, np.ndarray]:

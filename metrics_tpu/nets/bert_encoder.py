@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.nets._torch_convert import as_numpy_state_dict, dense_kernel, set_nested
+from metrics_tpu.nets._torch_convert import as_numpy_state_dict, dense_kernel, set_nested, to_mutable
 
 Array = jax.Array
 
@@ -131,7 +131,7 @@ def load_bert_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) -> 
     skipped (BERTScore never runs them); raises on unknown keys or shape
     mismatches."""
     state = as_numpy_state_dict(path_or_dict)
-    new_vars = _to_mutable(variables)
+    new_vars = to_mutable(variables)
     params = new_vars["params"]
     for key, value in state.items():
         k = key[5:] if key.startswith("bert.") else key
@@ -168,10 +168,6 @@ def load_bert_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) -> 
     return new_vars
 
 
-def _to_mutable(tree: Any) -> Any:
-    if hasattr(tree, "items"):
-        return {k: _to_mutable(v) for k, v in tree.items()}
-    return tree
 
 
 class BertEncoder:

@@ -35,7 +35,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from metrics_tpu.nets._torch_convert import as_numpy_state_dict, conv_kernel, dense_kernel, set_nested
+from metrics_tpu.nets._torch_convert import (
+    as_numpy_state_dict,
+    conv_kernel,
+    dense_kernel,
+    set_nested,
+    to_mutable,
+)
 
 Array = jax.Array
 
@@ -264,7 +270,7 @@ def load_inception_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any
     shape mismatches so silent architecture drift is impossible.
     """
     state = as_numpy_state_dict(path_or_dict)
-    new_vars = _to_mutable(variables)
+    new_vars = to_mutable(variables)
     for key, value in state.items():
         if key.startswith("AuxLogits.") or key.endswith("num_batches_tracked"):
             continue
@@ -297,11 +303,6 @@ def load_inception_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any
     return new_vars
 
 
-def _to_mutable(tree: Any) -> Any:
-    """Rebuild a (possibly frozen) variables tree as plain nested dicts."""
-    if hasattr(tree, "items"):
-        return {k: _to_mutable(v) for k, v in tree.items()}
-    return tree
 
 
 class InceptionV3Extractor:

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.nets._torch_convert import as_numpy_state_dict, conv_kernel, set_nested
+from metrics_tpu.nets._torch_convert import as_numpy_state_dict, conv_kernel, set_nested, to_mutable
 
 Array = jax.Array
 
@@ -161,7 +161,7 @@ def load_lpips_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) ->
       ``lins.<K>.model.1.weight`` heads.
     """
     state = as_numpy_state_dict(path_or_dict)
-    new_vars = _to_mutable(variables)
+    new_vars = to_mutable(variables)
     for key, value in state.items():
         parts = key.split(".")
         if parts[0] == "classifier" or key.endswith("num_batches_tracked"):
@@ -186,10 +186,6 @@ def load_lpips_torch_state_dict(variables: Dict[str, Any], path_or_dict: Any) ->
     return new_vars
 
 
-def _to_mutable(tree: Any) -> Any:
-    if hasattr(tree, "items"):
-        return {k: _to_mutable(v) for k, v in tree.items()}
-    return tree
 
 
 class LPIPSNet:

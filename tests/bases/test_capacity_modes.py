@@ -265,6 +265,28 @@ def test_inception_score_capacity_single_split_equals_exact():
     np.testing.assert_allclose(float(e_mean), float(r_mean), rtol=1e-5)
 
 
+def test_inception_score_capacity_underfilled_splits():
+    """Fewer valid rows than splits must not fabricate exp(0)=1.0 scores
+    for empty splits — the reduction covers non-empty splits only, and an
+    empty ring is NaN."""
+    c = 6
+    logits = rng.standard_normal((4, c)).astype(np.float32)
+    ring = mt.InceptionScore(feature=c, splits=10, capacity=16)
+    ring.update(jnp.asarray(logits))
+    mean, _ = ring.compute()
+    # 4 rows < 10 splits -> 4 singleton splits (each scoring exp(0)=1) and
+    # 6 empty splits that must NOT enter the mean/std; the result equals
+    # the same data dealt into exactly-4 splits
+    four = mt.InceptionScore(feature=c, splits=4, capacity=16)
+    four.update(jnp.asarray(logits))
+    np.testing.assert_allclose(float(mean), float(four.compute()[0]), rtol=1e-5)
+
+    empty = mt.InceptionScore(feature=c, splits=2, capacity=8)
+    empty.update(jnp.zeros((0, c), np.float32))
+    e_mean, _ = empty.compute()
+    assert np.isnan(float(e_mean))
+
+
 def test_inception_score_capacity_multisplit_jittable():
     c, n = 5, 40
     logits = rng.standard_normal((n, c)).astype(np.float32)
