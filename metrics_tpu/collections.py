@@ -177,6 +177,8 @@ class MetricCollection:
             return False
         if metric1._defaults.keys() != metric2._defaults.keys():
             return False
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
         for key in metric1._defaults:
             state1 = metric1._state[key]
             state2 = metric2._state[key]
@@ -186,6 +188,16 @@ class MetricCollection:
                 if len(state1) != len(state2):
                     return False
                 if not all(s1.shape == s2.shape and np.allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            elif isinstance(state1, CatBuffer):
+                # capacity-mode (ring) states: equal iff the full buffer
+                # triple matches — same capacity, same rows, same fill
+                if state1.data.shape != state2.data.shape:
+                    return False
+                if not (
+                    np.array_equal(np.asarray(state1.mask), np.asarray(state2.mask))
+                    and np.allclose(np.asarray(state1.data), np.asarray(state2.data))
+                ):
                     return False
             else:
                 if state1.shape != state2.shape or not np.allclose(state1, state2):

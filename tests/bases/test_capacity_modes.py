@@ -307,6 +307,34 @@ def test_inception_score_capacity_multisplit_jittable():
 
 
 # ------------------------------------------------------- traced overflow sig
+def test_collection_compute_groups_over_ring_states():
+    """A collection of capacity-mode metrics forms compute groups over
+    their CatBuffer states (this crashed with AttributeError before the
+    ring branch in _equal_metric_states) and matches singletons."""
+    p = jnp.asarray(rng.random(16).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 2, 16))
+    mc = mt.MetricCollection([mt.AUROC(capacity=64), mt.AveragePrecision(capacity=64)])
+    mc.update(p, t)
+    mc.update(p, t)
+    assert mc.compute_groups == {0: ["AUROC", "AveragePrecision"]}
+    out = mc.compute()
+
+    a = mt.AUROC(capacity=64)
+    ap = mt.AveragePrecision(capacity=64)
+    for m in (a, ap):
+        m.update(p, t)
+        m.update(p, t)
+    np.testing.assert_allclose(float(out["AUROC"]), float(a.compute()), rtol=1e-6)
+    np.testing.assert_allclose(float(out["AveragePrecision"]), float(ap.compute()), rtol=1e-6)
+
+    # reset keeps the group consistent for the next epoch
+    mc.reset()
+    mc.update(p, t)
+    a.reset()
+    a.update(p, t)
+    np.testing.assert_allclose(float(mc.compute()["AUROC"]), float(a.compute()), rtol=1e-6)
+
+
 def test_metricdef_dropped_traced_scalar():
     """MetricDef.dropped is the in-graph form of Metric.dropped_count (which
     is None under trace): an int32 scalar consumable inside jit."""
