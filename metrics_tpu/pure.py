@@ -92,7 +92,20 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
 
     if isinstance(metric, MetricCollection):
         return _functionalize_collection(metric, axis_name)
-    assert isinstance(metric, Metric)
+    if not isinstance(metric, Metric):
+        raise TypeError(
+            f"functionalize expects a Metric or MetricCollection, got {type(metric).__name__}. "
+            "(MetricTracker is epoch bookkeeping over copies — functionalize the tracked metric "
+            "itself and keep per-epoch states in your own pytree.)"
+        )
+    from metrics_tpu.wrappers.bootstrapping import BootStrapper
+
+    if isinstance(metric, BootStrapper):
+        raise ValueError(
+            "BootStrapper's eager copy-loop cannot be traced; use "
+            "bootstrap_functionalize(base_metric, num_bootstraps) — the vmapped form of the same "
+            "resampling."
+        )
     if _is_trace_safe_wrapper(metric):
         return _functionalize_wrapper(metric, axis_name)
     if any(isinstance(d, list) for d in metric._defaults.values()):
