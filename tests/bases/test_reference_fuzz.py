@@ -273,3 +273,24 @@ def test_fuzz_exact_vs_capacity_under_random_fill(seed):
             )
             dropped = ring.dropped_count
             assert dropped == max(0, n - cap), f"{name}: dropped {dropped}, expected {max(0, n - cap)}"
+
+        # curve metrics: terminal-padded static outputs equal the exact
+        # curves point-for-point on the kept prefix
+        for name, exact_ctor, cap_ctor in [
+            ("roc", lambda: mt.ROC(), lambda: mt.ROC(capacity=cap, on_overflow="ignore")),
+            (
+                "prc",
+                lambda: mt.PrecisionRecallCurve(),
+                lambda: mt.PrecisionRecallCurve(capacity=cap, on_overflow="ignore"),
+            ),
+        ]:
+            exact = exact_ctor()
+            ring = cap_ctor()
+            exact.update(jnp.asarray(preds[:kept]), jnp.asarray(target[:kept]))
+            ring.update(jnp.asarray(preds), jnp.asarray(target))
+            e_curves = [np.asarray(x) for x in exact.compute()]
+            r_curves = [np.asarray(x) for x in ring.compute()]
+            for e_arr, r_arr in zip(e_curves, r_curves):
+                np.testing.assert_allclose(
+                    r_arr[: len(e_arr)], e_arr, atol=1e-5, err_msg=f"{name} n={n} cap={cap}"
+                )
