@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.classification.kl_divergence import _kld_compute, _kld_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
+from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 
 Array = jax.Array
 
@@ -17,7 +18,12 @@ class KLDivergence(Metric):
     """KL(P || Q) (reference ``kl_divergence.py:24-105``).
 
     State is a scalar sum for mean/sum reductions and a ``cat`` list for
-    ``reduction='none'`` (reference ``:77-82``).
+    ``reduction='none'`` (reference ``:77-82``). ``capacity=N`` gives the
+    ``'none'`` output a static-shape :class:`CatBuffer` ring instead —
+    jittable/shardable, ``(capacity,)`` output with NaN padding at unfilled
+    slots (the same contract as ``CosineSimilarity(reduction='none',
+    capacity=...)``); mean/sum reductions are already scalar sums and
+    ignore ``capacity``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -33,7 +39,13 @@ class KLDivergence(Metric):
     higher_is_better = False
     full_state_update = False
 
-    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+    def __init__(
+        self,
+        log_prob: bool = False,
+        reduction: Optional[str] = "mean",
+        capacity: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
         if not isinstance(log_prob, bool):
             raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
@@ -42,21 +54,42 @@ class KLDivergence(Metric):
             raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
         self.log_prob = log_prob
         self.reduction = reduction
+        self.capacity = capacity
 
         if self.reduction in ("mean", "sum"):
             self.add_state("measures", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        elif capacity is not None:
+            self.add_state(
+                "measures", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat"
+            )
         else:
             self.add_state("measures", default=[], dist_reduce_fx="cat")
         self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
-    def update(self, p: Array, q: Array) -> None:
+    def update(self, p: Array, q: Array, valid: Optional[Array] = None) -> None:
+        """``valid`` (bool ``(N,)``) is accepted in capacity mode only."""
         measures, total = _kld_update(p, q, self.log_prob)
         if self.reduction is None or self.reduction == "none":
-            self.measures.append(measures)
+            if self.capacity is not None:
+                if valid is not None:
+                    # zero-select BEFORE accumulation-by-append is not
+                    # needed (rows scatter out), but total must count only
+                    # valid rows
+                    total = jnp.sum(jnp.asarray(valid, jnp.int32))
+                self.measures = cat_append(self.measures, measures, valid)
+            else:
+                reject_valid_kwarg(valid)
+                self.measures.append(measures)
         else:
+            if valid is not None:
+                w = jnp.asarray(valid, measures.dtype)
+                measures = measures * w
+                total = jnp.sum(jnp.asarray(valid, jnp.int32))
             self.measures = measures.sum() + self.measures
         self.total = total + self.total
 
     def compute(self) -> Array:
+        if self.reduction in ("none", None) and self.capacity is not None:
+            return jnp.where(self.measures.mask, self.measures.data, jnp.nan)
         measures = dim_zero_cat(self.measures) if self.reduction in ("none", None) else self.measures
         return _kld_compute(measures, self.total, self.reduction)

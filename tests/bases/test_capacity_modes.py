@@ -251,6 +251,27 @@ def test_kid_capacity_validates_capacity_vs_subset_size():
         mt.KernelInceptionDistance(feature=4, subset_size=16, capacity=8)
 
 
+def test_kld_none_capacity_ring():
+    """KLDivergence(reduction='none', capacity=N): NaN-padded static output
+    matching the exact per-batch measures, jittable via functionalize."""
+    p = rng.random((6, 4)).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    q = rng.random((6, 4)).astype(np.float32)
+    q /= q.sum(1, keepdims=True)
+
+    exact = mt.KLDivergence(reduction="none")
+    exact.update(jnp.asarray(p), jnp.asarray(q))
+    dense = np.asarray(exact.compute())
+
+    mdef = functionalize(mt.KLDivergence(reduction="none", capacity=8))
+    state = mdef.init()
+    state = jax.jit(mdef.update)(state, jnp.asarray(p), jnp.asarray(q))
+    out = np.asarray(jax.jit(mdef.compute)(state))
+    assert out.shape == (8,)
+    np.testing.assert_allclose(out[:6], dense, rtol=1e-5)
+    assert np.isnan(out[6:]).all()
+
+
 def test_inception_score_capacity_single_split_equals_exact():
     """With splits=1 the split partition is the whole set and IS is
     permutation-invariant, so capacity mode must equal the exact mode."""
