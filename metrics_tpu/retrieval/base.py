@@ -172,13 +172,13 @@ class RetrievalMetric(Metric, ABC):
         values = self._per_query_values(indexes, preds, target)
         return values.mean() if values.size else jnp.asarray(0.0)
 
-    def _compute_capacity(self) -> Array:
-        """Static-shape grouped compute: sort rows by query id (invalid rows
-        to a sentinel), derive each row's rank within its query from the
-        sorted array itself (``i - searchsorted(idx, idx_i)``), scatter into
-        a dense ``(Q, L)`` layout, and vmap the same masked row kernel the
-        eager path uses. Fully jittable: shapes depend only on ``capacity``,
-        ``num_queries`` and ``max_docs_per_query``."""
+    def _grouped_capacity_matrices(self) -> Tuple[Array, Array, Array]:
+        """The static-shape grouped layout: sort rows by query id (invalid
+        rows to a sentinel), derive each row's rank within its query from
+        the sorted array itself (``i - searchsorted(idx, idx_i)``), and
+        scatter into dense ``(Q, L)`` score/target/mask matrices. Fully
+        jittable: shapes depend only on ``capacity``, ``num_queries`` and
+        ``max_docs_per_query``. Shared by the scalar and curve computes."""
         q, l = self.num_queries, self.max_docs_per_query
         idx_buf, pred_buf, tgt_buf = self.indexes, self.preds, self.target
         n = idx_buf.capacity
@@ -197,6 +197,12 @@ class RetrievalMetric(Metric, ABC):
         pmat = jnp.zeros((q, l), p_s.dtype).at[idx_s, pos].set(p_s, mode="drop")
         tmat = jnp.zeros((q, l), t_s.dtype).at[idx_s, pos].set(t_s, mode="drop")
         mask = jnp.zeros((q, l), bool).at[idx_s, pos].set(True, mode="drop")
+        return pmat, tmat, mask
+
+    def _compute_capacity(self) -> Array:
+        """Vmapped masked row kernel over the grouped layout — the compiled
+        form of the eager per-query mean."""
+        pmat, tmat, mask = self._grouped_capacity_matrices()
 
         values = jax.vmap(self._row_metric)(pmat, tmat, mask)
         pos_counts = jnp.sum((tmat > 0) & mask, axis=1)

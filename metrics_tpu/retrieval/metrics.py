@@ -170,22 +170,33 @@ def _retrieval_recall_at_fixed_precision(
     precision: Array, recall: Array, top_k: Array, min_precision: float
 ) -> Tuple[Array, Array]:
     """Lexicographic best (recall, k) subject to precision floor
-    (reference ``retrieval/precision_recall_curve.py:35-58``)."""
-    mask = np.asarray(precision) >= min_precision
-    recall_np = np.asarray(recall)
-    k_np = np.asarray(top_k)
-    if not mask.any():
-        return jnp.asarray(0.0, jnp.float32), jnp.asarray(len(k_np), k_np.dtype)
-    cand = [(recall_np[i], k_np[i]) for i in range(len(k_np)) if mask[i]]
-    max_recall, best_k = max(cand)
-    if max_recall == 0.0:
-        best_k = len(k_np)
-    return jnp.asarray(max_recall, jnp.float32), jnp.asarray(best_k, k_np.dtype)
+    (reference ``retrieval/precision_recall_curve.py:35-58``) — pure jnp so
+    the capacity mode's jitted compute can run it; identical on concrete
+    arrays."""
+    precision = jnp.asarray(precision)
+    recall = jnp.asarray(recall)
+    top_k = jnp.asarray(top_k)
+    n = top_k.shape[0]
+    meets = precision >= min_precision
+    any_meets = jnp.any(meets)
+    r_star = jnp.max(jnp.where(meets, recall, -jnp.inf))
+    # reference tie-break: max() over (recall, k) tuples → largest k
+    best_k = jnp.max(jnp.where(meets & (recall == r_star), top_k, 0))
+    max_recall = jnp.where(any_meets, r_star, 0.0).astype(jnp.float32)
+    # no candidate, or best recall is 0 → k = len(top_k) (reference ``:54-56``)
+    best_k = jnp.where(any_meets & (r_star > 0), best_k, n).astype(top_k.dtype)
+    return max_recall, best_k
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
     """Query-averaged precision/recall curve over k
-    (reference ``retrieval/precision_recall_curve.py:61-186``)."""
+    (reference ``retrieval/precision_recall_curve.py:61-186``).
+
+    ``capacity=`` mode (round 5): the same :class:`CatBuffer` ring states
+    and compiled grouped layout as the scalar retrieval metrics, with the
+    masked curve kernel vmapped per query — fully jittable. ``max_k``
+    defaults to ``max_docs_per_query`` there (the static bound), not the
+    data-dependent max group size the eager mode infers."""
 
     def __init__(
         self,
@@ -196,8 +207,6 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         **kwargs: Any,
     ) -> None:
         super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
-        if self.capacity is not None:
-            raise ValueError("`capacity` mode is not supported for curve-valued retrieval metrics")
         if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
             raise ValueError("`max_k` has to be a positive integer or None")
         if not isinstance(adaptive_k, bool):
@@ -208,10 +217,35 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
     def _row_metric(self, preds: Array, target: Array, mask: Array) -> Array:  # pragma: no cover - unused
         raise NotImplementedError
 
+    def _compute_capacity(self) -> Tuple[Array, Array, Array]:
+        """Compiled grouped curves: vmap the masked curve kernel over the
+        dense (Q, L) layout, then the base class's include/fill semantics
+        broadcast over the (2, max_k) curve values."""
+        max_k = self.max_k if self.max_k is not None else self.max_docs_per_query
+        pmat, tmat, mask = self._grouped_capacity_matrices()
+        curves = jax.vmap(
+            lambda pp, tt, mm: jnp.stack(
+                _masked_precision_recall_curve(pp, tt, mm, max_k, self.adaptive_k)
+            )
+        )(pmat, tmat, mask)  # (Q, 2, max_k)
+        pos_counts = jnp.sum((tmat > 0) & mask, axis=1)
+        neg_counts = jnp.sum(mask, axis=1) - pos_counts
+        present = jnp.any(mask, axis=1)
+        empty = self._query_is_empty(pos_counts, neg_counts)
+        fill = 1.0 if self.empty_target_action == "pos" else 0.0
+        curves = jnp.where((empty | ~present)[:, None, None], fill, curves)
+        include = present if self.empty_target_action in ("pos", "neg") else present & ~empty
+        denom = jnp.maximum(jnp.sum(include), 1)
+        mean = jnp.sum(curves * include[:, None, None].astype(curves.dtype), axis=0) / denom
+        top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+        return mean[0], mean[1], top_k
+
     def compute(self) -> Tuple[Array, Array, Array]:
         """Vectorized form of reference ``precision_recall_curve.py:157-186``:
         per-query (2, max_k) curves from the shared bucketed helper, then
         average over (non-skipped) queries."""
+        if self.capacity is not None:
+            return self._compute_capacity()
         indexes = np.asarray(dim_zero_cat(self.indexes))
         preds = np.asarray(dim_zero_cat(self.preds))
         target = np.asarray(dim_zero_cat(self.target))

@@ -109,8 +109,8 @@ def test_ctor_validation():
         mt.RetrievalMAP(capacity=64)
     with pytest.raises(ValueError, match="error"):
         mt.RetrievalMAP(capacity=64, num_queries=4, empty_target_action="error")
-    with pytest.raises(ValueError, match="curve"):
-        mt.RetrievalPrecisionRecallCurve(capacity=64, num_queries=4)
+    # round 5: curve metrics SUPPORT capacity mode
+    assert mt.RetrievalPrecisionRecallCurve(capacity=64, num_queries=4).capacity == 64
 
 
 def test_functionalize_jit():
@@ -168,3 +168,59 @@ def test_sharded_union():
         indexes=jnp.asarray(i_dev.reshape(-1)[keep]),
     )
     np.testing.assert_allclose(got, float(ref.compute()), atol=1e-6)
+
+
+def test_curve_capacity_matches_list_mode():
+    """Round 5: the curve metrics join capacity mode — compiled grouped
+    curves equal the eager bucketed curves at the same max_k."""
+    a = mt.RetrievalPrecisionRecallCurve(max_k=8)
+    b = mt.RetrievalPrecisionRecallCurve(max_k=8, capacity=256, num_queries=Q, max_docs_per_query=64)
+    for lo in range(0, N, 50):
+        sl = slice(lo, lo + 50)
+        for m in (a, b):
+            m.update(jnp.asarray(PREDS[sl]), jnp.asarray(TARGET[sl]), indexes=jnp.asarray(IDX[sl]))
+    pa, ra, ka = (np.asarray(x) for x in a.compute())
+    pb, rb, kb = (np.asarray(x) for x in b.compute())
+    np.testing.assert_allclose(pb, pa, atol=1e-6)
+    np.testing.assert_allclose(rb, ra, atol=1e-6)
+    np.testing.assert_array_equal(kb, ka)
+
+
+@pytest.mark.parametrize("adaptive_k", [False, True])
+def test_curve_capacity_functionalize_jit(adaptive_k):
+    mdef = mt.functionalize(
+        mt.RetrievalPrecisionRecallCurve(
+            max_k=6, adaptive_k=adaptive_k, capacity=256, num_queries=Q, max_docs_per_query=64
+        )
+    )
+    state = mdef.init()
+    state = jax.jit(mdef.update)(
+        state, jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX)
+    )
+    prec, rec, top_k = jax.jit(mdef.compute)(state)
+    eager = mt.RetrievalPrecisionRecallCurve(max_k=6, adaptive_k=adaptive_k)
+    eager.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX))
+    pe, re_, ke = eager.compute()
+    np.testing.assert_allclose(np.asarray(prec), np.asarray(pe), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(re_), atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(top_k), np.asarray(ke))
+
+
+def test_recall_at_fixed_precision_capacity_jit():
+    for min_precision in (0.2, 0.95):
+        exact = mt.RetrievalRecallAtFixedPrecision(min_precision=min_precision, max_k=8)
+        exact.update(jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX))
+        e_recall, e_k = exact.compute()
+
+        mdef = mt.functionalize(
+            mt.RetrievalRecallAtFixedPrecision(
+                min_precision=min_precision, max_k=8, capacity=256, num_queries=Q, max_docs_per_query=64
+            )
+        )
+        state = mdef.init()
+        state = jax.jit(mdef.update)(
+            state, jnp.asarray(PREDS), jnp.asarray(TARGET), indexes=jnp.asarray(IDX)
+        )
+        recall, k = jax.jit(mdef.compute)(state)
+        np.testing.assert_allclose(float(recall), float(e_recall), atol=1e-6)
+        assert int(k) == int(e_k)
