@@ -42,6 +42,10 @@ CASES = [
     ("auroc_ring", lambda: mt.AUROC(capacity=2 * N), BIN_P, BIN_T),
     ("ap_ring", lambda: mt.AveragePrecision(capacity=2 * N), BIN_P, BIN_T),
     ("ap_ring_mc", lambda: mt.AveragePrecision(num_classes=C, capacity=2 * N), PROBS, LABELS),
+    ("calibration_binned", lambda: mt.CalibrationError(n_bins=8, binned=True), BIN_P, BIN_T),
+    ("cosine_moment", lambda: mt.CosineSimilarity(reduction="mean", capacity=4), PROBS, np.flip(PROBS, -1).copy()),
+    ("auc_ring", lambda: mt.AUC(reorder=True, capacity=2 * N), BIN_P, BIN_P + 0.1),
+    ("kld_none_ring", lambda: mt.KLDivergence(reduction="none", capacity=2 * N), PROBS, np.flip(PROBS, -1).copy()),
     ("kld", lambda: mt.KLDivergence(), PROBS, np.flip(PROBS, axis=-1).copy()),
     ("mse", lambda: mt.MeanSquaredError(), REG_A, REG_B),
     ("mae", lambda: mt.MeanAbsoluteError(), REG_A, REG_B),
