@@ -251,6 +251,24 @@ def test_kid_capacity_validates_capacity_vs_subset_size():
         mt.KernelInceptionDistance(feature=4, subset_size=16, capacity=8)
 
 
+def test_set_dtype_on_ring_states():
+    """set_dtype converts a CatBuffer's float payload but must leave the
+    bool mask, integer rows, and dropped counter alone."""
+    m = mt.AUROC(capacity=16)
+    p = jnp.asarray(rng.random(8).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 2, 8))
+    m.update(p, t)
+    before = float(m.compute())
+    m.set_dtype(jnp.bfloat16)
+    buf = m._state["preds"]
+    assert buf.data.dtype == jnp.bfloat16
+    assert buf.mask.dtype == jnp.bool_
+    assert m._state["target"].data.dtype == jnp.int32
+    assert buf.dropped.dtype == jnp.int32
+    # rank statistic is tie-free here at bf16 resolution -> value unchanged
+    np.testing.assert_allclose(float(m.compute()), before, atol=1e-2)
+
+
 def test_kld_none_capacity_ring():
     """KLDivergence(reduction='none', capacity=N): NaN-padded static output
     matching the exact per-batch measures, jittable via functionalize."""
