@@ -4,6 +4,7 @@
 from typing import Any, Optional, Sequence, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.image.ssim import _multiscale_ssim_compute, _ssim_compute, _ssim_update
 from metrics_tpu.metric import Metric
@@ -12,8 +13,36 @@ from metrics_tpu.utilities.data import dim_zero_cat
 Array = jax.Array
 
 
+def _check_streaming_args(reduction, data_range, owner: str, **flags: bool) -> None:
+    """Validation shared by the streaming SSIM variants."""
+    if reduction not in ("elementwise_mean", "sum"):
+        raise ValueError(
+            f"streaming {owner} requires reduction 'elementwise_mean' or 'sum' (per-image rows "
+            "are folded into sums at update); use the accumulate mode for 'none'"
+        )
+    if data_range is None:
+        raise ValueError(
+            f"streaming {owner} requires an explicit `data_range`: the reference infers it from "
+            "the min/max of ALL accumulated images, which a constant-memory update cannot see"
+        )
+    for name, val in flags.items():
+        if val:
+            raise ValueError(f"`{name}` needs per-image maps and cannot stream; use the accumulate mode")
+
+
 class StructuralSimilarityIndexMeasure(Metric):
     """SSIM over accumulated image batches (reference ``image/ssim.py:25-131``).
+
+    Two accumulation modes:
+
+    - default: raw image batches accumulate in ``cat`` lists (the
+      reference's pattern — O(total pixels) state!).
+    - ``streaming=True``: per-image SSIM is computed AT UPDATE and folded
+      into two scalar sum states. SSIM is per-image independent, so for
+      ``reduction='elementwise_mean'|'sum'`` this is **exact** — same
+      value, constant memory, fully jittable/shardable/functionalize-able.
+      Requires an explicit ``data_range`` (the reference would infer it
+      from the global min/max of everything accumulated).
 
     Example:
         >>> import jax.numpy as jnp
@@ -40,11 +69,24 @@ class StructuralSimilarityIndexMeasure(Metric):
         k2: float = 0.03,
         return_full_image: bool = False,
         return_contrast_sensitivity: bool = False,
+        streaming: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.streaming = bool(streaming)
+        if self.streaming:
+            _check_streaming_args(
+                reduction,
+                data_range,
+                "SSIM",
+                return_full_image=return_full_image,
+                return_contrast_sensitivity=return_contrast_sensitivity,
+            )
+            self.add_state("similarity_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
         self.gaussian_kernel = gaussian_kernel
         self.sigma = sigma
         self.kernel_size = kernel_size
@@ -55,12 +97,43 @@ class StructuralSimilarityIndexMeasure(Metric):
         self.return_full_image = return_full_image
         self.return_contrast_sensitivity = return_contrast_sensitivity
 
-    def update(self, preds: Array, target: Array) -> None:
+    def _per_image(self, preds: Array, target: Array) -> Array:
+        return _ssim_compute(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            "none",
+            self.data_range,
+            self.k1,
+            self.k2,
+        )
+
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """``valid`` (bool ``(N,)``) is accepted in streaming mode only —
+        the ragged-SPMD-batch contract shared with the capacity metrics."""
         preds, target = _ssim_update(preds, target)
+        if self.streaming:
+            sims = self._per_image(preds, target)
+            if valid is None:
+                self.similarity_sum += sims.sum()
+                self.total += jnp.asarray(sims.shape[0], jnp.float32)
+            else:
+                keep = jnp.asarray(valid, bool)
+                self.similarity_sum += jnp.where(keep, sims, 0.0).sum()
+                self.total += keep.astype(jnp.float32).sum()
+            return
+        if valid is not None:
+            raise ValueError("`valid` masks are only supported in streaming mode")
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.streaming:
+            if self.reduction == "sum":
+                return self.similarity_sum
+            return self.similarity_sum / self.total
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _ssim_compute(
@@ -79,7 +152,9 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
-    """MS-SSIM (reference ``image/ssim.py:134-262``)."""
+    """MS-SSIM (reference ``image/ssim.py:134-262``). Supports the same
+    ``streaming=True`` constant-memory mode as
+    :class:`StructuralSimilarityIndexMeasure`."""
 
     is_differentiable = True
     higher_is_better = True
@@ -96,11 +171,18 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         k2: float = 0.03,
         betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
         normalize: Optional[str] = None,
+        streaming: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.streaming = bool(streaming)
+        if self.streaming:
+            _check_streaming_args(reduction, data_range, "MS-SSIM")
+            self.add_state("similarity_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
         if not (isinstance(kernel_size, (Sequence, int))):
             raise ValueError("Argument `kernel_size` expected to be an sequence or an int")
@@ -119,12 +201,44 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         self.betas = betas
         self.normalize = normalize
 
-    def update(self, preds: Array, target: Array) -> None:
+    def _per_image(self, preds: Array, target: Array) -> Array:
+        return _multiscale_ssim_compute(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            "none",
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.betas,
+            self.normalize,
+        )
+
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """``valid`` (bool ``(N,)``) is accepted in streaming mode only."""
         preds, target = _ssim_update(preds, target)
+        if self.streaming:
+            sims = self._per_image(preds, target)
+            if valid is None:
+                self.similarity_sum += sims.sum()
+                self.total += jnp.asarray(sims.shape[0], jnp.float32)
+            else:
+                keep = jnp.asarray(valid, bool)
+                self.similarity_sum += jnp.where(keep, sims, 0.0).sum()
+                self.total += keep.astype(jnp.float32).sum()
+            return
+        if valid is not None:
+            raise ValueError("`valid` masks are only supported in streaming mode")
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
+        if self.streaming:
+            if self.reduction == "sum":
+                return self.similarity_sum
+            return self.similarity_sum / self.total
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _multiscale_ssim_compute(
