@@ -385,6 +385,41 @@ def _phase_vsref(jax, platform) -> None:
             f"s end-to-end incl. h2d+fetch ({platform}); reference torch-cpu same data: {ref_s:.3f}s",
             round(ref_s / ours_s, 2),
         )
+
+        # metric-level accumulation over 8 batches: r5 streaming scalars vs
+        # the reference metric's grow-the-image-list-and-concat pattern
+        import torchmetrics as RM
+
+        from metrics_tpu import StructuralSimilarityIndexMeasure
+
+        batches = [
+            (rng.random((4, 3, 256, 256)).astype(np.float32), rng.random((4, 3, 256, 256)).astype(np.float32))
+            for _ in range(8)
+        ]
+        ours_m = StructuralSimilarityIndexMeasure(data_range=1.0, streaming=True)
+        for x, y in batches:  # warm/compile
+            ours_m.update(jnp.asarray(x), jnp.asarray(y))
+        float(ours_m.compute())
+        t0 = time.perf_counter()
+        ours_m = StructuralSimilarityIndexMeasure(data_range=1.0, streaming=True)
+        for x, y in batches:
+            ours_m.update(jnp.asarray(x), jnp.asarray(y))
+        ours_val = float(ours_m.compute())
+        ours_stream_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        theirs_m = RM.StructuralSimilarityIndexMeasure(data_range=1.0)
+        for x, y in batches:
+            theirs_m.update(torch.from_numpy(x), torch.from_numpy(y))
+        theirs_val = float(theirs_m.compute())
+        ref_stream_s = time.perf_counter() - t0
+        assert abs(ours_val - theirs_val) < 1e-3, (ours_val, theirs_val)
+        _emit(
+            "ssim_metric_8batch_s",
+            round(ours_stream_s, 4),
+            f"s for 8x(4,3,256,256) update+compute, streaming scalars ({platform}); reference "
+            f"torch-cpu image-list metric same data: {ref_stream_s:.3f}s",
+            round(ref_stream_s / ours_stream_s, 2),
+        )
     except Exception as err:  # pragma: no cover
         print(f"bench: vsref ssim failed: {err}", file=sys.stderr)
 
