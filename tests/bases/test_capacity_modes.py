@@ -189,6 +189,45 @@ def test_msssim_streaming_equals_accumulate():
     np.testing.assert_allclose(float(exact.compute()), float(stream.compute()), rtol=1e-5)
 
 
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum"])
+def test_simple_image_metrics_streaming_equals_accumulate(reduction):
+    """UQI/ERGAS/SAM streaming folds are exact (per-image-independent
+    kernels + linear reductions). D-lambda is deliberately excluded: its
+    cross-band UQI norm is nonlinear in batch statistics."""
+    a = jnp.asarray(rng.random((6, 3, 32, 32)).astype(np.float32))
+    b = jnp.asarray((0.8 * np.asarray(a) + 0.2 * rng.random((6, 3, 32, 32))).astype(np.float32))
+    ctors = [
+        lambda **k: mt.UniversalImageQualityIndex(data_range=1.0, **k),
+        lambda **k: mt.ErrorRelativeGlobalDimensionlessSynthesis(**k),
+        lambda **k: mt.SpectralAngleMapper(**k),
+    ]
+    for ctor in ctors:
+        exact = ctor(reduction=reduction)
+        stream = ctor(reduction=reduction, streaming=True)
+        for lo in (0, 3):
+            exact.update(a[lo : lo + 3], b[lo : lo + 3])
+            stream.update(a[lo : lo + 3], b[lo : lo + 3])
+        np.testing.assert_allclose(
+            float(exact.compute()), float(stream.compute()), rtol=1e-5, err_msg=type(exact).__name__
+        )
+
+    assert "streaming" not in type(mt.SpectralDistortionIndex()).__init__.__code__.co_varnames
+
+
+def test_sam_streaming_valid_mask_functionalize():
+    a = jnp.asarray(rng.random((6, 3, 16, 16)).astype(np.float32))
+    b = jnp.asarray(rng.random((6, 3, 16, 16)).astype(np.float32))
+    valid = jnp.asarray([True, False, True, True, False, True])
+    exact = mt.SpectralAngleMapper()
+    exact.update(a[np.asarray(valid)], b[np.asarray(valid)])
+    mdef = functionalize(mt.SpectralAngleMapper(streaming=True))
+    state = mdef.init()
+    state = jax.jit(mdef.update)(state, a, b, valid=valid)
+    np.testing.assert_allclose(
+        float(jax.jit(mdef.compute)(state)), float(exact.compute()), rtol=1e-5
+    )
+
+
 def test_ssim_streaming_validation():
     with pytest.raises(ValueError, match="data_range"):
         mt.StructuralSimilarityIndexMeasure(streaming=True)
