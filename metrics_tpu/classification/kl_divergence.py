@@ -67,14 +67,15 @@ class KLDivergence(Metric):
         self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
     def update(self, p: Array, q: Array, valid: Optional[Array] = None) -> None:
-        """``valid`` (bool ``(N,)``) is accepted in capacity mode only."""
+        """``valid`` (bool ``(N,)``) masks rows — in the ``'none'``+capacity
+        ring and in the mean/sum scalar folds (the shared ragged-SPMD-batch
+        contract)."""
         measures, total = _kld_update(p, q, self.log_prob)
         if self.reduction is None or self.reduction == "none":
             if self.capacity is not None:
                 if valid is not None:
-                    # zero-select BEFORE accumulation-by-append is not
-                    # needed (rows scatter out), but total must count only
-                    # valid rows
+                    # rows scatter out of the ring via the append mask, but
+                    # total must count only valid rows
                     total = jnp.sum(jnp.asarray(valid, jnp.int32))
                 self.measures = cat_append(self.measures, measures, valid)
             else:
@@ -82,8 +83,9 @@ class KLDivergence(Metric):
                 self.measures.append(measures)
         else:
             if valid is not None:
-                w = jnp.asarray(valid, measures.dtype)
-                measures = measures * w
+                # select, don't multiply: zero-padded invalid rows can carry
+                # NaN measures and NaN * 0 is NaN
+                measures = jnp.where(jnp.asarray(valid, bool), measures, 0.0)
                 total = jnp.sum(jnp.asarray(valid, jnp.int32))
             self.measures = measures.sum() + self.measures
         self.total = total + self.total

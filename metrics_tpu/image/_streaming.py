@@ -1,0 +1,54 @@
+"""Shared machinery for the image metrics' ``streaming=True`` modes.
+
+The streamable image kernels (SSIM, MS-SSIM, UQI, ERGAS, SAM) are
+per-image independent and their final reduction is a plain mean/sum over
+the unreduced kernel output — so folding that output into two scalar sum
+states at update time is EXACT for ``reduction='elementwise_mean'|'sum'``
+while replacing the reference's O(total pixels) image-list states with
+constant memory. (D-lambda is excluded: its cross-band norm is nonlinear
+in batch statistics — see ``simple.py``.)
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+__all__ = ["stream_init", "stream_fold", "stream_result", "reject_valid_streaming"]
+
+
+def stream_init(metric, reduction: Optional[str], owner: str) -> None:
+    """Validate the reduction and register the (value_sum, n_elements)
+    streaming states."""
+    if reduction not in ("elementwise_mean", "sum"):
+        raise ValueError(
+            f"streaming {owner} requires reduction 'elementwise_mean' or 'sum'; use the "
+            "accumulate mode for 'none'"
+        )
+    metric.add_state("value_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+    metric.add_state("n_elements", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+
+def stream_fold(metric, vals: Array, n_images: int, valid: Optional[Array]) -> None:
+    """Fold an unreduced kernel output into the streaming sums; ``valid``
+    masks whole images (rows of the leading axis) via select — a
+    multiplicative mask would let NaNs from padded rows poison the sums."""
+    if valid is None:
+        metric.value_sum += vals.sum()
+        metric.n_elements += jnp.asarray(vals.size, jnp.float32)
+    else:
+        keep = jnp.asarray(valid, bool)
+        rows = vals.reshape(n_images, -1)
+        metric.value_sum += jnp.where(keep[:, None], rows, 0.0).sum()
+        metric.n_elements += keep.astype(jnp.float32).sum() * (vals.size // n_images)
+
+
+def stream_result(metric) -> Array:
+    return metric.value_sum if metric.reduction == "sum" else metric.value_sum / metric.n_elements
+
+
+def reject_valid_streaming(valid) -> None:
+    """Accumulate-mode guard: ``valid`` masks only exist in streaming mode."""
+    if valid is not None:
+        raise ValueError("`valid` masks are only supported in streaming mode")

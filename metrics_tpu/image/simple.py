@@ -13,6 +13,12 @@ from typing import Any, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.image._streaming import (
+    reject_valid_streaming,
+    stream_fold,
+    stream_init,
+    stream_result,
+)
 from metrics_tpu.functional.image.d_lambda import (
     _spectral_distortion_index_compute,
     _spectral_distortion_index_update,
@@ -24,34 +30,6 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
-
-
-def _stream_init(metric: Metric, reduction: Optional[str], owner: str) -> None:
-    """Register the (value_sum, n_elements) streaming states."""
-    if reduction not in ("elementwise_mean", "sum"):
-        raise ValueError(
-            f"streaming {owner} requires reduction 'elementwise_mean' or 'sum'; use the "
-            "accumulate mode for 'none'"
-        )
-    metric.add_state("value_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-    metric.add_state("n_elements", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-
-
-def _stream_fold(metric: Metric, vals: Array, n_images: int, valid: Optional[Array]) -> None:
-    """Fold an unreduced kernel output into the streaming sums; ``valid``
-    masks whole images (rows of the leading axis)."""
-    if valid is None:
-        metric.value_sum += vals.sum()
-        metric.n_elements += jnp.asarray(vals.size, jnp.float32)
-    else:
-        keep = jnp.asarray(valid, bool)
-        rows = vals.reshape(n_images, -1)
-        metric.value_sum += jnp.where(keep[:, None], rows, 0.0).sum()
-        metric.n_elements += keep.astype(jnp.float32).sum() * (vals.size // n_images)
-
-
-def _stream_result(metric: Metric) -> Array:
-    return metric.value_sum if metric.reduction == "sum" else metric.value_sum / metric.n_elements
 
 
 class UniversalImageQualityIndex(Metric):
@@ -90,7 +68,7 @@ class UniversalImageQualityIndex(Metric):
                     "streaming UQI requires an explicit `data_range` (the reference infers it "
                     "from the min/max of ALL accumulated images)"
                 )
-            _stream_init(self, reduction, "UQI")
+            stream_init(self, reduction, "UQI")
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -102,16 +80,15 @@ class UniversalImageQualityIndex(Metric):
         preds, target = _uqi_update(preds, target)
         if self.streaming:
             vals = _uqi_compute(preds, target, self.kernel_size, self.sigma, "none", self.data_range)
-            _stream_fold(self, vals, preds.shape[0], valid)
+            stream_fold(self, vals, preds.shape[0], valid)
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in streaming mode")
+        reject_valid_streaming(valid)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
         if self.streaming:
-            return _stream_result(self)
+            return stream_result(self)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _uqi_compute(preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range)
@@ -135,7 +112,7 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
         self.reduction = reduction
         self.streaming = bool(streaming)
         if self.streaming:
-            _stream_init(self, reduction, "ERGAS")
+            stream_init(self, reduction, "ERGAS")
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -144,16 +121,15 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         preds, target = _ergas_update(preds, target)
         if self.streaming:
-            _stream_fold(self, _ergas_compute(preds, target, self.ratio, "none"), preds.shape[0], valid)
+            stream_fold(self, _ergas_compute(preds, target, self.ratio, "none"), preds.shape[0], valid)
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in streaming mode")
+        reject_valid_streaming(valid)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
         if self.streaming:
-            return _stream_result(self)
+            return stream_result(self)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _ergas_compute(preds, target, self.ratio, self.reduction)
@@ -183,7 +159,7 @@ class SpectralAngleMapper(Metric):
         self.reduction = reduction
         self.streaming = bool(streaming)
         if self.streaming:
-            _stream_init(self, reduction, "SAM")
+            stream_init(self, reduction, "SAM")
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -191,16 +167,15 @@ class SpectralAngleMapper(Metric):
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         preds, target = _sam_update(preds, target)
         if self.streaming:
-            _stream_fold(self, _sam_compute(preds, target, "none"), preds.shape[0], valid)
+            stream_fold(self, _sam_compute(preds, target, "none"), preds.shape[0], valid)
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in streaming mode")
+        reject_valid_streaming(valid)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
         if self.streaming:
-            return _stream_result(self)
+            return stream_result(self)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _sam_compute(preds, target, self.reduction)

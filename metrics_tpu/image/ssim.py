@@ -6,28 +6,17 @@ from typing import Any, Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.image._streaming import (
+    reject_valid_streaming,
+    stream_fold,
+    stream_init,
+    stream_result,
+)
 from metrics_tpu.functional.image.ssim import _multiscale_ssim_compute, _ssim_compute, _ssim_update
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
-
-
-def _check_streaming_args(reduction, data_range, owner: str, **flags: bool) -> None:
-    """Validation shared by the streaming SSIM variants."""
-    if reduction not in ("elementwise_mean", "sum"):
-        raise ValueError(
-            f"streaming {owner} requires reduction 'elementwise_mean' or 'sum' (per-image rows "
-            "are folded into sums at update); use the accumulate mode for 'none'"
-        )
-    if data_range is None:
-        raise ValueError(
-            f"streaming {owner} requires an explicit `data_range`: the reference infers it from "
-            "the min/max of ALL accumulated images, which a constant-memory update cannot see"
-        )
-    for name, val in flags.items():
-        if val:
-            raise ValueError(f"`{name}` needs per-image maps and cannot stream; use the accumulate mode")
 
 
 class StructuralSimilarityIndexMeasure(Metric):
@@ -75,15 +64,18 @@ class StructuralSimilarityIndexMeasure(Metric):
         super().__init__(**kwargs)
         self.streaming = bool(streaming)
         if self.streaming:
-            _check_streaming_args(
-                reduction,
-                data_range,
-                "SSIM",
-                return_full_image=return_full_image,
-                return_contrast_sensitivity=return_contrast_sensitivity,
-            )
-            self.add_state("similarity_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            if data_range is None:
+                raise ValueError(
+                    "streaming SSIM requires an explicit `data_range`: the reference infers it "
+                    "from the min/max of ALL accumulated images, which a constant-memory update "
+                    "cannot see"
+                )
+            if return_full_image or return_contrast_sensitivity:
+                raise ValueError(
+                    "`return_full_image`/`return_contrast_sensitivity` need per-image maps and "
+                    "cannot stream; use the accumulate mode"
+                )
+            stream_init(self, reduction, "SSIM")
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -115,25 +107,15 @@ class StructuralSimilarityIndexMeasure(Metric):
         the ragged-SPMD-batch contract shared with the capacity metrics."""
         preds, target = _ssim_update(preds, target)
         if self.streaming:
-            sims = self._per_image(preds, target)
-            if valid is None:
-                self.similarity_sum += sims.sum()
-                self.total += jnp.asarray(sims.shape[0], jnp.float32)
-            else:
-                keep = jnp.asarray(valid, bool)
-                self.similarity_sum += jnp.where(keep, sims, 0.0).sum()
-                self.total += keep.astype(jnp.float32).sum()
+            stream_fold(self, self._per_image(preds, target), preds.shape[0], valid)
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in streaming mode")
+        reject_valid_streaming(valid)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Union[Array, Tuple[Array, Array]]:
         if self.streaming:
-            if self.reduction == "sum":
-                return self.similarity_sum
-            return self.similarity_sum / self.total
+            return stream_result(self)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _ssim_compute(
@@ -177,9 +159,12 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         super().__init__(**kwargs)
         self.streaming = bool(streaming)
         if self.streaming:
-            _check_streaming_args(reduction, data_range, "MS-SSIM")
-            self.add_state("similarity_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            if data_range is None:
+                raise ValueError(
+                    "streaming MS-SSIM requires an explicit `data_range`: the reference infers "
+                    "it from the min/max of ALL accumulated images"
+                )
+            stream_init(self, reduction, "MS-SSIM")
         else:
             self.add_state("preds", default=[], dist_reduce_fx="cat")
             self.add_state("target", default=[], dist_reduce_fx="cat")
@@ -220,25 +205,15 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         """``valid`` (bool ``(N,)``) is accepted in streaming mode only."""
         preds, target = _ssim_update(preds, target)
         if self.streaming:
-            sims = self._per_image(preds, target)
-            if valid is None:
-                self.similarity_sum += sims.sum()
-                self.total += jnp.asarray(sims.shape[0], jnp.float32)
-            else:
-                keep = jnp.asarray(valid, bool)
-                self.similarity_sum += jnp.where(keep, sims, 0.0).sum()
-                self.total += keep.astype(jnp.float32).sum()
+            stream_fold(self, self._per_image(preds, target), preds.shape[0], valid)
             return
-        if valid is not None:
-            raise ValueError("`valid` masks are only supported in streaming mode")
+        reject_valid_streaming(valid)
         self.preds.append(preds)
         self.target.append(target)
 
     def compute(self) -> Array:
         if self.streaming:
-            if self.reduction == "sum":
-                return self.similarity_sum
-            return self.similarity_sum / self.total
+            return stream_result(self)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _multiscale_ssim_compute(

@@ -374,6 +374,25 @@ def test_kld_none_capacity_ring():
     assert np.isnan(out[6:]).all()
 
 
+def test_kld_masked_nan_rows_do_not_poison_sums():
+    """Zero-padded invalid rows give NaN per-row KLD; the mean/sum valid
+    mask must SELECT them out (a multiplicative mask keeps the NaN) — and
+    on the eager path too, not only after XLA simplification."""
+    p = np.zeros((2, 3), np.float32)
+    p[0] = [0.2, 0.3, 0.5]
+    q = np.zeros((2, 3), np.float32)
+    q[0] = [0.3, 0.3, 0.4]
+    m = mt.KLDivergence(reduction="mean")
+    m._original_update(jnp.asarray(p), jnp.asarray(q), valid=jnp.asarray([True, False]))
+    object.__setattr__(m, "_update_called", True)
+    v = float(m.compute())
+    assert not np.isnan(v)
+
+    ref = mt.KLDivergence(reduction="mean")
+    ref.update(jnp.asarray(p[:1]), jnp.asarray(q[:1]))
+    np.testing.assert_allclose(v, float(ref.compute()), rtol=1e-6)
+
+
 def test_inception_score_capacity_single_split_equals_exact():
     """With splits=1 the split partition is the whole set and IS is
     permutation-invariant, so capacity mode must equal the exact mode."""
