@@ -335,6 +335,32 @@ def test_kid_capacity_validates_capacity_vs_subset_size():
         mt.KernelInceptionDistance(feature=4, subset_size=16, capacity=8)
 
 
+def test_compute_on_cpu_and_pickle_with_round5_modes():
+    """compute_on_cpu and mid-accumulation pickling both compose with the
+    round-5 state forms (rings, binned counters, moment sums)."""
+    import pickle
+
+    p = jnp.asarray(rng.random(10).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 2, 10))
+
+    m = mt.AUROC(capacity=16, compute_on_cpu=True)
+    m.update(p, t)
+    assert np.isfinite(float(m.compute()))
+    m2 = mt.CalibrationError(binned=True, compute_on_cpu=True)
+    m2.update(p, t)
+    assert np.isfinite(float(m2.compute()))
+
+    fid = mt.FrechetInceptionDistance(feature=4, capacity=16)
+    fid.update(jnp.asarray(rng.standard_normal((5, 4)).astype(np.float32)), real=True)
+    fid.update(jnp.asarray(rng.standard_normal((5, 4)).astype(np.float32)), real=False)
+    ce = mt.CalibrationError(binned=True)
+    ce.update(p, t)
+    for m3 in (fid, ce):
+        np.testing.assert_allclose(
+            float(pickle.loads(pickle.dumps(m3)).compute()), float(m3.compute()), rtol=1e-5
+        )
+
+
 def test_set_dtype_on_ring_states():
     """set_dtype converts a CatBuffer's float payload but must leave the
     bool mask, integer rows, and dropped counter alone."""
