@@ -32,6 +32,10 @@ class AUC(Metric):
         >>> metric = AUC(reorder=True)
         >>> round(float(metric(jnp.asarray([0.0, 0.5, 1.0]), jnp.asarray([0.0, 0.5, 1.0]))), 4)
         0.5
+        >>> ring = AUC(reorder=True, capacity=8)  # static-shape, jittable
+        >>> ring.update(jnp.asarray([0.0, 0.5, 1.0]), jnp.asarray([0.0, 0.5, 1.0]))
+        >>> round(float(ring.compute()), 4)
+        0.5
     """
 
     is_differentiable = False

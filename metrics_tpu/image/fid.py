@@ -83,6 +83,14 @@ class FrechetInceptionDistance(Metric):
         >>> fid.update(imgs, real=False)
         >>> round(float(fid.compute()), 4)  # identical distributions
         0.0
+
+    Capacity (compiled) mode with pre-extracted features:
+        >>> ring = FrechetInceptionDistance(feature=8, capacity=32)
+        >>> feats = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+        >>> ring.update(feats, real=True)
+        >>> ring.update(feats, real=False)
+        >>> round(float(ring.compute()), 4)
+        0.0
     """
 
     is_differentiable = False

@@ -41,6 +41,10 @@ class StructuralSimilarityIndexMeasure(Metric):
         >>> metric.update(imgs, imgs)
         >>> round(float(metric.compute()), 4)
         1.0
+        >>> stream = StructuralSimilarityIndexMeasure(data_range=1.0, streaming=True)
+        >>> stream.update(imgs, imgs)  # folds per-image SSIM into 2 scalars
+        >>> round(float(stream.compute()), 4)
+        1.0
     """
 
     is_differentiable = True
