@@ -129,3 +129,54 @@ def test_topk_subset_ignore_grid():
         assert _run_cell("f1_score", "multilabel_probs", {"threshold": th, "num_classes": C}) == "ok"
     for iname in ["binary_probs", "multiclass_probs", "multiclass_labels"]:
         assert _run_cell("dice", iname, {}) == "ok"
+
+
+def test_samplewise_module_accumulation_vs_reference():
+    """Module-level mdmc samplewise: the per-sample cat-list states must
+    accumulate across batches exactly like the reference modules (the grid
+    above only covers single-call functional parity)."""
+    import warnings
+
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from tests.helpers.reference import import_reference
+
+    ref = import_reference()
+    import torch
+
+    rng = np.random.default_rng(5)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        pairs = [
+            (
+                mt.Precision(num_classes=C, average="macro", mdmc_average="samplewise"),
+                ref.Precision(num_classes=C, average="macro", mdmc_average="samplewise"),
+            ),
+            (
+                mt.Recall(num_classes=C, average="micro", mdmc_average="samplewise"),
+                ref.Recall(num_classes=C, average="micro", mdmc_average="samplewise"),
+            ),
+            (
+                mt.F1Score(num_classes=C, average="macro", mdmc_average="samplewise"),
+                ref.F1Score(num_classes=C, average="macro", mdmc_average="samplewise"),
+            ),
+            (
+                mt.Accuracy(num_classes=C, mdmc_average="samplewise"),
+                ref.Accuracy(num_classes=C, mdmc_average="samplewise"),
+            ),
+        ]
+        for _ in range(3):  # three accumulation batches
+            probs = rng.random((6, C, 5)).astype(np.float32)
+            probs /= probs.sum(1, keepdims=True)
+            labels = rng.integers(0, C, (6, 5))
+            for ours, theirs in pairs:
+                ours.update(jnp.asarray(probs), jnp.asarray(labels))
+                theirs.update(torch.from_numpy(probs), torch.from_numpy(labels))
+        for ours, theirs in pairs:
+            np.testing.assert_allclose(
+                float(ours.compute()),
+                float(theirs.compute()),
+                atol=1e-5,
+                err_msg=type(ours).__name__,
+            )
