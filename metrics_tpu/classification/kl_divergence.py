@@ -33,6 +33,10 @@ class KLDivergence(Metric):
         >>> q = jnp.asarray([[0.25, 0.75]])
         >>> round(float(metric(p, q)), 4)
         0.1438
+        >>> ring = KLDivergence(reduction='none', capacity=4)  # jittable rows
+        >>> ring.update(p, q)
+        >>> [round(float(v), 4) for v in ring.compute()[:1]]
+        [0.1438]
     """
 
     is_differentiable = True
