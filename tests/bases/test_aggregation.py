@@ -142,3 +142,20 @@ def test_cat_metric_capacity_mode():
     out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(P("data"),), out_specs=P()))(vals)
     got = np.asarray(out)
     assert sorted(got[~np.isnan(got)].tolist()) == vals.tolist()
+
+
+@pytest.mark.parametrize(
+    "weights, expected",
+    [(1, 11.5), (np.ones((2, 1, 1)), 11.5), (np.asarray([1, 2]).reshape(2, 1, 1), 13.5)],
+)
+def test_mean_metric_broadcasting(weights, expected):
+    """Reference ``test_aggregation.py:158-167``: weights broadcast to the
+    value shape with standard trailing-dim alignment (invalid broadcasts
+    raise, exactly like the reference's torch.broadcast_to)."""
+    values = jnp.arange(24).reshape(2, 3, 4)
+    avg = MeanMetric()
+    assert float(avg(values, jnp.asarray(weights, jnp.float32))) == expected
+
+    with pytest.raises(ValueError, match="broadcast"):
+        bad = MeanMetric()
+        bad._original_update(jnp.ones((2, 3)), weight=jnp.asarray([1.0, 2.0]))
