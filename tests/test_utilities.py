@@ -1,0 +1,148 @@
+"""Utilities tests ported from the reference
+(``/root/reference/test/unittests/test_utilities.py``) — the shared tensor
+helpers were previously covered only indirectly through metric suites.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.parallel.sync import class_reduce, reduce
+from metrics_tpu.utilities.checks import _allclose_recursive, check_forward_full_state_property
+from metrics_tpu.utilities.data import (
+    _bincount,
+    _flatten,
+    _flatten_dict,
+    apply_to_collection,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+
+def test_prints():
+    """Reference ``test_utilities.py:25-28``: rank-zero helpers run."""
+    rank_zero_debug("DEBUG")
+    rank_zero_info("INFO")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rank_zero_warn("WARN")
+
+
+def test_reduce():
+    """Reference ``test_utilities.py:31-39``."""
+    start = jnp.zeros(50)
+    for reduction in ("elementwise_mean", "sum", "none"):
+        result = reduce(start, reduction)
+        assert np.allclose(np.asarray(result), 0.0)
+    with pytest.raises(ValueError):
+        reduce(start, "error_reduction")
+
+
+def test_class_reduce():
+    """Reference ``test_utilities.py:42-52``."""
+    num = jnp.asarray(np.random.default_rng(0).integers(1, 10, 100).astype(np.float32))
+    denom = jnp.asarray(np.random.default_rng(1).random(100).astype(np.float32)) + num
+    weights = jnp.asarray(np.random.default_rng(2).integers(1, 100, 100).astype(np.float32))
+
+    for reduction in ("micro", "macro", "weighted", "none", None):
+        result = class_reduce(num, denom, weights, class_reduction=reduction)
+        assert np.all(np.isfinite(np.asarray(result)))
+    with pytest.raises(ValueError):
+        class_reduce(num, denom, weights, class_reduction="error_reduction")
+
+
+def test_onehot():
+    """Reference ``test_utilities.py:55-76``: labels to (B, C, X) one-hot,
+    with and without an explicit num_classes."""
+    test_tensor = jnp.asarray([[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]])
+    onehot_classes = to_onehot(test_tensor, num_classes=10)
+    onehot_no_classes = to_onehot(test_tensor)
+    np.testing.assert_allclose(np.asarray(onehot_classes), np.asarray(onehot_no_classes))
+    assert onehot_classes.shape == (2, 10, 5)
+    flat = np.asarray(onehot_classes)
+    for b in range(2):
+        for pos in range(5):
+            cls = int(np.asarray(test_tensor)[b, pos])
+            assert flat[b, cls, pos] == 1
+            assert flat[b].sum(axis=0)[pos] == 1
+
+
+def test_to_categorical():
+    """Reference ``test_utilities.py:79-94``: (B, C, X) probabilities back
+    to class indices via argmax over the class axis — inverse of one-hot."""
+    labels = jnp.asarray([[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]])
+    probs = to_onehot(labels, num_classes=10).astype(jnp.float32)
+    result = to_categorical(probs, argmax_dim=1)
+    np.testing.assert_array_equal(np.asarray(result), np.asarray(labels))
+
+
+def test_flatten_list():
+    """Reference ``test_utilities.py:97-101``."""
+    inp = [[1, 2, 3], [4, 5], [6]]
+    assert _flatten(inp) == [1, 2, 3, 4, 5, 6]
+
+
+def test_flatten_dict():
+    """Reference ``test_utilities.py:104-109``."""
+    inp = {"a": {"b": 1, "c": 2}, "d": 3}
+    assert _flatten_dict(inp) == {"b": 1, "c": 2, "d": 3}
+
+
+def test_bincount():
+    """Reference ``test_utilities.py:112-131``: parity with np.bincount at a
+    fixed minlength, including empty input."""
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 10, 100)
+    got = np.asarray(_bincount(jnp.asarray(x), minlength=10))
+    np.testing.assert_array_equal(got, np.bincount(x, minlength=10))
+    empty = np.asarray(_bincount(jnp.asarray([], dtype=np.int32), minlength=4))
+    np.testing.assert_array_equal(empty, np.zeros(4))
+
+
+def test_select_topk():
+    """``select_topk`` marks the top-k probabilities per row."""
+    probs = jnp.asarray([[0.1, 0.7, 0.2], [0.5, 0.4, 0.1]])
+    top1 = np.asarray(select_topk(probs, topk=1))
+    np.testing.assert_array_equal(top1, [[0, 1, 0], [1, 0, 0]])
+    top2 = np.asarray(select_topk(probs, topk=2))
+    assert top2.sum(axis=1).tolist() == [2, 2]
+
+
+def test_apply_to_collection():
+    """The pytree map handles dicts, sequences and passthrough leaves."""
+    out = apply_to_collection({"a": jnp.asarray([1.0]), "b": [jnp.asarray([2.0])]}, jnp.ndarray, lambda t: t * 2)
+    assert float(out["a"][0]) == 2.0 and float(out["b"][0][0]) == 4.0
+    assert apply_to_collection("keep", jnp.ndarray, lambda t: t * 2) == "keep"
+
+
+@pytest.mark.parametrize(
+    "inp, expected",
+    [
+        ((jnp.ones(2), jnp.ones(2)), True),
+        ((jnp.ones(2), jnp.zeros(2)), False),
+        (({"a": jnp.ones(2)}, {"a": jnp.ones(2)}), True),
+        (([jnp.ones(2)], [jnp.zeros(2)]), False),
+    ],
+)
+def test_recursive_allclose(inp, expected):
+    """Reference ``test_utilities.py:155-163``."""
+    assert _allclose_recursive(*inp) == expected
+
+
+def test_check_full_state_update_fn(capsys):
+    """Reference ``test_utilities.py:134-152``: the prober runs, prints a
+    recommendation, and full- vs partial-state outputs agree for a
+    sum-state metric."""
+    from metrics_tpu import MeanSquaredError
+
+    check_forward_full_state_property(
+        MeanSquaredError,
+        input_args={"preds": jnp.ones(10), "target": jnp.ones(10) * 2},
+        num_update_to_compare=[10, 100],
+        reps=2,
+    )
+    captured = capsys.readouterr()
+    assert "full_state_update" in captured.out
