@@ -68,14 +68,24 @@ def _flatten_dict(x: Dict) -> Dict:
     return new_dict
 
 
-def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
     """Convert integer labels ``(N, ...)`` to dense one-hot ``(N, C, ...)``.
 
     Reference ``utilities/data.py:82-113``. TPU-first: implemented as a direct
     comparison against an iota over a new class axis — a single fused XLA op,
-    no scatter.
+    no scatter. ``num_classes`` may be omitted EAGERLY only (the reference
+    infers ``max + 1`` from the data — a data-dependent shape that cannot
+    exist under trace; compiled callers must pass it).
     """
     labels = jnp.asarray(label_tensor)
+    if num_classes is None:
+        try:
+            num_classes = int(labels.max()) + 1
+        except jax.errors.ConcretizationTypeError as err:
+            raise ValueError(
+                "to_onehot needs an explicit `num_classes` inside jit/scan/vmap — inferring it "
+                "from the data is a data-dependent shape."
+            ) from err
     iota = jnp.arange(num_classes, dtype=labels.dtype)
     iota = iota.reshape((1, num_classes) + (1,) * (labels.ndim - 1))
     return (labels[:, None] == iota).astype(jnp.int32)
