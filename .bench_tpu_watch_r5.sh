@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 TPU window watcher: the 03:47 UTC live window captured the
+# headline/auroc/ssim phases before the tunnel wedged; this loop waits for
+# the NEXT window and runs each still-missing bench phase in its own fresh
+# process (a mid-phase wedge then can't take out the rest). Results append
+# to .tpu_bench_results_r5.log (gitignored; committed snapshots go to
+# TPU_STATUS.md / BASELINE.md).
+LOG=/root/repo/.tpu_bench_results_r5.log
+PROBELOG=/root/repo/.tpu_probe_log_r5
+cd /root/repo || exit 1
+PHASES=(ssim retrieval detection sync vsref)
+declare -A DONE
+while true; do
+  TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+  if timeout 90 python -c "import jax; ds=jax.devices(); assert ds[0].platform=='tpu', ds" 2>/dev/null; then
+    echo "$TS UP — running missing phases" >> "$PROBELOG"
+    for p in "${PHASES[@]}"; do
+      [ -n "${DONE[$p]}" ] && continue
+      TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+      echo "=== $TS phase $p ===" >> "$LOG"
+      if timeout 420 python bench.py --phase "$p" >> "$LOG" 2>&1; then
+        # mark done only if a result line was emitted (phase bodies swallow
+        # their own exceptions and exit 0)
+        if tail -5 "$LOG" | grep -q '"metric"'; then DONE[$p]=1; fi
+      else
+        echo "phase $p: timeout/nonzero exit" >> "$LOG"
+        # a wedge mid-run poisons the tunnel for every process: stop the
+        # sweep, wait for the next window
+        break
+      fi
+    done
+    ALL=1; for p in "${PHASES[@]}"; do [ -z "${DONE[$p]}" ] && ALL=0; done
+    if [ "$ALL" = 1 ]; then
+      echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) all phases captured" >> "$LOG"
+      exit 0
+    fi
+  else
+    echo "$TS DOWN (timeout-or-error)" >> "$PROBELOG"
+  fi
+  sleep 150
+done

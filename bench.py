@@ -111,8 +111,27 @@ def _device_loop_ms(jax, step_fn, carry, iters: int) -> float:
             best = min(best, time.perf_counter() - t0)
         return best
 
+    # Grow the iteration count until the baseline-subtracted delta clears
+    # the tunnel's jitter floor — a fixed count floored ssim_512 to 0.0 at
+    # the r5 live window (16 iters of a fast kernel < ~ms-scale RTT noise).
+    # One growth step sized from the first measured delta (not blind
+    # doubling): each looped() call is a fresh compile + 3 tunnel
+    # round-trips, so extra probes both cost minutes and raise the odds of
+    # a mid-run wedge.
+    noise_floor_s = 0.040
+    cap = 4096
     base = looped(1)
     full = looped(1 + iters)
+    if full - base < noise_floor_s and iters < cap:
+        scale = noise_floor_s / max(full - base, noise_floor_s / 64.0)
+        iters = min(cap, max(iters + 1, int(iters * scale * 1.5)))
+        full = looped(1 + iters)
+    if full - base < noise_floor_s:
+        print(
+            f"bench: WARNING loop delta {full - base:.4f}s below noise floor at "
+            f"{iters} iters; value is jitter-dominated, treat as an upper bound",
+            file=sys.stderr,
+        )
     return max(full - base, 0.0) / iters * 1e3
 
 
