@@ -25,7 +25,10 @@ while true; do
       else
         echo "phase $p: timeout/nonzero exit" >> "$LOG"
         # a wedge mid-run poisons the tunnel for every process: stop the
-        # sweep, wait for the next window
+        # sweep and back off hard — the lightweight probe can pass while
+        # bench dispatch still hangs, so without this sleep the same phase
+        # would re-run back-to-back burning 420s timeouts
+        sleep 600
         break
       fi
     done

@@ -122,7 +122,19 @@ def _chrf_score_update(
         pred_char += p_char_tot
         pred_word += p_word_tot
 
-        best = None  # (f, m_char, m_word, t_char, t_word)
+        # Zero-stat start + strict improvement, matching the reference
+        # (``functional/text/chrf.py:332-364``): when every reference ties at
+        # f==0, NO target/matching counts enter the corpus totals (the pred
+        # counts above were already added unconditionally). Picking e.g. the
+        # first reference instead inflates the recall denominator — found by
+        # the text differential fuzz (round 5).
+        best = (
+            0.0,
+            np.zeros(n_char_order, np.float32),
+            np.zeros(n_word_order, np.float32),
+            np.zeros(n_char_order, np.float32),
+            np.zeros(n_word_order, np.float32),
+        )
         for ref in refs:
             r_char, r_word = _sentence_stats(ref, n_char_order, n_word_order, lowercase, whitespace)
             m_char, m_word = _matches(p_char, r_char), _matches(p_word, r_word)
@@ -132,7 +144,7 @@ def _chrf_score_update(
                     m_char, m_word, p_char_tot, p_word_tot, t_char, t_word, n_order, beta
                 )
             )
-            if best is None or f > best[0]:
+            if f > best[0]:
                 best = (f, m_char, m_word, t_char, t_word)
 
         f, m_char, m_word, t_char, t_word = best

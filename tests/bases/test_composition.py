@@ -117,6 +117,42 @@ def test_nested_composition_and_lifecycle():
     np.testing.assert_allclose(float(comp2.compute()), 0.0)
 
 
+@pytest.mark.parametrize(
+    ("name", "op", "ref_op", "int_only"),
+    [c for c in _BINARY_CASES if c[0] != "matmul"],
+)
+def test_binary_metric_array_operand(name, op, ref_op, int_only):
+    """Array (non-metric, non-scalar) second operands, both orientations —
+    the reference parametrizes every operator test over ``tensor(...)``
+    operands alongside scalars (``test_composition.py:39-46``)."""
+    a_val = _A.astype(np.int32) if int_only else _A
+    b_val = _B.astype(np.int32) if int_only else _B
+    arr = jnp.asarray(b_val)
+    a = Dummy(a_val)
+    a.update()
+    comp = op(a, arr)
+    np.testing.assert_allclose(
+        np.asarray(comp.compute()), np.asarray(ref_op(jnp.asarray(a_val), arr)), atol=1e-6
+    )
+    refl = op(arr, a)
+    np.testing.assert_allclose(
+        np.asarray(refl.compute()), np.asarray(ref_op(arr, jnp.asarray(a_val))), atol=1e-6
+    )
+
+
+def test_compositional_metrics_update_count():
+    """``comp.update`` reaches both children on every call (reference
+    ``test_composition.py:543-556`` asserts ``_num_updates == 3`` each)."""
+    a, b = Dummy(np.float32(5.0)), Dummy(np.float32(4.0))
+    comp = a + b
+    assert isinstance(comp, CompositionalMetric)
+    for _ in range(3):
+        comp.update()
+    assert comp.metric_a is a and comp.metric_b is b
+    np.testing.assert_allclose(float(a.compute()), 15.0)
+    np.testing.assert_allclose(float(b.compute()), 12.0)
+
+
 def test_composition_forward():
     a, b = SumMetric(), SumMetric()
     comp = a + b

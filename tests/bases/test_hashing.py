@@ -1,0 +1,60 @@
+"""Instance-identity hashing (analogue of reference
+``test/unittests/bases/test_hashing.py``).
+
+The reference hashes a metric by ``(class name, id(states...))`` so two
+same-config instances never collide in a dict/set — required because
+``MetricCollection`` and Lightning both key metrics by object. This build
+keeps default object identity hashing, which gives the same contract.
+"""
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Metric
+
+
+class _Scalar(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("x", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, v):
+        self.x = self.x + jnp.asarray(v, jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+class _ListState(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("xs", default=[], dist_reduce_fx=None)
+
+    def update(self, v):
+        self.xs.append(jnp.asarray(v, jnp.float32))
+
+    def compute(self):
+        return jnp.concatenate([x.reshape(-1) for x in self.xs]) if self.xs else jnp.zeros(0)
+
+
+@pytest.mark.parametrize("metric_cls", [_Scalar, _ListState])
+def test_metric_hashing(metric_cls):
+    """Two same-config instances must hash (and compare) as distinct objects."""
+    instance_1 = metric_cls()
+    instance_2 = metric_cls()
+
+    assert hash(instance_1) != hash(instance_2)
+    assert id(instance_1) != id(instance_2)
+    # usable as dict/set keys without collision
+    assert len({instance_1, instance_2}) == 2
+
+
+def test_hash_distinct_with_equal_state_values():
+    """Hashes must differ even when two instances hold numerically identical
+    state — the reference hashes by state object identity, not value
+    (``metric.py:716-733``: "PyTorch requires a module hash to be unique"),
+    and this build keeps that uniqueness contract."""
+    m1, m2 = _ListState(), _ListState()
+    for m in (m1, m2):
+        m.update(1.0)
+        m.update(2.0)
+    assert hash(m1) != hash(m2)
