@@ -360,3 +360,125 @@ def test_differential_fuzz_text(seed):
                 r_ours = F.rouge_score(preds, target, rouge_keys=keys)
                 for key in ("rouge1_fmeasure", "rouge2_fmeasure", "rougeL_fmeasure"):
                     cmp(f"rouge:{key}", r_ours[key], r_ref[key])
+
+
+@pytest.mark.parametrize("seed", [23, 89])
+def test_differential_fuzz_image(seed):
+    """Random-shape image kernels vs the reference: SSIM/MS-SSIM (gaussian
+    and uniform windows, odd kernel sizes, custom data ranges), PSNR, UQI,
+    ERGAS, SAM, D-lambda, image gradients."""
+    RF = import_reference().functional
+    torch = _torch()
+    rng = np.random.default_rng(seed)
+
+    def cmp(name, ours, theirs, atol=1e-4):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), atol=atol, err_msg=name)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(2):
+            n = int(rng.integers(1, 4))
+            # c >= 2: the spectral metrics (SAM, D-lambda) are undefined for
+            # a single band (the reference NaNs on C=1)
+            c = int(rng.integers(2, 4))
+            h = int(rng.integers(32, 80))
+            w = int(rng.integers(32, 80))
+            dr = float(rng.choice([1.0, 2.0, 255.0]))
+            a = (rng.random((n, c, h, w)) * dr).astype(np.float32)
+            b = (rng.random((n, c, h, w)) * dr).astype(np.float32)
+            ja, jb = jnp.asarray(a), jnp.asarray(b)
+            ta, tb = torch.from_numpy(a), torch.from_numpy(b)
+
+            sigma = float(rng.uniform(0.8, 2.0))
+            k = int(rng.choice([7, 9, 11]))
+            cmp(
+                "ssim",
+                F.structural_similarity_index_measure(ja, jb, data_range=dr, sigma=sigma, kernel_size=k),
+                RF.structural_similarity_index_measure(ta, tb, data_range=dr, sigma=sigma, kernel_size=k),
+                atol=1e-4,
+            )
+            # the reference's uniform-kernel SSIM crashes on multi-channel
+            # input (its [1,1,k,k] kernel is never expanded to the channel
+            # group count — upstream bug in v0.10.0dev, found by this fuzz);
+            # this build handles any C, so compare on a 1-channel slice
+            cmp(
+                "ssim_uniform",
+                F.structural_similarity_index_measure(ja[:, :1], jb[:, :1], data_range=dr, gaussian_kernel=False, kernel_size=k),
+                RF.structural_similarity_index_measure(ta[:, :1], tb[:, :1], data_range=dr, gaussian_kernel=False, kernel_size=k),
+                atol=1e-4,
+            )
+            cmp("psnr", F.peak_signal_noise_ratio(ja, jb, data_range=dr), RF.peak_signal_noise_ratio(ta, tb, data_range=dr), atol=1e-3)
+            cmp("uqi", F.universal_image_quality_index(ja, jb), RF.universal_image_quality_index(ta, tb), atol=1e-4)
+            cmp("ergas", F.error_relative_global_dimensionless_synthesis(ja, jb), RF.error_relative_global_dimensionless_synthesis(ta, tb), atol=1e-2)
+            cmp("sam", F.spectral_angle_mapper(ja, jb), RF.spectral_angle_mapper(ta, tb), atol=1e-4)
+            cmp("d_lambda", F.spectral_distortion_index(ja, jb), RF.spectral_distortion_index(ta, tb), atol=1e-4)
+
+            gy_o, gx_o = F.image_gradients(ja)
+            gy_r, gx_r = RF.image_gradients(ta)
+            cmp("grad_y", gy_o, gy_r, atol=1e-5)
+            cmp("grad_x", gx_o, gx_r, atol=1e-5)
+
+        # MS-SSIM needs larger inputs (5 scales); one fixed-size trial
+        a = rng.random((2, 3, 180, 180)).astype(np.float32)
+        b = rng.random((2, 3, 180, 180)).astype(np.float32)
+        cmp(
+            "ms_ssim",
+            F.multiscale_structural_similarity_index_measure(jnp.asarray(a), jnp.asarray(b), data_range=1.0),
+            RF.multiscale_structural_similarity_index_measure(torch.from_numpy(a), torch.from_numpy(b), data_range=1.0),
+            atol=1e-4,
+        )
+
+
+@pytest.mark.parametrize("seed", [31, 101])
+def test_differential_fuzz_audio(seed):
+    """Random-signal audio kernels vs the reference: SNR, SI-SNR, SI-SDR
+    (with and without zero-mean), SDR, and exhaustive-permutation PIT."""
+    RF = import_reference().functional
+    torch = _torch()
+    rng = np.random.default_rng(seed)
+
+    def cmp(name, ours, theirs, atol=1e-3):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), atol=atol, err_msg=name)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(2):
+            n = int(rng.integers(1, 4))
+            # keep signals longer than SDR's 512-tap distortion filter: below
+            # that the Toeplitz system is underdetermined and the reference
+            # returns NaN in every precision (found by this fuzz; this build
+            # regularizes instead, but neither number is a meaningful SDR)
+            t_len = int(rng.integers(600, 2000))
+            tgt = rng.standard_normal((n, t_len)).astype(np.float32)
+            est = (tgt + 0.3 * rng.standard_normal((n, t_len))).astype(np.float32)
+            je, jt = jnp.asarray(est), jnp.asarray(tgt)
+            te, tt = torch.from_numpy(est), torch.from_numpy(tgt)
+
+            cmp("snr", F.signal_noise_ratio(je, jt), RF.signal_noise_ratio(te, tt))
+            cmp("snr_zm", F.signal_noise_ratio(je, jt, zero_mean=True), RF.signal_noise_ratio(te, tt, zero_mean=True))
+            cmp("si_snr", F.scale_invariant_signal_noise_ratio(je, jt), RF.scale_invariant_signal_noise_ratio(te, tt))
+            cmp("si_sdr", F.scale_invariant_signal_distortion_ratio(je, jt), RF.scale_invariant_signal_distortion_ratio(te, tt))
+            cmp(
+                "si_sdr_zm",
+                F.scale_invariant_signal_distortion_ratio(je, jt, zero_mean=True),
+                RF.scale_invariant_signal_distortion_ratio(te, tt, zero_mean=True),
+            )
+            cmp("sdr", F.signal_distortion_ratio(je, jt), RF.signal_distortion_ratio(te, tt), atol=5e-2)
+
+            # PIT over S speakers with exhaustive permutation search: one
+            # coherent speaker permutation applied to whole signals (so the
+            # best assignment is unambiguous and ref_perm is ground truth)
+            s = int(rng.integers(2, 4))
+            mix_t = rng.standard_normal((n, s, t_len)).astype(np.float32)
+            perm = rng.permutation(s)
+            mix_e = mix_t[:, perm, :] + 0.2 * rng.standard_normal((n, s, t_len)).astype(np.float32)
+            jme, jmt = jnp.asarray(mix_e), jnp.asarray(mix_t)
+            tme, tmt = torch.from_numpy(mix_e), torch.from_numpy(mix_t)
+            ours_val, ours_perm = F.permutation_invariant_training(
+                jme, jmt, F.scale_invariant_signal_distortion_ratio, eval_func="max"
+            )
+            ref_val, ref_perm = RF.permutation_invariant_training(
+                tme, tmt, RF.scale_invariant_signal_distortion_ratio, eval_func="max"
+            )
+            cmp("pit_val", ours_val, ref_val)
+            cmp("pit_perm", ours_perm, ref_perm.numpy())
