@@ -52,6 +52,34 @@ def test_differential_fuzz_vs_reference(seed):
             cmp("auroc", F.auroc(jp, jt, num_classes=c, average="macro"), RF.auroc(tp, tt, num_classes=c, average="macro"))
             cmp("calibration", F.calibration_error(jp, jt), RF.calibration_error(tp, tt))
 
+            # the canonicalizer's branchy parameter paths: top-k selection,
+            # ignore_index masking, and multidim-multiclass reductions
+            if c > 2:  # top_k must be strictly smaller than C
+                k = int(rng.integers(2, c))
+                cmp("accuracy_topk", F.accuracy(jp, jt, num_classes=c, top_k=k), RF.accuracy(tp, tt, num_classes=c, top_k=k))
+            ign = int(rng.integers(0, c))
+            cmp(
+                "accuracy_ignore",
+                F.accuracy(jp, jt, num_classes=c, ignore_index=ign),
+                RF.accuracy(tp, tt, num_classes=c, ignore_index=ign),
+            )
+            d = int(rng.integers(2, 9))
+            p3 = rng.random((n, c, d)).astype(np.float32)
+            t3 = rng.integers(0, c, (n, d))
+            jp3, jt3 = jnp.asarray(p3), jnp.asarray(t3)
+            tp3, tt3 = torch.from_numpy(p3), torch.from_numpy(t3)
+            for mdmc in ("global", "samplewise"):
+                cmp(
+                    f"accuracy_mdmc_{mdmc}",
+                    F.accuracy(jp3, jt3, num_classes=c, mdmc_average=mdmc),
+                    RF.accuracy(tp3, tt3, num_classes=c, mdmc_average=mdmc),
+                )
+                cmp(
+                    f"stat_scores_mdmc_{mdmc}",
+                    F.stat_scores(jp3, jt3, num_classes=c, reduce="macro", mdmc_reduce=mdmc),
+                    RF.stat_scores(tp3, tt3, num_classes=c, reduce="macro", mdmc_reduce=mdmc),
+                )
+
             x = rng.standard_normal(n).astype(np.float32)
             y = (x + 0.5 * rng.standard_normal(n)).astype(np.float32)
             jx, jy = jnp.asarray(x), jnp.asarray(y)
