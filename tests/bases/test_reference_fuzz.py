@@ -510,3 +510,76 @@ def test_differential_fuzz_audio(seed):
             )
             cmp("pit_val", ours_val, ref_val)
             cmp("pit_perm", ours_perm, ref_perm.numpy())
+
+
+@pytest.mark.parametrize("seed", [37, 61])
+def test_differential_fuzz_losses_ranking(seed):
+    """Hinge (binary + multiclass crammer-singer), KL divergence (all
+    reductions), AUC (with and without reorder), and the multilabel ranking
+    family vs the reference."""
+    RF = import_reference().functional
+    torch = _torch()
+    rng = np.random.default_rng(seed)
+
+    def cmp(name, ours, theirs, atol=1e-4):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), atol=atol, equal_nan=True, err_msg=name)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(3):
+            n = int(rng.integers(5, 40))
+            c = int(rng.integers(3, 6))
+
+            # binary hinge: raw scores + {0,1} targets
+            sc = rng.standard_normal(n).astype(np.float32)
+            bt = rng.integers(0, 2, n)
+            cmp("hinge_binary", F.hinge_loss(jnp.asarray(sc), jnp.asarray(bt)), RF.hinge_loss(torch.from_numpy(sc), torch.from_numpy(bt)))
+
+            # multiclass hinge, both decision modes
+            mc = rng.standard_normal((n, c)).astype(np.float32)
+            mt = rng.integers(0, c, n)
+            jm, jt = jnp.asarray(mc), jnp.asarray(mt)
+            tm, tt = torch.from_numpy(mc), torch.from_numpy(mt)
+            cmp("hinge_mc", F.hinge_loss(jm, jt), RF.hinge_loss(tm, tt))
+            cmp(
+                "hinge_cs",
+                F.hinge_loss(jm, jt, multiclass_mode="crammer-singer"),
+                RF.hinge_loss(tm, tt, multiclass_mode="crammer-singer"),
+            )
+            cmp(
+                "hinge_ovr",
+                F.hinge_loss(jm, jt, multiclass_mode="one-vs-all"),
+                RF.hinge_loss(tm, tt, multiclass_mode="one-vs-all"),
+            )
+
+            # KL divergence over distribution pairs, all reductions
+            p = rng.random((n, c)).astype(np.float32) + 1e-3
+            q = rng.random((n, c)).astype(np.float32) + 1e-3
+            p /= p.sum(1, keepdims=True); q /= q.sum(1, keepdims=True)
+            jp_, jq = jnp.asarray(p), jnp.asarray(q)
+            tp_, tq = torch.from_numpy(p), torch.from_numpy(q)
+            for red in ("mean", "sum", "none"):
+                cmp(f"kld_{red}", F.kl_divergence(jp_, jq, reduction=red), RF.kl_divergence(tp_, tq, reduction=red))
+            cmp("kld_log", F.kl_divergence(jnp.log(jp_), jq, log_prob=True), RF.kl_divergence(torch.log(tp_), tq, log_prob=True))
+
+            # AUC: unsorted x with reorder, sorted x without
+            x = np.sort(rng.random(n).astype(np.float32))
+            y = rng.random(n).astype(np.float32)
+            cmp("auc_sorted", F.auc(jnp.asarray(x), jnp.asarray(y)), RF.auc(torch.from_numpy(x), torch.from_numpy(y)))
+            xs = rng.permutation(x).astype(np.float32)
+            cmp(
+                "auc_reorder",
+                F.auc(jnp.asarray(xs), jnp.asarray(y), reorder=True),
+                RF.auc(torch.from_numpy(xs), torch.from_numpy(y), reorder=True),
+            )
+
+            # multilabel ranking family
+            ml_s = rng.standard_normal((n, c)).astype(np.float32)
+            ml_t = (rng.random((n, c)) < 0.4).astype(np.int64)
+            # every row needs at least one positive for LRAP to be defined
+            ml_t[np.arange(n), rng.integers(0, c, n)] = 1
+            js, jlt = jnp.asarray(ml_s), jnp.asarray(ml_t)
+            ts, tlt = torch.from_numpy(ml_s), torch.from_numpy(ml_t)
+            cmp("coverage", F.coverage_error(js, jlt), RF.coverage_error(ts, tlt))
+            cmp("lrap", F.label_ranking_average_precision(js, jlt), RF.label_ranking_average_precision(ts, tlt))
+            cmp("lr_loss", F.label_ranking_loss(js, jlt), RF.label_ranking_loss(ts, tlt))

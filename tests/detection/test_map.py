@@ -233,3 +233,65 @@ def test_map_segm_mixed_resolutions():
     result = metric.compute()
     np.testing.assert_allclose(float(result["map"]), 1.0, atol=1e-6)
     np.testing.assert_allclose(float(result["mar_100"]), 1.0, atol=1e-6)
+
+
+def test_map_empty_metric_compute():
+    """compute() on a never-updated metric must not crash (reference
+    ``test_map.py:414-418``)."""
+    metric = MeanAveragePrecision()
+    res = metric.compute()
+    assert float(res["map"]) == -1.0
+
+
+def test_map_missing_pred_and_missing_gt():
+    """One good detection plus a false negative (missing pred) or a false
+    positive (missing gt) pins map strictly below 1 (reference
+    ``test_map.py:421-463``)."""
+    box = np.array([[10, 20, 15, 25]], np.float32)
+    lab = np.array([0])
+    empty_p = dict(boxes=np.zeros((0, 4), np.float32), scores=np.zeros(0, np.float32), labels=np.zeros(0, np.int64))
+    good_p = dict(boxes=box, scores=np.array([0.9], np.float32), labels=lab)
+
+    m = MeanAveragePrecision()
+    m.update([good_p, empty_p], [dict(boxes=box, labels=lab), dict(boxes=box, labels=lab)])
+    assert float(m.compute()["map"]) < 1
+
+    m = MeanAveragePrecision()
+    m.update(
+        [good_p, dict(boxes=box, scores=np.array([0.95], np.float32), labels=lab)],
+        [dict(boxes=box, labels=lab), dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros(0, np.int64))],
+    )
+    assert float(m.compute()["map"]) < 1
+
+
+def test_map_custom_iou_thresholds():
+    """With thresholds excluding 0.5/0.75, map_50 and map_75 report -1
+    (reference ``test_map.py:402-411``)."""
+    metric = MeanAveragePrecision(iou_thresholds=[0.1, 0.2])
+    metric.update(
+        [dict(boxes=np.array([[258.0, 41.0, 606.0, 285.0]], np.float32), scores=np.array([0.536], np.float32), labels=np.array([0]))],
+        [dict(boxes=np.array([[214.0, 41.0, 562.0, 285.0]], np.float32), labels=np.array([0]))],
+    )
+    res = metric.compute()
+    assert float(res["map_50"]) == -1.0
+    assert float(res["map_75"]) == -1.0
+    assert float(res["map"]) >= 0
+
+
+def test_segm_empty_gt_and_empty_pred_masks():
+    """Empty mask arrays on either side must compute cleanly (reference
+    ``test_map.py:465-505``)."""
+    pred_mask = (np.arange(100).reshape(1, 10, 10) % 7 == 0)
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(
+        [dict(masks=pred_mask, scores=np.array([0.5], np.float32), labels=np.array([4]))],
+        [dict(masks=np.zeros((0, 10, 10), bool), labels=np.zeros(0, np.int64))],
+    )
+    m.compute()
+
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(
+        [dict(masks=np.zeros((0, 10, 10), bool), scores=np.zeros(0, np.float32), labels=np.zeros(0, np.int64))],
+        [dict(masks=pred_mask, labels=np.array([4]))],
+    )
+    m.compute()
