@@ -1,0 +1,268 @@
+"""Ported canonicalizer case matrix (reference
+``test/unittests/classification/test_inputs.py``, 312 LoC): every usual
+input case with its expected mode + canonical form, the threshold boundary,
+and the full incorrect-input / incorrect-top_k rejection grids.
+
+`_input_format_classification` is the single most load-bearing helper in
+the library (SURVEY.md §2.3) — this pins its observable contract.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+
+NUM_CLASSES = 5
+BATCH_SIZE = 8
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+_rng = np.random.default_rng(42)
+
+
+def _rand(*shape):
+    return jnp.asarray(_rng.random(shape), jnp.float32)
+
+
+def _randint(high, shape):
+    return jnp.asarray(_rng.integers(0, high, shape))
+
+
+def _norm(p, axis):
+    return p / p.sum(axis=axis, keepdims=True)
+
+
+# input fixtures (single batch each; the reference indexes [0] of its
+# NUM_BATCHES stacks)
+_bin = (_randint(2, (BATCH_SIZE,)), _randint(2, (BATCH_SIZE,)))
+_bin_prob = (_rand(BATCH_SIZE), _randint(2, (BATCH_SIZE,)))
+_ml_prob = (_rand(BATCH_SIZE, NUM_CLASSES), _randint(2, (BATCH_SIZE, NUM_CLASSES)))
+_ml = (_randint(2, (BATCH_SIZE, NUM_CLASSES)), _randint(2, (BATCH_SIZE, NUM_CLASSES)))
+_mlmd = (
+    _randint(2, (BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+    _randint(2, (BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)),
+)
+_mlmd_prob = (_rand(BATCH_SIZE, NUM_CLASSES, EXTRA_DIM), _randint(2, (BATCH_SIZE, NUM_CLASSES, EXTRA_DIM)))
+_mc = (_randint(NUM_CLASSES, (BATCH_SIZE,)), _randint(NUM_CLASSES, (BATCH_SIZE,)))
+_mc_prob = (_norm(_rand(BATCH_SIZE, NUM_CLASSES), 1), _randint(NUM_CLASSES, (BATCH_SIZE,)))
+_mdmc = (
+    _randint(NUM_CLASSES, (BATCH_SIZE, EXTRA_DIM)),
+    _randint(NUM_CLASSES, (BATCH_SIZE, EXTRA_DIM)),
+)
+_mdmc_prob = (
+    _norm(_rand(BATCH_SIZE, NUM_CLASSES, EXTRA_DIM), 1),
+    _randint(NUM_CLASSES, (BATCH_SIZE, EXTRA_DIM)),
+)
+_mdmc_prob_many_dims = (
+    _norm(_rand(BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM), 1),
+    _randint(NUM_CLASSES, (BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+_mc_prob_2cls = (_norm(_rand(BATCH_SIZE, 2), 1), _randint(2, (BATCH_SIZE,)))
+_mdmc_prob_2cls = (_norm(_rand(BATCH_SIZE, 2, EXTRA_DIM), 1), _randint(2, (BATCH_SIZE, EXTRA_DIM)))
+_ml_prob_half = (_ml_prob[0].astype(jnp.float16), _ml_prob[1])
+
+
+# post-transforms describing the expected canonical form
+def _idn(x):
+    return x
+
+
+def _usq(x):
+    return x[..., None]
+
+
+def _thrs(x):
+    return x >= THRESHOLD
+
+
+def _rshp1(x):
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(x, NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(x, 2)
+
+
+def _top1(x):
+    return select_topk(x, 1)
+
+
+def _top2(x):
+    return select_topk(x, 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x).astype(jnp.int32))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)).astype(jnp.int32))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        # usual expected cases (reference rows :134-149)
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        # special cases (reference rows :150-168)
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(x.astype(jnp.float32)), _rshp1),
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target):
+    preds_in, target_in = inputs
+    for batch_slice in (slice(None), slice(0, 1)):  # full batch and batch_size=1
+        p, t = preds_in[batch_slice], target_in[batch_slice]
+        preds_out, target_out, mode = _input_format_classification(
+            preds=p, target=t, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+        )
+        assert mode == DataType(exp_mode)
+        np.testing.assert_array_equal(np.asarray(preds_out), np.asarray(post_preds(p)).astype(np.int32))
+        np.testing.assert_array_equal(np.asarray(target_out), np.asarray(post_target(t)).astype(np.int32))
+
+
+def test_threshold():
+    """Threshold boundary: >= passes, < fails (reference :206-212)."""
+    target = jnp.asarray([1, 1, 1])
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(preds_out).squeeze(), [0, 1, 1])
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass",
+    [
+        # target not integer
+        (_randint(2, (7,)), _randint(2, (7,)).astype(jnp.float32), None, None),
+        # target negative
+        (_randint(2, (7,)), -1 - _randint(2, (7,)), None, None),
+        # preds negative integers
+        (-1 - _randint(2, (7,)), _randint(2, (7,)), None, None),
+        # multiclass=False and target > 1
+        (_rand(7), 2 + _randint(2, (7,)), None, False),
+        # multiclass=False and preds integers with > 1
+        (2 + _randint(2, (7,)), _randint(2, (7,)), None, False),
+        # wrong batch size
+        (_randint(2, (8,)), _randint(2, (7,)), None, None),
+        # completely wrong shape
+        (_randint(2, (7,)), _randint(2, (7, 4)), None, None),
+        # same #dims, different shape
+        (_randint(2, (7, 3)), _randint(2, (7, 4)), None, None),
+        # same shape, preds floats, target not binary
+        (_rand(7, 3), 2 + _randint(2, (7, 3)), None, None),
+        # #dims preds = 1 + #dims target, C not second or last
+        (_rand(7, 3, 4, 3), _randint(4, (7, 3, 3)), None, None),
+        # #dims preds = 1 + #dims target, preds not float
+        (_randint(2, (7, 3, 3, 4)), _randint(4, (7, 3, 3)), None, None),
+        # multiclass=False with C dimension > 2
+        (_mc_prob[0], _randint(2, (BATCH_SIZE,)), None, False),
+        # max target >= C dimension
+        (_mc_prob[0], NUM_CLASSES + 1 + _randint(94, (BATCH_SIZE,)), None, None),
+        # C dimension != num_classes
+        (_mc_prob[0], _mc_prob[1], NUM_CLASSES + 1, None),
+        # max target > num_classes (#dims preds = 1 + #dims target)
+        (_mc_prob[0], NUM_CLASSES + 1 + _randint(94, (BATCH_SIZE, NUM_CLASSES)), 4, None),
+        # max target > num_classes (#dims preds = #dims target)
+        (_randint(4, (7, 3)), 5 + _randint(2, (7, 3)), 4, None),
+        # num_classes=1 but multiclass not false
+        (_randint(2, (7,)), _randint(2, (7,)), 1, None),
+        # multiclass=False but implied class dim != num_classes
+        (_randint(2, (7, 3, 3)), _randint(2, (7, 3, 3)), 4, False),
+        # multilabel input with implied class dim != num_classes
+        (_rand(7, 3, 3), _randint(2, (7, 3, 3)), 4, False),
+        # multilabel with multiclass=True but num_classes != 2
+        (_rand(7, 3), _randint(2, (7, 3)), 4, True),
+        # binary input, num_classes > 2
+        (_rand(7), _randint(2, (7,)), 4, None),
+        # binary input, num_classes == 2, multiclass not True
+        (_rand(7), _randint(2, (7,)), 2, None),
+        (_rand(7), _randint(2, (7,)), 2, False),
+        # binary input, num_classes == 1, multiclass=True
+        (_rand(7), _randint(2, (7,)), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=preds, target=target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, top_k",
+    [
+        # top_k with non-(md)mc-or-ml-prob data
+        (_bin[0], _bin[1], None, None, 2),
+        (_bin_prob[0], _bin_prob[1], None, None, 2),
+        (_mc[0], _mc[1], None, None, 2),
+        (_ml[0], _ml[1], None, None, 2),
+        (_mlmd[0], _mlmd[1], None, None, 2),
+        (_mdmc[0], _mdmc[1], None, None, 2),
+        # top_k = 0 / float
+        (_mc_prob_2cls[0], _mc_prob_2cls[1], None, None, 0),
+        (_mc_prob_2cls[0], _mc_prob_2cls[1], None, None, 0.123),
+        # top_k = 2 with 2 classes, multiclass=False
+        (_mc_prob_2cls[0], _mc_prob_2cls[1], None, False, 2),
+        # top_k = C
+        (_mc_prob[0], _mc_prob[1], None, None, NUM_CLASSES),
+        # multiclass=True for ml prob with top_k set
+        (_ml_prob[0], _ml_prob[1], None, True, 2),
+        (_ml_prob[0], _ml_prob[1], None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=preds, target=target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+        )
