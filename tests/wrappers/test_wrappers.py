@@ -13,6 +13,7 @@ from metrics_tpu import (
     Accuracy,
     BootStrapper,
     ClasswiseWrapper,
+    ConfusionMatrix,
     MeanSquaredError,
     MetricCollection,
     MetricTracker,
@@ -20,6 +21,7 @@ from metrics_tpu import (
     MultioutputWrapper,
     Precision,
     R2Score,
+    Recall,
 )
 from tests.helpers import seed_all
 
@@ -397,3 +399,102 @@ class TestWrapperFunctionalize:
         md.compute(s)
         assert child._update_count == 0 and not child._update_called
         assert w._update_count == 0 and not w._update_called
+
+
+@pytest.mark.parametrize("prefix", [None, "pre_"])
+@pytest.mark.parametrize("postfix", [None, "_post"])
+def test_classwise_in_collection_with_affixes(prefix, postfix):
+    """ClasswiseWrapper inside a MetricCollection: 6 per-class keys with
+    prefix/postfix applied (reference ``test_classwise.py:41-69``)."""
+    labels = ["horse", "fish", "cat"]
+    metric = MetricCollection(
+        {
+            "accuracy": ClasswiseWrapper(Accuracy(num_classes=3, average=None), labels=labels),
+            "recall": ClasswiseWrapper(Recall(num_classes=3, average=None), labels=labels),
+        },
+        prefix=prefix,
+        postfix=postfix,
+    )
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.random((10, 3)), jnp.float32)
+    preds = preds / preds.sum(1, keepdims=True)
+    target = jnp.asarray(rng.integers(0, 3, 10))
+    val = metric(preds, target)
+    assert isinstance(val, dict)
+    assert len(val) == 6
+
+    def name_of(base):
+        name = base if prefix is None else prefix + base
+        return name if postfix is None else name + postfix
+
+    for lab in labels:
+        assert name_of(f"accuracy_{lab}") in val
+        assert name_of(f"recall_{lab}") in val
+
+
+def test_minmax_error_contracts():
+    """Non-metric ctor arg raises; non-scalar base compute raises
+    (reference ``test_minmax.py:112-123``)."""
+    with pytest.raises(ValueError, match="Expected base metric to be an instance"):
+        MinMaxMetric([])
+    nsm = MinMaxMetric(ConfusionMatrix(num_classes=2))
+    nsm.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+    with pytest.raises(RuntimeError, match="Returned value from base metric should be a scalar"):
+        nsm.compute()
+
+
+@pytest.mark.parametrize(
+    "base_metric",
+    [
+        ConfusionMatrix(num_classes=3),
+        MetricCollection([Accuracy(num_classes=3), ConfusionMatrix(num_classes=3)]),
+    ],
+)
+def test_tracker_best_metric_not_well_defined(base_metric):
+    """best_metric of a matrix-valued metric warns and returns None; in a
+    collection only the ill-defined member degrades (reference
+    ``test_tracker.py:129-165``)."""
+    tracker = MetricTracker(base_metric)
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        tracker.increment()
+        for _ in range(5):
+            tracker.update(jnp.asarray(rng.integers(0, 3, 10)), jnp.asarray(rng.integers(0, 3, 10)))
+
+    with pytest.warns(UserWarning, match="Encountered the following error when trying to get the best metric"):
+        best = tracker.best_metric()
+    if isinstance(best, dict):
+        assert best["Accuracy"] is not None
+        assert best["ConfusionMatrix"] is None
+    else:
+        assert best is None
+
+    with pytest.warns(UserWarning, match="Encountered the following error when trying to get the best metric"):
+        idx, best = tracker.best_metric(return_step=True)
+    if isinstance(best, dict):
+        assert best["Accuracy"] is not None and idx["Accuracy"] is not None
+        assert best["ConfusionMatrix"] is None and idx["ConfusionMatrix"] is None
+    else:
+        assert best is None and idx is None
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler_properties(sampling_strategy):
+    """Sampled indices only reference existing rows, and resampling
+    actually resamples (some row drawn twice, some dropped) — reference
+    ``test_bootstrapping.py:60-76``."""
+    from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+    rng = np.random.default_rng(11)
+    old_samples = rng.standard_normal((20, 2))
+    found_twice = found_dropped = False
+    for attempt in range(10):  # sampler is stochastic; retry like the reference's loop
+        idx = np.asarray(_bootstrap_sampler(20, sampling_strategy=sampling_strategy))
+        assert ((idx >= 0) & (idx < 20)).all()
+        counts = np.bincount(idx, minlength=20)
+        found_twice = found_twice or (counts >= 2).any()
+        found_dropped = found_dropped or (counts == 0).any()
+        if found_twice and found_dropped:
+            break
+    assert found_twice, "no row was ever drawn twice"
+    assert found_dropped, "no row was ever dropped"
