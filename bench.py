@@ -442,6 +442,48 @@ def _phase_vsref(jax, platform) -> None:
     except Exception as err:  # pragma: no cover
         print(f"bench: vsref ssim failed: {err}", file=sys.stderr)
 
+    # --- Retrieval MAP over 20k ragged queries: bucketed vectorized grouping
+    # vs the reference's host dict loop (one .item() sync per row,
+    # reference utilities/data.py:210-233)
+    try:
+        import jax.numpy as jnp
+
+        import torchmetrics as RM
+
+        from metrics_tpu import RetrievalMAP
+
+        rng = np.random.default_rng(7)
+        nq = 20_000
+        sizes = rng.integers(5, 30, nq)
+        idx = np.repeat(np.arange(nq), sizes)
+        preds = rng.random(idx.size).astype(np.float32)
+        target = (rng.random(idx.size) < 0.2).astype(np.int64)
+
+        ours_m = RetrievalMAP()
+        ours_m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+        float(ours_m.compute())  # warm compile
+        t0 = time.perf_counter()
+        ours_m = RetrievalMAP()
+        ours_m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+        ours_val = float(ours_m.compute())
+        ours_s = time.perf_counter() - t0
+
+        theirs_m = RM.RetrievalMAP()
+        t0 = time.perf_counter()
+        theirs_m.update(torch.from_numpy(preds), torch.from_numpy(target), indexes=torch.from_numpy(idx))
+        theirs_val = float(theirs_m.compute())
+        ref_s = time.perf_counter() - t0
+        assert abs(ours_val - theirs_val) < 1e-4, (ours_val, theirs_val)
+        _emit(
+            "retrieval_map_20k_queries_s",
+            round(ours_s, 4),
+            f"s update+compute, 20k ragged queries ({platform}); reference torch-cpu dict-loop "
+            f"same data: {ref_s:.3f}s",
+            round(ref_s / ours_s, 2),
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: vsref retrieval failed: {err}", file=sys.stderr)
+
 
 def _phase_detection(jax, platform) -> None:
     """COCO mAP at scale: 100 images x 50 boxes, box IoU + greedy matching
