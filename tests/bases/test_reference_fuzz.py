@@ -389,6 +389,22 @@ def test_differential_fuzz_text(seed):
                 for key in ("rouge1_fmeasure", "rouge2_fmeasure", "rougeL_fmeasure"):
                     cmp(f"rouge:{key}", r_ours[key], r_ref[key])
 
+            # SQuAD: the official normalization rules (article dropping,
+            # punctuation stripping, casing, whitespace collapse) against
+            # adversarially decorated answers with multi-answer targets
+            decorations = ["The {}!", "a {}.", "  {} ", "{},", "AN {}", "{}"]
+            sq_preds, sq_target = [], []
+            for qi in range(n):
+                base = sentence(1, 5)
+                deco = str(rng.choice(decorations))
+                sq_preds.append({"prediction_text": deco.format(base), "id": f"q{qi}"})
+                alts = [base if rng.random() < 0.5 else sentence(1, 5), sentence(1, 4)]
+                sq_target.append({"answers": {"answer_start": [0, 0], "text": alts}, "id": f"q{qi}"})
+            ours_sq = F.squad(sq_preds, sq_target)
+            ref_sq = RF.squad(sq_preds, sq_target)
+            cmp("squad_em", ours_sq["exact_match"], ref_sq["exact_match"])
+            cmp("squad_f1", ours_sq["f1"], ref_sq["f1"])
+
 
 @pytest.mark.parametrize("seed", [23, 89])
 def test_differential_fuzz_image(seed):
