@@ -251,6 +251,25 @@ def test_differential_fuzz_retrieval_ragged(seed):
                 float(ours.compute()), float(theirs.compute()), atol=1e-5, err_msg=name
             )
 
+        # positive-free queries through each empty_target_action (the base
+        # class's special path, reference retrieval/base.py:44-52,110-139) —
+        # zero out two random queries' positives
+        target_empty = target.copy()
+        empty_qs = rng.choice(num_queries, 2, replace=False)
+        for q in empty_qs:
+            target_empty[indexes == q] = 0
+        jte = jnp.asarray(target_empty)
+        tte = torch.from_numpy(target_empty)
+        for action in ("neg", "pos", "skip"):
+            ours = mt.RetrievalMAP(empty_target_action=action)
+            theirs = ref.RetrievalMAP(empty_target_action=action)
+            ours.update(jp, jte, indexes=ji)
+            theirs.update(tp, tte, indexes=ti)
+            np.testing.assert_allclose(
+                float(ours.compute()), float(theirs.compute()), atol=1e-5,
+                err_msg=f"empty_target_action={action}",
+            )
+
 
 @pytest.mark.parametrize("seed", [23, 67, 101])
 def test_fuzz_exact_vs_capacity_under_random_fill(seed):
