@@ -183,3 +183,100 @@ class TestMeanMergeDrift:
         got = float(m.compute())
         exp = float(np.mean(vals, dtype=np.float64))
         np.testing.assert_allclose(got, exp, rtol=5e-5)
+
+
+class TestSyncedStateDictLifecycle:
+    """The reference's DDP state-dict/sync lifecycle loop
+    (``test_ddp.py:130-235``) on a stubbed 2-process gather: synced values
+    double, unsync restores the local stream, every double-entry error
+    fires, and state_dict snapshots whichever regime is active."""
+
+    def _metric(self):
+        class DummyCatMetric(mt.Metric):
+            full_state_update = True
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+                self.add_state("c", default=jnp.asarray(0.0), dist_reduce_fx="mean")
+
+            def update(self, v):
+                self.x = self.x + jnp.asarray(v, jnp.float32)
+                self.c = self.c + 1.0
+
+            def compute(self):
+                return self.x
+
+        m = DummyCatMetric()
+        m.persistent(True)
+        return m
+
+    def test_lifecycle_loop(self):
+        from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+        metric = self._metric()
+        # emulate world_size=2: every rank contributes an identical replica
+        # (the reference's test gets this from a real 2-proc gloo group)
+        sync_kwargs = dict(
+            dist_sync_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+
+        def verify(i, world_size):
+            exp_sum = i * (i + 1) / 2
+            sd = metric.state_dict()
+            np.testing.assert_allclose(float(np.asarray(sd["x"])), exp_sum * world_size)
+            np.testing.assert_allclose(float(np.asarray(metric.x)), exp_sum * world_size)
+            # mean-reduced state: stub gathers two identical replicas, so the
+            # mean equals the local count
+            np.testing.assert_allclose(float(np.asarray(metric.c)), i + 1)
+
+        for i in range(5):
+            if metric._is_synced:
+                with pytest.raises(MetricsTPUUserError, match="shouldn't be synced when performing"):
+                    metric(i)
+                metric.unsync()
+
+            metric(i)
+            verify(i, 1)
+
+            metric.sync(**sync_kwargs)
+            assert metric._is_synced
+            with pytest.raises(MetricsTPUUserError, match="has already been synced"):
+                metric.sync(**sync_kwargs)
+            verify(i, 2)
+
+            metric.unsync()
+            assert not metric._is_synced
+            with pytest.raises(MetricsTPUUserError, match="has already been un-synced"):
+                metric.unsync()
+
+            with metric.sync_context(**sync_kwargs):
+                assert metric._is_synced
+                verify(i, 2)
+            assert not metric._is_synced
+
+            with metric.sync_context(should_unsync=False, **sync_kwargs):
+                assert metric._is_synced
+                verify(i, 2)
+            assert metric._is_synced
+
+            metric.unsync()
+            metric.sync(**sync_kwargs)
+            cache = metric._cache
+            metric._cache = None
+            with pytest.raises(MetricsTPUUserError, match="internal cache should exist"):
+                metric.unsync()
+            metric._cache = cache
+
+        # reload semantics: synced snapshot then local snapshot
+        def reload(sd, expected_x):
+            m2 = self._metric()
+            m2.load_state_dict(sd)
+            np.testing.assert_allclose(float(np.asarray(m2.x)), expected_x)
+
+        import copy
+
+        reload(copy.deepcopy(metric.state_dict()), 20)  # synced: 2 * (0+..+4)
+        metric.unsync()
+        reload(copy.deepcopy(metric.state_dict()), 10)  # local stream
