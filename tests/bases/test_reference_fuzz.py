@@ -618,3 +618,55 @@ def test_differential_fuzz_losses_ranking(seed):
             cmp("coverage", F.coverage_error(js, jlt), RF.coverage_error(ts, tlt))
             cmp("lrap", F.label_ranking_average_precision(js, jlt), RF.label_ranking_average_precision(ts, tlt))
             cmp("lr_loss", F.label_ranking_loss(js, jlt), RF.label_ranking_loss(ts, tlt))
+
+
+@pytest.mark.parametrize("seed", [43, 79])
+def test_differential_fuzz_binned_curves(seed):
+    """Binned PR-curve family vs the reference's binned modules bit-for-bit:
+    same threshold grids (int count and explicit list), same static (C, T)
+    counter semantics — not just sklearn convergence."""
+    ref = import_reference()
+    torch = _torch()
+    import metrics_tpu as mt
+
+    rng = np.random.default_rng(seed)
+
+    def cmp(name, ours, theirs, atol=1e-5):
+        np.testing.assert_allclose(np.asarray(ours), np.asarray(theirs), atol=atol, equal_nan=True, err_msg=name)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for trial in range(2):
+            n = int(rng.integers(20, 80))
+            c = int(rng.integers(2, 5))
+            probs = rng.random((n, c)).astype(np.float32)
+            probs /= probs.sum(1, keepdims=True)
+            t = rng.integers(0, c, n)
+            jp, jt = jnp.asarray(probs), jnp.asarray(t)
+            tp, tt = torch.from_numpy(probs), torch.from_numpy(t)
+
+            thresholds = (
+                int(rng.integers(5, 40))
+                if trial == 0
+                else sorted(float(x) for x in rng.random(int(rng.integers(3, 9))))
+            )
+
+            ours_m = mt.BinnedPrecisionRecallCurve(num_classes=c, thresholds=thresholds)
+            ref_m = ref.BinnedPrecisionRecallCurve(num_classes=c, thresholds=thresholds)
+            cut = n // 2
+            ours_m.update(jp[:cut], jt[:cut]); ours_m.update(jp[cut:], jt[cut:])
+            ref_m.update(tp[:cut], tt[:cut]); ref_m.update(tp[cut:], tt[cut:])
+            o_prec, o_rec, o_thr = ours_m.compute()
+            r_prec, r_rec, r_thr = ref_m.compute()
+            for ci in range(c):
+                cmp(f"binned_prc_prec[{ci}]", o_prec[ci], r_prec[ci])
+                cmp(f"binned_prc_rec[{ci}]", o_rec[ci], r_rec[ci])
+            cmp("binned_prc_thr", o_thr[0] if isinstance(o_thr, (list, tuple)) else o_thr,
+                r_thr[0] if isinstance(r_thr, (list, tuple)) else r_thr)
+
+            ours_ap = mt.BinnedAveragePrecision(num_classes=c, thresholds=thresholds)
+            ref_ap = ref.BinnedAveragePrecision(num_classes=c, thresholds=thresholds)
+            ours_ap.update(jp, jt); ref_ap.update(tp, tt)
+            o = ours_ap.compute(); r = ref_ap.compute()
+            for ci in range(c):
+                cmp(f"binned_ap[{ci}]", o[ci], r[ci])
