@@ -12,7 +12,18 @@ Array = jax.Array
 
 
 class FBetaScore(StatScores):
-    """F-beta score (reference ``f_beta.py:24-147``)."""
+    """F-beta score (reference ``f_beta.py:24-147``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import FBetaScore
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = FBetaScore(num_classes=4, beta=0.5, average='macro')
+        >>> round(float(metric(preds, target)), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -54,7 +65,18 @@ class FBetaScore(StatScores):
 
 
 class F1Score(FBetaScore):
-    """F1 = F-beta with beta=1 (reference ``f_beta.py:150-275``)."""
+    """F1 = F-beta with beta=1 (reference ``f_beta.py:150-275``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import F1Score
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.05, 0.15], [0.1, 0.15, 0.7, 0.05],
+        ...                      [0.3, 0.4, 0.2, 0.1], [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.asarray([0, 1, 3, 2])
+        >>> metric = F1Score(num_classes=4, average='macro')
+        >>> round(float(metric(preds, target)), 4)
+        0.25
+    """
 
     is_differentiable = False
     higher_is_better = True

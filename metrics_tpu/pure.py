@@ -86,6 +86,16 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     subgraphs (e.g. four StatScores-backed metrics) are merged by XLA CSE
     inside the single jitted graph, which is the compile-time form of the
     reference's compute groups (``collections.py:191-267``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, functionalize
+        >>> mdef = functionalize(Accuracy(num_classes=3))
+        >>> state = mdef.init()
+        >>> preds = jnp.asarray([[0.9, 0.05, 0.05], [0.1, 0.8, 0.1]])
+        >>> state = jax.jit(mdef.update)(state, preds, jnp.asarray([0, 2]))
+        >>> round(float(mdef.compute(state)), 4)
+        0.5
     """
     from metrics_tpu.collections import MetricCollection  # local import to avoid cycle
     from metrics_tpu.metric import Metric  # local import to avoid cycle
