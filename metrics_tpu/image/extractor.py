@@ -54,6 +54,16 @@ class TinyImageEncoder:
         data_range: input scale; images are mapped to ``[-1, 1]`` by
             ``2 * x / data_range - 1`` (use 255 for uint8 images, 1.0 for
             floats in ``[0, 1]``).
+
+    Example:
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.image.extractor import TinyImageEncoder
+        >>> rng = np.random.default_rng(0)
+        >>> encoder = TinyImageEncoder(feature_dim=16)
+        >>> imgs = jnp.asarray((rng.random((4, 3, 32, 32)) * 255).astype(np.uint8))
+        >>> encoder(imgs).shape
+        (4, 16)
     """
 
     def __init__(
