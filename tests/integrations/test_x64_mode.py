@@ -1,0 +1,57 @@
+"""The package must work under ``jax_enable_x64`` — users flip it globally
+and every state/default dtype choice has to survive (the reference works at
+float64 by construction; torch defaults are per-tensor).
+
+Runs in a subprocess because x64 must be set before backend init.
+"""
+import os
+import pathlib
+import subprocess
+import sys
+
+_PROBE = """
+import warnings; warnings.simplefilter("ignore")
+import numpy as np, jax, jax.numpy as jnp
+import metrics_tpu as mt
+from sklearn.metrics import accuracy_score, roc_auc_score
+
+rng = np.random.default_rng(0)
+p = rng.random((64, 5)); t = rng.integers(0, 5, 64)
+m = mt.Accuracy(num_classes=5)
+m.update(jnp.asarray(p), jnp.asarray(t))
+assert abs(float(m.compute()) - accuracy_score(t, p.argmax(1))) < 1e-7
+
+a = mt.AUROC(capacity=256)
+ps = rng.random(200); ts = (rng.random(200) < 0.4).astype(int)
+a.update(jnp.asarray(ps), jnp.asarray(ts))
+assert abs(float(a.compute()) - roc_auc_score(ts, ps)) < 1e-6
+
+c = mt.MetricCollection([mt.Precision(num_classes=5), mt.Recall(num_classes=5)])
+c.update(jnp.asarray(p), jnp.asarray(t))
+c.compute()
+
+mdef = mt.functionalize(mt.F1Score(num_classes=5))
+st = jax.jit(mdef.update)(mdef.init(), jnp.asarray(p), jnp.asarray(t))
+float(mdef.compute(st))
+
+ssim = mt.StructuralSimilarityIndexMeasure(data_range=1.0, streaming=True)
+x64 = jnp.asarray(rng.random((2, 3, 64, 64)))  # float64 under x64
+ssim.update(x64, x64)
+assert abs(float(ssim.compute()) - 1.0) < 1e-9
+
+import pickle
+pickle.loads(pickle.dumps(c))
+print("X64-OK")
+"""
+
+
+def test_package_works_under_x64():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    env["PYTHONPATH"] = str(pathlib.Path(__file__).resolve().parents[2])
+    proc = subprocess.run(
+        [sys.executable, "-c", _PROBE], capture_output=True, text=True, timeout=600, env=env
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "X64-OK" in proc.stdout
