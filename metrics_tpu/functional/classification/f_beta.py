@@ -128,6 +128,7 @@ def fbeta_score(
 def f1_score(
     preds: Array,
     target: Array,
+    beta: float = 1.0,
     average: Optional[str] = "micro",
     mdmc_average: Optional[str] = None,
     ignore_index: Optional[int] = None,
@@ -137,6 +138,11 @@ def f1_score(
     multiclass: Optional[bool] = None,
 ) -> Array:
     """F1 = F-beta with beta=1 (reference ``f_beta.py:255-354``).
+
+    ``beta`` is accepted (third positional, matching the reference's
+    signature so positional call sites port unchanged) and ignored exactly
+    as the reference ignores it — F1 always delegates with beta=1.0
+    (reference ``f_beta.py:250,351-353``).
 
     Example:
         >>> import jax.numpy as jnp
