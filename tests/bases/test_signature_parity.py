@@ -1,0 +1,66 @@
+"""Signature-surface parity vs the importable reference: every shared
+functional export accepts the reference's parameter names, and every shared
+module class accepts the reference's constructor parameters. Positional
+call sites from reference-based code must port unchanged (this sweep
+caught `f1_score` missing the reference's ignored-but-positional `beta`).
+"""
+import inspect
+
+import pytest
+
+import metrics_tpu as M
+import metrics_tpu.functional as F
+from tests.helpers.reference import import_reference
+
+# Documented divergence: bert_score replaces the reference's torch-infra
+# parameters (model download, device, threading) with the injected-encoder
+# contract (metrics_tpu/text/bert.py docstring, PARITY.md).
+_FUNCTIONAL_EXEMPT = {"bert_score"}
+
+# Reference ctor params that are deprecated no-ops there and intentionally
+# absent here.
+_CTOR_PARAM_EXEMPT = {"compute_on_step"}
+
+
+def _reference():
+    return import_reference()
+
+
+def test_functional_parameter_surface():
+    RF = _reference().functional
+    shared = [
+        n for n in dir(RF)
+        if not n.startswith("_") and hasattr(F, n) and callable(getattr(RF, n)) and n not in _FUNCTIONAL_EXEMPT
+    ]
+    assert len(shared) >= 75
+    gaps = {}
+    for n in sorted(shared):
+        try:
+            rp = set(inspect.signature(getattr(RF, n)).parameters)
+            op = set(inspect.signature(getattr(F, n)).parameters)
+        except (ValueError, TypeError):
+            continue
+        missing = rp - op
+        if missing:
+            gaps[n] = sorted(missing)
+    assert not gaps, f"functional exports missing reference parameters: {gaps}"
+
+
+def test_module_constructor_surface():
+    R = _reference()
+    shared = [
+        n for n in dir(R)
+        if not n.startswith("_") and hasattr(M, n) and inspect.isclass(getattr(R, n))
+    ]
+    assert len(shared) >= 80
+    gaps = {}
+    for n in sorted(shared):
+        try:
+            rp = set(inspect.signature(getattr(R, n).__init__).parameters) - {"self", "args", "kwargs"} - _CTOR_PARAM_EXEMPT
+            op = set(inspect.signature(getattr(M, n).__init__).parameters) - {"self", "args", "kwargs"}
+        except (ValueError, TypeError):
+            continue
+        missing = rp - op
+        if missing:
+            gaps[n] = sorted(missing)
+    assert not gaps, f"module classes missing reference ctor parameters: {gaps}"
