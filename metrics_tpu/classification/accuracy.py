@@ -48,7 +48,12 @@ class Accuracy(StatScores):
         threshold: float = 0.5,
         num_classes: Optional[int] = None,
         average: Optional[str] = "micro",
-        mdmc_average: Optional[str] = "global",
+        # None, not "global": multidim inputs must raise until the caller
+        # picks a reduction — the reference's class/functional defaults
+        # genuinely differ here (classification/accuracy.py:168 vs
+        # functional/classification/accuracy.py) and the error is part of
+        # the class contract
+        mdmc_average: Optional[str] = None,
         ignore_index: Optional[int] = None,
         top_k: Optional[int] = None,
         multiclass: Optional[bool] = None,

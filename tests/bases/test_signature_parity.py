@@ -1,12 +1,12 @@
 """Signature-surface parity vs the importable reference: every shared
-functional export accepts the reference's parameter names, and every shared
-module class accepts the reference's constructor parameters. Positional
-call sites from reference-based code must port unchanged (this sweep
-caught `f1_score` missing the reference's ignored-but-positional `beta`).
+functional export accepts the reference's parameter names with the
+reference's defaults, and every shared module class accepts the reference's
+constructor parameters. Positional call sites from reference-based code
+must port unchanged (this sweep caught `f1_score` missing the reference's
+ignored-but-positional `beta`, and `Accuracy` defaulting `mdmc_average`
+to 'global' where the reference's None makes multidim inputs raise).
 """
 import inspect
-
-import pytest
 
 import metrics_tpu as M
 import metrics_tpu.functional as F
@@ -22,45 +22,90 @@ _FUNCTIONAL_EXEMPT = {"bert_score"}
 _CTOR_PARAM_EXEMPT = {"compute_on_step"}
 
 
-def _reference():
-    return import_reference()
-
-
-def test_functional_parameter_surface():
-    RF = _reference().functional
-    shared = [
+def _shared_functionals():
+    RF = import_reference().functional
+    names = [
         n for n in dir(RF)
         if not n.startswith("_") and hasattr(F, n) and callable(getattr(RF, n)) and n not in _FUNCTIONAL_EXEMPT
     ]
-    assert len(shared) >= 75
+    assert len(names) >= 75
+    return [(n, getattr(RF, n), getattr(F, n)) for n in sorted(names)]
+
+
+def _shared_classes():
+    R = import_reference()
+    names = [
+        n for n in dir(R)
+        if not n.startswith("_") and hasattr(M, n) and inspect.isclass(getattr(R, n))
+    ]
+    assert len(names) >= 80
+    return [(n, getattr(R, n).__init__, getattr(M, n).__init__) for n in sorted(names)]
+
+
+def _param_sets(r_fn, o_fn, skip):
+    try:
+        rp = inspect.signature(r_fn).parameters
+        op = inspect.signature(o_fn).parameters
+    except (ValueError, TypeError):
+        return None
+    return (
+        {k: v for k, v in rp.items() if k not in skip},
+        {k: v for k, v in op.items() if k not in skip},
+    )
+
+
+def _surface_gaps(pairs, skip=frozenset()):
     gaps = {}
-    for n in sorted(shared):
-        try:
-            rp = set(inspect.signature(getattr(RF, n)).parameters)
-            op = set(inspect.signature(getattr(F, n)).parameters)
-        except (ValueError, TypeError):
+    for n, r_fn, o_fn in pairs:
+        sets = _param_sets(r_fn, o_fn, skip)
+        if sets is None:
             continue
-        missing = rp - op
+        missing = set(sets[0]) - set(sets[1])
         if missing:
             gaps[n] = sorted(missing)
+    return gaps
+
+
+def _default_gaps(pairs, skip=frozenset()):
+    gaps = {}
+    for n, r_fn, o_fn in pairs:
+        sets = _param_sets(r_fn, o_fn, skip)
+        if sets is None:
+            continue
+        rp, op = sets
+        out = []
+        for name, p in rp.items():
+            if name not in op:
+                continue  # reported by the surface sweep
+            rd, od = p.default, op[name].default
+            if rd is inspect.Parameter.empty:
+                continue
+            if od is inspect.Parameter.empty:
+                # reference-defaulted param made REQUIRED here: reference
+                # call sites omitting it break — a gap, not a skip
+                out.append((name, rd, "<required>"))
+            elif repr(rd) != repr(od):
+                out.append((name, rd, od))
+        if out:
+            gaps[n] = out
+    return gaps
+
+
+def test_functional_parameter_surface():
+    gaps = _surface_gaps(_shared_functionals())
     assert not gaps, f"functional exports missing reference parameters: {gaps}"
 
 
 def test_module_constructor_surface():
-    R = _reference()
-    shared = [
-        n for n in dir(R)
-        if not n.startswith("_") and hasattr(M, n) and inspect.isclass(getattr(R, n))
-    ]
-    assert len(shared) >= 80
-    gaps = {}
-    for n in sorted(shared):
-        try:
-            rp = set(inspect.signature(getattr(R, n).__init__).parameters) - {"self", "args", "kwargs"} - _CTOR_PARAM_EXEMPT
-            op = set(inspect.signature(getattr(M, n).__init__).parameters) - {"self", "args", "kwargs"}
-        except (ValueError, TypeError):
-            continue
-        missing = rp - op
-        if missing:
-            gaps[n] = sorted(missing)
+    gaps = _surface_gaps(_shared_classes(), skip={"self", "args", "kwargs"} | _CTOR_PARAM_EXEMPT)
     assert not gaps, f"module classes missing reference ctor parameters: {gaps}"
+
+
+def test_parameter_defaults_match():
+    """Shared parameters must share DEFAULTS too — a differing default
+    silently changes semantics."""
+    gaps = _default_gaps(_shared_functionals())
+    gaps.update(
+        {f"ctor.{k}": v for k, v in _default_gaps(_shared_classes(), skip={"self", "args", "kwargs"} | _CTOR_PARAM_EXEMPT).items()}
+    )
+    assert not gaps, f"parameter defaults diverge from the reference: {gaps}"
