@@ -92,6 +92,13 @@ def test_differential_fuzz_vs_reference(seed):
             ml_t = (rng.random((n, c)) < 0.4).astype(np.int64)
             cmp("ml_accuracy", F.accuracy(jnp.asarray(ml_p), jnp.asarray(ml_t)), RF.accuracy(torch.from_numpy(ml_p), torch.from_numpy(ml_t)))
             cmp("ml_hamming", F.hamming_distance(jnp.asarray(ml_p), jnp.asarray(ml_t)), RF.hamming_distance(torch.from_numpy(ml_p), torch.from_numpy(ml_t)))
+            # the samplewise averaging path (the one mode the micro/macro/
+            # weighted rotation above never exercises)
+            cmp(
+                "ml_f1_samples",
+                F.f1_score(jnp.asarray(ml_p), jnp.asarray(ml_t), average="samples"),
+                RF.f1_score(torch.from_numpy(ml_p), torch.from_numpy(ml_t), average="samples"),
+            )
 
 
 @pytest.mark.parametrize("seed", [7, 41, 83])
