@@ -9,8 +9,9 @@ Run: ``python examples/distributed_mesh.py``
 import jax
 
 if __name__ == "__main__":  # virtual devices must be set before backend init
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from metrics_tpu.utilities.backend import force_cpu_backend
+
+    force_cpu_backend(8)
 
 import jax.numpy as jnp
 import numpy as np

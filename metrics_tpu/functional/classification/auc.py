@@ -6,6 +6,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.bucketed_rank import ascending_order
 from metrics_tpu.utilities.compute import _auc_compute
 
 Array = jax.Array
@@ -42,7 +43,7 @@ def _auc_compute_masked(x: Array, y: Array, mask: Array, reorder: bool = False) 
         key = jnp.where(mask, x, jnp.inf)
     else:
         key = jnp.where(mask, jnp.arange(n, dtype=jnp.float32), jnp.inf)
-    order = jnp.argsort(key, stable=True)
+    order = ascending_order(key)
     x_s, y_s, m_s = x[order], y[order], mask[order]
     valid_pair = m_s[:-1] & m_s[1:]
     dx = jnp.where(valid_pair, jnp.diff(x_s), 0.0)

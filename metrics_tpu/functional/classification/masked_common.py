@@ -17,6 +17,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.bucketed_rank import descending_order
+
 Array = jax.Array
 
 
@@ -36,7 +38,9 @@ def masked_curve_prologue(preds: Array, target: Array, mask: Array) -> MaskedCur
     rel = (mask & (jnp.asarray(target) == 1)).astype(jnp.float32)
     score = jnp.where(mask, jnp.asarray(preds, jnp.float32), -jnp.inf)
 
-    order = jnp.argsort(-score)
+    # packed-radix replacement for jnp.argsort(-score): same permutation,
+    # bitwise (ops/bucketed_rank.py) — the capacity-mode sort bound
+    order = descending_order(score)
     s = score[order]
     r = rel[order]
     v = mask[order]

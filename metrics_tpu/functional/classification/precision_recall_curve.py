@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.masked_common import masked_curve_prologue
+from metrics_tpu.ops.bucketed_rank import descending_order, partition_order
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -33,7 +34,9 @@ def _binary_clf_curve(
     target = jnp.asarray(target)
     if preds.ndim > target.ndim:
         preds = preds[:, 0]
-    desc_score_indices = jnp.argsort(-preds)
+    # bucketed-rank kernel: bit-identical permutation to jnp.argsort(-preds)
+    # at a fraction of the variadic-sort cost (ops/bucketed_rank.py)
+    desc_score_indices = descending_order(preds)
 
     preds = preds[desc_score_indices]
     target = target[desc_score_indices]
@@ -141,7 +144,7 @@ def _binary_precision_recall_curve_masked(
     s, tps, kv, boundary = parts.s, parts.tps, parts.kv, parts.boundary
     n_pos = parts.n_pos
 
-    comp = jnp.argsort(~boundary, stable=True)
+    comp = partition_order(boundary)
     b_tps, b_kv, b_thr = tps[comp], kv[comp], s[comp]
     n_b = boundary.sum()
     i = jnp.arange(cap)

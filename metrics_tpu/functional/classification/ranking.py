@@ -12,6 +12,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.bucketed_rank import ascending_ranks
+
 Array = jax.Array
 
 
@@ -125,7 +127,7 @@ def _label_ranking_loss_update(
     n_relevant = relevant.sum(axis=1)
     mask = (n_relevant > 0) & (n_relevant < n_labels)
 
-    inverse = jnp.argsort(jnp.argsort(preds, axis=1), axis=1)
+    inverse = jax.vmap(ascending_ranks)(preds)  # argsort(argsort(...)) via packed radix
     per_label_loss = ((n_labels - inverse) * relevant).astype(jnp.float32)
     correction = 0.5 * n_relevant * (n_relevant + 1)
     denom = n_relevant * (n_labels - n_relevant)

@@ -13,6 +13,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _binary_clf_curve,
     _precision_recall_curve_update,
 )
+from metrics_tpu.ops.bucketed_rank import partition_order
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -84,7 +85,7 @@ def _binary_roc_masked(preds: Array, target: Array, mask: Array) -> Tuple[Array,
     n_neg = parts.n_valid - n_pos
 
     # compact the boundary rows to the front, preserving descending order
-    comp = jnp.argsort(~boundary, stable=True)
+    comp = partition_order(boundary)
     b_tps, b_fps, b_thr = tps[comp], fps[comp], s[comp]
     n_b = boundary.sum()
     i = jnp.arange(cap)

@@ -11,13 +11,14 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.bucketed_rank import descending_order
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
 
 Array = jax.Array
 
 
 def _sort_target_by_preds(preds: Array, target: Array) -> Array:
-    return target[jnp.argsort(-preds)]
+    return target[descending_order(preds)]
 
 
 def retrieval_average_precision(preds: Array, target: Array) -> Array:
@@ -221,7 +222,7 @@ def retrieval_precision_recall_curve(
 
 def _masked_sort(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
     """Target and mask reordered by descending score, padding last."""
-    order = jnp.argsort(-jnp.where(mask, preds, -jnp.inf))
+    order = descending_order(jnp.where(mask, preds, -jnp.inf))
     return (target * mask)[order].astype(jnp.float32), mask[order]
 
 

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.bucketed_rank import ascending_order, inverse_permutation
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 
@@ -106,8 +107,8 @@ class InceptionScore(Metric):
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), buf.count())
         # random rank among valid rows (invalid rows sink to the end)
         scores = jnp.where(mask, jax.random.uniform(key, (buf.capacity,)), jnp.inf)
-        order = jnp.argsort(scores)
-        rank = jnp.argsort(order)  # row -> shuffled position
+        order = ascending_order(scores)
+        rank = inverse_permutation(order)  # row -> shuffled position
         split_id = jnp.where(mask, rank % self.splits, self.splits)
 
         prob = jax.nn.softmax(buf.data, axis=1)

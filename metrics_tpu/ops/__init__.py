@@ -6,3 +6,13 @@ docstring). Every kernel has an identical-semantics XLA fallback and runs in
 pallas interpret mode off-TPU, so parity tests execute everywhere.
 """
 from metrics_tpu.ops.binned_counters import binned_counter_update  # noqa: F401
+from metrics_tpu.ops.bucketed_rank import (  # noqa: F401
+    ascending_order,
+    ascending_ranks,
+    bucket_counts,
+    descending_order,
+    inverse_permutation,
+    partition_order,
+    sharded_descending_ranks,
+    stable_key_order,
+)

@@ -1,0 +1,366 @@
+"""Bucketed-rank kernels: exact sort orders without comparison argsort.
+
+Every exact threshold-curve compute (AUROC, AveragePrecision, ROC,
+PrecisionRecallCurve in ``capacity=`` mode) and the retrieval grouping
+funnel through a global ``jnp.argsort`` — the measured #1 scaling wall
+(264 ms/1M on TPU, BASELINE.md). The expensive part is NOT comparison
+sorting per se but XLA's *variadic* sort carrying an index payload through
+every comparison: on the CPU backend a value-only ``jnp.sort`` of uint32
+keys is ~10x cheaper than ``jnp.argsort`` of the same data, and gathers
+are nearly free. These kernels exploit that asymmetry.
+
+Two cooperating forms:
+
+1. **Packed-radix orders** (single program): the sort key is decomposed
+   into static bit-slices ("buckets" on a 2^b-point quantization grid of
+   the orderable key bits). Each LSD pass packs ``(key_slice << idx_bits)
+   | running_rank`` into ONE uint32 word and value-sorts it — cumulative
+   bucket offsets and within-bucket positions come out of the same sort,
+   so per-element ranks stay exact at full key resolution, with ties
+   broken by position exactly like a stable argsort. Permutations are
+   **bit-identical** to ``jnp.argsort`` (see comparator notes below).
+
+2. **Histogram ranks** (``shard_map``): pass 1 computes per-bucket counts
+   over a static score-quantization grid and reduces them with ONE small
+   ``psum``/``all_gather`` of ``(num_buckets + 3,)`` histograms — the fused
+   computation-collective pattern — instead of all-gathering the raw
+   scores for a replicated sort. Pass 2 converts cumulative bucket
+   offsets + within-bucket positions (device-prefix from the gathered
+   histograms + a local packed-radix order) into global ranks. Ranks are
+   exact whenever no quantization bucket holds two distinct scores from
+   different devices (always true for binned/quantized scores); the
+   returned ``resolved`` flag reports bucket collisions so callers can
+   fall back to the gathered-sort path when bit-exactness matters for
+   continuous scores.
+
+Comparator parity: XLA's float sort comparator (measured on the CPU
+backend) treats -0.0 == +0.0 and flushes float32 denormals to zero, and
+jax sorts NaNs last. The orderable-key construction below reproduces all
+three, so ``ascending_order(x) == jnp.argsort(x, stable=True)`` and
+``descending_order(x) == jnp.argsort(-x)`` hold bitwise — including
+tie-heavy and adversarial inputs (verified in
+``tests/ops/test_bucketed_rank.py``).
+"""
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_U32_MAX = 0xFFFFFFFF
+
+
+def _index_bits(n: int) -> int:
+    """Bits needed to carry a position in ``[0, n)`` through a packed word."""
+    return max(1, (n - 1).bit_length()) if n > 1 else 1
+
+
+def _float32_ascending_word(s: Array) -> Array:
+    """Monotone uint32 key: unsigned ascending order == XLA float32 sort order.
+
+    Standard sign-fold (non-negative floats keep bit order; negative floats
+    reverse it), with two comparator-parity fixes measured on the CPU
+    backend: denormals (including -0.0/+0.0) collapse to the +0.0 key
+    because XLA comparisons flush them to zero, and NaNs of either sign map
+    to the maximum key (jax sorts NaNs last).
+    """
+    i = jax.lax.bitcast_convert_type(s, jnp.int32)
+    u = jax.lax.bitcast_convert_type(s, jnp.uint32)
+    # exponent == 0 -> zero or denormal -> comparator sees exactly 0.0
+    u = jnp.where((u & jnp.uint32(0x7F800000)) == 0, jnp.uint32(0), u)
+    i = jnp.where((u & jnp.uint32(0x7F800000)) == 0, jnp.int32(0), i)
+    asc = jnp.where(i >= 0, u | jnp.uint32(0x80000000), ~u)
+    return jnp.where(jnp.isnan(s), jnp.uint32(_U32_MAX), asc)
+
+
+def _key_words_ascending(x: Array) -> Tuple[List[Array], int]:
+    """Decompose ``x`` into uint32 key words (most-significant first) whose
+    lexicographic unsigned ascending order equals ``jnp.argsort(x)`` order.
+
+    Returns ``(words, total_bits)``; ``total_bits`` may be below 32 for
+    small integer/bool keys so the radix can skip whole passes.
+    """
+    dt = x.dtype
+    if dt == jnp.bool_:
+        return [x.astype(jnp.uint32)], 1
+    if jnp.issubdtype(dt, jnp.floating):
+        if dt in (jnp.float16, jnp.bfloat16):
+            # widening is monotone and preserves ties exactly (distinct
+            # halfs stay distinct floats), so order carries over bitwise
+            x = x.astype(jnp.float32)
+        if x.dtype == jnp.float32:
+            return [_float32_ascending_word(x)], 32
+        # float64 exists only under x64; uint64 ops are available there
+        i = jax.lax.bitcast_convert_type(x, jnp.int64)
+        u = jax.lax.bitcast_convert_type(x, jnp.uint64)
+        exp_mask = jnp.uint64(0x7FF0000000000000)
+        u = jnp.where((u & exp_mask) == 0, jnp.uint64(0), u)
+        i = jnp.where((u & exp_mask) == 0, jnp.int64(0), i)
+        asc = jnp.where(i >= 0, u | jnp.uint64(1 << 63), ~u)
+        asc = jnp.where(jnp.isnan(x), jnp.uint64(0xFFFFFFFFFFFFFFFF), asc)
+        return [(asc >> jnp.uint64(32)).astype(jnp.uint32), (asc & jnp.uint64(_U32_MAX)).astype(jnp.uint32)], 64
+    if jnp.issubdtype(dt, jnp.signedinteger):
+        if jnp.dtype(dt).itemsize <= 4:
+            asc = jax.lax.bitcast_convert_type(x.astype(jnp.int32), jnp.uint32) ^ jnp.uint32(0x80000000)
+            return [asc], 32
+        asc = jax.lax.bitcast_convert_type(x, jnp.uint64) ^ jnp.uint64(1 << 63)
+        return [(asc >> jnp.uint64(32)).astype(jnp.uint32), (asc & jnp.uint64(_U32_MAX)).astype(jnp.uint32)], 64
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        if jnp.dtype(dt).itemsize <= 4:
+            return [x.astype(jnp.uint32)], 32
+        return [(x >> jnp.uint64(32)).astype(jnp.uint32), (x & jnp.uint64(_U32_MAX)).astype(jnp.uint32)], 64
+    raise TypeError(f"bucketed_rank has no orderable key for dtype {dt}")
+
+
+def _radix_order_words(words: List[Array], total_bits: int) -> Array:
+    """Stable ascending order of lexicographic uint32 key words via LSD
+    packed-radix passes.
+
+    Each pass value-sorts ``(key_slice << idx_bits) | rank`` — the slice is
+    the pass's bucket id on a ``2^slice_bits`` grid, the low bits are the
+    element's rank after the previous pass, so the single sort realizes
+    both the cumulative bucket offsets and the stable within-bucket
+    positions of a counting sort. Composing passes LSD-first yields the
+    exact full-resolution stable order.
+    """
+    n = words[0].shape[0]
+    if n <= 1:
+        return jnp.arange(n, dtype=jnp.int32)
+    idx_bits = _index_bits(n)
+    slice_bits = 32 - idx_bits
+    if slice_bits <= 0:
+        raise ValueError(f"packed radix supports up to 2^31 rows, got {n}")
+    idx_mask = jnp.uint32((1 << idx_bits) - 1)
+    slice_mask = jnp.uint32((1 << slice_bits) - 1) if slice_bits < 32 else jnp.uint32(_U32_MAX)
+    ranks = jnp.arange(n, dtype=jnp.uint32)
+    perm = ranks
+    first = True
+    bits_left = total_bits
+    for word in reversed(words):  # least-significant word first (LSD)
+        word_bits = min(32, bits_left)
+        bits_left -= word_bits
+        shift = 0
+        while shift < word_bits:
+            bits = (word >> jnp.uint32(shift)) & slice_mask if shift else word & slice_mask
+            # gather the slice into current order (first pass is identity)
+            cur = bits if first else bits[perm]
+            packed = (cur << jnp.uint32(idx_bits)) | ranks
+            pos = (jnp.sort(packed) & idx_mask).astype(jnp.int32)
+            perm = pos if first else perm[pos]
+            first = False
+            shift += slice_bits
+    return perm.astype(jnp.int32)
+
+
+def ascending_order(x: Array) -> Array:
+    """Exact stable ascending order: bitwise equal to
+    ``jnp.argsort(x, stable=True)`` (see comparator notes in the module
+    docstring), at a fraction of the variadic-sort cost for large ``n``."""
+    words, bits = _key_words_ascending(jnp.asarray(x))
+    return _radix_order_words(words, bits)
+
+
+def descending_order(x: Array) -> Array:
+    """Exact replacement for ``jnp.argsort(-x)`` — the curve kernels'
+    descending-score order.
+
+    Negation happens in the INPUT dtype so every quirk of the argsort path
+    is reproduced bitwise: float -0.0/NaN sign flips (collapsed by the key
+    map exactly as the comparator collapses them) and integer INT_MIN
+    wraparound.
+    """
+    return ascending_order(-jnp.asarray(x))
+
+
+def stable_key_order(keys: Array, num_buckets: int) -> Array:
+    """Stable ascending order for integer keys in ``[0, num_buckets)`` —
+    the counting-sort form used for retrieval query-id grouping. Equal to
+    ``jnp.argsort(keys, stable=True)`` but needs only
+    ``ceil(log2(num_buckets) / (32 - ceil(log2(n))))`` value-sort passes
+    (one pass for every realistic query-id width).
+
+    PRECONDITION: every key must lie in ``[0, num_buckets)``. The packed
+    word keeps only the low ``ceil(log2(num_buckets))`` key bits, so
+    out-of-range or negative keys wrap onto valid bucket ids and the result
+    is a silently wrong permutation — clamp or mask first (as
+    ``retrieval/base.py`` does). Checked eagerly; uncheckable under jit.
+    """
+    bits = max(1, int(num_buckets - 1).bit_length()) if num_buckets > 1 else 1
+    if bits > 32:
+        raise ValueError("stable_key_order supports key widths up to 32 bits")
+    keys = jnp.asarray(keys)
+    if not isinstance(keys, jax.core.Tracer) and keys.size:
+        import numpy as np
+
+        # one fetch for both bounds — two int() calls would each block
+        kmin, kmax = (int(x) for x in np.asarray(jnp.stack([keys.min(), keys.max()])))
+        if kmin < 0 or kmax >= num_buckets:
+            raise ValueError(
+                f"stable_key_order keys must be in [0, {num_buckets}), got "
+                f"[{kmin}, {kmax}] — low-bit packing would wrap them onto "
+                "other buckets and silently mis-sort"
+            )
+    word = (keys & ((1 << bits) - 1)).astype(jnp.uint32) if bits < 32 else keys.astype(jnp.uint32)
+    return _radix_order_words([word], bits)
+
+
+def partition_order(first: Array) -> Array:
+    """Stable order with ``first``-flagged rows compacted to the front —
+    the single-pass (1-bit bucket) replacement for
+    ``jnp.argsort(~first, stable=True)`` boundary compactions."""
+    return _radix_order_words([(~jnp.asarray(first, bool)).astype(jnp.uint32)], 1)
+
+
+def inverse_permutation(perm: Array) -> Array:
+    """Invert a permutation without a scatter: the inverse is the stable
+    ascending order of the permutation's values (they are distinct), so one
+    more packed pass does it. ``inverse_permutation(ascending_order(x))``
+    equals ``jnp.argsort(jnp.argsort(x))`` — per-element ranks."""
+    perm = jnp.asarray(perm)
+    n = perm.shape[0]
+    return _radix_order_words([perm.astype(jnp.uint32)], _index_bits(n))
+
+
+def ascending_ranks(x: Array) -> Array:
+    """Per-element stable ascending ranks — bitwise equal to
+    ``jnp.argsort(jnp.argsort(x, axis=-1), axis=-1)`` on 1-D input (vmap
+    for batches)."""
+    return inverse_permutation(ascending_order(x))
+
+
+# --------------------------------------------------------------------------
+# Histogram pass (pass 1) + sharded exact ranks
+# --------------------------------------------------------------------------
+
+
+def bucket_counts(
+    scores: Array,
+    lo: Array,
+    hi: Array,
+    num_buckets: int,
+    valid: Optional[Array] = None,
+) -> Tuple[Array, Array]:
+    """Pass 1: per-bucket counts over a static quantization grid.
+
+    Lower bucket ids hold HIGHER scores (descending-rank orientation).
+    ``lo``/``hi`` are the FINITE score bounds; the layout appends dedicated
+    edge buckets so an infinite outlier cannot poison the grid span for
+    every row (the regression that motivated this: one ``+inf`` made
+    ``hi - lo`` infinite and every bucket id ``floor(nan)``):
+
+    - bucket ``0``: ``+inf`` scores (rank highest)
+    - buckets ``1 .. num_buckets``: the finite grid, full resolution
+    - bucket ``num_buckets + 1``: ``-inf`` scores
+    - bucket ``num_buckets + 2``: overflow — valid ``nan`` scores together
+      with invalid rows, exactly where the local sort's ``nan`` fill ties
+      them (jax sorts nans last)
+
+    Returns ``(counts, bucket_ids)`` with ``counts`` of shape
+    ``(num_buckets + 3,)``.
+    """
+    scores = jnp.asarray(scores, jnp.float32)
+    finite = jnp.isfinite(scores)
+    # no-finite-scores edge: lo/hi come in as +inf/-inf; every row is
+    # where-routed to an edge/overflow bucket, but the grid arithmetic must
+    # still be finite (floor(inf/nan) -> int32 is XLA-UB even on dead lanes)
+    lo = jnp.where(jnp.isfinite(lo), lo, jnp.float32(0))
+    hi = jnp.where(jnp.isfinite(hi), hi, jnp.float32(0))
+    span = jnp.maximum(hi - lo, jnp.float32(1e-30))
+    # clamp into the grid: semantics-preserving (out-of-range values hit the
+    # same edge buckets the id-clip below would give them) and it keeps
+    # (hi - s) / span * num_buckets finite for huge invalid-but-finite
+    # scores that would otherwise overflow float32 before the int32 cast
+    s = jnp.clip(jnp.where(finite, scores, jnp.float32(0)), lo, hi)
+    b = 1 + jnp.clip(
+        jnp.floor((hi - s) / span * num_buckets).astype(jnp.int32), 0, num_buckets - 1
+    )
+    b = jnp.where(scores == jnp.inf, 0, b)
+    b = jnp.where(scores == -jnp.inf, num_buckets + 1, b)
+    b = jnp.where(jnp.isnan(scores), num_buckets + 2, b)
+    if valid is not None:
+        b = jnp.where(jnp.asarray(valid, bool), b, num_buckets + 2)
+    counts = jnp.zeros(num_buckets + 3, jnp.int32).at[b].add(1)
+    return counts, b
+
+
+def sharded_descending_ranks(
+    scores: Array,
+    axis_name: str,
+    num_buckets: int = 2048,
+    valid: Optional[Array] = None,
+) -> Tuple[Array, Array]:
+    """Exact global descending ranks of per-device score shards under
+    ``shard_map`` — one histogram collective instead of a gathered sort.
+
+    Two small collectives total (vs gathering the raw scores): a 2-scalar
+    ``pmax`` agreeing the quantization grid, then ONE ``all_gather`` of a
+    fused per-device payload — the ``(num_buckets + 3,)`` histogram (finite
+    grid plus the ``+inf``/``-inf``/overflow edge buckets) concatenated
+    with the per-bucket min/max orderable keys that feed the ``resolved``
+    collision check. Pass 2 assembles each local element's global rank as::
+
+        global_bucket_offset[b]            # exclusive cumsum over buckets
+        + device_prefix[b]                 # same-bucket counts, lower ranks
+        + local_within_bucket_position     # local packed-radix order
+
+    Global order is (score desc, device, local position): bit-identical to
+    a stable ``argsort(-concat(shards))`` whenever every bucket holds at
+    most one distinct score globally. The returned ``resolved`` bool says
+    exactly that (via per-bucket pmin/pmax of the orderable key); with
+    continuous scores in colliding buckets, ranks are still a valid
+    permutation but only bucket-granular, and callers that need bit-exact
+    ranks should fall back to the gathered path when ``~resolved``.
+    Invalid rows rank after all valid rows.
+    """
+    scores = jnp.asarray(scores, jnp.float32)
+    v = jnp.ones(scores.shape, bool) if valid is None else jnp.asarray(valid, bool)
+    # grid bounds over FINITE valid scores only — an inf outlier must not
+    # stretch the span to infinity (it gets a dedicated edge bucket instead)
+    vf = v & jnp.isfinite(scores)
+    local_lo = jnp.min(jnp.where(vf, scores, jnp.inf))
+    local_hi = jnp.max(jnp.where(vf, scores, -jnp.inf))
+    # one fused grid-agreement collective: pmax of (-lo, hi) == (-pmin(lo), pmax(hi))
+    neg_lo, hi = jax.lax.pmax(jnp.stack([-local_lo, local_hi]), axis_name)
+    lo = -neg_lo
+
+    counts, b = bucket_counts(scores, lo, hi, num_buckets, valid=v)
+
+    # per-bucket min/max orderable keys for the resolved collision check,
+    # with the same nan fill as the local sort, so valid-nan rows and
+    # invalid rows share one key (they genuinely tie, broken by position)
+    # and the overflow bucket does not spuriously report a collision
+    nb = num_buckets + 3
+    key = _float32_ascending_word(jnp.where(v, -scores, jnp.nan))
+    kmin = jnp.full(nb, jnp.uint32(_U32_MAX)).at[b].min(key)
+    kmax = jnp.zeros(nb, jnp.uint32).at[b].max(key)
+
+    # ONE fused histogram collective: counts + kmin + kmax ride a single
+    # all_gather payload instead of three bucket-axis collectives
+    payload = jnp.concatenate([counts.astype(jnp.uint32), kmin, kmax])
+    gathered = jax.lax.all_gather(payload, axis_name)  # (D, 3 * (num_buckets + 3))
+    counts_g = gathered[:, :nb].astype(counts.dtype)
+    gmin = gathered[:, nb : 2 * nb].min(axis=0)
+    gmax = gathered[:, 2 * nb :].max(axis=0)
+
+    totals = counts_g.sum(axis=0)
+    offsets = jnp.concatenate([jnp.zeros(1, totals.dtype), jnp.cumsum(totals)[:-1]])
+    d = jax.lax.axis_index(axis_name)
+    ndev = counts_g.shape[0]
+    below = jnp.where(jnp.arange(ndev)[:, None] < d, counts_g, 0).sum(axis=0)
+
+    # local within-bucket positions from the local full-resolution order:
+    # rank among local same-bucket rows = local desc rank - bucket offset.
+    # Invalid rows are NaN-filled so they sort strictly after every valid
+    # score (even valid -inf), matching their overflow-bucket routing.
+    order = descending_order(jnp.where(v, scores, jnp.nan))
+    local_rank = inverse_permutation(order)
+    local_offsets = jnp.concatenate(
+        [jnp.zeros(1, counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    within = local_rank - local_offsets[b]
+
+    granks = (offsets[b] + below[b] + within).astype(jnp.int32)
+
+    resolved = jnp.all((gmin == gmax) | (totals <= 1))
+    return granks, resolved

@@ -3,10 +3,11 @@
 
 The reference computes per query in a Python loop over ``get_group_indexes``
 (``retrieval/base.py:110-139``, ``utilities/data.py:210``) — one device
-dispatch per query. Here compute is vectorized: queries are grouped by one
-host ``argsort``+``unique``, bucketed by padded power-of-two length, and each
-bucket runs as ONE ``vmap``-ped masked-row kernel on device — O(log max_docs)
-dispatches total regardless of query count (SURVEY.md §7 hard part #2).
+dispatch per query. Here compute is vectorized: queries are grouped by ONE
+device packed-radix sort (``ops/bucketed_rank.py`` — no host ``argsort``
+round-trip), bucketed by padded power-of-two length, and each bucket runs as
+ONE ``vmap``-ped masked-row kernel on device — O(log max_docs) dispatches
+total regardless of query count (SURVEY.md §7 hard part #2).
 """
 from abc import ABC, abstractmethod
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -16,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops.bucketed_rank import ascending_order, stable_key_order
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
 from metrics_tpu.utilities.data import dim_zero_cat
 
@@ -23,10 +25,33 @@ Array = jax.Array
 
 
 def _group_layout(indexes: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Sort order + per-query (start, count) over the concatenated state."""
-    order = np.argsort(indexes, kind="stable")
-    _, starts, counts = np.unique(indexes[order], return_index=True, return_counts=True)
-    return order, starts, counts
+    """Sort order + per-query (start, count) over the concatenated state.
+
+    The big O(n log n) work — the stable sort by query id — runs on device
+    through the packed-radix kernel (same permutation as
+    ``np.argsort(kind='stable')``); only the tiny (num_queries,)
+    starts/counts layout arrays come back to host for the bucket packing.
+    """
+    idx_np = np.asarray(indexes)
+    if idx_np.dtype.itemsize > 4 and idx_np.size and (
+        idx_np.max() > np.iinfo(np.int32).max or idx_np.min() < np.iinfo(np.int32).min
+    ):
+        # ids beyond int32 would truncate on device (x64 disabled) — keep
+        # the exact host layout for this pathological case
+        order = np.argsort(idx_np, kind="stable")
+        _, starts, counts = np.unique(idx_np[order], return_index=True, return_counts=True)
+        return order, starts, counts
+
+    if idx_np.size == 0:  # no rows -> no groups (np.unique layout)
+        return np.zeros(0, np.int64), np.zeros(0, np.int64), np.zeros(0, np.int64)
+
+    idx = jnp.asarray(indexes)
+    order = ascending_order(idx)
+    sorted_idx = idx[order]
+    boundary = jnp.concatenate([jnp.ones((1,), bool), sorted_idx[1:] != sorted_idx[:-1]])
+    starts = np.asarray(jnp.nonzero(boundary)[0])
+    counts = np.diff(np.append(starts, idx.shape[0]))
+    return np.asarray(order), starts, counts
 
 
 def _bucket_rows(
@@ -188,7 +213,9 @@ class RetrievalMetric(Metric, ABC):
         # query q-1 and corrupt it (update() already filters these; states
         # merged/restored from elsewhere get the same protection here)
         idx = jnp.where(valid & (idx_buf.data >= 0) & (idx_buf.data < q), idx_buf.data, q)
-        order = jnp.argsort(idx, stable=True)
+        # counting-sort form: ids are bounded by construction, so the stable
+        # grouping sort is one packed value-sort pass (ops/bucketed_rank.py)
+        order = stable_key_order(idx, q + 1)
         idx_s = idx[order]
         p_s = pred_buf.data[order]
         t_s = tgt_buf.data[order]

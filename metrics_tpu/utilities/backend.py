@@ -16,7 +16,29 @@ def force_cpu_backend(n_devices: Optional[int] = None) -> None:
 
     jax.config.update("jax_platforms", "cpu")
     if n_devices is not None:
-        jax.config.update("jax_num_cpu_devices", n_devices)
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except AttributeError:
+            # jax < 0.5 predates the config option; fall back to the XLA flag.
+            # CAVEAT: XLA parses XLA_FLAGS once per process, so this only
+            # works if no backend has been initialized yet — verified below.
+            import os
+            import re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            # replace any existing count (a stale value would win at backend
+            # re-init and silently hand back the wrong device count)
+            flags = re.sub(r"--xla_force_host_platform_device_count=\S+", "", flags)
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
+            )
     from jax.extend import backend as _jeb
 
     _jeb.clear_backends()
+    if n_devices is not None and jax.device_count() < n_devices:
+        raise RuntimeError(
+            f"force_cpu_backend({n_devices}) took no effect: jax reports "
+            f"{jax.device_count()} device(s). On jax < 0.5 the virtual-device "
+            "count rides on XLA_FLAGS, which XLA reads once per process — call "
+            "force_cpu_backend before anything initializes a jax backend."
+        )

@@ -1,0 +1,54 @@
+"""Version compatibility for the pinned container jax.
+
+The framework (and its tests) target the current jax surface —
+``jax.shard_map`` at top level, ``jax.lax.pcast`` for replicated→varying
+conversion, and the ``jax_num_cpu_devices`` config option. The container
+pins jax 0.4.37, which predates all three. This module back-fills them so
+one code path serves both:
+
+- ``jax.shard_map``: aliased from ``jax.experimental.shard_map`` (same
+  call signature for the mesh/in_specs/out_specs keywords used here), with
+  ``check_rep=False`` — 0.4.37's replication checker miscounts scan
+  carries (its own error message says to disable it), and it is a static
+  lint, not part of execution semantics.
+- ``jax.lax.pcast``: identity. 0.4.37's shard_map does not track varying
+  manual axes, so the replicated→varying cast new jax requires is a no-op
+  there; the rep-checker treats replicated values as usable wherever a
+  varying one is expected.
+- ``jax_num_cpu_devices``: handled in ``backend.force_cpu_backend`` via
+  the ``--xla_force_host_platform_device_count`` XLA flag, which the CPU
+  client reads at (re)initialization — equivalent as long as it is set
+  before the backend comes up (``clear_backends`` forces that).
+
+Idempotent; imported for its side effect by ``metrics_tpu/__init__``. The
+back-fill is a process-wide mutation of the ``jax`` namespace by design:
+~45 call sites (library, tests, examples, bench) target the current
+``jax.shard_map`` surface, and on old jax the attribute does not exist, so
+nothing that feature-detects it loses a working code path — but be aware
+that other libraries in the same process will also see the shim.
+"""
+
+
+def ensure_jax_compat() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        import functools
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def _shard_map_compat(f=None, *args, **kwargs):
+            # new jax renamed check_rep -> check_vma; accept both spellings
+            if "check_vma" in kwargs:
+                kwargs["check_rep"] = kwargs.pop("check_vma")
+            kwargs.setdefault("check_rep", False)
+            if f is None:
+                return functools.partial(_shard_map_compat, **kwargs)
+            return _shard_map(f, *args, **kwargs)
+
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax.lax, "pcast"):
+        jax.lax.pcast = lambda x, axes, to=None: x
+
+
+ensure_jax_compat()

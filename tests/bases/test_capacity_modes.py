@@ -178,6 +178,7 @@ def test_ssim_streaming_equals_accumulate():
     np.testing.assert_allclose(float(jax.jit(mdef.compute)(state)), float(exact.compute()), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_msssim_streaming_equals_accumulate():
     a = jnp.asarray(rng.random((4, 3, 192, 192)).astype(np.float32))
     b = jnp.asarray((0.7 * np.asarray(a) + 0.3 * rng.random((4, 3, 192, 192))).astype(np.float32))

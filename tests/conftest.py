@@ -17,3 +17,9 @@ force_cpu_backend(NUM_DEVICES)
 
 def pytest_configure(config):
     assert jax.device_count() >= NUM_DEVICES, f"expected {NUM_DEVICES} devices, got {jax.device_count()}"
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight tests (real pretrained-weight loads, subprocess example "
+        "runs, multi-seed fuzz repeats) excluded from the tier-1 fast lane "
+        "(ROADMAP.md runs pytest -m 'not slow' under a hard timeout)",
+    )

@@ -354,6 +354,7 @@ def test_fid_ill_conditioned_features_vs_scipy():
     assert bool(jnp.all(jnp.isfinite(grads)))
 
 
+@pytest.mark.slow
 def test_bundled_encoder_end_to_end():
     """The bundled TinyImageEncoder drives FID/KID/IS/LPIPS with no injected
     network: uint8 images in, scores out, deterministic across instances."""
@@ -429,6 +430,7 @@ class TestLPIPSBundledDefault:
     TinyImageEncoder perceptual distance constructs and computes with no
     injection, warns about calibration once, and behaves like a distance."""
 
+    @pytest.mark.slow  # bundled-encoder weight load
     def test_zero_arg_construct_and_warn(self):
         import warnings
         import metrics_tpu.image.lpip as lpip_mod

@@ -42,6 +42,7 @@ def _quiet_lpips(**kwargs):
         return LPIPSNet(**kwargs)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("variant", ["fid", "torchvision"])
 def test_inception_torch_weight_parity(variant):
     """Random torch-twin weights loaded into flax produce the same features
@@ -65,6 +66,7 @@ def test_inception_torch_weight_parity(variant):
         np.testing.assert_allclose(got, want, atol=1e-4, err_msg=f"tap {name}")
 
 
+@pytest.mark.slow
 def test_inception_extractor_end_to_end_uint8():
     """The extractor's uint8→[-1,1] preprocessing matches the torch-side
     replication (no resize; resize parity is covered separately)."""
@@ -119,6 +121,7 @@ def test_inception_loader_skips_auxlogits_and_counters():
     load_inception_torch_state_dict(ex.variables, sd)  # no KeyError
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("net_type", ["alex", "vgg"])
 def test_lpips_torch_weight_parity(net_type):
     """Torchvision-keyed backbone + lpips-keyed lin heads loaded into the

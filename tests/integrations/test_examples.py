@@ -8,9 +8,17 @@ import sys
 import pytest
 
 _EXAMPLES = sorted((pathlib.Path(__file__).resolve().parents[2] / "examples").glob("*.py"))
+# examples that pull real pretrained encoders run in the slow lane
+_HEAVY = {"fid_with_real_inception.py", "bertscore_with_real_bert.py"}
 
 
-@pytest.mark.parametrize("script", _EXAMPLES, ids=lambda p: p.name)
+@pytest.mark.parametrize(
+    "script",
+    [
+        pytest.param(p, id=p.name, marks=[pytest.mark.slow] if p.name in _HEAVY else [])
+        for p in _EXAMPLES
+    ],
+)
 def test_example_runs(script):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

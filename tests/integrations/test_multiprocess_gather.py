@@ -29,7 +29,13 @@ assert jax.process_count() == 2
 
 # uneven per-process shapes: the reference's hard case (distributed.py:128-151)
 local = jnp.arange(3 + 4 * pid, dtype=jnp.float32) + 100 * pid
-gathered = gather_all_arrays(local)
+try:
+    gathered = gather_all_arrays(local)
+except Exception as err:  # old jaxlib: no CPU cross-process collectives
+    if "implemented on the CPU backend" in str(err):
+        print(f"proc {{pid}} unsupported: {{err}}")
+        sys.exit(42)
+    raise
 assert [tuple(g.shape) for g in gathered] == [(3,), (7,)], [g.shape for g in gathered]
 np.testing.assert_array_equal(np.asarray(gathered[0]), np.arange(3, dtype=np.float32))
 np.testing.assert_array_equal(np.asarray(gathered[1]), np.arange(7, dtype=np.float32) + 100)
@@ -68,6 +74,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_gather_all_arrays(tmp_path):
     port = _free_port()
     script = tmp_path / "worker.py"
@@ -94,6 +101,8 @@ def test_two_process_gather_all_arrays(tmp_path):
     for p in procs:
         out, _ = p.communicate(timeout=240)
         outs.append(out)
+    if all(p.returncode == 42 for p in procs):
+        pytest.skip("CPU backend lacks cross-process collectives (old jaxlib); regime 3 needs real multi-host")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-2000:]}"
         assert f"proc {i} ok" in out

@@ -9,6 +9,8 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 _PROBE = """
 import warnings; warnings.simplefilter("ignore")
 import numpy as np, jax, jax.numpy as jnp
@@ -45,6 +47,7 @@ print("X64-OK")
 """
 
 
+@pytest.mark.slow
 def test_package_works_under_x64():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,300 @@
+"""Bucketed-rank kernel parity: every order/rank helper must be BITWISE
+equal to the ``jnp.argsort`` path it replaced (the curve kernels' sort bound,
+ISSUE 1 / BASELINE.md), including the adversarial tie cases that stress the
+collision-threshold design — all-equal scores, two-value scores, edge grids —
+plus masked rows and the sharded histogram-rank variant on the 8-device mesh.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.ops.bucketed_rank import (
+    ascending_order,
+    ascending_ranks,
+    descending_order,
+    inverse_permutation,
+    partition_order,
+    sharded_descending_ranks,
+    stable_key_order,
+)
+
+_RNG = np.random.default_rng(0)
+
+
+def _adversarial_cases():
+    """Tie-heavy and comparator-edge inputs (the tier-1 regression net for
+    the within-bucket fallback semantics)."""
+    rng = np.random.default_rng(7)
+    return {
+        "all_equal": np.full(4097, 0.5, np.float32),
+        "two_value": rng.integers(0, 2, 8191).astype(np.float32),
+        "edge_grid": (rng.integers(0, 16, 4096) / 16).astype(np.float32),
+        "uniform": rng.random(10001).astype(np.float32),
+        "signed_zero": np.where(rng.random(4096) < 0.4, -0.0, rng.standard_normal(4096)).astype(np.float32),
+        "denormal": (rng.standard_normal(2048) * 1e-42).astype(np.float32),
+        "inf_ends": np.concatenate(
+            [np.full(8, np.inf, np.float32), rng.standard_normal(1000).astype(np.float32), np.full(8, -np.inf, np.float32)]
+        ),
+        "tiny": np.array([2.0, 1.0, 1.0, 3.0], np.float32),
+        "single": np.array([42.0], np.float32),
+    }
+
+
+@pytest.mark.parametrize("name,x", sorted(_adversarial_cases().items()))
+def test_orders_bitwise_vs_argsort(name, x):
+    xj = jnp.asarray(x)
+    np.testing.assert_array_equal(ascending_order(xj), jnp.argsort(xj, stable=True), err_msg=name)
+    np.testing.assert_array_equal(descending_order(xj), jnp.argsort(-xj), err_msg=name)
+    np.testing.assert_array_equal(
+        ascending_ranks(xj), jnp.argsort(jnp.argsort(xj, stable=True), stable=True), err_msg=name
+    )
+
+
+def test_orders_bitwise_with_nan():
+    rng = np.random.default_rng(1)
+    x = np.where(rng.random(5000) < 0.1, np.nan, rng.standard_normal(5000)).astype(np.float32)
+    xj = jnp.asarray(x)
+    np.testing.assert_array_equal(ascending_order(xj), jnp.argsort(xj, stable=True))
+    np.testing.assert_array_equal(descending_order(xj), jnp.argsort(-xj))
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16", "int32", "int8", "uint16", "bool"])
+def test_orders_bitwise_across_dtypes(dtype):
+    rng = np.random.default_rng(2)
+    if dtype == "bool":
+        x = jnp.asarray(rng.random(4097) < 0.5)
+    elif dtype == "bfloat16":
+        x = jnp.asarray(rng.standard_normal(4096).astype(np.float32)).astype(jnp.bfloat16)
+    elif dtype.startswith("float"):
+        x = jnp.asarray(rng.standard_normal(4096).astype(dtype))
+    else:
+        info = np.iinfo(dtype)
+        x = jnp.asarray(rng.integers(info.min, info.max, 6000, dtype=dtype))
+    np.testing.assert_array_equal(ascending_order(x), jnp.argsort(x, stable=True), err_msg=dtype)
+    if dtype != "bool":  # argsort(-x) is itself a TypeError on bool
+        np.testing.assert_array_equal(descending_order(x), jnp.argsort(-x), err_msg=dtype)
+
+
+def test_partition_and_inverse_and_key_order():
+    rng = np.random.default_rng(3)
+    first = jnp.asarray(rng.random(9999) < 0.3)
+    np.testing.assert_array_equal(partition_order(first), jnp.argsort(~first, stable=True))
+    keys = jnp.asarray(rng.integers(0, 777, 20000).astype(np.int32))
+    np.testing.assert_array_equal(stable_key_order(keys, 777), jnp.argsort(keys, stable=True))
+    perm = jnp.asarray(rng.permutation(5000).astype(np.int32))
+    np.testing.assert_array_equal(inverse_permutation(perm), jnp.argsort(perm))
+
+
+def test_masked_prologue_order_is_argsort_exact():
+    """Masked rows: -inf fill ties with valid -inf scores — the order must
+    still match the argsort path bitwise (capacity-mode invariant)."""
+    from metrics_tpu.functional.classification.masked_common import masked_curve_prologue
+
+    rng = np.random.default_rng(4)
+    cap = 1024
+    preds = rng.integers(0, 8, cap).astype(np.float32) / 8  # heavy ties
+    preds[:4] = -np.inf  # valid -inf rows tie with the invalid fill
+    mask = rng.random(cap) < 0.7
+    target = (rng.random(cap) < 0.5).astype(np.int32)
+
+    score = jnp.where(jnp.asarray(mask), jnp.asarray(preds), -jnp.inf)
+    parts = masked_curve_prologue(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(mask))
+    np.testing.assert_array_equal(parts.s, score[jnp.argsort(-score)])
+    # the prologue's cumulative counts must equal the argsort path's exactly
+    ref_order = jnp.argsort(-score)
+    rel = (jnp.asarray(mask) & (jnp.asarray(target) == 1)).astype(jnp.float32)
+    np.testing.assert_array_equal(parts.tps, jnp.cumsum(rel[ref_order]))
+
+
+@pytest.mark.parametrize("case", ["ties", "two_value", "all_equal"])
+def test_curve_metrics_bit_exact_vs_argsort_path(case):
+    """AUROC/AP/ROC/PRC through the wired kernel vs a local argsort-path
+    replica of `_binary_clf_curve` — exact equality, not allclose."""
+    from metrics_tpu.functional.classification.precision_recall_curve import _binary_clf_curve
+
+    rng = np.random.default_rng(5)
+    n = 4096
+    if case == "ties":
+        preds = rng.integers(0, 32, n).astype(np.float32) / 32
+    elif case == "two_value":
+        preds = rng.integers(0, 2, n).astype(np.float32)
+    else:
+        preds = np.full(n, 0.25, np.float32)
+    target = (rng.random(n) < 0.4).astype(np.int32)
+    pj, tj = jnp.asarray(preds), jnp.asarray(target)
+
+    fps, tps, thr = _binary_clf_curve(pj, tj)
+
+    # argsort-path replica (the pre-bucketed-rank implementation)
+    order = jnp.argsort(-pj)
+    ps, ts = pj[order], tj[order]
+    distinct = jnp.nonzero(ps[1:] - ps[:-1])[0]
+    thr_idx = jnp.concatenate([distinct, jnp.array([n - 1])])
+    ts_bin = (ts == 1).astype(jnp.int32)
+    ref_tps = jnp.cumsum(ts_bin, axis=0)[thr_idx]
+    ref_fps = 1 + thr_idx - ref_tps
+    np.testing.assert_array_equal(fps, ref_fps)
+    np.testing.assert_array_equal(tps, ref_tps)
+    np.testing.assert_array_equal(thr, ps[thr_idx])
+
+    # and the public curve consumers agree with themselves run on the
+    # identical permutation (smoke: values are finite and well-formed)
+    from metrics_tpu.functional import auroc, average_precision, precision_recall_curve, roc
+
+    if target.any() and not target.all():
+        a = float(auroc(pj, tj, pos_label=1))
+        ap = float(average_precision(pj, tj, pos_label=1))
+        assert 0.0 <= a <= 1.0 and 0.0 <= ap <= 1.0
+        roc(pj, tj, pos_label=1)
+        precision_recall_curve(pj, tj, pos_label=1)
+
+
+def test_group_layout_matches_host_numpy():
+    """Retrieval grouping (device kernel) == the host np.argsort/np.unique
+    layout it replaced, including non-contiguous query ids."""
+    from metrics_tpu.retrieval.base import _group_layout
+
+    rng = np.random.default_rng(6)
+    idx = rng.choice(np.array([0, 3, 4, 17, 18, 1000, 65535]), 5000).astype(np.int64)
+    order, starts, counts = _group_layout(idx)
+    ref_order = np.argsort(idx, kind="stable")
+    _, ref_starts, ref_counts = np.unique(idx[ref_order], return_index=True, return_counts=True)
+    np.testing.assert_array_equal(order, ref_order)
+    np.testing.assert_array_equal(starts, ref_starts)
+    np.testing.assert_array_equal(counts, ref_counts)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def test_sharded_ranks_exact_on_quantized_scores():
+    """8-device histogram ranks == stable argsort ranks of the concatenated
+    shards, bit-exact, when each bucket holds one distinct score."""
+    rng = np.random.default_rng(8)
+    n = 8 * 2048
+    scores = (rng.integers(0, 2048, n) / 2048.0).astype(np.float32)
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s: sharded_descending_ranks(s, "data"),
+            mesh=_mesh(),
+            in_specs=(P("data"),),
+            out_specs=(P("data"), P()),
+        )
+    )
+    granks, resolved = fn(jnp.asarray(scores))
+    assert bool(resolved)
+    ref = np.argsort(np.argsort(-scores, kind="stable"), kind="stable")
+    np.testing.assert_array_equal(np.asarray(granks), ref)
+
+
+def test_sharded_ranks_all_equal_and_masked():
+    """Adversarial tie case (one global tie group) and invalid rows: ranks
+    stay an exact permutation ordered (score desc, device, position), with
+    invalid rows after every valid one."""
+    n = 8 * 64
+    scores = np.full(n, 0.5, np.float32)
+    valid = np.ones(n, bool)
+    valid[5::7] = False
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s, v: sharded_descending_ranks(s, "data", valid=v),
+            mesh=_mesh(),
+            in_specs=(P("data"), P("data")),
+            out_specs=(P("data"), P()),
+        )
+    )
+    granks, resolved = fn(jnp.asarray(scores), jnp.asarray(valid))
+    assert bool(resolved)
+    granks = np.asarray(granks)
+    assert np.array_equal(np.sort(granks), np.arange(n))
+    n_valid = int(valid.sum())
+    assert granks[valid].max() == n_valid - 1  # valid rows first...
+    assert granks[~valid].min() == n_valid  # ...invalid strictly after
+    # within the tie group, order is (device, position) == original index
+    np.testing.assert_array_equal(np.argsort(granks[valid], kind="stable"), np.arange(n_valid))
+
+
+def test_sharded_ranks_exact_with_inf_outliers():
+    """An infinite outlier must not stretch the quantization span: +/-inf
+    get dedicated edge buckets, finite scores keep the full grid, and ranks
+    stay bit-exact (regression: one inf used to collapse every bucket id to
+    floor(nan))."""
+    rng = np.random.default_rng(10)
+    n = 8 * 512
+    scores = np.round(rng.random(n), 2).astype(np.float32)
+    scores[3] = np.inf
+    scores[100] = -np.inf
+    scores[2000] = np.inf
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s: sharded_descending_ranks(s, "data"),
+            mesh=_mesh(),
+            in_specs=(P("data"),),
+            out_specs=(P("data"), P()),
+        )
+    )
+    granks, resolved = fn(jnp.asarray(scores))
+    assert bool(resolved)
+    ref = np.argsort(np.argsort(-scores, kind="stable"), kind="stable")
+    np.testing.assert_array_equal(np.asarray(granks), ref)
+
+    # all -inf: one global tie group in the bottom edge bucket
+    granks, resolved = fn(jnp.asarray(np.full(n, -np.inf, np.float32)))
+    assert bool(resolved)
+    np.testing.assert_array_equal(np.asarray(granks), np.arange(n))
+
+
+def test_sharded_ranks_valid_nan_ties_with_invalid_fill():
+    """Valid nan scores share the overflow bucket with invalid rows — the
+    same tie the local sort's nan fill produces — so ranks match the stable
+    argsort of the nan-filled concat and the bucket is not a collision."""
+    rng = np.random.default_rng(11)
+    n = 8 * 512
+    scores = np.round(rng.random(n), 2).astype(np.float32)
+    scores[5] = np.nan
+    scores[700] = np.nan
+    valid = np.ones(n, bool)
+    valid[50] = False
+    valid[3000] = False
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s, v: sharded_descending_ranks(s, "data", valid=v),
+            mesh=_mesh(),
+            in_specs=(P("data"), P("data")),
+            out_specs=(P("data"), P()),
+        )
+    )
+    granks, resolved = fn(jnp.asarray(scores), jnp.asarray(valid))
+    assert bool(resolved)
+    filled = np.where(valid, scores, np.nan)
+    ref = np.argsort(np.argsort(-filled, kind="stable"), kind="stable")
+    np.testing.assert_array_equal(np.asarray(granks), ref)
+
+
+def test_sharded_ranks_reports_unresolved_on_continuous_collisions():
+    """Continuous scores at n >> buckets must trip the resolved=False flag
+    (the caller's signal to take the gathered-sort fallback)."""
+    rng = np.random.default_rng(9)
+    n = 8 * 1024
+    scores = rng.random(n).astype(np.float32)
+
+    fn = jax.jit(
+        jax.shard_map(
+            lambda s: sharded_descending_ranks(s, "data", num_buckets=64),
+            mesh=_mesh(),
+            in_specs=(P("data"),),
+            out_specs=(P("data"), P()),
+        )
+    )
+    granks, resolved = fn(jnp.asarray(scores))
+    assert not bool(resolved)
+    # even unresolved, the output is a valid permutation (bucket-granular)
+    assert np.array_equal(np.sort(np.asarray(granks)), np.arange(n))

@@ -51,6 +51,7 @@ def _quiet_encoder(**kwargs):
         return BertEncoder(_dummy_tokenizer, cfg=BertConfigLite(**CFG), **kwargs)
 
 
+@pytest.mark.slow
 def test_bert_torch_weight_parity_all_layers():
     """HF BertModel random-init weights loaded into the flax model give the
     same hidden states at every layer, atol 1e-4."""

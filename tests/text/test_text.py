@@ -335,6 +335,7 @@ def test_bertscore_module():
     np.testing.assert_allclose(np.asarray(out["f1"]), np.asarray(single["f1"]), atol=1e-5)
 
 
+@pytest.mark.slow  # real transformer checkpoint
 def test_bert_score_with_real_flax_transformer(tmp_path):
     """End-to-end BERTScore through genuine HF machinery — a FlaxBertModel
     (random init, no download) and a BertTokenizerFast built from a local

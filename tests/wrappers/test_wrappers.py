@@ -28,6 +28,7 @@ from tests.helpers import seed_all
 seed_all(13)
 
 
+@pytest.mark.slow  # 20 bootstrap replicas
 def test_bootstrapper_mean_std():
     np.random.seed(0)
     preds = np.random.randint(0, 5, 200)
