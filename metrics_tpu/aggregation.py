@@ -2,9 +2,14 @@
 
 NaN handling is branchless (``jnp.where`` masks) instead of the reference's
 eager ``torch.isnan`` boolean-indexing (``aggregation.py:66-84``), so every
-update stays a static-shape XLA graph. The ``error`` strategy needs a
-concrete value check and therefore runs eagerly (it is for debugging, not the
-hot path).
+update stays a static-shape XLA graph. ``nan_strategy='warn'`` is re-based
+on the in-graph fault channel (``utilities/guard.py``): masking is the same
+branchless graph as ``'ignore'``, the NaN count accumulates in the traced
+``FaultCounters`` state, and the warning fires at the next eager boundary
+(``compute()``) from the globally summed counter — so ``'warn'`` now stays
+fully jitted/functionalizable instead of forcing the eager fallback. Only
+the ``'error'`` strategy still needs a concrete value check at update time
+(its contract is an immediate raise; it is for debugging, not the hot path).
 """
 from typing import Any, Callable, Optional, Union
 
@@ -24,6 +29,12 @@ class BaseAggregator(Metric):
     higher_is_better = None
     full_state_update = False
 
+    # the update body itself neutralizes invalid values (NaN masking), so
+    # the guard's drop policy only counts — it never rewrites args; and the
+    # counters track NaN only (inf is a legitimate aggregation value)
+    _guard_handles_drop = True
+    _guard_nan_only = True
+
     def __init__(
         self,
         fn: Union[Callable, str],
@@ -31,39 +42,59 @@ class BaseAggregator(Metric):
         nan_strategy: Union[str, float] = "error",
         **kwargs: Any,
     ) -> None:
-        super().__init__(**kwargs)
         allowed = ("error", "warn", "ignore")
         if not (isinstance(nan_strategy, (int, float)) and not isinstance(nan_strategy, bool)) and nan_strategy not in allowed:
             raise ValueError(f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}")
+        if (
+            nan_strategy == "warn"
+            and "on_invalid" not in kwargs
+            and getattr(self, "capacity", True) is not None  # list-mode CatMetric stays eager/legacy
+        ):
+            # re-base 'warn' on the traced fault channel: mask in-graph,
+            # count in-graph, warn at the eager boundary → stays jittable
+            kwargs["on_invalid"] = "warn"
+        super().__init__(**kwargs)
         self.nan_strategy = nan_strategy
         self.add_state("value", default=default_value, dist_reduce_fx=fn)
-        if nan_strategy == "error" or nan_strategy == "warn":
-            # needs concrete values for the raise/warn path
+        if nan_strategy == "error" or (nan_strategy == "warn" and self.on_invalid == "ignore"):
+            # immediate raise/warn at update needs concrete values
             object.__setattr__(self, "jittable_update", False)
 
     def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Union[float, Array, None] = None):
-        """Mask NaNs per strategy (reference ``aggregation.py:66-84``)."""
+        """Mask NaNs per strategy (reference ``aggregation.py:66-84``).
+
+        Every strategy treats a NaN in the value OR its weight as the fault:
+        'error' raises on either, and the masking strategies ('warn'/
+        'ignore' and the drop policy) mask the whole row — a NaN weight
+        would otherwise flow into the weighted sums and poison the result
+        while the fault channel reports the row as dropped.
+        """
         x = jnp.asarray(x, dtype=jnp.float32)
         if weight is not None:
             weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), x.shape)
         nans = jnp.isnan(x)
+        bad = nans if weight is None else (nans | jnp.isnan(weight))
         if self.nan_strategy == "error":
-            if bool(jnp.any(nans)):
+            if bool(jnp.any(bad)):
                 raise RuntimeError("Encountered `nan` values in tensor")
-        elif self.nan_strategy == "warn":
-            if bool(jnp.any(nans)):
+        elif self.nan_strategy == "warn" and self.on_invalid == "ignore":
+            # legacy eager path (explicit on_invalid='ignore' opt-out);
+            # warns on exactly what it masks: value-or-weight NaN rows
+            if bool(jnp.any(bad)):
                 import warnings
 
                 warnings.warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
-            x = jnp.where(nans, self._neutral_value(), x)
+            x = jnp.where(bad, self._neutral_value(), x)
             if weight is not None:
-                weight = jnp.where(nans, 0.0, weight)
-        elif self.nan_strategy == "ignore":
-            x = jnp.where(nans, self._neutral_value(), x)
+                weight = jnp.where(bad, 0.0, weight)
+        elif self.nan_strategy == "warn" or self.nan_strategy == "ignore":
+            x = jnp.where(bad, self._neutral_value(), x)
             if weight is not None:
-                weight = jnp.where(nans, 0.0, weight)
-        else:  # float imputation
+                weight = jnp.where(bad, 0.0, weight)
+        else:  # float imputation (NaN weights still zero out — see above)
             x = jnp.where(nans, float(self.nan_strategy), x)
+            if weight is not None:
+                weight = jnp.where(jnp.isnan(weight), 0.0, weight)
         if weight is None:
             return x, None
         return x, weight
@@ -161,7 +192,8 @@ class CatMetric(BaseAggregator):
 
             x = jnp.asarray(value, dtype=jnp.float32).reshape(-1)
             nans = jnp.isnan(x)
-            if self.nan_strategy in ("error", "warn"):  # concrete by construction
+            if self.nan_strategy == "error" or (self.nan_strategy == "warn" and self.on_invalid == "ignore"):
+                # concrete by construction (these strategies force eager)
                 import numpy as np
 
                 if np.asarray(nans).any():
@@ -171,7 +203,8 @@ class CatMetric(BaseAggregator):
 
                     warnings.warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
                 self.value = cat_append(self.value, x, ~nans)
-            elif self.nan_strategy == "ignore":
+            elif self.nan_strategy in ("warn", "ignore"):
+                # 'warn' counts via the fault channel; masking is identical
                 self.value = cat_append(self.value, x, ~nans)
             else:
                 self.value = cat_append(self.value, jnp.where(nans, float(self.nan_strategy), x))

@@ -83,8 +83,8 @@ class AUROC(Metric):
             self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
         else:
             self.mode: Optional[DataType] = None
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.float32))
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.int32))
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         """Reference ``auroc.py:160-175``.

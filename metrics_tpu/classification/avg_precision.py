@@ -67,8 +67,8 @@ class AveragePrecision(Metric):
                 raise ValueError("`average='micro'` is not supported together with `capacity` mode")
             self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.float32))
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.int32))
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         if self.capacity is not None:

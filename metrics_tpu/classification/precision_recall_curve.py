@@ -9,6 +9,7 @@ all_gather-heavy path, SURVEY.md §2.5). Inside compiled code prefer
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_masked,
@@ -59,8 +60,8 @@ class PrecisionRecallCurve(Metric):
         if capacity is not None:
             self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.float32))
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.int32))
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         """Reference ``precision_recall_curve.py:119-133``."""

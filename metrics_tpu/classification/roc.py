@@ -4,6 +4,7 @@
 from typing import Any, List, Optional, Tuple, Union
 
 import jax
+import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.roc import (
     _binary_roc_masked,
@@ -58,8 +59,8 @@ class ROC(Metric):
         if capacity is not None:
             self.mode = init_score_ring_states(self, capacity, num_classes, pos_label)
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.float32))
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.int32))
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         if self.capacity is not None:

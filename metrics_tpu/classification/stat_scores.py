@@ -71,7 +71,7 @@ class StatScores(Metric):
                 self.add_state(s, default=jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
         else:
             for s in ("tp", "fp", "tn", "fn"):
-                self.add_state(s, default=[], dist_reduce_fx="cat")
+                self.add_state(s, default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.int32))
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate stat scores for a batch (reference ``stat_scores.py:170-192``)."""

@@ -374,7 +374,9 @@ class MetricCollection:
         heads = [self._modules[cg[0]] for cg in self._groups.values()] if self._groups else list(self._modules.values())
         states = [dict(m._state) for m in heads]
         reductions = [m._reductions for m in heads]
-        synced = fused_sync(states, reductions, axis_name)
+        synced = fused_sync(
+            states, reductions, axis_name, defaults=[m._sync_defaults() for m in heads]
+        )
         for m, s in zip(heads, synced):
             object.__setattr__(m, "_state", s)
         self._compute_groups_create_state_ref()

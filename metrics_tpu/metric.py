@@ -100,8 +100,12 @@ class Metric:
         dist_sync_fn: Optional[Callable] = None,
         sync_on_compute: bool = True,
         on_overflow: str = "warn",
+        on_invalid: str = "ignore",
+        debug_checks: bool = False,
         **kwargs: Any,
     ) -> None:
+        from metrics_tpu.utilities.guard import VALID_POLICIES, FaultCounters
+
         # kwargs popped like reference ``metric.py:91-109``
         object.__setattr__(self, "_state", {})
         object.__setattr__(self, "_defaults", {})
@@ -115,6 +119,15 @@ class Metric:
         if on_overflow not in ("warn", "error", "ignore"):
             raise ValueError(f"`on_overflow` must be 'warn', 'error' or 'ignore', got {on_overflow!r}")
         self.on_overflow = on_overflow
+        if on_invalid not in VALID_POLICIES:
+            raise ValueError(f"`on_invalid` must be one of {VALID_POLICIES}, got {on_invalid!r}")
+        self.on_invalid = on_invalid
+        self.debug_checks = debug_checks
+        self._faults_reported = 0
+        if on_invalid != "ignore":
+            # the in-graph fault channel: per-class uint32 counters carried
+            # as ordinary sum-reduced metric state (see utilities/guard.py)
+            self.add_state("_faults", default=FaultCounters.zeros(), dist_reduce_fx="sum")
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
 
@@ -129,7 +142,7 @@ class Metric:
         self._enable_grad = False
 
         # wrap the subclass's update/compute (reference ``metric.py:113-114``)
-        self._original_update = self.update
+        self._original_update = self._maybe_guard(self.update)
         self._original_compute = self.compute
         object.__setattr__(self, "update", self._wrap_update(self._original_update))
         object.__setattr__(self, "compute", self._wrap_compute(self._original_compute))
@@ -147,26 +160,47 @@ class Metric:
         default: Union[Array, list],
         dist_reduce_fx: Reduction = None,
         persistent: bool = False,
+        template: Optional[Array] = None,
     ) -> None:
         """Register a named state leaf (reference ``metric.py:150-217``).
 
         ``default`` is either an array (fixed-shape accumulator) or an empty
         list (a ``cat`` state — batches appended, concatenated lazily).
+
+        ``template`` (list states only) is an empty ``(0, *row)`` array
+        declaring the entries' dtype/trailing shape, so a sync of an
+        *empty* list state can gather with the declared dtype instead of
+        collapsing to float32 ``(0,)`` (see ``parallel/sync.py``).
         """
+        from metrics_tpu.utilities.guard import FaultCounters
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
-        if isinstance(default, CatBuffer):
-            pass  # static-shape concat state (jittable cat)
+        if isinstance(default, (CatBuffer, FaultCounters)):
+            pass  # static-shape pytree states (jittable cat / fault counters)
         elif not isinstance(default, list) or default:
             if not isinstance(default, (jax.Array, np.ndarray, int, float)):
                 raise ValueError("state variable must be an array, a CatBuffer, or an empty list (any value)")
             default = jnp.asarray(default)
         if dist_reduce_fx not in ("sum", "mean", "cat", "max", "min", None) and not callable(dist_reduce_fx):
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if template is not None:
+            if not isinstance(default, list):
+                raise ValueError("`template` is only meaningful for list ('cat') states")
+            self.__dict__.setdefault("_list_templates", {})[name] = jnp.asarray(template)
         self._defaults[name] = deepcopy(default) if isinstance(default, list) else default
         self._reductions[name] = dist_reduce_fx
         self._persistent[name] = persistent
         self._state[name] = [] if isinstance(default, list) else default
+
+    def _sync_defaults(self) -> Dict[str, Any]:
+        """Defaults for the sync layer: list-state defaults are replaced by
+        their registered dtype/shape ``template`` (when one exists), so
+        ``sync_state``/``fused_sync`` can gather empty list states with the
+        declared dtype instead of the legacy float32 ``(0,)``."""
+        out = dict(self._defaults)
+        for name, tpl in self.__dict__.get("_list_templates", {}).items():
+            out[name] = tpl
+        return out
 
     # attribute routing so subclass code can write ``self.tp += x``
     def __setattr__(self, name: str, value: Any) -> None:
@@ -210,6 +244,30 @@ class Metric:
             return False
         return not any(isinstance(d, list) for d in self._defaults.values())
 
+    def _maybe_guard(self, update: Callable) -> Callable:
+        """Wrap the raw update body with the in-graph fault channel.
+
+        Counting/masking happens *inside* whatever traces this body —
+        the module runtime's own jit, ``functionalize``, or a user's
+        ``shard_map`` — so faults are detected on the compiled path the
+        concrete-only checks in ``utilities/checks.py`` cannot see.
+        Attribute reads are lazy: subclass ``__init__`` sets ``num_classes``
+        / ``capacity`` / ``threshold`` after this wrapper is built.
+        """
+
+        if self.on_invalid == "ignore":
+            return update  # guard compiled out entirely — zero overhead
+
+        @functools.wraps(update)
+        def guarded(*args: Any, **kwargs: Any) -> None:
+            from metrics_tpu.utilities.guard import guard_update_args
+
+            args, kwargs, counters = guard_update_args(self, args, kwargs)
+            self._faults = self._faults + counters
+            return update(*args, **kwargs)
+
+        return guarded
+
     def _make_update_jit(self) -> Callable:
         def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
             prev = self.__dict__["_state"]
@@ -220,7 +278,22 @@ class Metric:
             finally:
                 object.__setattr__(self, "_state", prev)
 
-        return jax.jit(pure_update)
+        if not self.debug_checks:
+            return jax.jit(pure_update)
+
+        # strict mode: trap in-graph NaN/inf *production* and bad gathers,
+        # not just faulty inputs — the errors surface at this (eager) call
+        # site instead of silently poisoning the accumulators
+        from jax.experimental import checkify
+
+        checked = jax.jit(checkify.checkify(pure_update, errors=checkify.float_checks))
+
+        def run_checked(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
+            err, out = checked(state, args, kwargs)
+            checkify.check_error(err)
+            return out
+
+        return run_checked
 
     def _make_compute_jit(self) -> Callable:
         def pure_compute(state: Dict[str, Any]) -> Any:
@@ -294,9 +367,11 @@ class Metric:
                 should_unsync=self._should_unsync,
             ):
                 value = self._compute_unsynced(*args, **kwargs)
-                # checked while synced: `dropped` is then the global (summed)
-                # count, so every rank takes the same warn/error branch
+                # checked while synced: `dropped`/fault counters are then the
+                # global (summed) counts, so every rank takes the same
+                # warn/error branch
                 self._check_cat_overflow()
+                self._check_faults()
             self._computed = _squeeze_if_scalar(value)
             return self._computed
 
@@ -344,6 +419,61 @@ class Metric:
         if self.on_overflow == "error":
             raise MetricsTPUUserError(msg)
         rank_zero_warn(msg, UserWarning)
+
+    @property
+    def fault_counts(self) -> Optional[Dict[str, int]]:
+        """Per-class fault counts from the in-graph channel, as a dict keyed
+        by ``guard.FAULT_CLASSES`` name. ``None`` when the guard is off
+        (``on_invalid='ignore'``) or the state is traced — inside compiled
+        code consume ``MetricDef.faults`` from :func:`metrics_tpu.functionalize`
+        instead (the traced, psum'd form of this signal)."""
+        fc = self._state.get("_faults")
+        if fc is None:
+            return None
+        try:
+            return fc.as_dict()
+        except _TRACE_ERRORS:
+            return None
+
+    def _check_faults(self) -> None:
+        """The eager boundary of the fault channel: ``on_invalid='warn'`` /
+        ``'error'`` fire here from the (post-sync, globally summed) in-graph
+        counters; a NaN state-leaf scan rounds out the ``nonfinite_state``
+        class. ``drop`` already degraded in-graph and stays silent —
+        inspect :attr:`fault_counts` to observe what was masked."""
+        if self.on_invalid in ("ignore", "drop"):
+            return
+        from metrics_tpu.utilities.guard import _IDX, nan_state_leaves
+
+        fc = self._state.get("_faults")
+        if fc is None:
+            return
+        try:
+            counts = np.asarray(fc.counts).astype(np.int64)
+        except _TRACE_ERRORS:
+            return  # traced compute: the caller consumes MetricDef.faults
+        counts[_IDX["nonfinite_state"]] += nan_state_leaves(
+            {k: v for k, v in self._state.items() if k != "_faults"}
+        )
+        total = int(counts.sum())
+        from metrics_tpu.utilities.guard import format_fault_report
+
+        if self.on_invalid == "error":
+            # no warn-once watermark for errors: poisoned accumulators must
+            # keep raising until the state is actually reset
+            if total > 0:
+                raise MetricsTPUUserError(format_fault_report(counts, type(self).__name__))
+            return
+        if total <= self._faults_reported:
+            return
+        self._faults_reported = total
+        rank_zero_warn(format_fault_report(counts, type(self).__name__), UserWarning)
+
+    def report_faults(self) -> None:
+        """Public eager boundary for ``sync()``-without-``compute()`` users:
+        apply the ``on_invalid`` policy to the current (ideally synced)
+        counters immediately."""
+        self._check_faults()
 
     def _compute_unsynced(self, *args: Any, **kwargs: Any) -> Any:
         if self.compute_on_cpu:
@@ -408,13 +538,22 @@ class Metric:
         self._deep_reset()
         self.update(*args, **kwargs)
         self._should_unsync = False
-        batch_val = self.compute()
-        # restore global state (self + children)
-        self._deep_restore(cache)
-        self._should_unsync = True
-        self._to_sync = True
-        self._computed = None
-        self._is_synced = False
+        reported = self._faults_reported
+        try:
+            batch_val = self.compute()
+        finally:
+            # restore global state (self + children) even when compute
+            # raises (on_overflow/on_invalid='error'): the epoch's
+            # accumulation and the sync flags must survive the exception.
+            # The fault-warn watermark is batch-scoped inside this compute —
+            # restore it too, or a large first batch would suppress warnings
+            # for every smaller later batch
+            self._deep_restore(cache)
+            self._faults_reported = reported
+            self._should_unsync = True
+            self._to_sync = True
+            self._computed = None
+            self._is_synced = False
         return batch_val
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -426,13 +565,22 @@ class Metric:
         self.update(*args, **kwargs)
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
-        batch_val = self.compute()
-        # merge batch state into global state (reference ``metric.py:319``)
-        self._deep_merge(global_snap)
-        self._should_unsync = True
-        self._to_sync = True
-        self._computed = None
-        self._is_synced = False
+        reported = self._faults_reported
+        try:
+            batch_val = self.compute()
+        finally:
+            # merge batch state into global state (reference ``metric.py:319``)
+            # even when compute raises (on_overflow/on_invalid='error'): the
+            # accumulated stream — including this batch and its fault
+            # counters — and the sync flags must survive the exception. The
+            # fault-warn watermark was batch-scoped inside this compute:
+            # restore it so per-batch warnings stay order-independent
+            self._faults_reported = reported
+            self._deep_merge(global_snap)
+            self._should_unsync = True
+            self._to_sync = True
+            self._computed = None
+            self._is_synced = False
         return batch_val
 
     # ------------------------------------------------------------------
@@ -544,10 +692,18 @@ class Metric:
         """Gather + reduce every state across processes (reference ``metric.py:348-374``)."""
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
+        from metrics_tpu.utilities.guard import FaultCounters
+
         input_dict = {attr: self._state[attr] for attr in self._reductions}
         # CatBuffer states: gather data and mask; the union of valid rows is
         # the stacked buffers (masked rows stay masked)
         for attr, value in list(input_dict.items()):
+            if isinstance(value, FaultCounters):
+                group = self.process_group if process_group is None else process_group
+                gathered = dist_sync_fn(value.counts, group)
+                self._state[attr] = FaultCounters(counts=sum(jnp.asarray(g) for g in gathered))
+                del input_dict[attr]
+                continue
             if isinstance(value, CatBuffer):
                 group = self.process_group if process_group is None else process_group
                 data = jnp.concatenate(dist_sync_fn(value.data, group), axis=0)
@@ -680,6 +836,7 @@ class Metric:
         self._restore_defaults()
         self._cache = None
         self._is_synced = False
+        self._faults_reported = 0  # counters reset with the state; so must the warn watermark
 
     def clone(self) -> "Metric":
         """Deep copy (reference ``metric.py:556``)."""
@@ -691,7 +848,17 @@ class Metric:
             self._persistent[key] = mode
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
-        """Persistent states as numpy copies (reference ``metric.py:654-672``)."""
+        """Persistent states as numpy copies (reference ``metric.py:654-672``).
+
+        Structured states serialize to checkpoint-friendly primitives:
+        :class:`CatBuffer` as a ``{"data", "mask", "dropped"}`` dict of
+        arrays, :class:`FaultCounters` as its raw counts vector — both
+        round-trip through orbax/pickle with no custom node handling and are
+        rebuilt (and validated) by :meth:`load_state_dict`.
+        """
+        from metrics_tpu.utilities.guard import FaultCounters
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
         out: Dict[str, Any] = {}
         for key in self._defaults:
             if not self._persistent[key]:
@@ -699,21 +866,95 @@ class Metric:
             current = self._state[key]
             if isinstance(current, list):
                 out[prefix + key] = [np.asarray(x) for x in current]
+            elif isinstance(current, CatBuffer):
+                dropped = current.dropped if current.dropped is not None else jnp.zeros((), jnp.int32)
+                out[prefix + key] = {
+                    "data": np.asarray(current.data),
+                    "mask": np.asarray(current.mask),
+                    "dropped": np.asarray(dropped),
+                }
+            elif isinstance(current, FaultCounters):
+                out[prefix + key] = np.asarray(current.counts)
             else:
                 out[prefix + key] = np.asarray(current)
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
-        """Restore states saved by :meth:`state_dict` (reference ``metric.py:674-692``)."""
+        """Restore states saved by :meth:`state_dict` (reference ``metric.py:674-692``).
+
+        Every loaded value is validated against the registered default's
+        shape/dtype/structure before it replaces state — a corrupt or
+        mismatched checkpoint raises a ``ValueError`` naming the offending
+        state key instead of silently loading garbage accumulators.
+        """
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:
-                v = state_dict[name]
-                if isinstance(v, list):
-                    self._state[key] = [jnp.asarray(x) for x in v]
-                else:
-                    self._state[key] = jnp.asarray(v)
+                self._state[key] = self._validated_state_value(key, state_dict[name])
                 self._update_called = True
+
+    def _validated_state_value(self, key: str, v: Any) -> Any:
+        """Check one loaded state value against ``self._defaults[key]``."""
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES, FaultCounters
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        default = self._defaults[key]
+
+        def fail(why: str) -> None:
+            raise ValueError(
+                f"{type(self).__name__}.load_state_dict: state {key!r} {why}; refusing to load a "
+                "corrupt checkpoint."
+            )
+
+        def as_leaf(value: Any, like: Array, part: str = "") -> Array:
+            try:
+                arr = np.asarray(value)
+            except Exception:
+                fail(f"{part}is not array-like (got {type(value).__name__})")
+            if arr.dtype == object:
+                fail(f"{part}is not a numeric array (object dtype)")
+            if tuple(arr.shape) != tuple(like.shape):
+                fail(f"{part}has shape {tuple(arr.shape)}, expected {tuple(like.shape)}")
+            if not np.can_cast(arr.dtype, np.dtype(like.dtype), casting="same_kind"):
+                fail(f"{part}has dtype {arr.dtype}, incompatible with expected {like.dtype}")
+            return jnp.asarray(arr).astype(like.dtype)
+
+        if isinstance(default, CatBuffer):
+            if isinstance(v, CatBuffer):
+                v = {"data": v.data, "mask": v.mask, "dropped": v.dropped}
+            if not isinstance(v, dict) or not {"data", "mask"} <= set(v):
+                fail(
+                    "is a CatBuffer ring state and must load from a {'data', 'mask', 'dropped'} "
+                    f"mapping (got {type(v).__name__})"
+                )
+            dropped_like = default.dropped if default.dropped is not None else jnp.zeros((), jnp.int32)
+            loaded_dropped = v.get("dropped")
+            return CatBuffer(
+                data=as_leaf(v["data"], default.data, "slot 'data' "),
+                mask=as_leaf(v["mask"], default.mask, "slot 'mask' "),
+                dropped=(
+                    as_leaf(loaded_dropped, dropped_like, "slot 'dropped' ")
+                    if loaded_dropped is not None
+                    else jnp.zeros((), jnp.int32)
+                ),
+            )
+        if isinstance(default, FaultCounters):
+            if isinstance(v, FaultCounters):
+                v = v.counts
+            arr = np.asarray(v).reshape(-1)
+            if arr.dtype == object or not np.issubdtype(arr.dtype, np.number):
+                fail("is a FaultCounters state and must load from a numeric counts vector")
+            # FAULT_CLASSES is appends-only, so both directions stay loadable:
+            # older checkpoints (shorter vector) zero-pad the new classes,
+            # newer ones (longer) keep the classes this build knows
+            if arr.shape[0] < NUM_FAULT_CLASSES:
+                arr = np.concatenate([arr, np.zeros(NUM_FAULT_CLASSES - arr.shape[0], arr.dtype)])
+            return FaultCounters(counts=jnp.asarray(arr[:NUM_FAULT_CLASSES], jnp.uint32))
+        if isinstance(default, list):
+            if not isinstance(v, (list, tuple)):
+                fail(f"is a list ('cat') state and must load from a list (got {type(v).__name__})")
+            return [jnp.asarray(x) for x in v]
+        return as_leaf(v, default)
 
     def __getstate__(self) -> Dict[str, Any]:
         """Pickle support: drop wrapped/bound/jitted fns (reference ``metric.py:560-569``)."""
@@ -726,9 +967,13 @@ class Metric:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        # pickles from before the fault channel lack its knobs
+        self.__dict__.setdefault("on_invalid", "ignore")
+        self.__dict__.setdefault("debug_checks", False)
+        self.__dict__.setdefault("_faults_reported", 0)
         self.__dict__["_state"] = jax.tree_util.tree_map(jnp.asarray, state["_state"])
         self.__dict__["_defaults"] = jax.tree_util.tree_map(jnp.asarray, state["_defaults"])
-        object.__setattr__(self, "_original_update", type(self).update.__get__(self))
+        object.__setattr__(self, "_original_update", self._maybe_guard(type(self).update.__get__(self)))
         object.__setattr__(self, "_original_compute", type(self).compute.__get__(self))
         object.__setattr__(self, "update", self._wrap_update(self._original_update))
         object.__setattr__(self, "compute", self._wrap_compute(self._original_compute))
@@ -749,7 +994,7 @@ class Metric:
                 object.__setattr__(new, k, jax.tree_util.tree_map(lambda x: x, v) if v is not None else None)
             else:
                 object.__setattr__(new, k, deepcopy(v, memo))
-        object.__setattr__(new, "_original_update", type(new).update.__get__(new))
+        object.__setattr__(new, "_original_update", new._maybe_guard(type(new).update.__get__(new)))
         object.__setattr__(new, "_original_compute", type(new).compute.__get__(new))
         object.__setattr__(new, "update", new._wrap_update(new._original_update))
         object.__setattr__(new, "compute", new._wrap_compute(new._original_compute))

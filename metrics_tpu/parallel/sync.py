@@ -106,8 +106,36 @@ def sync_cat_buffer(buffer: Any, axis_name: str) -> Any:
     return CatBuffer(data=data, mask=mask, dropped=dropped)
 
 
-def sync_state(state: Dict[str, Any], reductions: Dict[str, Reduction], axis_name: str) -> Dict[str, Any]:
-    """Sync a metric-state dict across ``axis_name`` (explicit-collective regime)."""
+def _empty_cat_like(default: Any) -> Array:
+    """Shape/dtype template for an empty list ('cat') state.
+
+    An empty rank must not change the gathered dtype or trailing shape: when
+    the registered default (or a non-empty default entry) carries an array
+    template, the empty contribution is ``(0, *trailing)`` of that dtype;
+    only template-less states keep the legacy float32 ``(0,)``.
+    """
+    if isinstance(default, (list, tuple)) and default:
+        default = default[0]
+    if isinstance(default, (jax.Array, np.ndarray)):
+        template = jnp.asarray(default)
+        trailing = template.shape[1:] if template.ndim >= 1 else ()
+        return jnp.zeros((0, *trailing), template.dtype)
+    return jnp.zeros((0,))
+
+
+def sync_state(
+    state: Dict[str, Any],
+    reductions: Dict[str, Reduction],
+    axis_name: str,
+    defaults: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Sync a metric-state dict across ``axis_name`` (explicit-collective regime).
+
+    ``defaults`` (optional, keyed like ``state``) supplies dtype/shape
+    templates so empty list states gather with their declared dtype instead
+    of the float32 fallback (see :func:`_empty_cat_like`).
+    """
+    from metrics_tpu.utilities.guard import FaultCounters
     from metrics_tpu.utilities.ringbuffer import CatBuffer
 
     out = {}
@@ -116,8 +144,15 @@ def sync_state(state: Dict[str, Any], reductions: Dict[str, Reduction], axis_nam
         if isinstance(value, CatBuffer):
             out[name] = sync_cat_buffer(value, axis_name)
             continue
+        if isinstance(value, FaultCounters):
+            out[name] = FaultCounters(counts=sync_leaf(value.counts, "sum", axis_name))
+            continue
         if isinstance(value, (list, tuple)):
-            value = jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0) if value else jnp.zeros((0,))
+            value = (
+                jnp.concatenate([jnp.atleast_1d(v) for v in value], axis=0)
+                if value
+                else _empty_cat_like(defaults.get(name) if defaults else None)
+            )
             fx = "cat" if fx in ("cat", None) else fx
         out[name] = sync_leaf(value, fx, axis_name)
     return out
@@ -127,6 +162,7 @@ def fused_sync(
     states: Sequence[Dict[str, Any]],
     reductions: Sequence[Dict[str, Reduction]],
     axis_name: str,
+    defaults: Optional[Sequence[Dict[str, Any]]] = None,
 ) -> List[Dict[str, Any]]:
     """Sync many metrics' states with one collective per (reduction, dtype).
 
@@ -135,15 +171,28 @@ def fused_sync(
     max/min. This is the structural version of the reference's per-tensor
     all_gather loop (``metric.py:356``): a ``MetricCollection`` of K metrics
     with S scalar states costs **1** ICI collective instead of ``2*K*S``.
+
+    Fault-counter states (:class:`FaultCounters`, ``utilities/guard.py``)
+    fold their uint32 counts vector into the sum bucket, so the whole
+    collection's fault channel syncs inside the same fused collective
+    family — robustness costs no per-metric collective.
+
+    ``defaults`` (optional, one dict per metric) supplies templates for
+    empty list states, as in :func:`sync_state`.
     """
+    from metrics_tpu.utilities.guard import FaultCounters
     from metrics_tpu.utilities.ringbuffer import CatBuffer
 
     buckets: Dict[Tuple[str, Any], List[Tuple[int, str, Array]]] = {}
+    fault_slots: set = set()
     passthrough: List[Tuple[int, str, Array, Reduction]] = []
     for i, (state, reds) in enumerate(zip(states, reductions)):
         for name, value in state.items():
             fx = reds[name]
-            if fx in ("sum", "mean", "max", "min") and isinstance(value, jax.Array):
+            if isinstance(value, FaultCounters):
+                fault_slots.add((i, name))
+                buckets.setdefault(("sum", value.counts.dtype), []).append((i, name, value.counts))
+            elif fx in ("sum", "mean", "max", "min") and isinstance(value, jax.Array):
                 buckets.setdefault((fx, value.dtype), []).append((i, name, value))
             else:
                 passthrough.append((i, name, value, fx))
@@ -154,14 +203,20 @@ def fused_sync(
         synced = sync_leaf(flat, fx, axis_name)
         offset = 0
         for (i, name, v) in leaves:
-            out[i][name] = jax.lax.dynamic_slice_in_dim(synced, offset, v.size).reshape(v.shape)
+            leaf = jax.lax.dynamic_slice_in_dim(synced, offset, v.size).reshape(v.shape)
+            out[i][name] = FaultCounters(counts=leaf) if (i, name) in fault_slots else leaf
             offset += v.size
     for (i, name, value, fx) in passthrough:
         if isinstance(value, CatBuffer):
             out[i][name] = sync_cat_buffer(value, axis_name)
             continue
         if isinstance(value, (list, tuple)):
-            value = jnp.concatenate([jnp.atleast_1d(x) for x in value], axis=0) if value else jnp.zeros((0,))
+            template = defaults[i].get(name) if defaults is not None else None
+            value = (
+                jnp.concatenate([jnp.atleast_1d(x) for x in value], axis=0)
+                if value
+                else _empty_cat_like(template)
+            )
             fx = "cat" if fx in ("cat", None) else fx
         out[i][name] = sync_leaf(value, fx, axis_name)
     return out
@@ -186,9 +241,19 @@ def _pad_gather_trim(array: Array, allgather: Any) -> List[Array]:
     all_shapes = np.asarray(allgather(local_shape))  # (nproc, ndim)
     max_shape = all_shapes.max(axis=0)
     # 2) pad to elementwise max, gather payload, 3) trim per-rank
+    # (scalars have nothing to pad — jnp.pad rejects an empty width list)
     pad = [(0, int(m - s)) for s, m in zip(array.shape, max_shape)]
-    padded = jnp.pad(array, pad)
+    padded = jnp.pad(array, pad) if pad else array
     gathered = allgather(padded)  # (nproc, *max_shape)
+    if np.asarray(gathered).shape[0] != all_shapes.shape[0]:
+        # one of the two collectives degraded to local-only (see
+        # RetryingGather) — the pair is no longer consistent, so the only
+        # trustworthy data is this host's own contribution. Covers both
+        # directions: payload degraded (its single row is the local padded
+        # array; rank 0's shape would mis-trim it on other hosts) and shape
+        # degraded with a later-recovered payload (whose rows can't be
+        # attributed to ranks without the shape table).
+        return [jnp.asarray(array)]
     out = []
     for r in range(all_shapes.shape[0]):
         sl = tuple(slice(0, int(d)) for d in all_shapes[r])
@@ -196,19 +261,168 @@ def _pad_gather_trim(array: Array, allgather: Any) -> List[Array]:
     return out
 
 
-def gather_all_arrays(array: Array, group: Any = None) -> List[Array]:
+class GatherTimeoutError(RuntimeError):
+    """A multihost allgather did not complete within its timeout."""
+
+
+class RetryingGather:
+    """Timeout + exponential-backoff wrapper around a multihost allgather
+    transport, with a degraded local-only fallback.
+
+    ``multihost_utils.process_allgather`` blocks until every process
+    arrives; a crashed or wedged peer therefore hangs the *healthy* hosts
+    indefinitely — the exact failure the ROADMAP's production north-star
+    cannot afford. Each call here runs the transport on a worker thread and
+    bounds it with ``timeout_s``; transport *exceptions* retry with
+    exponential backoff, while *timeouts* skip straight to the fallback (a
+    timed-out collective may still complete on slow peers, so re-issuing it
+    would pair with the peers' next collective and desynchronize the
+    sequence). When every permitted attempt fails the gather degrades to
+    the local contribution only — shaped ``(1, *local)``, i.e. a valid
+    world-size-1 result — behind a loud warning, instead of blocking
+    forever. Pass ``fallback_local=False`` to raise instead.
+
+    The transport is injectable (any ``array -> (nproc, *array.shape)``
+    callable), so the retry/degradation logic is testable without a pod.
+    A timed-out transport call cannot be cancelled; it runs on an explicit
+    **daemon** thread and is abandoned on timeout — the thread cannot block
+    interpreter exit (a non-daemon executor worker would: concurrent.futures'
+    atexit hook joins its threads, re-creating the very hang this class
+    exists to bound).
+
+    After a call exhausts every permitted attempt, a circuit breaker opens
+    for ``cooldown_s``: while open, calls skip straight to the degraded
+    fallback instead of re-paying the full timeout+backoff budget — a sync
+    loops this transport over every state leaf of every metric, so without
+    the breaker one dead peer would cost minutes *per leaf*. A successful
+    call (after the cooldown lets one through) closes the breaker.
+    """
+
+    def __init__(
+        self,
+        allgather: Callable[[Any], Any],
+        timeout_s: float = 120.0,
+        max_retries: int = 2,
+        backoff_s: float = 1.0,
+        fallback_local: bool = True,
+        cooldown_s: float = 60.0,
+    ) -> None:
+        self.allgather = allgather
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.fallback_local = fallback_local
+        self.cooldown_s = cooldown_s
+        self._open_until = 0.0
+
+    def _attempt(self, array: Any) -> Any:
+        import queue
+        import threading
+
+        box: "queue.Queue" = queue.Queue(maxsize=1)
+
+        def run() -> None:
+            try:
+                box.put(("ok", self.allgather(array)))
+            except BaseException as err:  # noqa: BLE001 — relayed to the caller
+                box.put(("err", err))
+
+        worker = threading.Thread(target=run, daemon=True, name="metrics-tpu-gather")
+        worker.start()
+        try:
+            kind, payload = box.get(timeout=self.timeout_s)
+        except queue.Empty:
+            raise GatherTimeoutError(
+                f"multihost allgather exceeded {self.timeout_s}s (peer process down or wedged?)"
+            )
+        if kind == "err":
+            raise payload
+        return payload
+
+    def __call__(self, array: Any) -> Any:
+        import time as _time
+        import warnings
+
+        if _time.monotonic() < self._open_until:
+            # circuit open: a recent call already paid the full failure
+            # budget; degrade immediately instead of re-blocking per leaf
+            if not self.fallback_local:
+                raise GatherTimeoutError(
+                    f"multihost gather circuit open for {self._open_until - _time.monotonic():.0f}s "
+                    "more after repeated failures"
+                )
+            return np.asarray(array)[None]
+
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            try:
+                out = self._attempt(array)
+                self._open_until = 0.0  # healthy again: close the breaker
+                return out
+            except GatherTimeoutError as err:
+                # a timed-out collective must NOT be re-issued: the abandoned
+                # attempt may still complete on slow-but-alive peers, and a
+                # retry would then pair with the peers' NEXT collective,
+                # desynchronizing the whole sequence. Timeouts go straight to
+                # the fallback (or raise); only failures that erred on every
+                # participant are safe to retry.
+                last_err = err
+                break
+            except Exception as err:  # noqa: BLE001 — transport faults of any shape
+                last_err = err
+                if attempt < self.max_retries:
+                    _time.sleep(self.backoff_s * (2**attempt))
+        self._open_until = _time.monotonic() + self.cooldown_s
+        if not self.fallback_local:
+            raise last_err
+        warnings.warn(
+            f"multihost gather FAILED after {self.max_retries + 1} attempts ({last_err}); "
+            "degrading to LOCAL-ONLY state — synced values on this process cover this "
+            "process's stream only, NOT the global one. Investigate the pod before trusting "
+            "aggregate metrics.",
+            UserWarning,
+        )
+        return np.asarray(array)[None]  # world-size-1 shaped result
+
+
+_DEFAULT_TRANSPORT: Optional[Callable[[Any], Any]] = None
+
+
+def _default_transport() -> Callable[[Any], Any]:
+    global _DEFAULT_TRANSPORT
+    if _DEFAULT_TRANSPORT is None:
+        from jax.experimental import multihost_utils
+
+        _DEFAULT_TRANSPORT = RetryingGather(multihost_utils.process_allgather)
+    return _DEFAULT_TRANSPORT
+
+
+def set_gather_transport(transport: Optional[Callable[[Any], Any]]) -> Optional[Callable[[Any], Any]]:
+    """Swap the process-level gather transport (None restores the default
+    retrying ``process_allgather``). Returns the previous transport —
+    fault-injection tests and exotic pods (e.g. DCN proxies) hook in here."""
+    global _DEFAULT_TRANSPORT
+    prev = _DEFAULT_TRANSPORT
+    _DEFAULT_TRANSPORT = transport
+    return prev
+
+
+def gather_all_arrays(array: Array, group: Any = None, transport: Optional[Callable[[Any], Any]] = None) -> List[Array]:
     """All-gather ``array`` from every process into a list, handling uneven
     leading dimensions — the analogue of reference
     ``utilities/distributed.py:102-151``.
+
+    The transport defaults to a :class:`RetryingGather` around
+    ``multihost_utils.process_allgather`` (timeout + backoff + degraded
+    local-only fallback), so a wedged peer costs bounded time, never an
+    indefinite hang.
 
     Single-process: returns ``[array]`` (matching the reference's behavior at
     world_size 1).
     """
     if not distributed_available():
         return [jnp.asarray(array)]
-    from jax.experimental import multihost_utils
-
-    return _pad_gather_trim(array, multihost_utils.process_allgather)
+    return _pad_gather_trim(array, transport or _default_transport())
 
 
 # --------------------------------------------------------------------------

@@ -38,6 +38,15 @@ class MetricDef(NamedTuple):
     jitted/``shard_map`` users can actually consume. Under ``axis_name`` it
     is ``psum``-med, so every shard sees the global count. Always callable;
     returns 0 for metrics with no ring states.
+
+    ``faults(state)`` is the same contract for the in-graph fault channel
+    (``utilities/guard.py``): the ``(NUM_FAULT_CLASSES,)`` uint32 counter
+    vector accumulated by guarded updates (``on_invalid != 'ignore'``),
+    summed over members for wrappers/collections and ``psum``-med under
+    ``axis_name`` so every shard sees the global counts. All-zero for
+    unguarded metrics. Inside the state itself the counters sync through
+    ``fused_sync`` — they ride the one uint32 sum bucket shared by every
+    guarded metric in a collection, costing no per-metric collective.
     """
 
     init: Callable[[], Dict[str, Any]]
@@ -45,6 +54,7 @@ class MetricDef(NamedTuple):
     compute: Callable[[Dict[str, Any]], Any]
     merge: Callable[[Dict[str, Any], Dict[str, Any]], Dict[str, Any]]
     dropped: Callable[[Dict[str, Any]], Any] = None
+    faults: Callable[[Dict[str, Any]], Any] = None
 
 
 def _dropped_in_state(state: Dict[str, Any], independent: bool = False) -> Any:
@@ -66,6 +76,32 @@ def _dropped_in_state(state: Dict[str, Any], independent: bool = False) -> Any:
 
 def _psum_if(axis_name: Optional[str], value: Any) -> Any:
     return jax.lax.psum(value, axis_name) if axis_name is not None else value
+
+
+def _faults_in_state(state: Dict[str, Any]) -> Any:
+    """The metric's fault-counter vector, all-zero when unguarded."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES, FaultCounters
+
+    fc = state.get("_faults")
+    if isinstance(fc, FaultCounters):
+        return fc.counts
+    return jnp.zeros((NUM_FAULT_CLASSES,), jnp.uint32)
+
+
+def _check_drop_traceable(metric: "Metric") -> None:
+    """``on_invalid='drop'`` must stay in-graph under functionalize —
+    anything else would concretize mid-trace."""
+    from metrics_tpu.utilities.guard import can_drop_traced
+
+    if getattr(metric, "on_invalid", "ignore") == "drop" and not can_drop_traced(metric):
+        raise ValueError(
+            f"{type(metric).__name__} cannot apply on_invalid='drop' inside compiled code: its "
+            "update has no row-weight machinery (capacity-mode `valid` masks or aggregator NaN "
+            "masking). Construct it with capacity=N, or use on_invalid='warn'/'error' (counters "
+            "accumulate in-graph, the policy fires at the eager boundary)."
+        )
 
 
 def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDef:
@@ -132,7 +168,9 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
             "inside compiled code."
         )
 
+    _check_drop_traceable(metric)
     reductions = dict(metric._reductions)
+    defaults = metric._sync_defaults()
 
     def init() -> Dict[str, Any]:
         return dict(metric._defaults)
@@ -148,7 +186,7 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
 
     def compute(state: Dict[str, Any]) -> Any:
         if axis_name is not None:
-            state = sync_state(state, reductions, axis_name)
+            state = sync_state(state, reductions, axis_name, defaults=defaults)
         prev = metric.__dict__["_state"]
         object.__setattr__(metric, "_state", dict(state))
         try:
@@ -181,7 +219,10 @@ def functionalize(metric: "Metric", axis_name: Optional[str] = None) -> MetricDe
     def dropped(state: Dict[str, Any]) -> Any:
         return _psum_if(axis_name, _dropped_in_state(state, metric._independent_ring_drops))
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
+    def faults(state: Dict[str, Any]) -> Any:
+        return _psum_if(axis_name, _faults_in_state(state))
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped, faults=faults)
 
 
 def bootstrap_functionalize(
@@ -256,7 +297,12 @@ def bootstrap_functionalize(
         # replicas resample the same batch volume; report the worst replica
         return jax.vmap(mdef.dropped)(state).max()
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
+    def faults(state: Dict[str, Any]) -> Any:
+        # resampling duplicates/drops rows per replica: the worst replica is
+        # the representative per-class count for the shared batch stream
+        return jax.vmap(mdef.faults)(state).max(axis=0)
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped, faults=faults)
 
 
 def _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, owner_name):
@@ -318,6 +364,8 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
     metrics = _collect_metrics(wrapper)
 
     for m in metrics:
+        _check_drop_traceable(m)
+    for m in metrics:
         if any(isinstance(d, list) for d in m._defaults.values()):
             raise ValueError(
                 f"{type(m).__name__} (inside {type(wrapper).__name__}) has unbounded list ('cat') "
@@ -375,7 +423,12 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
 
     def compute(states):
         if axis_name is not None:
-            synced = fused_sync(states, [dict(m._reductions) for m in metrics], axis_name)
+            synced = fused_sync(
+                states,
+                [dict(m._reductions) for m in metrics],
+                axis_name,
+                defaults=[m._sync_defaults() for m in metrics],
+            )
             states = synced
         prev = _swap(states)
         try:
@@ -397,7 +450,11 @@ def _functionalize_wrapper(wrapper: "Metric", axis_name: Optional[str] = None) -
             total = total + _dropped_in_state(s, m._independent_ring_drops)
         return _psum_if(axis_name, total)
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
+    def faults(states):
+        total = sum(_faults_in_state(s) for s in states)
+        return _psum_if(axis_name, total)
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped, faults=faults)
 
 
 def _functionalize_collection(collection: "MetricCollection", axis_name: Optional[str] = None) -> MetricDef:
@@ -429,7 +486,12 @@ def _functionalize_collection(collection: "MetricCollection", axis_name: Optiona
         if axis_name is not None:
             fused = [(name, m) for name, m in members if name not in wrapper_names]
             ordered = [state[name] for name, _ in fused]
-            synced = fused_sync(ordered, [reductions[name] for name, _ in fused], axis_name)
+            synced = fused_sync(
+                ordered,
+                [reductions[name] for name, _ in fused],
+                axis_name,
+                defaults=[m._sync_defaults() for _, m in fused],
+            )
             state = {**state, **{name: s for (name, _), s in zip(fused, synced)}}
         res = {name: mdefs[name].compute(state[name]) for name, _ in members}
         res = _flatten_dict(res)
@@ -453,4 +515,14 @@ def _functionalize_collection(collection: "MetricCollection", axis_name: Optiona
                 total = total + _dropped_in_state(s, m._independent_ring_drops)
         return _psum_if(axis_name, total)
 
-    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped)
+    def faults(state: Dict[str, Any]) -> Any:
+        total = 0
+        for name, m in members:
+            s = state[name]
+            if name in wrapper_names:  # list of per-node state dicts
+                total = total + sum(_faults_in_state(ns) for ns in s)
+            else:
+                total = total + _faults_in_state(s)
+        return _psum_if(axis_name, total)
+
+    return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped, faults=faults)

@@ -143,9 +143,9 @@ class RetrievalMetric(Metric, ABC):
         else:
             # dist_reduce_fx=None: sync gathers the union of all ranks'
             # samples without reduction (reference ``base.py:93-95``)
-            self.add_state("indexes", default=[], dist_reduce_fx=None)
-            self.add_state("preds", default=[], dist_reduce_fx=None)
-            self.add_state("target", default=[], dist_reduce_fx=None)
+            self.add_state("indexes", default=[], dist_reduce_fx=None, template=jnp.zeros((0,), jnp.int32))
+            self.add_state("preds", default=[], dist_reduce_fx=None, template=jnp.zeros((0,), jnp.float32))
+            self.add_state("target", default=[], dist_reduce_fx=None, template=jnp.zeros((0,), jnp.float32))
 
     def update(self, preds: Array, target: Array, indexes: Array, valid: Optional[Array] = None) -> None:
         """Reference ``base.py:98-109``."""

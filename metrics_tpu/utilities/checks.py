@@ -6,11 +6,18 @@ TPU-first redesign of reference ``src/torchmetrics/utilities/checks.py``:
   (``checks.py:68-122``) branches on ``ndim`` and floating-ness only — both are
   static under tracing — so the ``DataType`` case is always resolved at trace
   time and never costs a device sync.
-- **Value validation is trace-aware.** The reference's value checks
-  (``checks.py:38-65``: target non-negative, probabilities in [0,1], label
-  ranges) need concrete data; here they run only when inputs are concrete
-  (eager / outside ``jit``) and are skipped for tracers. Structural errors
-  (shape/dtype/argument consistency) always raise.
+- **Value validation is trace-aware — and no longer skipped under
+  tracing.** The reference's value checks (``checks.py:38-65``: target
+  non-negative, probabilities in [0,1], label ranges) need concrete data;
+  the *raising* forms here run only when inputs are concrete (eager /
+  outside ``jit``). On the compiled path the same conditions are now
+  detected by the in-graph fault channel (``utilities/guard.py``): with
+  ``Metric(on_invalid='warn'|'error'|'drop')`` the traced validators count
+  non-finite/out-of-range rows into a psum'd ``FaultCounters`` state inside
+  the jitted update, degrade per policy, and surface at the next eager
+  boundary — faults inside ``jit``/``pjit``/``shard_map`` are observable,
+  not silent. Structural errors (shape/dtype/argument consistency) always
+  raise.
 - **``num_classes`` inference needs concrete data** (reference
   ``checks.py:432``: ``max(preds.max(), target.max()) + 1``). Under tracing
   this raises ``ConcretizationTypeError``, which the ``Metric`` runtime

@@ -1,0 +1,415 @@
+"""In-graph fault channel: traced validators, degradation policies, and the
+``FaultCounters`` state that threads through every compiled ``update``.
+
+The value checks ported from the reference (``utilities/checks.py``) need
+concrete data, so under ``jit``/``pjit`` they are silently skipped — on the
+compiled TPU path a single NaN batch or out-of-range label poisons an
+epoch's accumulators with no signal. This module is the traced counterpart:
+
+- **Validators are pure graph ops.** :func:`batch_fault_masks` turns a
+  ``(preds, target)`` batch into per-row boolean fault masks and a
+  :class:`FaultCounters` increment — ``isnan``/range compares and row
+  reductions, nothing that concretizes. They run *inside* the jitted update.
+- **Counters are metric state.** ``FaultCounters`` is a pytree (one
+  ``(NUM_FAULT_CLASSES,)`` uint32 leaf) registered with
+  ``dist_reduce_fx='sum'``, so it rides every existing channel for free:
+  forward-merge, ``state_dict``/orbax/pickle, and — critically — the fused
+  one-collective sync (``parallel/sync.py::fused_sync`` folds the counts
+  vector into its sum bucket, the fused computation-collective pattern of
+  Punniyamurthy et al., PAPERS.md), so distributed fault visibility costs
+  no extra collective beyond the one uint32 bucket shared by ALL metrics.
+- **Policies degrade, never hang.** ``on_invalid='drop'`` masks offending
+  rows in-graph (via the capacity-mode ``valid`` row masks or the
+  aggregators' NaN masking) so accumulators stay finite; ``'warn'``/
+  ``'error'`` accumulate counters in-graph and fire at the next eager
+  boundary (``Metric.compute()``) from the globally summed counts;
+  ``'ignore'`` compiles the guard out entirely (zero overhead, the
+  pre-fault-channel behavior).
+
+Strict debugging additionally wraps the jitted update in
+``jax.experimental.checkify`` (``Metric(debug_checks=True)``), which traps
+NaN *production* inside the graph, not just NaN inputs.
+"""
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+# Fault classes, in counter-vector order. Keep appends-only: the vector is
+# serialized state and old checkpoints must keep loading.
+FAULT_CLASSES: Tuple[str, ...] = (
+    "nonfinite_preds",  # non-finite values in a float preds/value row
+    "nonfinite_target",  # non-finite values in a float target row
+    "prob_out_of_range",  # probability input outside [0, 1]
+    "label_out_of_range",  # integer label < 0 or >= num_classes
+    "nonfinite_state",  # NaN found in an accumulated state leaf (eager boundary)
+    "dropped_rows",  # rows masked out of the accumulators by the drop policy
+)
+NUM_FAULT_CLASSES = len(FAULT_CLASSES)
+_IDX = {name: i for i, name in enumerate(FAULT_CLASSES)}
+
+VALID_POLICIES = ("error", "warn", "drop", "ignore")
+
+
+class FaultCounters(NamedTuple):
+    """Per-class fault counters as one ``(NUM_FAULT_CLASSES,)`` uint32 leaf.
+
+    A NamedTuple so it is a pytree with zero registration code (jit, vmap,
+    orbax, ``tree_map(np.asarray, ...)`` all traverse it), with named
+    accessors so call sites never index by magic number.
+    """
+
+    counts: Array
+
+    @classmethod
+    def zeros(cls) -> "FaultCounters":
+        return cls(counts=jnp.zeros((NUM_FAULT_CLASSES,), jnp.uint32))
+
+    @classmethod
+    def single(cls, **named: Any) -> "FaultCounters":
+        """Counters with the named classes set (traced or concrete values)."""
+        counts = jnp.zeros((NUM_FAULT_CLASSES,), jnp.uint32)
+        for name, value in named.items():
+            counts = counts.at[_IDX[name]].add(jnp.asarray(value, jnp.uint32))
+        return cls(counts=counts)
+
+    # NamedTuple inherits tuple.__add__ (concatenation); counters add
+    # elementwise so the plain ``g + b`` merge rule for 'sum' states works.
+    def __add__(self, other: "FaultCounters") -> "FaultCounters":  # type: ignore[override]
+        return FaultCounters(counts=self.counts + other.counts)
+
+    def __radd__(self, other: Any) -> "FaultCounters":
+        if other == 0:  # support sum([...]) over gathered counters
+            return self
+        return self.__add__(other)
+
+    def get(self, name: str) -> Array:
+        return self.counts[_IDX[name]]
+
+    def total(self) -> Array:
+        return self.counts.sum()
+
+    def as_dict(self) -> Dict[str, int]:
+        """Concrete per-class counts — eager/host use only."""
+        host = np.asarray(self.counts)
+        return {name: int(host[i]) for i, name in enumerate(FAULT_CLASSES)}
+
+
+# --------------------------------------------------------------------------
+# traced validators (pure graph ops; the jit-safe form of the concrete-only
+# value checks in utilities/checks.py)
+# --------------------------------------------------------------------------
+
+
+def nonfinite_rows(x: Array, nan_only: bool = False) -> Array:
+    """Bool ``(N,)`` — rows (leading axis) containing NaN (or any
+    non-finite value unless ``nan_only``). All-False for integer dtypes,
+    which are finite by construction."""
+    x = jnp.atleast_1d(jnp.asarray(x))
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return jnp.zeros((x.shape[0],), bool)
+    bad = jnp.isnan(x) if nan_only else ~jnp.isfinite(x)
+    return bad.reshape(x.shape[0], -1).any(axis=-1)
+
+
+def prob_out_of_range_rows(p: Array) -> Array:
+    """Bool ``(N,)`` — rows with a probability outside ``[0, 1]``.
+
+    Non-finite entries are counted by :func:`nonfinite_rows`, not here
+    (NaN compares False on both bounds, so they are excluded explicitly).
+    """
+    p = jnp.atleast_1d(jnp.asarray(p))
+    bad = jnp.isfinite(p) & ((p < 0.0) | (p > 1.0))
+    return bad.reshape(p.shape[0], -1).any(axis=-1)
+
+
+def label_out_of_range_rows(
+    target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> Array:
+    """Bool ``(N,)`` — rows with an integer label ``< 0`` or
+    ``>= num_classes`` (rows equal to ``ignore_index`` are exempt)."""
+    t = jnp.atleast_1d(jnp.asarray(target))
+    bad = (t < 0) | (t >= num_classes)
+    if ignore_index is not None:
+        bad = bad & (t != ignore_index)
+    return bad.reshape(t.shape[0], -1).any(axis=-1)
+
+
+def nan_state_leaves(state: Dict[str, Any]) -> int:
+    """Number of *state leaves* containing NaN — the eager-boundary
+    ``nonfinite_state`` check (concrete arrays only).
+
+    NaN in accumulated state is always a fault; ``inf`` is not flagged here
+    because it is a legitimate reduction identity (Min/Max defaults).
+    """
+    n = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and bool(np.isnan(arr).any()):
+            n += 1
+    return n
+
+
+def batch_fault_masks(
+    preds: Optional[Array],
+    target: Optional[Array],
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    check_probs: bool = False,
+    nan_only: bool = False,
+) -> Tuple[FaultCounters, Optional[Array]]:
+    """Traced validation of one ``(preds, target)`` batch.
+
+    Returns ``(counters, bad_rows)`` where ``bad_rows`` is the bool ``(N,)``
+    union of every per-row fault (None when no row-aligned check applies).
+    All checks are static-shape graph ops — safe under jit/shard_map/vmap.
+    """
+    counters = FaultCounters.zeros()
+    bad: Optional[Array] = None
+
+    def _union(mask: Array, existing: Optional[Array]) -> Array:
+        return mask if existing is None else (existing | mask)
+
+    if preds is not None:
+        n_rows = jnp.atleast_1d(preds).shape[0]
+        p_bad = nonfinite_rows(preds, nan_only=nan_only)
+        counters += FaultCounters.single(nonfinite_preds=p_bad.sum())
+        bad = _union(p_bad, bad)
+        if check_probs and jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+            r_bad = prob_out_of_range_rows(preds)
+            counters += FaultCounters.single(prob_out_of_range=r_bad.sum())
+            bad = _union(r_bad, bad)
+    else:
+        n_rows = None
+
+    if target is not None:
+        t = jnp.atleast_1d(jnp.asarray(target))
+        t_bad = nonfinite_rows(t, nan_only=nan_only)
+        counters += FaultCounters.single(nonfinite_target=t_bad.sum())
+        if jnp.issubdtype(t.dtype, jnp.integer) and num_classes is not None:
+            l_bad = label_out_of_range_rows(t, num_classes, ignore_index)
+            counters += FaultCounters.single(label_out_of_range=l_bad.sum())
+            t_bad = t_bad | l_bad
+        if n_rows is None or t.shape[0] == n_rows:
+            bad = _union(t_bad, bad)
+        # target not row-aligned with preds (e.g. broadcast scalar): counted
+        # above but cannot participate in row dropping
+
+    return counters, bad
+
+
+# --------------------------------------------------------------------------
+# the update-wrapping policy engine (used by Metric._maybe_guard)
+# --------------------------------------------------------------------------
+
+
+def resolve_guard_config(metric: Any, preds: Optional[Array], target: Optional[Array]) -> Dict[str, Any]:
+    """Read the metric's static guard knobs at call time (ctor attrs are
+    set *after* ``Metric.__init__`` wraps update, so resolution is lazy).
+    ``preds``/``target`` are the already-coerced numeric arrays (or None)."""
+    num_classes = getattr(metric, "num_classes", None)
+    if not isinstance(num_classes, int):
+        num_classes = None
+    if (
+        num_classes is None
+        and preds is not None
+        and target is not None
+        and preds.ndim >= 2
+        and preds.ndim == target.ndim + 1
+        and jnp.issubdtype(preds.dtype, jnp.floating)
+    ):
+        num_classes = preds.shape[1]  # implied (N, C, ...) class axis
+    ignore_index = getattr(metric, "ignore_index", None)
+    # probability-range checks are OPT-IN (`metric._guard_probs = True`):
+    # the eager pipeline thresholds raw float preds without a [0,1]
+    # constraint, so by default out-of-range scores/logits are legal input,
+    # not a fault. When opted in, the check applies exactly where
+    # thresholding does: float preds of the same rank as target
+    check_probs = (
+        bool(getattr(metric, "_guard_probs", False))
+        and getattr(metric, "threshold", None) is not None
+        and preds is not None
+        and target is not None
+        and preds.ndim == target.ndim
+    )
+    return {
+        "num_classes": num_classes,
+        "ignore_index": ignore_index,
+        "check_probs": bool(check_probs),
+        "nan_only": bool(getattr(metric, "_guard_nan_only", False)),
+    }
+
+
+def _as_checkable(a: Any) -> Optional[Array]:
+    """Coerce an update argument to a numeric array, or None if it is not
+    array-like (strings, dicts, None — the guard skips those)."""
+    if isinstance(a, (jax.Array, np.ndarray)):
+        arr = a
+    elif isinstance(a, (bool, str)) or a is None:
+        return None
+    elif isinstance(a, (int, float)):
+        arr = jnp.asarray(a)
+    elif isinstance(a, (list, tuple)):
+        try:
+            arr = jnp.asarray(a)
+        except (ValueError, TypeError):
+            return None
+    else:
+        return None
+    dtype = np.asarray(arr).dtype if isinstance(arr, np.ndarray) else arr.dtype
+    if not (jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(dtype, jnp.integer)):
+        return None
+    return jnp.asarray(arr)
+
+
+def _body_neutralizes(metric: Any) -> Tuple[bool, bool]:
+    """(masks, imputes): how a ``_guard_handles_drop`` metric's own update
+    body neutralizes invalid values — row masking under the 'warn'/'ignore'
+    nan strategies, value imputation under a float strategy. Either way the
+    accumulators stay finite with no arg rewriting by the guard."""
+    if not getattr(metric, "_guard_handles_drop", False):
+        return False, False
+    strategy = getattr(metric, "nan_strategy", None)
+    masks = strategy in ("warn", "ignore")
+    imputes = isinstance(strategy, (int, float)) and not isinstance(strategy, bool)
+    return masks, imputes
+
+
+def can_drop_traced(metric: Any) -> bool:
+    """True when ``on_invalid='drop'`` stays inside the compiled graph:
+    the update takes capacity-mode ``valid`` row masks, or the metric's own
+    body neutralizes invalid values (aggregator masking/imputation).
+    Anything else needs concrete boolean indexing and degrades to the eager
+    path."""
+    if any(_body_neutralizes(metric)):
+        return True
+    return (
+        "valid" in getattr(metric, "_update_signature").parameters
+        and getattr(metric, "capacity", None) is not None
+    )
+
+
+def _normalize_call(metric: Any, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
+    """Bind an update call to its signature → ``{param: value}`` in
+    declaration order, so keyword-style calls are guarded identically to
+    positional ones. Returns None when the call cannot be normalized
+    (binding fails — let the update raise its own error — or the signature
+    uses ``*args``, where positions are ambiguous)."""
+    import inspect
+
+    sig = metric._update_signature
+    if any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in sig.parameters.values()):
+        return None
+    try:
+        bound = sig.bind(*args, **kwargs)
+    except TypeError:
+        return None
+    norm: Dict[str, Any] = {}
+    for name, param in sig.parameters.items():
+        if name not in bound.arguments:
+            continue
+        if param.kind == inspect.Parameter.VAR_KEYWORD:
+            norm.update(bound.arguments[name])
+        else:
+            norm[name] = bound.arguments[name]
+    return norm
+
+
+def guard_update_args(metric: Any, args: tuple, kwargs: dict) -> Tuple[tuple, dict, FaultCounters]:
+    """Apply the metric's ``on_invalid`` policy to one update call.
+
+    Returns possibly-masked ``(args, kwargs)`` plus the counter increment.
+    Runs traced or eager; the only concretization is the eager boolean-index
+    drop fallback, which raises a tracer-conversion error under jit — the
+    module runtime catches exactly that family and re-runs eagerly.
+    """
+    policy = metric.on_invalid
+    norm = _normalize_call(metric, args, kwargs)
+    if norm is None:
+        # un-normalizable call: guard the first two positionals (legacy path)
+        names = [f"__arg{i}" for i in range(len(args))]
+        norm = dict(zip(names, args))
+        norm.update(kwargs)
+        param_names = names
+        rebuild_positional = True
+    else:
+        param_names = [n for n in norm if n != "valid"]
+        rebuild_positional = False
+
+    first_two = param_names[:2]
+    preds = _as_checkable(norm[first_two[0]]) if len(first_two) > 0 else None
+    target = _as_checkable(norm[first_two[1]]) if len(first_two) > 1 else None
+    cfg = resolve_guard_config(metric, preds, target)
+    counters, bad = batch_fault_masks(
+        preds,
+        target,
+        num_classes=cfg["num_classes"],
+        ignore_index=cfg["ignore_index"],
+        check_probs=cfg["check_probs"],
+        nan_only=cfg["nan_only"],
+    )
+
+    def rebuild(norm: Dict[str, Any]) -> Tuple[tuple, dict]:
+        if rebuild_positional:
+            n_pos = sum(1 for k in norm if k.startswith("__arg"))
+            return tuple(norm[f"__arg{i}"] for i in range(n_pos)), {
+                k: v for k, v in norm.items() if not k.startswith("__arg")
+            }
+        return (), dict(norm)
+
+    # aggregators neutralize invalid values inside their own update body:
+    # masking strategies drop the rows (recorded as dropped_rows),
+    # float-imputation replaces the values (nothing dropped) — in both
+    # cases the guard must not rewrite args (and must not fall through to
+    # the concrete-only eager drop, which would break under tracing)
+    body_masks, body_imputes = _body_neutralizes(metric)
+    if (body_masks or body_imputes) and bad is not None:
+        if body_masks:
+            counters += FaultCounters.single(dropped_rows=bad.sum())
+        a, k = rebuild(norm)
+        return a, k, counters
+
+    if policy != "drop" or bad is None:
+        a, k = rebuild(norm)
+        return a, k, counters
+
+    counters += FaultCounters.single(dropped_rows=bad.sum())
+    good = ~bad
+    if "valid" in metric._update_signature.parameters and getattr(metric, "capacity", None) is not None:
+        prior = norm.get("valid")
+        norm = dict(norm)
+        norm["valid"] = good if prior is None else (jnp.asarray(prior, bool) & good)
+        a, k = rebuild(norm)
+        return a, k, counters
+
+    # eager fallback: boolean-index every row-aligned array argument.
+    # np.asarray on a tracer raises TracerArrayConversionError, which the
+    # Metric runtime translates into its eager re-run — the same degradation
+    # path as every other concrete-only operation.
+    keep = np.asarray(good)
+    n = keep.shape[0]
+    masked = {}
+    for name, v in norm.items():
+        arr = _as_checkable(v)
+        if arr is not None and arr.ndim >= 1 and arr.shape[0] == n:
+            masked[name] = jnp.asarray(np.asarray(arr)[keep])
+        else:
+            masked[name] = v
+    a, k = rebuild(masked)
+    return a, k, counters
+
+
+def format_fault_report(counts: np.ndarray, owner: str) -> str:
+    """Human-readable summary of non-zero fault classes."""
+    parts = [
+        f"{name}={int(counts[i])}" for i, name in enumerate(FAULT_CLASSES) if int(counts[i]) > 0
+    ]
+    return (
+        f"{owner}: input/state faults detected inside the compiled update "
+        f"({', '.join(parts)}). Counts are cumulative since the last report and, after a "
+        "distributed sync, global across ranks. Use on_invalid='drop' to mask offending "
+        "rows in-graph, or 'ignore' to silence this channel."
+    )

@@ -1,0 +1,499 @@
+"""The in-graph fault channel (``metrics_tpu/utilities/guard.py``):
+traced validators, ``on_invalid`` degradation policies, the psum'd
+``FaultCounters`` state, and the fault-injection fuzz.
+
+Acceptance anchor (ISSUE 2): a batch with NaN preds under
+``on_invalid='drop'`` must leave a *jitted* metric's computed value finite
+and equal to the same stream with the bad rows removed, and the psum'd
+fault counter must report the dropped-row count across an 8-device
+``shard_map`` mesh.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu import FaultCounters
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+from metrics_tpu.utilities.guard import (
+    FAULT_CLASSES,
+    batch_fault_masks,
+    label_out_of_range_rows,
+    nonfinite_rows,
+    prob_out_of_range_rows,
+)
+from tests.helpers.fault_injection import (
+    corrupt_labels_out_of_range,
+    corrupt_probs_out_of_range,
+    corrupt_rows_nonfinite,
+    corrupt_state_leaf,
+    nan_stream_pair,
+    pick_rows,
+)
+
+NDEV = 8
+
+
+def _mesh(n=NDEV):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _counts(fc):
+    return np.asarray(fc.counts if isinstance(fc, FaultCounters) else fc).astype(np.int64)
+
+
+def _cls(name):
+    return FAULT_CLASSES.index(name)
+
+
+# --------------------------------------------------------------------------
+# traced validators
+# --------------------------------------------------------------------------
+
+
+pytestmark = pytest.mark.faults
+
+
+class TestValidators:
+    def test_nonfinite_rows_matrix_and_int(self):
+        x = jnp.asarray([[1.0, 2.0], [np.nan, 0.0], [np.inf, 1.0], [3.0, 4.0]])
+        np.testing.assert_array_equal(np.asarray(nonfinite_rows(x)), [False, True, True, False])
+        np.testing.assert_array_equal(
+            np.asarray(nonfinite_rows(x, nan_only=True)), [False, True, False, False]
+        )
+        # integer arrays are finite by construction
+        assert not np.asarray(nonfinite_rows(jnp.asarray([1, 2, 3]))).any()
+
+    def test_prob_range_rows_excludes_nonfinite(self):
+        p = jnp.asarray([0.5, 1.7, -0.1, np.nan, 1.0, 0.0])
+        np.testing.assert_array_equal(
+            np.asarray(prob_out_of_range_rows(p)), [False, True, True, False, False, False]
+        )
+
+    def test_label_range_rows_respects_ignore_index(self):
+        t = jnp.asarray([0, 2, 5, -1, -99])
+        np.testing.assert_array_equal(
+            np.asarray(label_out_of_range_rows(t, 3)), [False, False, True, True, True]
+        )
+        np.testing.assert_array_equal(
+            np.asarray(label_out_of_range_rows(t, 3, ignore_index=-1)),
+            [False, False, True, False, True],
+        )
+
+    def test_batch_fault_masks_jits(self):
+        @jax.jit
+        def run(p, t):
+            counters, bad = batch_fault_masks(p, t, num_classes=3, check_probs=True)
+            return counters.counts, bad
+
+        p = jnp.asarray([0.5, np.nan, 1.5, 0.2])
+        t = jnp.asarray([0, 1, 2, 9])
+        counts, bad = run(p, t)
+        counts = np.asarray(counts)
+        assert counts[_cls("nonfinite_preds")] == 1
+        assert counts[_cls("prob_out_of_range")] == 1
+        assert counts[_cls("label_out_of_range")] == 1
+        np.testing.assert_array_equal(np.asarray(bad), [False, True, True, True])
+
+
+# --------------------------------------------------------------------------
+# policies through the module API
+# --------------------------------------------------------------------------
+
+
+class TestPolicies:
+    def test_default_has_no_guard_state(self):
+        m = mt.Accuracy(num_classes=3)
+        assert "_faults" not in m._state and m.fault_counts is None
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="on_invalid"):
+            mt.Accuracy(num_classes=3, on_invalid="explode")
+
+    def test_warn_fires_at_compute_from_traced_counters(self):
+        m = mt.Accuracy(num_classes=3, on_invalid="warn")
+        m.update(jnp.asarray([[0.8, 0.1, 0.1]]), jnp.asarray([7]))
+        assert m.jittable_update  # counting stayed inside the jitted update
+        with pytest.warns(UserWarning, match="label_out_of_range=1"):
+            m.compute()
+        assert m.fault_counts["label_out_of_range"] == 1
+        # watermark: a second compute on the same counters does not re-warn
+        m._computed = None
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            m.compute()
+
+    def test_error_raises_at_compute(self):
+        m = mt.MeanMetric(nan_strategy="warn", on_invalid="error")
+        m.update(jnp.asarray([1.0, np.nan]))
+        with pytest.raises(MetricsTPUUserError, match="nonfinite_preds=1"):
+            m.compute()
+
+    def test_drop_on_capacity_metric_stays_jitted(self):
+        rng = np.random.default_rng(0)
+        bad_p, t, clean_p, clean_t = nan_stream_pair(rng, 64, 0.125)
+        m = mt.AUROC(capacity=128, on_invalid="drop")
+        m.update(jnp.asarray(bad_p), jnp.asarray(t))
+        assert m.jittable_update
+        ref = mt.AUROC(capacity=128)
+        ref.update(jnp.asarray(clean_p), jnp.asarray(clean_t))
+        got = float(m.compute())
+        assert np.isfinite(got)
+        np.testing.assert_allclose(got, float(ref.compute()), atol=1e-7)
+        assert m.fault_counts["dropped_rows"] == 64 - clean_p.shape[0]
+
+    def test_drop_eager_fallback_without_row_machinery(self):
+        """Metrics without `valid`/aggregator masking degrade to the eager
+        boolean-indexing path (jit falls back, value stays correct)."""
+        p = np.asarray([[0.8, 0.1, 0.1], [np.nan] * 3, [0.1, 0.1, 0.8]], np.float32)
+        m = mt.Accuracy(num_classes=3, on_invalid="drop")
+        m.update(jnp.asarray(p), jnp.asarray([0, 1, 2]))
+        assert not m.jittable_update  # degraded, documented
+        np.testing.assert_allclose(float(m.compute()), 1.0)
+        assert m.fault_counts["dropped_rows"] == 1
+
+    def test_nonfinite_state_leaf_detected_at_compute(self):
+        class Raw(mt.Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("v", jnp.asarray(0.0), "sum")
+
+            def update(self, x):
+                self.v = self.v + jnp.sum(x)
+
+            def compute(self):
+                return self.v
+
+        m = Raw(on_invalid="warn")
+        m.update(jnp.asarray([jnp.inf, -jnp.inf]))  # inf - inf -> NaN state
+        with pytest.warns(UserWarning, match="nonfinite_state=1"):
+            m.compute()
+
+    def test_scalar_weight_update_guarded(self):
+        """A scalar second argument (MeanMetric's default weight) must not
+        trip the implied-num_classes inference."""
+        m = mt.MeanMetric()  # nan_strategy='warn' -> guard active by default
+        m.update(jnp.asarray([1.0, 2.0]), 0.5)
+        np.testing.assert_allclose(float(m.compute()), 1.5)
+
+    def test_kwarg_style_update_is_guarded(self):
+        a = mt.MeanSquaredError(on_invalid="warn")
+        a.update(preds=jnp.asarray([1.0, np.nan]), target=jnp.asarray([1.0, 2.0]))
+        with pytest.warns(UserWarning, match="nonfinite_preds=1"):
+            a.compute()
+        assert a.fault_counts["nonfinite_preds"] == 1
+
+    def test_error_policy_re_raises_and_reset_clears(self):
+        m = mt.MeanMetric(nan_strategy="warn", on_invalid="error")
+        m.update(jnp.asarray([1.0, np.nan]))
+        for _ in range(2):  # no warn-once watermark for errors
+            with pytest.raises(MetricsTPUUserError):
+                m.compute()
+            m._computed = None
+        m.reset()
+        m.update(jnp.asarray([np.nan]))  # fresh fault after reset must still raise
+        with pytest.raises(MetricsTPUUserError):
+            m.compute()
+        m.reset()
+        m.update(jnp.asarray([3.0]))
+        np.testing.assert_allclose(float(m.compute()), 3.0)
+
+    def test_warn_watermark_resets_with_state(self):
+        m = mt.SumMetric(nan_strategy="warn")
+        m.update(jnp.asarray([1.0, np.nan]))
+        with pytest.warns(UserWarning, match="nonfinite_preds=1"):
+            m.compute()
+        m.reset()
+        m.update(jnp.asarray([np.nan, 2.0]))
+        with pytest.warns(UserWarning, match="nonfinite_preds=1"):
+            m.compute()
+
+    def test_float_imputation_aggregator_drops_traced(self):
+        """on_invalid='drop' + a float nan_strategy: imputation neutralizes
+        the values in-graph (nothing dropped), so the guarded update must
+        stay traceable instead of falling to the concrete-only drop path."""
+        mdef = mt.functionalize(mt.MeanMetric(nan_strategy=1.0, on_invalid="drop"))
+        st = jax.jit(mdef.update)(mdef.init(), jnp.asarray([1.0, np.nan, 3.0]))
+        np.testing.assert_allclose(float(mdef.compute(st)), (1.0 + 1.0 + 3.0) / 3)
+        counts = _counts(mdef.faults(st))
+        assert counts[_cls("nonfinite_preds")] == 1
+        assert counts[_cls("dropped_rows")] == 0  # imputed, not dropped
+
+    def test_legacy_eager_warn_covers_nan_weights(self):
+        """The opt-out eager 'warn' path warns on exactly what it masks:
+        value-or-weight NaN rows."""
+        m = mt.MeanMetric(nan_strategy="warn", on_invalid="ignore")
+        with pytest.warns(UserWarning, match="Encountered `nan`"):
+            m.update(jnp.ones(3), jnp.asarray([1.0, np.nan, 1.0]))
+        np.testing.assert_allclose(float(m.compute()), 1.0)
+
+    def test_nan_weight_raises_under_error_strategy(self):
+        """'error' treats a NaN weight like a NaN value — the strictest
+        strategy must not be the only one that lets NaN through silently."""
+        m = mt.MeanMetric(nan_strategy="error")
+        with pytest.raises(RuntimeError, match="Encountered `nan`"):
+            m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, np.nan]))
+
+    def test_forward_warns_per_batch_not_once_per_epoch(self):
+        """The warn watermark is batch-scoped inside forward: a large first
+        batch must not suppress warnings for smaller later batches."""
+        m = mt.SumMetric(nan_strategy="warn")
+        with pytest.warns(UserWarning, match="nonfinite_preds=5"):
+            m(jnp.asarray([np.nan] * 5))
+        with pytest.warns(UserWarning, match="nonfinite_preds=3"):
+            m(jnp.asarray([np.nan] * 3 + [1.0]))
+
+    def test_nan_weight_masked_not_just_reported(self):
+        """A NaN *weight* must be masked like a NaN value — otherwise the
+        weighted sums are poisoned while dropped_rows claims the row was
+        handled."""
+        m = mt.MeanMetric(nan_strategy="warn")
+        m.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.0, np.nan, 1.0]))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = float(m.compute())
+        assert np.isfinite(out)
+        np.testing.assert_allclose(out, 2.0)  # (1 + 3) / 2
+        assert m.fault_counts["nonfinite_target"] == 1
+
+    def test_error_raise_in_forward_preserves_accumulation(self):
+        """on_invalid='error' firing from forward()'s internal compute must
+        not destroy the epoch's accumulated state or corrupt sync flags."""
+        m = mt.SumMetric(nan_strategy="warn", on_invalid="error")
+        m(jnp.asarray([1.0, 2.0]))
+        with pytest.raises(MetricsTPUUserError):
+            m(jnp.asarray([np.nan, 4.0]))
+        # the stream (incl. the masked bad batch) survived the raise
+        np.testing.assert_allclose(float(np.asarray(m._state["value"])), 7.0)
+        assert m._should_unsync and m._to_sync and not m._is_synced
+        m.reset()
+        m.update(jnp.asarray([5.0]))
+        np.testing.assert_allclose(float(m.compute()), 5.0)
+
+    def test_forward_merge_carries_counters(self):
+        m = mt.SumMetric(nan_strategy="warn")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m(jnp.asarray([1.0, np.nan]))
+            m(jnp.asarray([np.nan, 2.0]))
+            m.compute()
+        assert m.fault_counts["nonfinite_preds"] == 2
+        assert m.fault_counts["dropped_rows"] == 2
+
+    def test_prob_out_of_range_is_opt_in(self):
+        """Raw scores/logits are legal input to the thresholded pipeline, so
+        the [0,1] range check only fires when the metric opts in."""
+        m = mt.Accuracy(on_invalid="warn")  # binary, threshold=0.5
+        m._guard_probs = True
+        m.update(jnp.asarray([0.9, 1.7, 0.2]), jnp.asarray([1, 1, 0]))
+        with pytest.warns(UserWarning, match="prob_out_of_range=1"):
+            m.compute()
+        # default: logit-style inputs are NOT counted as faults
+        m2 = mt.Accuracy(on_invalid="warn")
+        m2.update(jnp.asarray([-2.0, 3.0, 1.5]), jnp.asarray([0, 1, 1]))
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            m2.compute()
+        assert m2.fault_counts["prob_out_of_range"] == 0
+
+
+# --------------------------------------------------------------------------
+# the functional / compiled path
+# --------------------------------------------------------------------------
+
+
+class TestFunctional:
+    def test_metricdef_faults_zero_for_unguarded(self):
+        mdef = mt.functionalize(mt.Accuracy(num_classes=3))
+        counts = np.asarray(mdef.faults(mdef.init()))
+        assert counts.shape == (len(FAULT_CLASSES),) and not counts.any()
+
+    def test_drop_without_row_machinery_rejected_at_functionalize(self):
+        with pytest.raises(ValueError, match="on_invalid='drop'"):
+            mt.functionalize(mt.Accuracy(num_classes=3, on_invalid="drop"))
+
+    def test_acceptance_drop_nan_preds_jitted_and_sharded(self):
+        """THE acceptance criterion: NaN preds + on_invalid='drop' leave the
+        jitted metric finite and equal to the clean stream, and the psum'd
+        counter reports the dropped rows across an 8-device mesh."""
+        rng = np.random.default_rng(7)
+        n = 128
+        bad_p, t, clean_p, clean_t = nan_stream_pair(rng, n, 0.1)
+        n_bad = n - clean_p.shape[0]
+
+        # single-chip jit
+        mdef = mt.functionalize(mt.AUROC(capacity=n, on_invalid="drop"))
+        state = jax.jit(mdef.update)(mdef.init(), jnp.asarray(bad_p), jnp.asarray(t))
+        got = float(jax.jit(mdef.compute)(state))
+        ref = mt.AUROC(capacity=n)
+        ref.update(jnp.asarray(clean_p), jnp.asarray(clean_t))
+        assert np.isfinite(got)
+        np.testing.assert_allclose(got, float(ref.compute()), atol=1e-7)
+        counts = _counts(jax.jit(mdef.faults)(state))
+        assert counts[_cls("dropped_rows")] == n_bad
+        assert counts[_cls("nonfinite_preds")] == n_bad
+
+        # 8-device shard_map mesh: value parity AND globally psum'd counters
+        sdef = mt.functionalize(
+            mt.AUROC(capacity=n // NDEV, on_invalid="drop"), axis_name="data"
+        )
+
+        def step(pp, tt):
+            st = sdef.update(sdef.init(), pp, tt)
+            return sdef.compute(st), sdef.faults(st)
+
+        val, counts = jax.jit(
+            jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"), P("data")), out_specs=(P(), P()))
+        )(jnp.asarray(bad_p), jnp.asarray(t))
+        assert np.isfinite(float(val))
+        np.testing.assert_allclose(float(val), float(ref.compute()), atol=1e-7)
+        counts = _counts(counts)
+        assert counts[_cls("dropped_rows")] == n_bad, "psum'd dropped-row count must be global"
+
+    def test_sharded_label_faults_counted_globally(self):
+        ndev, per = NDEV, 8
+        rng = np.random.default_rng(11)
+        p = rng.random((ndev * per, 4)).astype(np.float32)
+        t = rng.integers(0, 4, ndev * per).astype(np.int32)
+        rows = pick_rows(rng, ndev * per, 0.25)
+        t_bad = corrupt_labels_out_of_range(t, rows, 4)
+
+        sdef = mt.functionalize(mt.Accuracy(num_classes=4, on_invalid="warn"), axis_name="data")
+
+        def step(pp, tt):
+            st = sdef.update(sdef.init(), pp, tt)
+            return sdef.compute(st), sdef.faults(st)
+
+        _, counts = jax.jit(
+            jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"), P("data")), out_specs=(P(), P()))
+        )(jnp.asarray(p), jnp.asarray(t_bad))
+        assert _counts(counts)[_cls("label_out_of_range")] == rows.shape[0]
+
+    def test_aggregator_warn_functionalizes_and_matches_clean_stream(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal(40).astype(np.float32)
+        rows = pick_rows(rng, 40, 0.2)
+        x_bad = corrupt_rows_nonfinite(x, rows)
+        keep = np.ones(40, bool)
+        keep[rows] = False
+
+        mdef = mt.functionalize(mt.MeanMetric(nan_strategy="warn"))
+        st = jax.jit(mdef.update)(mdef.init(), jnp.asarray(x_bad))
+        np.testing.assert_allclose(float(mdef.compute(st)), x[keep].mean(), rtol=1e-5)
+        counts = _counts(mdef.faults(st))
+        assert counts[_cls("nonfinite_preds")] == rows.shape[0]
+        assert counts[_cls("dropped_rows")] == rows.shape[0]
+
+    def test_collection_fused_sync_carries_fault_leaves(self):
+        """Guarded collection members sync their counters through fused_sync:
+        the whole HLO holds exactly two all-reduces (int32 states bucket +
+        uint32 fault bucket) — no per-metric fault collective."""
+        coll = mt.MetricCollection(
+            {
+                "acc": mt.Accuracy(num_classes=4, on_invalid="warn"),
+                "f1": mt.F1Score(num_classes=4, average="macro", on_invalid="warn"),
+            }
+        )
+        cdef = mt.functionalize(coll, axis_name="data")
+        rng = np.random.default_rng(2)
+        p = rng.random((NDEV * 4, 4)).astype(np.float32)
+        t = corrupt_labels_out_of_range(
+            rng.integers(0, 4, NDEV * 4).astype(np.int32), np.asarray([0, 5]), 4
+        )
+
+        def step(pp, tt):
+            st = cdef.update(cdef.init(), pp, tt)
+            return cdef.compute(st), cdef.faults(st)
+
+        fn = jax.jit(
+            jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"), P("data")), out_specs=(P(), P()))
+        )
+        res, counts = fn(jnp.asarray(p), jnp.asarray(t))
+        # both guarded members counted the same 2 bad label rows
+        assert _counts(counts)[_cls("label_out_of_range")] == 4
+        hlo = fn.lower(jnp.asarray(p), jnp.asarray(t)).compile().as_text()
+        n_all_reduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+        assert n_all_reduce <= 2, f"fault channel must ride fused_sync, got {n_all_reduce} all-reduces"
+
+    def test_merge_sums_counters(self):
+        mdef = mt.functionalize(mt.SumMetric(nan_strategy="warn"))
+        a = mdef.update(mdef.init(), jnp.asarray([1.0, np.nan]))
+        b = mdef.update(mdef.init(), jnp.asarray([np.nan, np.nan, 4.0]))
+        merged = mdef.merge(a, b)
+        assert _counts(mdef.faults(merged))[_cls("nonfinite_preds")] == 3
+        np.testing.assert_allclose(float(mdef.compute(merged)), 5.0)
+
+
+# --------------------------------------------------------------------------
+# state-leaf corruption + serialization of non-zero counters
+# --------------------------------------------------------------------------
+
+
+class TestStateFaults:
+    def test_corrupted_state_leaf_reported(self):
+        mdef = mt.functionalize(mt.MeanMetric(nan_strategy="ignore", on_invalid="warn"))
+        st = mdef.update(mdef.init(), jnp.asarray([1.0, 2.0]))
+        poisoned = corrupt_state_leaf(st, "value")
+        m = mt.MeanMetric(nan_strategy="ignore", on_invalid="warn")
+        object.__setattr__(m, "_state", dict(poisoned))
+        m._update_called = True
+        with pytest.warns(UserWarning, match="nonfinite_state=1"):
+            m.compute()
+
+
+# --------------------------------------------------------------------------
+# fault-injection fuzz: small seeds in tier-1, the sweep in the slow lane
+# --------------------------------------------------------------------------
+
+
+def _fuzz_one(seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(16, 96))
+    kind = ("nan", "inf", "-inf")[seed % 3]
+    bad_p, t, clean_p, clean_t = nan_stream_pair(rng, n, float(rng.uniform(0.05, 0.3)), kind)
+    n_bad = n - clean_p.shape[0]
+
+    mdef = mt.functionalize(mt.AUROC(capacity=n, on_invalid="drop"))
+    st = jax.jit(mdef.update)(mdef.init(), jnp.asarray(bad_p), jnp.asarray(t))
+    got = float(jax.jit(mdef.compute)(st))
+    ref = mt.AUROC(capacity=n)
+    ref.update(jnp.asarray(clean_p), jnp.asarray(clean_t))
+    assert np.isfinite(got), f"seed {seed}: drop left a non-finite value"
+    np.testing.assert_allclose(got, float(ref.compute()), atol=1e-6)
+    counts = _counts(mdef.faults(st))
+    assert counts[_cls("dropped_rows")] == n_bad
+
+    # aggregator stream under the same corruption
+    adef = mt.functionalize(mt.SumMetric(nan_strategy="warn"))
+    ast = jax.jit(adef.update)(adef.init(), jnp.asarray(corrupt_rows_nonfinite(clean_p, np.asarray([0]))))
+    assert np.isfinite(float(adef.compute(ast)))
+
+    # out-of-range probabilities on the thresholded binary path (opt-in)
+    rows = pick_rows(rng, n, 0.1)
+    p_oob = corrupt_probs_out_of_range(rng.random(n).astype(np.float32), rows)
+    m = mt.Accuracy(on_invalid="warn")
+    m._guard_probs = True
+    m.update(jnp.asarray(p_oob), jnp.asarray((rng.random(n) < 0.5).astype(np.int32)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m.compute()
+    assert m.fault_counts["prob_out_of_range"] == rows.shape[0]
+
+
+@pytest.mark.parametrize("seed", [3, 17])
+def test_fault_injection_fuzz_fast(seed):
+    """Tier-1 lane: two seeds through the corruptor suite."""
+    _fuzz_one(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(20, 30)))
+def test_fault_injection_fuzz_sweep(seed):
+    """Heavy repeat-seed sweep (slow lane)."""
+    _fuzz_one(seed)

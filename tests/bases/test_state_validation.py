@@ -1,0 +1,191 @@
+"""Checkpoint robustness: ``load_state_dict`` validation against the
+registered defaults (a corrupt checkpoint raises a ``ValueError`` naming
+the state key, instead of silently loading garbage), and
+``state_dict``/``load_state_dict`` round-trips of metrics holding non-zero
+``FaultCounters`` and ``CatBuffer`` states — through plain dicts, pickle,
+and orbax.
+"""
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utilities.guard import FaultCounters
+from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+
+def _guarded_mean_with_faults():
+    """A MeanMetric whose fault counters are non-zero (2 NaNs seen/masked)."""
+    m = mt.MeanMetric(nan_strategy="warn")
+    m.persistent(True)
+    m.update(jnp.asarray([1.0, np.nan, 3.0, np.nan]))
+    return m
+
+
+class TestLoadStateDictValidation:
+    def test_shape_mismatch_names_key(self):
+        m = mt.ConfusionMatrix(num_classes=3)
+        m.persistent(True)
+        with pytest.raises(ValueError, match="'confmat'.*shape \\(2, 2\\), expected \\(3, 3\\)"):
+            m.load_state_dict({"confmat": np.zeros((2, 2))})
+
+    def test_dtype_kind_mismatch_names_key(self):
+        m = mt.SumMetric(nan_strategy="ignore")
+        m.persistent(True)
+        with pytest.raises(ValueError, match="'value'.*dtype"):
+            m.load_state_dict({"value": np.asarray(1.5).astype(np.complex64)})
+
+    def test_non_array_rejected(self):
+        m = mt.SumMetric(nan_strategy="ignore")
+        with pytest.raises(ValueError, match="'value'"):
+            m.load_state_dict({"value": object()})
+
+    def test_catbuffer_slot_structure_validated(self):
+        m = mt.AUROC(capacity=8)
+        # wrong container type
+        with pytest.raises(ValueError, match="'preds'.*CatBuffer"):
+            m.load_state_dict({"preds": np.zeros((8,))})
+        # wrong slot capacity
+        with pytest.raises(ValueError, match="'preds'.*slot 'data'"):
+            m.load_state_dict(
+                {"preds": {"data": np.zeros((4,), np.float32), "mask": np.zeros((8,), bool), "dropped": 0}}
+            )
+        # float data loaded into the int32 target ring
+        with pytest.raises(ValueError, match="'target'.*slot 'data'.*dtype"):
+            m.load_state_dict(
+                {"target": {"data": np.zeros((8,), np.float32), "mask": np.zeros((8,), bool), "dropped": 0}}
+            )
+
+    def test_list_state_requires_list(self):
+        m = mt.CatMetric(nan_strategy="ignore")
+        with pytest.raises(ValueError, match="'value'.*list"):
+            m.load_state_dict({"value": np.zeros((3,))})
+
+    def test_valid_load_still_works(self):
+        m = mt.ConfusionMatrix(num_classes=3)
+        m.persistent(True)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        sd = m.state_dict()
+        m2 = mt.ConfusionMatrix(num_classes=3)
+        m2.load_state_dict(sd)
+        np.testing.assert_array_equal(np.asarray(m2._state["confmat"]), np.asarray(m._state["confmat"]))
+        # int64-saved counts load into the int32 default (same-kind cast)
+        m3 = mt.ConfusionMatrix(num_classes=3)
+        m3.load_state_dict({"confmat": np.asarray(sd["confmat"], np.int64)})
+        assert m3._state["confmat"].dtype == m._defaults["confmat"].dtype
+
+
+class TestFaultCountersRoundTrip:
+    def test_state_dict_roundtrip_nonzero_counters(self):
+        m = _guarded_mean_with_faults()
+        assert m.fault_counts["nonfinite_preds"] == 2
+        sd = m.state_dict()
+        assert isinstance(sd["_faults"], np.ndarray) and sd["_faults"].sum() > 0
+
+        m2 = mt.MeanMetric(nan_strategy="warn")
+        m2.persistent(True)
+        m2.load_state_dict(sd)
+        assert isinstance(m2._state["_faults"], FaultCounters)
+        assert m2.fault_counts == m.fault_counts
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            np.testing.assert_allclose(float(m2.compute()), 2.0)
+
+    def test_fault_counters_append_only_compat(self):
+        """FAULT_CLASSES is appends-only: shorter (older-release) vectors
+        zero-pad the new classes, longer (newer-release) ones truncate —
+        checkpoints keep loading in both directions. Non-numeric junk is
+        still rejected."""
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES
+
+        m = mt.MeanMetric(nan_strategy="warn")
+        m.load_state_dict({"_faults": np.asarray([3, 1], np.uint32)})
+        counts = np.asarray(m._state["_faults"].counts)
+        assert counts.shape == (NUM_FAULT_CLASSES,)
+        assert counts[0] == 3 and counts[1] == 1 and not counts[2:].any()
+        m.load_state_dict({"_faults": np.arange(NUM_FAULT_CLASSES + 2, dtype=np.uint32)})
+        assert np.asarray(m._state["_faults"].counts).shape == (NUM_FAULT_CLASSES,)
+        with pytest.raises(ValueError, match="'_faults'"):
+            m.load_state_dict({"_faults": np.asarray(["junk"], object)})
+
+    def test_pickle_roundtrip_nonzero_counters(self):
+        m = _guarded_mean_with_faults()
+        m2 = pickle.loads(pickle.dumps(m))
+        assert isinstance(m2._state["_faults"], FaultCounters)
+        assert m2.fault_counts == m.fault_counts
+        # the restored metric keeps counting through its (re-bound) guard
+        m2.update(jnp.asarray([np.nan]))
+        assert m2.fault_counts["nonfinite_preds"] == 3
+
+    def test_pre_fault_channel_pickle_loads(self):
+        """Pickles written before the fault channel lack its knobs; they
+        must keep loading (defaulting to the unguarded policy)."""
+        m = mt.SumMetric(nan_strategy="ignore")
+        m.update(jnp.asarray([2.0]))
+        state = m.__getstate__()
+        for k in ("on_invalid", "debug_checks", "_faults_reported"):
+            state.pop(k, None)
+        m2 = mt.SumMetric.__new__(mt.SumMetric)
+        m2.__setstate__(state)
+        assert m2.on_invalid == "ignore"
+        np.testing.assert_allclose(float(m2.compute()), 2.0)
+
+    def test_orbax_roundtrip_nonzero_counters(self, tmp_path):
+        ocp = pytest.importorskip("orbax.checkpoint")
+        m = _guarded_mean_with_faults()
+        sd = m.state_dict()
+        ckpt = ocp.StandardCheckpointer()
+        path = tmp_path / "guarded_state"
+        ckpt.save(path, sd)
+        ckpt.wait_until_finished()
+        restored = ckpt.restore(path, sd)
+        m2 = mt.MeanMetric(nan_strategy="warn")
+        m2.persistent(True)
+        m2.load_state_dict(dict(restored))
+        assert m2.fault_counts == m.fault_counts
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            np.testing.assert_allclose(float(m2.compute()), 2.0)
+
+    def test_orbax_functional_state_with_counters(self, tmp_path):
+        """The functional path: a guarded metric's explicit state pytree
+        (including its FaultCounters leaf) orbax-round-trips losslessly."""
+        ocp = pytest.importorskip("orbax.checkpoint")
+        import jax
+
+        mdef = mt.functionalize(mt.AUROC(capacity=16, on_invalid="drop"))
+        st = jax.jit(mdef.update)(
+            mdef.init(), jnp.asarray([0.1, np.nan, 0.8, 0.4]), jnp.asarray([0, 1, 1, 0])
+        )
+        ckpt = ocp.StandardCheckpointer()
+        path = tmp_path / "functional_state"
+        ckpt.save(path, st)
+        ckpt.wait_until_finished()
+        restored = ckpt.restore(path, st)
+        for a, b in zip(jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(
+            np.asarray(mdef.faults(restored)), np.asarray(mdef.faults(st))
+        )
+        assert np.asarray(mdef.faults(restored)).sum() > 0
+
+
+class TestCatBufferRoundTrip:
+    def test_state_dict_roundtrip_ring_state(self):
+        m = mt.AUROC(capacity=8)
+        m.persistent(True)
+        m.update(jnp.asarray([0.2, 0.9, 0.4]), jnp.asarray([0, 1, 1]))
+        sd = m.state_dict()
+        assert set(sd["preds"]) == {"data", "mask", "dropped"}
+
+        m2 = mt.AUROC(capacity=8)
+        m2.persistent(True)
+        m2.load_state_dict(sd)
+        assert isinstance(m2._state["preds"], CatBuffer)
+        np.testing.assert_allclose(float(m2.compute()), float(m.compute()))
+        # accumulation continues after restore
+        m2.update(jnp.asarray([0.6]), jnp.asarray([0]))
+        assert int(np.asarray(m2._state["preds"].count())) == 4

@@ -23,3 +23,10 @@ def pytest_configure(config):
         "runs, multi-seed fuzz repeats) excluded from the tier-1 fast lane "
         "(ROADMAP.md runs pytest -m 'not slow' under a hard timeout)",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection coverage of the in-graph fault channel "
+        "(utilities/guard.py) and degraded transports — small seeds run in the "
+        "tier-1 fast lane (select with -m faults); the heavy repeat-seed sweep "
+        "is additionally marked slow",
+    )

@@ -1,0 +1,139 @@
+"""Fault-injection harness for the in-graph fault channel
+(``metrics_tpu/utilities/guard.py``) and the retrying multihost transport
+(``metrics_tpu/parallel/sync.py``).
+
+Corruptors produce the fault classes the channel tracks — non-finite
+preds/target rows, out-of-range probabilities and labels, corrupted state
+leaves — with deterministic row selection so tests can assert exact
+counter values. Transport fakes simulate the pod-level failure modes
+(flaky, hanging, dead peers) without a real multi-host runtime.
+"""
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# batch corruptors
+# --------------------------------------------------------------------------
+
+
+def pick_rows(rng: np.random.Generator, n: int, frac: float) -> np.ndarray:
+    """Deterministically choose ``ceil(frac*n)`` distinct row indices."""
+    k = max(1, int(np.ceil(frac * n)))
+    return rng.choice(n, size=min(k, n), replace=False)
+
+
+def corrupt_rows_nonfinite(
+    arr: np.ndarray, rows: np.ndarray, kind: str = "nan"
+) -> np.ndarray:
+    """Overwrite the given rows of a float array with NaN/±inf."""
+    bad = {"nan": np.nan, "inf": np.inf, "-inf": -np.inf}[kind]
+    out = np.array(arr, copy=True)
+    out[rows, ...] = bad
+    return out
+
+
+def corrupt_labels_out_of_range(
+    target: np.ndarray, rows: np.ndarray, num_classes: int, negative: bool = False
+) -> np.ndarray:
+    """Overwrite the given rows of an int label array with labels outside
+    ``[0, num_classes)``."""
+    out = np.array(target, copy=True)
+    out[rows, ...] = -3 if negative else num_classes + 2
+    return out
+
+
+def corrupt_probs_out_of_range(arr: np.ndarray, rows: np.ndarray, high: bool = True) -> np.ndarray:
+    """Overwrite the given rows of a probability array with finite values
+    outside ``[0, 1]``."""
+    out = np.array(arr, copy=True)
+    out[rows, ...] = 1.7 if high else -0.4
+    return out
+
+
+def corrupt_state_leaf(state: Dict[str, Any], key: str, value: float = np.nan) -> Dict[str, Any]:
+    """Return a copy of a metric state dict with one float leaf poisoned."""
+    import jax.numpy as jnp
+
+    out = dict(state)
+    leaf = jnp.asarray(out[key])
+    out[key] = leaf.at[(0,) * leaf.ndim].set(value) if leaf.ndim else jnp.asarray(value, leaf.dtype)
+    return out
+
+
+def nan_stream_pair(
+    rng: np.random.Generator, n: int, frac: float, kind: str = "nan"
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """A (preds, target) binary-score stream plus its clean (rows-removed)
+    counterpart: ``(corrupt_preds, target, clean_preds, clean_target)``."""
+    preds = rng.random(n).astype(np.float32)
+    target = (rng.random(n) < 0.5).astype(np.int32)
+    rows = pick_rows(rng, n, frac)
+    corrupt = corrupt_rows_nonfinite(preds, rows, kind)
+    keep = np.ones(n, bool)
+    keep[rows] = False
+    return corrupt, target, preds[keep], target[keep]
+
+
+# --------------------------------------------------------------------------
+# transport fakes (process-level gather, regime 3)
+# --------------------------------------------------------------------------
+
+
+class CountingGather:
+    """Well-behaved world-size-``nproc`` transport: stacks ``nproc`` copies
+    of the local contribution and counts calls."""
+
+    def __init__(self, nproc: int = 2):
+        self.nproc = nproc
+        self.calls = 0
+
+    def __call__(self, array):
+        self.calls += 1
+        local = np.asarray(array)
+        return np.stack([local] * self.nproc)
+
+
+class FlakyGather(CountingGather):
+    """Raises on the first ``fail_times`` calls, then behaves — the
+    transient-DCN-blip case the retry loop must absorb."""
+
+    def __init__(self, fail_times: int, nproc: int = 2):
+        super().__init__(nproc)
+        self.fail_times = fail_times
+
+    def __call__(self, array):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise ConnectionError(f"injected transport failure #{self.calls}")
+        local = np.asarray(array)
+        return np.stack([local] * self.nproc)
+
+
+class FailingGather(CountingGather):
+    """Always raises — the dead-pod case that must degrade, not hang."""
+
+    def __call__(self, array):
+        self.calls += 1
+        raise ConnectionError("injected permanent transport failure")
+
+
+class HangingGather(CountingGather):
+    """Blocks far past any reasonable timeout — the wedged-peer case.
+
+    ``hang_s`` bounds the sleep so an abandoned worker thread cannot
+    outlive the test session.
+    """
+
+    def __init__(self, hang_s: float = 30.0, nproc: int = 2):
+        super().__init__(nproc)
+        self.hang_s = hang_s
+
+    def __call__(self, array):
+        import time
+
+        self.calls += 1
+        time.sleep(self.hang_s)
+        local = np.asarray(array)
+        return np.stack([local] * self.nproc)

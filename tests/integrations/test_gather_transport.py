@@ -1,0 +1,257 @@
+"""The retrying multihost transport (``parallel/sync.py::RetryingGather``):
+timeout + exponential backoff around the process-level allgather, with the
+degraded local-only fallback — plus the empty-list dtype-preservation fix
+in ``sync_state``/``fused_sync``.
+
+Acceptance anchor (ISSUE 2): a multihost gather with an injected hanging
+transport must return (degraded or retried) instead of blocking past its
+timeout.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.parallel.sync import (
+    GatherTimeoutError,
+    RetryingGather,
+    _pad_gather_trim,
+    fused_sync,
+    gather_all_arrays,
+    set_gather_transport,
+    sync_state,
+)
+from tests.helpers.fault_injection import (
+    CountingGather,
+    FailingGather,
+    FlakyGather,
+    HangingGather,
+)
+
+pytestmark = pytest.mark.faults
+
+NDEV = 8
+
+
+class TestRetryingGather:
+    def test_healthy_transport_passes_through(self):
+        inner = CountingGather(nproc=3)
+        g = RetryingGather(inner, timeout_s=5.0)
+        out = g(np.arange(4))
+        assert out.shape == (3, 4) and inner.calls == 1
+
+    def test_flaky_transport_retried_with_backoff(self):
+        inner = FlakyGather(fail_times=2, nproc=2)
+        g = RetryingGather(inner, timeout_s=5.0, max_retries=2, backoff_s=0.01)
+        out = g(np.arange(3))
+        assert out.shape == (2, 3)
+        assert inner.calls == 3  # 2 failures + 1 success
+
+    def test_hanging_transport_returns_within_timeout(self):
+        """THE acceptance criterion: a wedged peer costs bounded time, the
+        call degrades to a local-only result instead of hanging."""
+        inner = HangingGather(hang_s=5.0)
+        g = RetryingGather(inner, timeout_s=0.2, max_retries=1, backoff_s=0.01)
+        t0 = time.perf_counter()
+        with pytest.warns(UserWarning, match="LOCAL-ONLY"):
+            out = g(np.arange(5))
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 3.0, f"hanging gather blocked {elapsed:.1f}s past its timeout"
+        np.testing.assert_array_equal(out, np.arange(5)[None])  # world-size-1 shape
+
+    def test_dead_transport_degrades_loudly(self):
+        inner = FailingGather()
+        g = RetryingGather(inner, timeout_s=1.0, max_retries=2, backoff_s=0.01)
+        with pytest.warns(UserWarning, match="degrading to LOCAL-ONLY"):
+            out = g(np.ones((2, 3)))
+        assert out.shape == (1, 2, 3)
+        assert inner.calls == 3
+
+    def test_circuit_breaker_skips_budget_after_failure(self):
+        """After one fully-failed call the breaker opens: subsequent calls
+        degrade immediately instead of re-paying timeout+retries per state
+        leaf; a success after the cooldown closes it."""
+        inner = FailingGather()
+        g = RetryingGather(inner, timeout_s=1.0, max_retries=2, backoff_s=0.01, cooldown_s=30.0)
+        with pytest.warns(UserWarning):
+            g(np.ones(2))
+        assert inner.calls == 3
+        t0 = time.perf_counter()
+        out = g(np.ones(2))  # circuit open: no transport attempt at all
+        assert time.perf_counter() - t0 < 0.05
+        assert inner.calls == 3 and out.shape == (1, 2)
+        # cooldown elapsed + transport healthy again -> breaker closes
+        g._open_until = 0.0
+        g.allgather = CountingGather(nproc=2)
+        assert g(np.ones(2)).shape == (2, 2)
+        assert g(np.ones(2)).shape == (2, 2)
+
+    def test_no_fallback_raises_after_retries(self):
+        g = RetryingGather(FailingGather(), timeout_s=1.0, max_retries=1, backoff_s=0.01, fallback_local=False)
+        with pytest.raises(ConnectionError):
+            g(np.ones(2))
+
+    def test_timeout_error_type(self):
+        g = RetryingGather(HangingGather(hang_s=5.0), timeout_s=0.1, max_retries=0, backoff_s=0.01, fallback_local=False)
+        with pytest.raises(GatherTimeoutError):
+            g(np.ones(2))
+
+    def test_degraded_payload_gather_keeps_local_rows(self):
+        """When the shape gather succeeds but the payload gather degrades to
+        local-only, the single returned row is THIS host's array and must be
+        trimmed with the LOCAL shape — not rank 0's, which would silently
+        drop or zero-pad real rows on non-rank-0 hosts."""
+
+        class ShapeOkPayloadDegraded:
+            def __init__(self):
+                self.calls = 0
+
+            def __call__(self, x):
+                self.calls += 1
+                local = np.asarray(x)
+                if self.calls == 1:  # shape gather: rank 0 claims 3 rows, we have 5
+                    return np.stack([np.asarray([3], np.int64), local])
+                return local[None]  # payload gather degraded to local-only
+
+        local = jnp.arange(5, dtype=jnp.int32)
+        out = _pad_gather_trim(local, ShapeOkPayloadDegraded())
+        assert len(out) == 1
+        np.testing.assert_array_equal(np.asarray(out[0]), np.arange(5))
+
+    def test_timed_out_worker_thread_is_daemon(self):
+        """The abandoned transport thread must be a daemon — a non-daemon
+        worker would be joined by the futures atexit hook and block
+        interpreter exit forever, re-creating the hang this class bounds."""
+        import threading
+
+        g = RetryingGather(HangingGather(hang_s=3.0), timeout_s=0.1, max_retries=0, backoff_s=0.01, fallback_local=False)
+        with pytest.raises(GatherTimeoutError):
+            g(np.ones(2))
+        workers = [t for t in threading.enumerate() if t.name == "metrics-tpu-gather"]
+        assert workers and all(t.daemon for t in workers)
+
+    def test_pad_gather_trim_through_retrying_transport(self):
+        """The ragged-gather logic composes with the retrying wrapper: a
+        transient failure mid pad-gather-trim is absorbed invisibly."""
+        inner = FlakyGather(fail_times=1, nproc=2)
+        out = _pad_gather_trim(jnp.arange(6, dtype=jnp.int32), RetryingGather(inner, timeout_s=5.0, backoff_s=0.01))
+        assert len(out) == 2
+        np.testing.assert_array_equal(np.asarray(out[0]), np.arange(6))
+
+    def test_gather_all_arrays_uses_injected_transport(self, monkeypatch):
+        """End-to-end: Metric.sync over a flaky (then healthy) injected
+        transport produces the 2-process result."""
+        import metrics_tpu.parallel.sync as sync_mod
+
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        monkeypatch.setattr("metrics_tpu.metric.distributed_available", lambda: True)
+        prev = set_gather_transport(RetryingGather(FlakyGather(fail_times=1, nproc=2), timeout_s=5.0, backoff_s=0.01))
+        try:
+            out = gather_all_arrays(jnp.asarray([1.0, 2.0]))
+            assert len(out) == 2
+            m = mt.SumMetric(nan_strategy="ignore")
+            m.update(jnp.asarray([2.0]))
+            m.sync()
+            np.testing.assert_allclose(float(np.asarray(m._state["value"])), 4.0)  # 2 ranks x 2.0
+            m.unsync()
+        finally:
+            set_gather_transport(prev)
+
+
+class TestEmptyListSyncDtype:
+    """Satellite: an empty rank's list state must gather with the declared
+    dtype/trailing shape, not collapse to float32 ``(0,)``."""
+
+    def _run(self, state, reductions, defaults):
+        mesh = Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+        def body():
+            return sync_state(state, reductions, "data", defaults=defaults)
+
+        return jax.jit(
+            jax.shard_map(lambda: body(), mesh=mesh, in_specs=(), out_specs=P())
+        )()
+
+    def test_empty_list_uses_default_template(self):
+        out = self._run(
+            {"vals": []},
+            {"vals": "cat"},
+            {"vals": jnp.zeros((0, 3), jnp.int32)},
+        )
+        assert out["vals"].dtype == jnp.int32
+        assert out["vals"].shape == (0, 3)
+
+    def test_empty_list_without_template_keeps_legacy_f32(self):
+        out = self._run({"vals": []}, {"vals": "cat"}, None)
+        assert out["vals"].dtype == jnp.float32 and out["vals"].shape == (0,)
+
+    def test_real_metric_templates_reach_the_sync_layer(self):
+        """The satellite end-to-end through a REAL metric: curve metrics
+        register dtype templates for their eager list states, so an empty
+        rank gathers `target` as int32, not the legacy float32."""
+        m = mt.AUROC()  # eager list mode: preds float32 / target int32 rows
+        out = self._run(dict(m._state), dict(m._reductions), m._sync_defaults())
+        assert out["target"].dtype == jnp.int32
+        assert out["preds"].dtype == jnp.float32
+
+        r = mt.RetrievalMAP()
+        tpl = r._sync_defaults()
+        assert tpl["indexes"].dtype == jnp.int32
+
+    def test_add_state_template_validated(self):
+        from metrics_tpu.metric import Metric
+
+        class M(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("v", jnp.asarray(0.0), "sum")
+
+            def update(self, x):
+                self.v = self.v + x
+
+            def compute(self):
+                return self.v
+
+        m = M()
+        with pytest.raises(ValueError, match="template"):
+            m.add_state("w", jnp.asarray(0.0), "sum", template=jnp.zeros((0,)))
+
+    def test_shape_gather_degraded_payload_recovered_returns_local(self):
+        """The inverse mixed-degradation case: shape gather degrades, the
+        payload gather later succeeds — the pair is inconsistent, so the
+        result must be THIS host's own data, not rank 0's payload."""
+
+        class ShapeDownPayloadOk:
+            def __init__(self):
+                self.calls = 0
+
+            def __call__(self, x):
+                self.calls += 1
+                local = np.asarray(x)
+                if self.calls == 1:  # shape gather degraded to local-only
+                    return local[None]
+                return np.stack([np.zeros_like(local), local])  # rank0 is NOT us
+
+        local = jnp.arange(4, dtype=jnp.int32) + 10
+        out = _pad_gather_trim(local, ShapeDownPayloadOk())
+        assert len(out) == 1
+        np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4) + 10)
+
+    def test_fused_sync_empty_list_template(self):
+        mesh = Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+        def body():
+            return fused_sync(
+                [{"vals": [], "total": jnp.ones((), jnp.int32)}],
+                [{"vals": "cat", "total": "sum"}],
+                "data",
+                defaults=[{"vals": jnp.zeros((0, 2), jnp.float16), "total": jnp.zeros((), jnp.int32)}],
+            )[0]
+
+        out = jax.jit(jax.shard_map(lambda: body(), mesh=mesh, in_specs=(), out_specs=P()))()
+        assert out["vals"].dtype == jnp.float16 and out["vals"].shape == (0, 2)
+        assert int(out["total"]) == NDEV
