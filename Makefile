@@ -1,0 +1,42 @@
+# One-command entry points for the repo's verification lanes (VERDICT r5
+# Missing #4): `make test` is the exact ROADMAP.md tier-1 command, `make
+# doctest` the docstring/README gate, `make bench` the perf harness. CI
+# (.github/workflows/ci.yml) calls these same targets, so what runs locally
+# is what runs in automation.
+SHELL := /bin/bash
+
+PYTHON        ?= python
+TIER1_TIMEOUT ?= 870
+TIER1_LOG     ?= /tmp/_t1.log
+
+.PHONY: test doctest bench dryrun test-resilience
+
+# ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
+# on the CPU backend under a hard timeout, with the dot-count echoed for the
+# driver. The `slow` lane (pretrained-weight loads, subprocess examples,
+# multi-seed fuzz) runs via `pytest -m slow` when you have the time.
+test:
+	set -o pipefail; rm -f $(TIER1_LOG); \
+	timeout -k 10 $(TIER1_TIMEOUT) env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+	  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee $(TIER1_LOG); \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' $(TIER1_LOG) | tr -cd . | wc -c); \
+	exit $$rc
+
+# Docstring examples are API contract (tests/test_doctests.py walks every
+# module + the README code blocks).
+doctest:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_doctests.py -q -p no:cacheprovider
+
+# Perf harness: probes the default backend in a subprocess (hang-proof),
+# falls back to CPU, and appends same-platform history to BENCH_HISTORY.json.
+bench:
+	$(PYTHON) bench.py
+
+# The multichip dry run on the 8-device virtual CPU mesh.
+dryrun:
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Fast feedback on the resilience subsystem only (snapshots + bootstrap).
+test-resilience:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/resilience/ -q -p no:cacheprovider

@@ -476,6 +476,81 @@ def _phase_guard(jax, platform) -> None:
         print(f"bench: guard warn failed: {err}", file=sys.stderr)
 
 
+def _phase_checkpoint(jax, platform) -> None:
+    """Snapshot + restore latency of the resilience subsystem (ISSUE 3):
+    a guarded 4-metric collection with two non-empty 64k-row CatBuffer ring
+    states, saved atomically with per-leaf sha256 checksums and restored
+    through full group verification. Restore includes checksum
+    re-verification of every leaf — that is the crash-recovery cost being
+    measured, not a raw unpickle."""
+    _stamp("checkpoint start")
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu.resilience.snapshot import SnapshotManager
+
+    try:
+        cap = 1 << 16
+
+        def build():
+            return mt.MetricCollection(
+                {
+                    "auroc": mt.AUROC(capacity=cap, on_invalid="drop"),
+                    "ap": mt.AveragePrecision(capacity=cap, on_invalid="drop"),
+                    "acc": mt.Accuracy(on_invalid="drop"),
+                    "f1": mt.F1Score(on_invalid="drop"),
+                }
+            )
+
+        coll = build()
+        rng = np.random.default_rng(5)
+        scores = jnp.asarray(rng.random(cap).astype(np.float32))
+        labels = jnp.asarray(rng.integers(0, 2, cap).astype(np.int32))
+        for i in range(4):
+            sl = slice(i * cap // 4, (i + 1) * cap // 4)
+            coll.update(scores[sl], labels[sl])
+        before = {k: float(v) for k, v in coll.compute().items()}
+
+        workdir = tempfile.mkdtemp(prefix="bench-ckpt-")
+        try:
+            mgr = SnapshotManager(workdir, keep=2)
+            t_save = float("inf")
+            for step in range(2):  # min-of-2 interleave discipline (BASELINE.md)
+                t0 = time.perf_counter()
+                path = mgr.save(coll, step=step)
+                t_save = min(t_save, time.perf_counter() - t0)
+            size_mb = os.path.getsize(path) / 1e6
+            t_restore = float("inf")
+            fresh = None
+            for _ in range(2):
+                fresh = build()
+                t0 = time.perf_counter()
+                mgr.restore(fresh)
+                t_restore = min(t_restore, time.perf_counter() - t0)
+            after = {k: float(v) for k, v in fresh.compute().items()}
+            if any(abs(before[k] - after[k]) > 1e-6 for k in before):
+                print(f"bench: PARITY-MISMATCH snapshot restore {before} vs {after}", file=sys.stderr)
+            _emit(
+                "snapshot_save_ms",
+                round(t_save * 1e3, 3),
+                f"ms/save (guarded 4-metric collection, 2 rings x {cap} rows, "
+                f"{size_mb:.2f} MB atomic+checksummed, {platform})",
+            )
+            _emit(
+                "snapshot_restore_ms",
+                round(t_restore * 1e3, 3),
+                f"ms/restore (newest intact group, every leaf checksum-verified, {platform})",
+            )
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+    except Exception as err:  # pragma: no cover
+        print(f"bench: checkpoint failed: {err}", file=sys.stderr)
+
+
 def _phase_sync(jax, platform) -> None:
     """Fused-collection sync us on a virtual 8-device CPU mesh.
 
@@ -788,6 +863,7 @@ _PHASES = {
     "detection": (_phase_detection, 120),
     "bucketed_rank": (_phase_bucketed_rank, 420),
     "guard": (_phase_guard, 300),
+    "checkpoint": (_phase_checkpoint, 240),
     "sync": (_phase_sync, 150),
 }
 

@@ -16,6 +16,18 @@ _logger.setLevel(logging.INFO)
 
 __version__ = "0.1.0"
 
+# Hang-proof bootstrap (resilience subsystem): importing metrics_tpu never
+# touches device discovery — nothing below calls jax.devices()/process_*
+# at import time — and the METRICS_TPU_FORCE_CPU=1 escape hatch is honored
+# HERE, before anything could initialize a backend, so a wedged TPU plugin
+# is never dialed. See utilities/backend.py and resilience/health.py.
+from metrics_tpu.utilities.backend import apply_force_cpu_escape_hatch as _apply_force_cpu  # noqa: E402
+
+_apply_force_cpu()
+
+from metrics_tpu.resilience import SnapshotManager, health_report  # noqa: E402
+from metrics_tpu.utilities.backend import ensure_backend  # noqa: E402
+
 from metrics_tpu.audio import (  # noqa: E402
     PermutationInvariantTraining,
     PerceptualEvaluationSpeechQuality,
@@ -208,6 +220,7 @@ __all__ = [
     "RetrievalRecallAtFixedPrecision",
     "SQuAD",
     "SacreBLEUScore",
+    "SnapshotManager",
     "ScaleInvariantSignalDistortionRatio",
     "ScaleInvariantSignalNoiseRatio",
     "ShortTimeObjectiveIntelligibility",
@@ -230,5 +243,7 @@ __all__ = [
     "WordInfoPreserved",
     "functional",
     "bootstrap_functionalize",
+    "ensure_backend",
     "functionalize",
+    "health_report",
 ]

@@ -13,6 +13,11 @@ Array = jax.Array
 class PermutationInvariantTraining(Metric):
     """Average best-permutation metric (reference ``audio/pit.py:22-102``).
 
+    .. note::
+        ``higher_is_better`` is **True** here; the reference leaves the
+        flag unset (``None``). The wrapped ``metric_func`` defaults (SI-SDR/SNR) improve upward (PARITY.md "Class behavior-flag
+        divergences" — strictly more informative for ``MetricTracker.best_metric``).
+
     Extra ``**kwargs`` not consumed by the base ``Metric`` are forwarded to
     ``metric_func`` on every update, mirroring the reference's kwarg split.
 

@@ -39,6 +39,7 @@ class Accuracy(StatScores):
         0.25
     """
 
+    _snapshot_attrs = ("mode", "subset_accuracy")  # data-inferred at update (resilience snapshots)
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -45,6 +45,7 @@ class AUROC(Metric):
         1.0
     """
 
+    _snapshot_attrs = ("mode",)  # data-inferred at update (resilience snapshots)
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -25,6 +25,11 @@ class AveragePrecision(Metric):
     """Average precision over accumulated predictions
     (reference ``avg_precision.py:24-136``).
 
+    .. note::
+        ``higher_is_better`` is **True** here; the reference leaves the
+        flag unset (``None``). A precision-family score: higher is better (PARITY.md "Class behavior-flag
+        divergences" — strictly more informative for ``MetricTracker.best_metric``).
+
     Two accumulation modes (same design as :class:`~metrics_tpu.AUROC`):
 
     - default: cat list states, step-integral of the PR curve at compute.
@@ -42,6 +47,7 @@ class AveragePrecision(Metric):
         1.0
     """
 
+    _snapshot_attrs = ("num_classes", "pos_label", "mode")  # data-inferred at update (resilience snapshots)
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

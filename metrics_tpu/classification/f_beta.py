@@ -14,6 +14,11 @@ Array = jax.Array
 class FBetaScore(StatScores):
     """F-beta score (reference ``f_beta.py:24-147``).
 
+    .. note::
+        ``higher_is_better`` is **True** here; the reference leaves the
+        flag unset (``None``). An F-score: higher is better (PARITY.md "Class behavior-flag
+        divergences" — strictly more informative for ``MetricTracker.best_metric``).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import FBetaScore

@@ -42,6 +42,7 @@ class PrecisionRecallCurve(Metric):
         [1.  0.5 0. ]
     """
 
+    _snapshot_attrs = ("num_classes", "pos_label", "mode")  # data-inferred at update (resilience snapshots)
     is_differentiable = False
     higher_is_better: Optional[bool] = None
     full_state_update = False

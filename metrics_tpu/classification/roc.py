@@ -41,6 +41,7 @@ class ROC(Metric):
         [0.  0.5 1.  1.  1. ]
     """
 
+    _snapshot_attrs = ("num_classes", "pos_label", "mode")  # data-inferred at update (resilience snapshots)
     is_differentiable = False
     higher_is_better: Optional[bool] = None
     full_state_update = False

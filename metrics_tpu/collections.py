@@ -142,6 +142,36 @@ class MetricCollection:
         # loaded states override group aliasing until the next update
         self._state_is_copy = True
 
+    def snapshot_state(self) -> Dict[str, Any]:
+        """Full-state snapshot payload of every member, keyed by base name —
+        the collection form of :meth:`Metric.snapshot_state` (used by
+        ``metrics_tpu.resilience.snapshot.SnapshotManager``)."""
+        return {
+            "members": {k: m.snapshot_state() for k, m in self.items(keep_base=True, copy_state=True)}
+        }
+
+    def load_snapshot_state(self, payload: Dict[str, Any]) -> None:
+        """Restore a :meth:`snapshot_state` payload; a member name in the
+        payload that this collection lacks raises naming it. Transactional:
+        every member's payload validates before ANY member commits, so a
+        rejected snapshot leaves the whole collection untouched (a
+        half-restored collection would silently mix epochs)."""
+        members = payload.get("members", {})
+        for name in members:
+            if name not in self._modules:
+                raise ValueError(
+                    f"MetricCollection.load_snapshot_state: snapshot carries member {name!r} "
+                    f"this collection does not have (members: {list(self._modules)})"
+                )
+        prepared = {
+            name: self._modules[name]._prepare_snapshot_state(member_payload)
+            for name, member_payload in members.items()
+        }
+        for name, member_prepared in prepared.items():
+            self._modules[name]._commit_snapshot_state(member_prepared)
+        # loaded states override group aliasing until the next update
+        self._state_is_copy = True
+
     # ------------------------------------------------------------------
     # compute groups
     # ------------------------------------------------------------------
@@ -177,6 +207,7 @@ class MetricCollection:
             return False
         if metric1._defaults.keys() != metric2._defaults.keys():
             return False
+        from metrics_tpu.utilities.guard import FaultCounters
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
         for key in metric1._defaults:
@@ -184,6 +215,12 @@ class MetricCollection:
             state2 = metric2._state[key]
             if type(state1) is not type(state2):
                 return False
+            if isinstance(state1, FaultCounters):
+                # guarded metrics carry a counts vector; compare it like any
+                # other leaf (a bare `.shape` access on the NamedTuple crashes)
+                if not np.array_equal(np.asarray(state1.counts), np.asarray(state2.counts)):
+                    return False
+                continue
             if isinstance(state1, list):
                 if len(state1) != len(state2):
                     return False

@@ -182,7 +182,20 @@ class SpectralAngleMapper(Metric):
 
 
 class SpectralDistortionIndex(Metric):
-    """D-lambda (reference ``image/d_lambda.py:23-102``)."""
+    """D-lambda (reference ``image/d_lambda.py:23-102``).
+
+    .. note::
+        ``higher_is_better`` is **False** here; the reference flags it True.
+        D-lambda is a *distortion* index — lower is better — so the
+        reference flag reads as a bug (PARITY.md "Class behavior-flag
+        divergences"). Users porting reference ``MetricTracker`` code must
+        flip the direction or ``best_metric`` will return the WORST epoch:
+
+        >>> from metrics_tpu import MetricTracker, SpectralDistortionIndex
+        >>> tracker = MetricTracker(SpectralDistortionIndex(), maximize=False)
+        >>> tracker.maximize
+        False
+    """
 
     is_differentiable = True
     higher_is_better = False

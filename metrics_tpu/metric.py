@@ -86,6 +86,13 @@ class Metric:
     jittable_update: bool = True
     jittable_compute: bool = True
 
+    # data-inferred python attributes (e.g. an input-mode enum resolved at
+    # the first update) that a crash-recovery snapshot must carry so a
+    # fresh instance can compute() right after restore — subclasses that
+    # infer config from data declare the attribute names here
+    # (resilience/snapshot.py; values must pickle and be cheap to repr)
+    _snapshot_attrs: Sequence[str] = ()
+
     # how this metric's CatBuffer ring states overflow together: False =
     # paired rings filled in lockstep (preds/target — dropped rows are the
     # SAME samples, count once via max); True = rings filled independently
@@ -847,37 +854,150 @@ class Metric:
         for key in self._persistent:
             self._persistent[key] = mode
 
-    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
-        """Persistent states as numpy copies (reference ``metric.py:654-672``).
-
-        Structured states serialize to checkpoint-friendly primitives:
-        :class:`CatBuffer` as a ``{"data", "mask", "dropped"}`` dict of
-        arrays, :class:`FaultCounters` as its raw counts vector — both
-        round-trip through orbax/pickle with no custom node handling and are
-        rebuilt (and validated) by :meth:`load_state_dict`.
-        """
+    @staticmethod
+    def _serialize_state_value(current: Any) -> Any:
+        """One state leaf as checkpoint-friendly primitives: lists of numpy
+        arrays, :class:`CatBuffer` as a ``{"data", "mask", "dropped"}`` dict,
+        :class:`FaultCounters` as its raw counts vector — all round-trip
+        through orbax/pickle with no custom node handling and are rebuilt
+        (and validated) by :meth:`_validated_state_value`."""
         from metrics_tpu.utilities.guard import FaultCounters
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
+        if isinstance(current, list):
+            return [np.asarray(x) for x in current]
+        if isinstance(current, CatBuffer):
+            dropped = current.dropped if current.dropped is not None else jnp.zeros((), jnp.int32)
+            return {
+                "data": np.asarray(current.data),
+                "mask": np.asarray(current.mask),
+                "dropped": np.asarray(dropped),
+            }
+        if isinstance(current, FaultCounters):
+            return np.asarray(current.counts)
+        return np.asarray(current)
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        """Persistent states as numpy copies (reference ``metric.py:654-672``),
+        serialized per :meth:`_serialize_state_value`."""
         out: Dict[str, Any] = {}
         for key in self._defaults:
             if not self._persistent[key]:
                 continue
-            current = self._state[key]
-            if isinstance(current, list):
-                out[prefix + key] = [np.asarray(x) for x in current]
-            elif isinstance(current, CatBuffer):
-                dropped = current.dropped if current.dropped is not None else jnp.zeros((), jnp.int32)
-                out[prefix + key] = {
-                    "data": np.asarray(current.data),
-                    "mask": np.asarray(current.mask),
-                    "dropped": np.asarray(dropped),
-                }
-            elif isinstance(current, FaultCounters):
-                out[prefix + key] = np.asarray(current.counts)
-            else:
-                out[prefix + key] = np.asarray(current)
+            out[prefix + key] = self._serialize_state_value(self._state[key])
         return out
+
+    # ------------------------------------------------------------------
+    # crash-safe snapshots (metrics_tpu/resilience/snapshot.py)
+    # ------------------------------------------------------------------
+
+    def _named_child_metrics(self):
+        """(name, child) pairs for every Metric held in an attribute or an
+        attribute list/tuple — the snapshot recursion set. Unlike
+        :meth:`_child_metrics` (the forward-protocol set) this includes a
+        ``CompositionalMetric``'s operands: snapshots must capture the whole
+        state tree, not just the forward-managed part."""
+        for key, v in self.__dict__.items():
+            if isinstance(v, Metric):
+                yield key, v
+            elif isinstance(v, (list, tuple)):
+                for i, x in enumerate(v):
+                    if isinstance(x, Metric):
+                        yield f"{key}[{i}]", x
+
+    def snapshot_state(self) -> Dict[str, Any]:
+        """EVERY state leaf (persistence flags ignored — a crash-recovery
+        snapshot that skipped non-persistent accumulators would restore a
+        different value) plus the update counter, recursively over child
+        metrics (wrappers hold their state in children). Values serialize
+        per :meth:`_serialize_state_value`; rebuilt by
+        :meth:`load_snapshot_state`."""
+        out: Dict[str, Any] = {
+            "states": {key: self._serialize_state_value(self._state[key]) for key in self._defaults},
+            "update_count": self._update_count,
+        }
+        attrs = {
+            name: getattr(self, name)
+            for name in self._snapshot_attrs
+            if getattr(self, name, None) is not None
+        }
+        if attrs:
+            out["attrs"] = attrs
+        children = {name: child.snapshot_state() for name, child in self._named_child_metrics()}
+        if children:
+            out["children"] = children
+        return out
+
+    def load_snapshot_state(self, payload: Dict[str, Any]) -> None:
+        """Restore a :meth:`snapshot_state` payload. Every value is validated
+        against the registered defaults (see :meth:`_validated_state_value`);
+        unknown state keys or missing children raise naming the offender.
+        Transactional over the WHOLE metric tree: validation of every state
+        and every child runs before anything commits, so a rejected payload
+        leaves this metric (and its children) untouched."""
+        self._commit_snapshot_state(self._prepare_snapshot_state(payload))
+
+    def _prepare_snapshot_state(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """The validate half: check every state/attr/child of ``payload``
+        recursively WITHOUT mutating anything; returns the prepared tree
+        :meth:`_commit_snapshot_state` applies."""
+        states = payload.get("states", {})
+        for key in states:
+            if key not in self._defaults:
+                raise ValueError(
+                    f"{type(self).__name__}.load_snapshot_state: snapshot carries unknown state "
+                    f"{key!r}; refusing to load (metric config mismatch?)"
+                )
+        loaded = {
+            key: self._validated_state_value(key, value, via="load_snapshot_state")
+            for key, value in states.items()
+        }
+        self._check_ring_capacity_consistency("load_snapshot_state", {**self._state, **loaded})
+        attrs = dict(payload.get("attrs", {}))
+        for name in attrs:
+            if name not in self._snapshot_attrs:
+                raise ValueError(
+                    f"{type(self).__name__}.load_snapshot_state: snapshot carries data-inferred "
+                    f"attribute {name!r} this class does not declare in `_snapshot_attrs`"
+                )
+        mine = dict(self._named_child_metrics())
+        children = {}
+        for name, child_payload in payload.get("children", {}).items():
+            if name not in mine:
+                raise ValueError(
+                    f"{type(self).__name__}.load_snapshot_state: snapshot carries child metric "
+                    f"{name!r} this instance does not have; refusing to load"
+                )
+            children[name] = (mine[name], mine[name]._prepare_snapshot_state(child_payload))
+        return {
+            "loaded": loaded,
+            "update_count": int(payload.get("update_count", self._update_count)),
+            "attrs": attrs,
+            "children": children,
+        }
+
+    def _commit_snapshot_state(self, prepared: Dict[str, Any]) -> None:
+        self._state.update(prepared["loaded"])
+        self._update_count = prepared["update_count"]
+        self._update_called = self._update_count > 0
+        self._computed = None
+        self._is_synced = False
+        self._cache = None
+        for name, value in prepared["attrs"].items():
+            current = getattr(self, name, None)
+            if current is not None and current != value:
+                # an attr can be BOTH ctor config and data-downgraded (e.g.
+                # Accuracy.subset_accuracy): honor the snapshot — its states
+                # were accumulated under that value — but never silently
+                rank_zero_warn(
+                    f"{type(self).__name__}.load_snapshot_state: overriding {name}={current!r} "
+                    f"with the snapshot's {value!r} (the restored states were accumulated "
+                    "under it)",
+                    UserWarning,
+                )
+            setattr(self, name, value)
+        for child, child_prepared in prepared["children"].values():
+            child._commit_snapshot_state(child_prepared)
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
         """Restore states saved by :meth:`state_dict` (reference ``metric.py:674-692``).
@@ -887,14 +1007,41 @@ class Metric:
         mismatched checkpoint raises a ``ValueError`` naming the offending
         state key instead of silently loading garbage accumulators.
         """
-        for key in self._defaults:
-            name = prefix + key
-            if name in state_dict:
-                self._state[key] = self._validated_state_value(key, state_dict[name])
-                self._update_called = True
+        # validate-then-commit: a rejected value must leave state untouched
+        loaded = {
+            key: self._validated_state_value(key, state_dict[prefix + key])
+            for key in self._defaults
+            if prefix + key in state_dict
+        }
+        self._check_ring_capacity_consistency("load_state_dict", {**self._state, **loaded})
+        if loaded:
+            self._state.update(loaded)
+            self._update_called = True
 
-    def _validated_state_value(self, key: str, v: Any) -> Any:
-        """Check one loaded state value against ``self._defaults[key]``."""
+    def _check_ring_capacity_consistency(self, via: str, state: Dict[str, Any]) -> None:
+        """Paired (lockstep) ring states must share ONE capacity — compute
+        pairs their rows positionally under a shared mask, so a preds ring
+        loaded at 16 with a target ring at 8 would silently misalign.
+        Classes with independently-filled rings (``_independent_ring_drops``,
+        FID/KID real-vs-fake) are exempt. Checked on the would-be state
+        BEFORE commit, so a refused load leaves state untouched."""
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        if self._independent_ring_drops:
+            return
+        caps = {key: v.capacity for key, v in state.items() if isinstance(v, CatBuffer)}
+        if len(set(caps.values())) > 1:
+            raise ValueError(
+                f"{type(self).__name__}.{via}: lockstep ring states loaded at different "
+                f"capacities ({caps}); their rows pair positionally, so a partial or "
+                "mismatched load would silently misalign them. Load all rings of this "
+                "metric at one capacity."
+            )
+
+    def _validated_state_value(self, key: str, v: Any, via: str = "load_state_dict") -> Any:
+        """Check one loaded state value against ``self._defaults[key]``.
+        ``via`` names the loading entry point in error messages (accurate
+        provenance matters most during crash-recovery debugging)."""
         from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES, FaultCounters
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
@@ -902,19 +1049,25 @@ class Metric:
 
         def fail(why: str) -> None:
             raise ValueError(
-                f"{type(self).__name__}.load_state_dict: state {key!r} {why}; refusing to load a "
+                f"{type(self).__name__}.{via}: state {key!r} {why}; refusing to load a "
                 "corrupt checkpoint."
             )
 
-        def as_leaf(value: Any, like: Array, part: str = "") -> Array:
+        def as_leaf(value: Any, like: Array, part: str = "", free_leading: bool = False) -> Array:
             try:
                 arr = np.asarray(value)
             except Exception:
                 fail(f"{part}is not array-like (got {type(value).__name__})")
             if arr.dtype == object:
                 fail(f"{part}is not a numeric array (object dtype)")
-            if tuple(arr.shape) != tuple(like.shape):
-                fail(f"{part}has shape {tuple(arr.shape)}, expected {tuple(like.shape)}")
+            # free_leading: ring (CatBuffer) slots may load at a different
+            # capacity — distributed sync and elastic world-size restore both
+            # legitimately produce grown union buffers; row shape stays fixed
+            want = tuple(like.shape[1:]) if free_leading else tuple(like.shape)
+            got = tuple(arr.shape[1:]) if free_leading else tuple(arr.shape)
+            if got != want or (free_leading and arr.ndim != like.ndim):
+                fail(f"{part}has shape {tuple(arr.shape)}, expected {tuple(like.shape)}"
+                     + (" (any capacity)" if free_leading else ""))
             if not np.can_cast(arr.dtype, np.dtype(like.dtype), casting="same_kind"):
                 fail(f"{part}has dtype {arr.dtype}, incompatible with expected {like.dtype}")
             return jnp.asarray(arr).astype(like.dtype)
@@ -929,9 +1082,13 @@ class Metric:
                 )
             dropped_like = default.dropped if default.dropped is not None else jnp.zeros((), jnp.int32)
             loaded_dropped = v.get("dropped")
+            data = as_leaf(v["data"], default.data, "slot 'data' ", free_leading=True)
+            mask = as_leaf(v["mask"], default.mask, "slot 'mask' ", free_leading=True)
+            if mask.shape[0] != data.shape[0]:
+                fail(f"has mask length {mask.shape[0]} != data capacity {data.shape[0]}")
             return CatBuffer(
-                data=as_leaf(v["data"], default.data, "slot 'data' "),
-                mask=as_leaf(v["mask"], default.mask, "slot 'mask' "),
+                data=data,
+                mask=mask,
                 dropped=(
                     as_leaf(loaded_dropped, dropped_like, "slot 'dropped' ")
                     if loaded_dropped is not None

@@ -346,6 +346,8 @@ class RetryingGather:
         if _time.monotonic() < self._open_until:
             # circuit open: a recent call already paid the full failure
             # budget; degrade immediately instead of re-blocking per leaf
+            # (no per-leaf health event either — the breaker-opening call
+            # already recorded one; a sync loops this over every leaf)
             if not self.fallback_local:
                 raise GatherTimeoutError(
                     f"multihost gather circuit open for {self._open_until - _time.monotonic():.0f}s "
@@ -354,7 +356,9 @@ class RetryingGather:
             return np.asarray(array)[None]
 
         last_err: Optional[BaseException] = None
+        attempts = 0
         for attempt in range(self.max_retries + 1):
+            attempts += 1
             try:
                 out = self._attempt(array)
                 self._open_until = 0.0  # healthy again: close the breaker
@@ -373,10 +377,21 @@ class RetryingGather:
                 if attempt < self.max_retries:
                     _time.sleep(self.backoff_s * (2**attempt))
         self._open_until = _time.monotonic() + self.cooldown_s
+        from metrics_tpu.resilience.health import record_degradation
+
+        record_degradation(
+            "gather_degraded",
+            # `attempts` counts what actually ran: a timeout aborts after ONE
+            # attempt by design (never re-issued), exceptions retry
+            f"multihost gather failed after {attempts} attempt(s): {last_err}",
+            timeout_s=self.timeout_s,
+            cooldown_s=self.cooldown_s,
+            fallback_local=self.fallback_local,
+        )
         if not self.fallback_local:
             raise last_err
         warnings.warn(
-            f"multihost gather FAILED after {self.max_retries + 1} attempts ({last_err}); "
+            f"multihost gather FAILED after {attempts} attempt(s) ({last_err}); "
             "degrading to LOCAL-ONLY state — synced values on this process cover this "
             "process's stream only, NOT the global one. Investigate the pod before trusting "
             "aggregate metrics.",

@@ -18,6 +18,11 @@ Array = jax.Array
 class TweedieDevianceScore(Metric):
     """Tweedie deviance (reference ``tweedie_deviance.py:24-104``).
 
+    .. note::
+        ``higher_is_better`` is **False** here; the reference leaves the
+        flag unset (``None``). A deviance is a loss: lower is better (PARITY.md "Class behavior-flag
+        divergences" — strictly more informative for ``MetricTracker.best_metric``).
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import TweedieDevianceScore

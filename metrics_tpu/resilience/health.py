@@ -1,0 +1,140 @@
+"""Process-wide degradation registry + ``health_report()``.
+
+PR 2 gave the framework three independent degradation channels: the
+in-graph fault counters (``utilities/guard.py``), the retrying multihost
+gather's local-only fallback (``parallel/sync.py::RetryingGather``), and
+the bench driver's backend probes. Each surfaced through its own warning;
+nothing aggregated them, so "is this job degraded, and how?" had no single
+answer. This module is that answer:
+
+- every degradation event — backend probe timeout/failure, forced-CPU
+  escape hatch, gather local-only fallback, snapshot corruption fallback —
+  lands in one bounded in-process :class:`HealthRegistry` via
+  :func:`record_degradation`;
+- :func:`health_report` renders the registry plus the backend bootstrap
+  state (``utilities/backend.py``) plus, for any metrics passed in, their
+  fault counters and overflow drop counts, as one plain dict.
+
+The registry is deliberately host-side and stdlib-only: it must stay
+usable precisely when the accelerator stack is wedged.
+"""
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Known degradation kinds (informative, not enforced — new subsystems may
+# record new kinds without touching this module):
+#   backend_probe_timeout  backend init probe exceeded its deadline
+#   backend_probe_failed   backend init probe exited non-zero
+#   forced_cpu             METRICS_TPU_FORCE_CPU / probe fallback re-pointed jax at CPU
+#   gather_degraded        multihost gather fell back to local-only state
+#   snapshot_fallback      a corrupt/incomplete snapshot was skipped for an older intact one
+_MAX_EVENTS = 256
+
+
+class HealthRegistry:
+    """Bounded, thread-safe event log of degradations in this process."""
+
+    def __init__(self, max_events: int = _MAX_EVENTS) -> None:
+        self._lock = threading.Lock()
+        self._events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
+        self._counts: Dict[str, int] = {}
+
+    def record(self, kind: str, message: str, **details: Any) -> Dict[str, Any]:
+        event: Dict[str, Any] = {"kind": kind, "message": message, "time_unix": time.time()}
+        if details:
+            event["details"] = details
+        with self._lock:
+            self._events.append(event)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+        return event
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            events = list(self._events)
+        if kind is not None:
+            events = [e for e in events if e["kind"] == kind]
+        return events
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return bool(self._counts)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._counts.clear()
+
+
+registry = HealthRegistry()
+
+
+def record_degradation(kind: str, message: str, **details: Any) -> Dict[str, Any]:
+    """Record one degradation event in the process-wide registry."""
+    return registry.record(kind, message, **details)
+
+
+def _metric_health(metric: Any) -> Dict[str, Any]:
+    """Fault/overflow view of one ``Metric`` (host-side reads only)."""
+    entry: Dict[str, Any] = {}
+    faults = getattr(metric, "fault_counts", None)
+    if faults:
+        nonzero = {k: v for k, v in faults.items() if v}
+        if nonzero:
+            entry["faults"] = nonzero
+    dropped = getattr(metric, "dropped_count", None)
+    if dropped:
+        entry["overflow_dropped"] = dropped
+    return entry
+
+
+def health_report(*metrics: Any) -> Dict[str, Any]:
+    """One dict describing every known degradation in this process.
+
+    ``metrics`` (optional) are ``Metric`` or ``MetricCollection`` instances
+    whose fault counters / overflow drops should be folded into the report
+    (they hold per-instance state the process-wide registry cannot see).
+    The report is plain JSON-serializable data::
+
+        {"backend": {...bootstrap state...},
+         "events": [...degradation events, oldest first...],
+         "event_counts": {kind: n},
+         "metrics": {name: {"faults": {...}, "overflow_dropped": n}},
+         "degraded": bool}
+
+    ``degraded`` is True when any registry event OR any reported metric
+    fault/overflow exists.
+    """
+    from metrics_tpu.utilities.backend import backend_status
+
+    report: Dict[str, Any] = {
+        "backend": backend_status(),
+        "events": registry.events(),
+        "event_counts": registry.counts(),
+        "metrics": {},
+    }
+    seen: Dict[str, int] = {}
+    for obj in metrics:
+        # copy_state=False: this is a read-only fault-counter sweep — the
+        # default copy would materialize per-member copies of group-aliased
+        # ring states and flip the collection's aliasing flag
+        members = (
+            obj.items(keep_base=True, copy_state=False)
+            if hasattr(obj, "items") and hasattr(obj, "_modules")
+            else None
+        )
+        for name, metric in members if members is not None else [(type(obj).__name__, obj)]:
+            entry = _metric_health(metric)
+            if entry:
+                # two bare instances of one class must not collide (the
+                # second would silently overwrite the first's faults)
+                seen[name] = seen.get(name, 0) + 1
+                report["metrics"][name if seen[name] == 1 else f"{name}#{seen[name]}"] = entry
+    report["degraded"] = bool(report["event_counts"]) or bool(report["metrics"])
+    return report

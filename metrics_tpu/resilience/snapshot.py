@@ -1,0 +1,605 @@
+"""Elastic, crash-safe snapshots of metric state.
+
+The reference delegates persistence to torch's module ``state_dict`` with no
+atomicity, versioning, or topology story (SURVEY §5.4): a preemption
+mid-write leaves a torn file, and a job that saved on 8 workers cannot
+restore on 4. This module is the TPU-native answer:
+
+- **Atomic writes.** Each snapshot is one file written to a ``.tmp`` sibling,
+  flushed, fsync'd, then ``os.replace``'d into place — a crash mid-save
+  leaves the previous snapshot untouched and at worst a stale ``.tmp``.
+- **Integrity.** Every state leaf carries a sha256 digest over its
+  dtype/shape/bytes — and the header fields are digested too (a flipped
+  ``reduced``/``world_size`` would change restore *semantics*) — plus a
+  magic string and a schema-version header.
+  A torn or bit-flipped file fails loudly, naming the file and the leaf;
+  :meth:`SnapshotManager.restore` then falls back to the newest intact
+  snapshot (recording the fallback in ``metrics_tpu.health_report()``).
+- **Elastic topology.** Each rank saves its *local* (unsynced) partial
+  state with ``(rank, world_size)`` recorded in the header and filename.
+  On restore at a different world size, old ranks are partitioned
+  contiguously over the new ranks and each partition is re-merged through
+  the state's registered reduction (sum / cat / min / max, CatBuffer
+  union, FaultCounters sum) — so a job preempted on 8 devices resumes on
+  4 (or 1) with value-parity ``compute()`` after the next sync, instead of
+  refusing to load. This is the checkpoint-side analogue of re-sharding
+  replicated state across replica counts ("Automatic Cross-Replica
+  Sharding of Weight Update in Data-Parallel Training", PAPERS.md).
+
+The payload format rides :meth:`Metric.snapshot_state` /
+:meth:`Metric.load_snapshot_state` (every state leaf, persistence flags
+ignored, recursive over wrapper children) and the ``MetricCollection``
+equivalents. Files are Python pickles of numpy trees — snapshots are
+**trusted** artifacts from your own job, same trust model as torch/orbax
+checkpoints.
+
+Merge caveats: ``mean``-reduced states merge as the unweighted mean of the
+per-rank partials, which is exact ONLY when the new world size divides the
+old one (equal partitions — 8→4→2→1 all qualify). Uneven shrinks AND grown
+worlds (share-less new ranks reset to defaults, which is not an identity
+for an unweighted mean) warn loudly and record a ``snapshot_mean_approx``
+health event; prefer sum+count states over ``mean`` for elastic jobs.
+``dist_reduce_fx=None`` non-list states (rare) have no merge rule and
+require a matching world size.
+"""
+import hashlib
+import os
+import pickle
+import re
+import time
+import warnings
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = "metrics-tpu-snapshot"
+SCHEMA_VERSION = 1
+
+_FILE_RE = re.compile(r"^(?P<tag>.+)\.step(?P<step>\d+)\.rank(?P<rank>\d+)\.of(?P<world>\d+)\.snap$")
+_TMP_TTL_S = 3600.0
+
+
+class SnapshotError(RuntimeError):
+    """Base class for snapshot load/save failures."""
+
+
+class SnapshotCorruptionError(SnapshotError):
+    """A snapshot file failed integrity verification (torn write, bit flip)."""
+
+
+class SnapshotSchemaError(SnapshotError):
+    """A snapshot was written by a newer schema than this build understands."""
+
+
+# --------------------------------------------------------------------------
+# integrity: per-leaf digests over a deterministic walk of the payload tree
+# --------------------------------------------------------------------------
+
+
+def _iter_leaves(node: Any, path: str = "") -> Iterator[Tuple[str, Any]]:
+    if isinstance(node, dict):
+        for k in sorted(node):
+            yield from _iter_leaves(node[k], f"{path}/{k}")
+    elif isinstance(node, (list, tuple)):
+        for i, x in enumerate(node):
+            yield from _iter_leaves(x, f"{path}/[{i}]")
+    else:
+        yield path, node
+
+
+def _leaf_digest(leaf: Any) -> str:
+    h = hashlib.sha256()
+    if isinstance(leaf, np.ndarray) or hasattr(leaf, "dtype"):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    else:
+        h.update(repr(leaf).encode())
+    return h.hexdigest()
+
+
+def _checksum_tree(payload: Any) -> Dict[str, str]:
+    return {path: _leaf_digest(leaf) for path, leaf in _iter_leaves(payload)}
+
+
+# --------------------------------------------------------------------------
+# elastic merge: per-rank payloads -> one payload, through the registered
+# reductions of the live target object
+# --------------------------------------------------------------------------
+
+
+def _merge_state_values(values: List[Any], fx: Any, default: Any, key: str, owner: str) -> Any:
+    """Merge one state's per-rank serialized values, mirroring the reduce
+    semantics of ``Metric._sync_dist`` (sum/mean/max/min/cat) on host numpy."""
+    from metrics_tpu.utilities.guard import FaultCounters
+    from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+    if len(values) == 1 and not isinstance(default, CatBuffer):
+        return values[0]
+    if isinstance(default, FaultCounters):
+        n = max(np.asarray(v).reshape(-1).shape[0] for v in values)
+        total = np.zeros((n,), np.uint64)
+        for v in values:
+            arr = np.asarray(v).reshape(-1)
+            total[: arr.shape[0]] += arr.astype(np.uint64)
+        return total.astype(np.uint32)
+    if isinstance(default, CatBuffer):
+        # union-and-compact: valid rows of every rank, in (rank, slot) order,
+        # packed to the front of a buffer whose capacity is the sum of the
+        # partials' capacities — the same union `_sync_dist` produces, but
+        # contiguous so later `cat_append`s stay well-defined
+        rows, caps, dropped = [], 0, np.zeros((), np.int64)
+        for v in values:
+            data, mask = np.asarray(v["data"]), np.asarray(v["mask"], bool)
+            rows.append(data[mask])
+            caps += data.shape[0]
+            if v.get("dropped") is not None:
+                dropped = dropped + np.asarray(v["dropped"]).astype(np.int64)
+        packed = (
+            np.concatenate(rows, axis=0)
+            if rows  # callers guard non-empty values, but keep the dtype right regardless
+            else np.zeros((0,) + np.asarray(default.data).shape[1:], np.asarray(default.data).dtype)
+        )
+        data = np.zeros((caps,) + packed.shape[1:], packed.dtype)
+        data[: packed.shape[0]] = packed
+        mask = np.zeros((caps,), bool)
+        mask[: packed.shape[0]] = True
+        return {"data": data, "mask": mask, "dropped": dropped.astype(np.int32)}
+    if isinstance(default, list):
+        merged: List[Any] = []
+        for v in values:
+            merged.extend(list(v))
+        return merged
+    stacked = [np.asarray(v) for v in values]
+    if fx == "sum":
+        return np.sum(np.stack(stacked, axis=0), axis=0)
+    if fx == "mean":
+        return np.mean(np.stack(stacked, axis=0), axis=0)
+    if fx == "max":
+        return np.max(np.stack(stacked, axis=0), axis=0)
+    if fx == "min":
+        return np.min(np.stack(stacked, axis=0), axis=0)
+    if fx == "cat":
+        return np.concatenate([np.atleast_1d(v) for v in stacked], axis=0)
+    raise SnapshotError(
+        f"{owner}: state {key!r} has dist_reduce_fx={fx!r}, which has no elastic merge rule — "
+        "restore this snapshot at its original world size"
+    )
+
+
+def _merge_metric_payloads(metric: Any, payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    # the bit-identical load path refuses snapshot states the target does
+    # not register; the merge path must refuse identically, or a
+    # config-mismatch restore silently loses state exactly when merging
+    unknown = sorted(
+        {k for p in payloads for k in p.get("states", {})} - set(metric._reductions)
+    )
+    if unknown:
+        raise ValueError(
+            f"{type(metric).__name__}: snapshot carries unknown state {unknown[0]!r}; "
+            "refusing to merge (metric config mismatch?)"
+        )
+    states: Dict[str, Any] = {}
+    for key, fx in metric._reductions.items():
+        values = [p["states"][key] for p in payloads if key in p.get("states", {})]
+        if values:
+            states[key] = _merge_state_values(values, fx, metric._defaults[key], key, type(metric).__name__)
+    out: Dict[str, Any] = {
+        "states": states,
+        "update_count": sum(int(p.get("update_count", 0)) for p in payloads),
+    }
+    attrs: Dict[str, Any] = {}
+    for p in payloads:  # data-inferred attrs are rank-invariant; first wins
+        for k, v in p.get("attrs", {}).items():
+            attrs.setdefault(k, v)
+    if attrs:
+        out["attrs"] = attrs
+    children = {}
+    mine = dict(metric._named_child_metrics())
+    unknown_children = sorted({k for p in payloads for k in p.get("children", {})} - set(mine))
+    if unknown_children:
+        raise ValueError(
+            f"{type(metric).__name__}: snapshot carries child metric {unknown_children[0]!r} "
+            "this instance does not have; refusing to merge"
+        )
+    for name in mine:
+        child_payloads = [p["children"][name] for p in payloads if name in p.get("children", {})]
+        if child_payloads:
+            children[name] = _merge_metric_payloads(mine[name], child_payloads)
+    if children:
+        out["children"] = children
+    return out
+
+
+def _merge_payloads(obj: Any, payloads: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-rank snapshot payloads through ``obj``'s reduction tags.
+    ``obj`` is the live restore target (Metric or MetricCollection) — it
+    supplies the reduction registry the serialized payloads lack."""
+    if _is_collection(obj):
+        members: Dict[str, Any] = {}
+        modules = dict(obj._modules)
+        unknown = sorted({k for p in payloads for k in p.get("members", {})} - set(modules))
+        if unknown:
+            raise ValueError(
+                f"MetricCollection: snapshot carries member {unknown[0]!r} this collection "
+                f"does not have (members: {list(modules)}); refusing to merge"
+            )
+        for name, member in modules.items():
+            member_payloads = [p["members"][name] for p in payloads if name in p.get("members", {})]
+            if member_payloads:
+                members[name] = _merge_metric_payloads(member, member_payloads)
+        return {"members": members}
+    return _merge_metric_payloads(obj, payloads)
+
+
+def _is_collection(obj: Any) -> bool:
+    return hasattr(obj, "_modules") and hasattr(obj, "snapshot_state")
+
+
+def _has_mean_state(obj: Any) -> bool:
+    """Whether any state (recursively) merges by unweighted mean — the one
+    reduction whose elastic merge is exact only for equal partitions."""
+    if _is_collection(obj):
+        return any(_has_mean_state(m) for m in obj._modules.values())
+    if any(fx == "mean" for fx in obj._reductions.values()):
+        return True
+    return any(_has_mean_state(child) for _name, child in obj._named_child_metrics())
+
+
+# --------------------------------------------------------------------------
+# the manager
+# --------------------------------------------------------------------------
+
+
+class SnapshotManager:
+    """Rolling, checksummed, topology-aware snapshots in one directory.
+
+    Example (single process)::
+
+        mgr = SnapshotManager("/ckpt/metrics", keep=3)
+        mgr.save(collection, step=epoch)            # atomic + pruned to 3
+        info = mgr.restore(collection)              # newest intact snapshot
+
+    Multi-host elastic use: every process calls ``save(obj, step, rank=r,
+    world_size=W)`` into shared storage; after preemption, the resumed job
+    (any world size W') calls ``restore(obj, rank=r', world_size=W')`` and
+    each new rank re-merges its contiguous share of the old per-rank
+    partials through the registered reductions. Ranks that receive no share
+    (W' > W) reset to defaults — the global reduction is preserved for
+    sum/cat/min/max/FaultCounters states ('mean' states warn: see the
+    module docstring caveat).
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        tag: str = "metrics",
+        keep: int = 3,
+        group_verification: str = "full",
+    ) -> None:
+        if keep < 1:
+            raise ValueError(f"`keep` must be >= 1, got {keep}")
+        if group_verification not in ("full", "assigned"):
+            raise ValueError(
+                f"`group_verification` must be 'full' or 'assigned', got {group_verification!r}"
+            )
+        self.directory = str(directory)
+        self.tag = tag
+        self.keep = keep
+        # 'full' (default): every restoring rank checksums every rank file of
+        # a group, so all ranks make the SAME intact/fallback decision —
+        # right for small/medium worlds. 'assigned': each rank fully
+        # verifies only its own share (+ old rank 0's header) and
+        # presence-checks the rest — O(share) reads instead of O(old world)
+        # per rank, for large worlds whose job layer coordinates fallback
+        # (a rank whose share is intact can otherwise disagree with one
+        # whose share is corrupt)
+        self.group_verification = group_verification
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- naming ---------------------------------------------------------
+
+    def _filename(self, step: int, rank: int, world_size: int) -> str:
+        return f"{self.tag}.step{step:010d}.rank{rank:05d}.of{world_size:05d}.snap"
+
+    def _scan(self) -> Dict[Tuple[int, int], Dict[int, str]]:
+        """{(step, world): {rank: path}} for this manager's tag."""
+        groups: Dict[Tuple[int, int], Dict[int, str]] = {}
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return groups
+        for name in names:
+            m = _FILE_RE.match(name)
+            if m is None or m.group("tag") != self.tag:
+                continue
+            key = (int(m.group("step")), int(m.group("world")))
+            groups.setdefault(key, {})[int(m.group("rank"))] = os.path.join(self.directory, name)
+        return groups
+
+    def steps(self) -> List[int]:
+        """Steps with at least one snapshot file, ascending."""
+        return sorted({step for (step, _world) in self._scan()})
+
+    # -- save -----------------------------------------------------------
+
+    def save(
+        self,
+        obj: Any,
+        step: int,
+        rank: int = 0,
+        world_size: int = 1,
+        reduced: bool = False,
+        mesh_axes: Optional[Dict[str, int]] = None,
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """Write one atomic snapshot of ``obj``'s full state; returns its path.
+
+        ``rank``/``world_size`` record the saving topology (``rank`` must be
+        this process's rank; every rank saves its *local*, unsynced state).
+        ``reduced=True`` marks the state as already globally reduced (saved
+        post-sync, e.g. from rank 0 after ``compute()``): on restore it loads
+        on rank 0 only, with every other rank reset to defaults, so the next
+        sync does not multiply-count it. ``mesh_axes`` (optional
+        ``{axis_name: size}``) and ``extra`` are recorded verbatim in the
+        header for the resuming job.
+        """
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} outside world of size {world_size}")
+        if reduced and world_size != 1:
+            raise ValueError("reduced=True snapshots are global state — save them with world_size=1")
+        from metrics_tpu import __version__
+
+        payload = obj.snapshot_state()
+        header = {
+            "step": int(step),
+            "rank": int(rank),
+            "world_size": int(world_size),
+            "reduced": bool(reduced),
+            "mesh_axes": dict(mesh_axes) if mesh_axes else None,
+            "created_unix": time.time(),
+            "library_version": __version__,
+            "extra": dict(extra) if extra else None,
+        }
+        blob = pickle.dumps(
+            {
+                "magic": MAGIC,
+                "schema_version": SCHEMA_VERSION,
+                "header": header,
+                "payload": payload,
+                # header is covered too: a bit-flipped `reduced`/`world_size`
+                # would silently change restore SEMANTICS, not just values
+                "checksums": _checksum_tree({"header": header, "payload": payload}),
+            },
+            protocol=4,
+        )
+        final = os.path.join(self.directory, self._filename(step, rank, world_size))
+        tmp = f"{final}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)  # atomic on POSIX: readers see old or new, never torn
+        self._fsync_dir()
+        self._prune(rank)
+        return final
+
+    def _fsync_dir(self) -> None:
+        try:
+            dir_fd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
+        except OSError:  # pragma: no cover - platform-dependent (e.g. no dir fsync)
+            pass
+
+    def _prune(self, rank: int) -> None:
+        """Keep the newest ``self.keep`` steps of THIS rank's files (each
+        rank prunes only what it wrote — safe on shared storage) and clear
+        stale tmp files left by crashed writers."""
+        mine: Dict[int, List[str]] = {}
+        for (step, _world), files in self._scan().items():
+            if rank in files:
+                mine.setdefault(step, []).append(files[rank])
+        for step in sorted(mine)[: -self.keep] if len(mine) > self.keep else []:
+            for path in mine[step]:
+                try:
+                    os.unlink(path)
+                except OSError:  # pragma: no cover - racing prune from another run
+                    pass
+        now = time.time()
+        for name in os.listdir(self.directory):
+            if ".snap.tmp." in name and name.startswith(self.tag + "."):
+                path = os.path.join(self.directory, name)
+                try:
+                    if now - os.path.getmtime(path) > _TMP_TTL_S:
+                        os.unlink(path)
+                except OSError:  # pragma: no cover
+                    pass
+
+    # -- load -----------------------------------------------------------
+
+    def load_file(self, path: str, verify: bool = True) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Read + verify one snapshot file → ``(header, payload)``.
+
+        Raises :class:`SnapshotCorruptionError` (torn/bit-flipped file,
+        checksum mismatch) or :class:`SnapshotSchemaError` (written by a
+        newer schema), always naming the snapshot file.
+        """
+        try:
+            with open(path, "rb") as f:
+                record = pickle.load(f)
+        except FileNotFoundError:
+            raise SnapshotError(f"snapshot {path} does not exist")
+        except Exception as err:
+            raise SnapshotCorruptionError(
+                f"snapshot {path} is unreadable ({type(err).__name__}: {err}) — torn write or corruption"
+            )
+        if not isinstance(record, dict) or record.get("magic") != MAGIC:
+            raise SnapshotCorruptionError(f"snapshot {path} has no {MAGIC!r} magic header")
+        version = record.get("schema_version")
+        if not isinstance(version, int) or version > SCHEMA_VERSION:
+            raise SnapshotSchemaError(
+                f"snapshot {path} has schema version {version!r}; this build understands <= "
+                f"{SCHEMA_VERSION} — upgrade metrics_tpu to restore it"
+            )
+        if verify:
+            stored = record.get("checksums")
+            computed = _checksum_tree({"header": record.get("header"), "payload": record.get("payload")})
+            if stored != computed:
+                bad = sorted(
+                    set(stored or {}).symmetric_difference(computed)
+                    | {k for k in (stored or {}) if k in computed and stored[k] != computed[k]}
+                )
+                raise SnapshotCorruptionError(
+                    f"snapshot {path} failed checksum verification at leaf "
+                    f"{bad[0] if bad else '<manifest>'} — corrupt state refused"
+                )
+        return record["header"], record["payload"]
+
+    def latest_intact(self) -> Optional[Tuple[int, int]]:
+        """Newest ``(step, world_size)`` whose snapshot group is complete and
+        verifies, or None."""
+        for (step, world), files in sorted(self._scan().items(), reverse=True):
+            try:
+                self._verify_group(step, world, files, keep=frozenset(), force_full=True)
+            except SnapshotError:
+                continue
+            return step, world
+        return None
+
+    def _verify_group(
+        self, step: int, world: int, files: Dict[int, str], keep: Any, force_full: bool = False
+    ) -> Tuple[Dict[int, Dict[str, Any]], Dict[int, Dict[str, Any]]]:
+        """Group intactness check → ``(headers, payloads)`` dicts keyed by
+        old rank, with payloads retained only for the ``keep`` ranks
+        (unassigned payloads are checksummed and dropped under 'full'
+        verification, or not read at all under 'assigned' — restore memory
+        is O(assigned share) either way; see ``group_verification`` in the
+        constructor for the read-cost/consistency trade-off)."""
+        missing = sorted(set(range(world)) - set(files))
+        if missing:
+            raise SnapshotError(
+                f"snapshot step {step} incomplete: missing rank file(s) {missing} of world {world}"
+            )
+        full = force_full or self.group_verification == "full"
+        headers: Dict[int, Dict[str, Any]] = {}
+        payloads: Dict[int, Dict[str, Any]] = {}
+        for r in range(world):
+            # old rank 0's header always loads: it carries the reduced flag
+            if full or r in keep or r == 0:
+                header, payload = self.load_file(files[r])
+                headers[r] = header
+                if r in keep:
+                    payloads[r] = payload
+            elif os.path.getsize(files[r]) == 0:
+                raise SnapshotCorruptionError(f"snapshot {files[r]} is empty — torn write")
+        return headers, payloads
+
+    def restore(self, obj: Any, rank: int = 0, world_size: int = 1) -> Dict[str, Any]:
+        """Restore ``obj`` from the newest intact snapshot group.
+
+        Corrupt or incomplete groups are skipped (loud warning + a
+        ``snapshot_fallback`` event in ``metrics_tpu.health_report()``) in
+        favor of the next older intact group; when no intact group remains,
+        the newest group's error re-raises, naming the snapshot. Returns an
+        info dict: ``{"step", "old_world", "world_size", "merged_ranks",
+        "reduced", "fallbacks"}``.
+        """
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} outside world of size {world_size}")
+        first_err: Optional[SnapshotError] = None
+        fallbacks = 0
+        for (step, world), files in sorted(self._scan().items(), reverse=True):
+            # keep=assigned covers reduced groups too: reduced implies
+            # world==1, whose only payload (old rank 0) maps to new rank 0's
+            # assignment; every other rank resets without reading a payload
+            assigned = [o for o in range(world) if (o * world_size) // world == rank]
+            try:
+                headers, payloads = self._verify_group(step, world, files, keep=set(assigned))
+            except SnapshotError as err:
+                if first_err is None:
+                    first_err = err
+                fallbacks += 1
+                warnings.warn(
+                    f"SnapshotManager: skipping snapshot step {step} ({err}); "
+                    "falling back to the next older snapshot",
+                    UserWarning,
+                )
+                from metrics_tpu.resilience.health import record_degradation
+
+                record_degradation("snapshot_fallback", str(err), step=step, directory=self.directory)
+                continue
+            info = self._restore_group(obj, step, world, headers, payloads, assigned, rank, world_size)
+            info["fallbacks"] = fallbacks
+            return info
+        if first_err is not None:
+            raise first_err
+        raise SnapshotError(f"no {self.tag!r} snapshots found under {self.directory}")
+
+    def _restore_group(
+        self,
+        obj: Any,
+        step: int,
+        old_world: int,
+        headers: Dict[int, Dict[str, Any]],
+        payloads: Dict[int, Dict[str, Any]],
+        assigned: List[int],
+        rank: int,
+        world_size: int,
+    ) -> Dict[str, Any]:
+        reduced = bool(headers[0].get("reduced"))
+        info = {
+            "step": step,
+            "old_world": old_world,
+            "world_size": world_size,
+            "reduced": reduced,
+            "merged_ranks": [],
+        }
+        if reduced:
+            # globally reduced state: rank 0 carries it, everyone else is the
+            # reduction identity, so the next sync reproduces the global value
+            if rank == 0:
+                obj.load_snapshot_state(payloads[0])
+                info["merged_ranks"] = [0]
+            else:
+                obj.reset()
+            return info
+        # `assigned` is the contiguous partition of old ranks over new ranks
+        # (preserves rank order under later cat-style syncs): old rank o ->
+        # new rank floor(o * world_size / old_world)
+        info["merged_ranks"] = assigned
+        # non-divisible worlds break the unweighted mean GLOBALLY, so every
+        # rank must warn — including one whose own share is a single old
+        # rank (its local merge is trivially exact, the synced value isn't).
+        # Grown worlds are subsumed: old_world % world_size == old_world != 0
+        if old_world % world_size != 0 and _has_mean_state(obj):
+            # the unweighted-over-ranks mean the live sync computes survives
+            # an elastic hop only for equal partitions: uneven shrink merges
+            # unequal-weight partition means, and a GROWN world is worse —
+            # share-less ranks reset to defaults, and there is no identity
+            # element for an unweighted mean, so the next sync dilutes the
+            # value. Loud, because the drift is otherwise silent
+            warnings.warn(
+                f"SnapshotManager: restoring 'mean'-reduced state from world {old_world} onto "
+                f"world {world_size}: merged means are approximate (exact only when the new "
+                "world size divides the saved one). Prefer sum+count states over 'mean' for "
+                "elastic jobs.",
+                UserWarning,
+            )
+            from metrics_tpu.resilience.health import record_degradation
+
+            record_degradation(
+                "snapshot_mean_approx",
+                f"elastic restore {old_world}->{world_size} with 'mean'-reduced state",
+                step=step,
+            )
+        if not assigned:
+            obj.reset()  # a grown world: this new rank starts from defaults
+        elif len(assigned) == 1 and old_world == world_size:
+            obj.load_snapshot_state(payloads[assigned[0]])  # bit-identical path
+        else:
+            obj.load_snapshot_state(_merge_payloads(obj, [payloads[o] for o in assigned]))
+        return info

@@ -13,6 +13,13 @@ Array = jax.Array
 class WordInfoPreserved(Metric):
     """Word information preserved over accumulated transcript pairs.
 
+    .. note::
+        ``higher_is_better`` is **True** here; the reference flags it False.
+        Preserved information is a similarity — higher is better — so the
+        reference flag reads as a bug (PARITY.md "Class behavior-flag
+        divergences"). ``MetricTracker.best_metric`` users porting reference
+        code: this build's default direction is maximize.
+
     Example:
         >>> from metrics_tpu import WordInfoPreserved
         >>> metric = WordInfoPreserved()

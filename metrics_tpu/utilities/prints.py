@@ -13,6 +13,15 @@ log = logging.getLogger("metrics_tpu")
 
 def _get_rank() -> int:
     try:
+        from metrics_tpu.utilities.backend import backend_is_initialized
+
+        if not backend_is_initialized():
+            # ``jax.process_index()`` initializes backends as a side effect;
+            # a *warning* path must never be the thing that dials a wedged
+            # TPU plugin (hang-proof bootstrap, resilience subsystem). With
+            # no backend up there is no multi-process runtime to be
+            # non-zero-rank in.
+            return 0
         import jax
 
         return jax.process_index()

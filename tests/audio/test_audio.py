@@ -36,9 +36,9 @@ PREDS_C = (TARGET + 0.3 * np.random.randn(4, 3, 500)).astype(np.float32)
 
 
 def _ref_audio(name):
+    ref = import_reference()  # skips when absent; a successful import implies torch
     import torch
 
-    ref = import_reference()
     fn = getattr(ref.functional, name)
 
     def oracle(*arrays, **kwargs):
@@ -145,9 +145,9 @@ class TestPIT(MetricTester):
     PIT_TARGET = np.random.randn(3, 4, 2, 100).astype(np.float32)
 
     def _ref_pit(self, p, t, spk=None):
+        ref = import_reference()  # skips when absent; a successful import implies torch
         import torch
 
-        ref = import_reference()
         best, _ = ref.functional.permutation_invariant_training(
             torch.from_numpy(np.asarray(p)), torch.from_numpy(np.asarray(t)),
             ref.functional.scale_invariant_signal_distortion_ratio, "max",

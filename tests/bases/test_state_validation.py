@@ -48,10 +48,32 @@ class TestLoadStateDictValidation:
         # wrong container type
         with pytest.raises(ValueError, match="'preds'.*CatBuffer"):
             m.load_state_dict({"preds": np.zeros((8,))})
-        # wrong slot capacity
-        with pytest.raises(ValueError, match="'preds'.*slot 'data'"):
+        # inconsistent slots: data capacity must match mask length (a ring
+        # may load at a DIFFERENT capacity — sync/elastic restore produce
+        # grown union buffers — but the pair must agree)
+        with pytest.raises(ValueError, match="'preds'.*mask length"):
             m.load_state_dict(
                 {"preds": {"data": np.zeros((4,), np.float32), "mask": np.zeros((8,), bool), "dropped": 0}}
+            )
+        # consistent different capacity loads fine (elastic restore contract)
+        # — but ALL lockstep rings must move together: preds/target pair
+        # rows positionally, so growing one alone refuses
+        with pytest.raises(ValueError, match="different capacities"):
+            m.load_state_dict(
+                {"preds": {"data": np.zeros((16,), np.float32), "mask": np.zeros((16,), bool), "dropped": 0}}
+            )
+        m.load_state_dict(
+            {
+                "preds": {"data": np.zeros((16,), np.float32), "mask": np.zeros((16,), bool), "dropped": 0},
+                "target": {"data": np.zeros((16,), np.int32), "mask": np.zeros((16,), bool), "dropped": 0},
+            }
+        )
+        assert m._state["preds"].capacity == 16 and m._state["target"].capacity == 16
+        # wrong ROW shape still refuses regardless of capacity
+        m2 = mt.AUROC(capacity=8, num_classes=3)
+        with pytest.raises(ValueError, match="'preds'.*shape"):
+            m2.load_state_dict(
+                {"preds": {"data": np.zeros((8, 5), np.float32), "mask": np.zeros((8,), bool), "dropped": 0}}
             )
         # float data loaded into the int32 target ring
         with pytest.raises(ValueError, match="'target'.*slot 'data'.*dtype"):

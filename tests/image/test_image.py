@@ -45,9 +45,9 @@ TARGET_UNCORR = _rng.random((2, 3, 32, 32), dtype=np.float32)
 
 def _ref_image_fn(name):
     """Fetch a functional metric from the reference as a numpy->float oracle."""
+    ref = import_reference()  # skips when absent; a successful import implies torch
     import torch
 
-    ref = import_reference()
     fn = getattr(ref.functional, name)
 
     def _to_np(out):

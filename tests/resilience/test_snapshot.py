@@ -1,0 +1,508 @@
+"""Crash-safe elastic snapshots (``metrics_tpu/resilience/snapshot.py``).
+
+Covers the ISSUE-3 acceptance criteria: a snapshot interrupted mid-write is
+detected (checksum/torn-pickle) and the previous snapshot restores with
+``compute()`` equal to its pre-crash value; per-rank state saved on an
+8-way world restores on 4 and 1 with value parity for sum-, cat-, and
+minmax-state metrics plus FaultCounters; corrupted-checksum and
+future-schema-version loads raise naming the snapshot.
+"""
+import os
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.resilience.health import registry
+from metrics_tpu.resilience.snapshot import (
+    MAGIC,
+    SCHEMA_VERSION,
+    SnapshotCorruptionError,
+    SnapshotError,
+    SnapshotManager,
+    SnapshotSchemaError,
+)
+
+N = 64
+_rng = np.random.default_rng(7)
+# integer-valued scores/labels: float reductions stay exact, so elastic
+# parity asserts can demand bit equality, not just allclose
+SCORES = (_rng.integers(0, 100, N) / 100.0).astype(np.float32)
+LABELS = _rng.integers(0, 2, N).astype(np.int32)
+SHARDS = np.split(np.arange(N), 8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry.clear()
+    yield
+    registry.clear()
+
+
+def _feed(metric, rows):
+    metric.update(jnp.asarray(SCORES[rows]), jnp.asarray(LABELS[rows]))
+    return metric
+
+
+class TestCrashRecovery:
+    def test_partial_write_falls_back_to_previous_intact(self, tmp_path):
+        """The acceptance crash-sim: snapshot B's write is torn mid-file
+        (what a SIGKILL between write and rename durability leaves behind if
+        the rename raced through); restore detects it and falls back to A,
+        whose compute() equals its pre-crash value."""
+        mgr = SnapshotManager(tmp_path, keep=3)
+        m = _feed(mt.Accuracy(), np.arange(32))
+        pre_crash_value = float(m.compute())
+        mgr.save(m, step=1)
+
+        _feed(m, np.arange(32, 64))
+        path_b = mgr.save(m, step=2)
+        blob = open(path_b, "rb").read()
+        with open(path_b, "wb") as f:  # torn: only half the bytes landed
+            f.write(blob[: len(blob) // 2])
+
+        fresh = mt.Accuracy()
+        with pytest.warns(UserWarning, match="falling back"):
+            info = mgr.restore(fresh)
+        assert info["step"] == 1 and info["fallbacks"] == 1
+        assert float(fresh.compute()) == pre_crash_value
+        events = registry.events("snapshot_fallback")
+        assert len(events) == 1 and events[0]["details"]["step"] == 2
+
+    def test_sigkill_leaves_only_tmp_file_previous_restores(self, tmp_path):
+        """A SIGKILL before ``os.replace`` leaves a ``.tmp`` sibling and no
+        final file — the normal crash shape. The tmp file is ignored and the
+        previous snapshot restores cleanly (no fallback: step 2 never
+        existed as a snapshot)."""
+        mgr = SnapshotManager(tmp_path, keep=3)
+        m = _feed(mt.Accuracy(), np.arange(16))
+        value_a = float(m.compute())
+        path_a = mgr.save(m, step=1)
+        half_blob = open(path_a, "rb").read()[:100]
+        with open(os.path.join(tmp_path, mgr._filename(2, 0, 1) + ".tmp.12345"), "wb") as f:
+            f.write(half_blob)
+
+        fresh = mt.Accuracy()
+        info = mgr.restore(fresh)
+        assert info["step"] == 1 and info["fallbacks"] == 0
+        assert float(fresh.compute()) == value_a
+
+    def test_corrupted_checksum_raises_naming_snapshot(self, tmp_path):
+        """A bit-flip that keeps the pickle decodable (leaf mutated, stored
+        digests untouched) fails checksum verification, naming file + leaf."""
+        mgr = SnapshotManager(tmp_path)
+        path = mgr.save(_feed(mt.Accuracy(), np.arange(16)), step=1)
+        record = pickle.load(open(path, "rb"))
+        key = next(iter(record["payload"]["states"]))
+        record["payload"]["states"][key] = np.asarray(record["payload"]["states"][key]) + 1
+        with open(path, "wb") as f:
+            pickle.dump(record, f)
+
+        with pytest.raises(SnapshotCorruptionError, match="checksum") as err:
+            mgr.load_file(path)
+        assert os.path.basename(path) in str(err.value)
+        # the only snapshot is corrupt -> restore re-raises it, still naming the file
+        with pytest.warns(UserWarning, match="falling back"):
+            with pytest.raises(SnapshotCorruptionError, match=os.path.basename(path)):
+                mgr.restore(mt.Accuracy())
+
+    def test_future_schema_version_raises_naming_snapshot(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        path = mgr.save(_feed(mt.Accuracy(), np.arange(16)), step=1)
+        record = pickle.load(open(path, "rb"))
+        record["schema_version"] = SCHEMA_VERSION + 1
+        with open(path, "wb") as f:
+            pickle.dump(record, f)
+        with pytest.raises(SnapshotSchemaError, match=os.path.basename(path)):
+            mgr.load_file(path)
+
+    def test_missing_magic_is_corruption(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        path = os.path.join(tmp_path, mgr._filename(1, 0, 1))
+        with open(path, "wb") as f:
+            pickle.dump({"something": "else"}, f)
+        with pytest.raises(SnapshotCorruptionError, match=MAGIC):
+            mgr.load_file(path)
+
+    def test_empty_directory_raises(self, tmp_path):
+        with pytest.raises(SnapshotError, match="no 'metrics' snapshots"):
+            SnapshotManager(tmp_path).restore(mt.Accuracy())
+
+    def test_rolling_retention(self, tmp_path):
+        mgr = SnapshotManager(tmp_path, keep=3)
+        m = _feed(mt.Accuracy(), np.arange(16))
+        for step in range(1, 6):
+            mgr.save(m, step=step)
+        assert mgr.steps() == [3, 4, 5]
+        # newest survivor still restores
+        assert mgr.restore(mt.Accuracy())["step"] == 5
+
+
+def _guarded_accuracy():
+    m = mt.Accuracy(on_invalid="drop")
+    return m
+
+
+def _poisoned(scores: np.ndarray) -> np.ndarray:
+    out = scores.copy()
+    out[0] = np.nan  # one fault per shard -> 8 global faults
+    return out
+
+
+class TestElasticRestore:
+    """8-rank per-rank saves restore at world 1 / 4 / 16 with value parity
+    (bit-equal here: integer-valued inputs make float reductions exact)."""
+
+    BUILDERS = {
+        "sum_state": (mt.Accuracy, lambda m, rows: m.update(jnp.asarray(SCORES[rows]), jnp.asarray(LABELS[rows]))),
+        "cat_ring_state": (
+            lambda: mt.AUROC(capacity=N),
+            lambda m, rows: m.update(jnp.asarray(SCORES[rows]), jnp.asarray(LABELS[rows])),
+        ),
+        "cat_list_state": (mt.CatMetric, lambda m, rows: m.update(jnp.asarray(SCORES[rows]))),
+        "min_state": (mt.MinMetric, lambda m, rows: m.update(jnp.asarray(SCORES[rows]))),
+        "max_state": (mt.MaxMetric, lambda m, rows: m.update(jnp.asarray(SCORES[rows]))),
+    }
+
+    def _save_8(self, tmp_path, build, feed):
+        mgr = SnapshotManager(tmp_path)
+        for rank in range(8):
+            m = build()
+            feed(m, SHARDS[rank])
+            mgr.save(m, step=10, rank=rank, world_size=8)
+        return mgr
+
+    @pytest.mark.parametrize("kind", sorted(BUILDERS))
+    def test_8_to_1(self, tmp_path, kind):
+        build, feed = self.BUILDERS[kind]
+        full = build()
+        feed(full, np.arange(N))
+        expect = np.asarray(full.compute())
+        mgr = self._save_8(tmp_path, build, feed)
+        restored = build()
+        info = mgr.restore(restored, rank=0, world_size=1)
+        assert info["old_world"] == 8 and info["merged_ranks"] == list(range(8))
+        assert np.array_equal(np.asarray(restored.compute()), expect)
+
+    @pytest.mark.parametrize("kind", sorted(BUILDERS))
+    def test_8_to_4_to_1(self, tmp_path, kind):
+        """Two elastic hops: 8 partials merged to 4, re-saved per-rank at
+        world 4, merged to 1 — the preempted-and-downsized-twice job."""
+        build, feed = self.BUILDERS[kind]
+        full = build()
+        feed(full, np.arange(N))
+        expect = np.asarray(full.compute())
+        mgr8 = self._save_8(tmp_path / "w8", build, feed)
+        mgr4 = SnapshotManager(tmp_path / "w4")
+        for rank in range(4):
+            m = build()
+            info = mgr8.restore(m, rank=rank, world_size=4)
+            assert info["merged_ranks"] == [2 * rank, 2 * rank + 1]
+            mgr4.save(m, step=11, rank=rank, world_size=4)
+        restored = build()
+        mgr4.restore(restored, rank=0, world_size=1)
+        assert np.array_equal(np.asarray(restored.compute()), expect)
+
+    def test_8_to_16_grown_world(self, tmp_path):
+        """World grows: half the new ranks get one old partial each, the
+        other half reset to defaults; the global sum is preserved."""
+        build, feed = self.BUILDERS["sum_state"]
+        mgr = self._save_8(tmp_path, build, feed)
+        parts = []
+        for rank in range(16):
+            m = build()
+            info = mgr.restore(m, rank=rank, world_size=16)
+            assert len(info["merged_ranks"]) in (0, 1)
+            parts.append(m)
+        total_correct = sum(int(np.asarray(m._state["tp"]).sum()) for m in parts if m.update_count)
+        full = build()
+        feed(full, np.arange(N))
+        assert total_correct == int(np.asarray(full._state["tp"]).sum())
+
+    def test_fault_counters_merge_as_sum(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        for rank in range(8):
+            m = _guarded_accuracy()
+            m.update(jnp.asarray(_poisoned(SCORES[SHARDS[rank]])), jnp.asarray(LABELS[SHARDS[rank]]))
+            mgr.save(m, step=1, rank=rank, world_size=8)
+        restored = _guarded_accuracy()
+        mgr.restore(restored, rank=0, world_size=1)
+        counts = restored.fault_counts
+        assert counts["nonfinite_preds"] == 8 and counts["dropped_rows"] == 8
+
+    def test_update_continues_after_elastic_restore(self, tmp_path):
+        """The merged CatBuffer is compacted, so post-restore appends land in
+        fresh slots instead of overwriting union rows."""
+        mgr = self._save_8(
+            tmp_path, lambda: mt.CatMetric(capacity=2 * N), lambda m, rows: m.update(jnp.asarray(SCORES[rows]))
+        )
+        restored = mt.CatMetric(capacity=2 * N)
+        mgr.restore(restored, rank=0, world_size=1)
+        restored.update(jnp.asarray([7.0, 9.0]))
+        out = np.asarray(restored.compute())  # (capacity,) with invalid slots NaN
+        got = np.sort(out[~np.isnan(out)])
+        expect = np.sort(np.concatenate([SCORES, [7.0, 9.0]]))
+        assert np.array_equal(got, expect.astype(got.dtype))
+
+
+class _MeanStateMetric(mt.Metric):
+    """Minimal user metric with a 'mean'-reduced state (no library metric
+    registers one; the reduction exists for user subclasses)."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, value):
+        self.avg = self.avg + jnp.asarray(value).mean()
+
+    def compute(self):
+        return self.avg
+
+
+class TestUnevenMeanRestore:
+    def _save_8(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        for rank in range(8):
+            m = _MeanStateMetric()
+            m.update(jnp.asarray(float(rank)))
+            mgr.save(m, step=1, rank=rank, world_size=8)
+        return mgr
+
+    def test_divisible_world_is_exact_and_silent(self, tmp_path):
+        mgr = self._save_8(tmp_path)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            m = _MeanStateMetric()
+            mgr.restore(m, rank=0, world_size=4)  # equal partitions: exact
+        assert float(m.compute()) == 0.5  # mean(0, 1)
+
+    def test_uneven_world_warns_and_records(self, tmp_path):
+        mgr = self._save_8(tmp_path)
+        with pytest.warns(UserWarning, match="approximate"):
+            mgr.restore(_MeanStateMetric(), rank=0, world_size=3)
+        assert registry.events("snapshot_mean_approx")
+
+    def test_uneven_world_without_mean_state_is_silent(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        for rank in range(8):
+            m = _feed(mt.Accuracy(), SHARDS[rank])
+            mgr.save(m, step=1, rank=rank, world_size=8)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            mgr.restore(mt.Accuracy(), rank=0, world_size=3)
+
+    def test_single_share_rank_also_warns_on_uneven_mean(self, tmp_path):
+        """World 3 -> 2: rank 1's share is one old rank (its local merge is
+        trivially exact), but the SYNCED value is still approximate — every
+        rank must warn, or rank 1's health_report claims healthy during a
+        globally approximate restore."""
+        mgr = SnapshotManager(tmp_path)
+        for rank in range(3):
+            m = _MeanStateMetric()
+            m.update(jnp.asarray(float(rank)))
+            mgr.save(m, step=1, rank=rank, world_size=3)
+        with pytest.warns(UserWarning, match="approximate"):
+            mgr.restore(_MeanStateMetric(), rank=1, world_size=2)
+        assert registry.events("snapshot_mean_approx")
+
+    def test_grown_world_with_mean_state_warns(self, tmp_path):
+        """W' > W has no identity element for an unweighted mean: share-less
+        ranks reset to defaults and the next sync dilutes the value — every
+        rank must hear about it."""
+        mgr = SnapshotManager(tmp_path)
+        m = _MeanStateMetric()
+        m.update(jnp.asarray(4.0))
+        mgr.save(m, step=1, rank=0, world_size=1)
+        for rank in range(2):
+            with pytest.warns(UserWarning, match="approximate"):
+                mgr.restore(_MeanStateMetric(), rank=rank, world_size=2)
+
+
+class TestVerificationScope:
+    def _save_8(self, tmp_path, **kwargs):
+        mgr = SnapshotManager(tmp_path, **kwargs)
+        for rank in range(8):
+            mgr.save(_feed(mt.Accuracy(), SHARDS[rank]), step=1, rank=rank, world_size=8)
+        return mgr
+
+    def test_full_mode_catches_unassigned_corruption(self, tmp_path):
+        mgr = self._save_8(tmp_path)
+        bad = os.path.join(tmp_path, mgr._filename(1, 7, 8))
+        blob = open(bad, "rb").read()
+        open(bad, "wb").write(blob[:50])
+        # rank 0's share (old ranks 0..3) is intact, but full verification
+        # still refuses the group — all ranks fall back identically
+        with pytest.raises(SnapshotError):
+            with pytest.warns(UserWarning):
+                mgr.restore(mt.Accuracy(), rank=0, world_size=2)
+
+    def test_assigned_mode_reads_only_its_share(self, tmp_path):
+        mgr = self._save_8(tmp_path, group_verification="assigned")
+        bad = os.path.join(tmp_path, mgr._filename(1, 7, 8))
+        blob = open(bad, "rb").read()
+        open(bad, "wb").write(blob[:50])
+        # old rank 7 is NOT in new rank 0's share (old ranks 0..3): the
+        # corrupt file is presence-checked only, and the restore succeeds
+        m = mt.Accuracy()
+        info = mgr.restore(m, rank=0, world_size=2)
+        assert info["merged_ranks"] == [0, 1, 2, 3]
+        full = _feed(mt.Accuracy(), np.concatenate(SHARDS[:4]))
+        assert float(m.compute()) == float(full.compute())
+        # ...but corruption INSIDE the share is still refused
+        with pytest.raises(SnapshotError):
+            with pytest.warns(UserWarning):
+                mgr.restore(mt.Accuracy(), rank=1, world_size=2)
+
+    def test_bad_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="group_verification"):
+            SnapshotManager(tmp_path, group_verification="none")
+
+
+class TestRingPairingGuards:
+    def test_mismatched_lockstep_ring_capacities_refused(self):
+        """preds/target rings pair rows positionally — a partial load that
+        grows one ring but not the other must refuse, naming the loader."""
+        m = mt.AUROC(capacity=8)
+        with pytest.raises(ValueError, match="load_state_dict.*capacities"):
+            m.load_state_dict(
+                {"preds": {"data": np.zeros((16,), np.float32), "mask": np.zeros((16,), bool), "dropped": 0}}
+            )
+
+    def test_snapshot_errors_name_load_snapshot_state(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        mgr.save(_feed(mt.AUROC(capacity=N), np.arange(16)), step=1)
+        target = mt.AUROC(capacity=N, num_classes=3)  # row shape (3,) != saved ()
+        with pytest.raises(ValueError, match="load_snapshot_state"):
+            mgr.restore(target)
+
+
+class TestTopologyAndCollections:
+    def test_reduced_snapshot_loads_on_rank0_only(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        m = _feed(mt.Accuracy(), np.arange(N))
+        value = float(m.compute())
+        mgr.save(m, step=1, reduced=True)
+        r0, r1 = mt.Accuracy(), _feed(mt.Accuracy(), np.arange(8))
+        assert mgr.restore(r0, rank=0, world_size=4)["reduced"] is True
+        assert float(r0.compute()) == value
+        mgr.restore(r1, rank=1, world_size=4)
+        assert r1.update_count == 0  # reset to defaults: the reduction identity
+
+    def test_reduced_requires_world_size_1(self, tmp_path):
+        with pytest.raises(ValueError, match="world_size=1"):
+            SnapshotManager(tmp_path).save(mt.Accuracy(), step=1, rank=1, world_size=2, reduced=True)
+
+    def test_collection_roundtrip_with_header_metadata(self, tmp_path):
+        coll = mt.MetricCollection(
+            {
+                "auroc": mt.AUROC(capacity=N, on_invalid="drop"),
+                "acc": mt.Accuracy(on_invalid="drop"),
+                "mean": mt.MeanMetric(),
+            }
+        )
+        coll["auroc"].update(jnp.asarray(_poisoned(SCORES)), jnp.asarray(LABELS))
+        coll["acc"].update(jnp.asarray(SCORES), jnp.asarray(LABELS))
+        coll["mean"].update(jnp.asarray(SCORES))
+        values = {k: np.asarray(v) for k, v in coll.compute().items()}
+
+        mgr = SnapshotManager(tmp_path, tag="train")
+        path = mgr.save(coll, step=3, mesh_axes={"data": 8}, extra={"epoch": 2})
+        header, _ = mgr.load_file(path)
+        assert header["mesh_axes"] == {"data": 8} and header["extra"] == {"epoch": 2}
+        assert header["world_size"] == 1 and header["reduced"] is False
+
+        fresh = mt.MetricCollection(
+            {
+                "auroc": mt.AUROC(capacity=N, on_invalid="drop"),
+                "acc": mt.Accuracy(on_invalid="drop"),
+                "mean": mt.MeanMetric(),
+            }
+        )
+        mgr.restore(fresh)
+        for k, v in fresh.compute().items():
+            assert np.array_equal(np.asarray(v), values[k]), k
+        assert fresh["auroc"].fault_counts["nonfinite_preds"] == 1
+
+    def test_wrapper_children_snapshot_recursively(self, tmp_path):
+        wrapped = mt.MinMaxMetric(mt.MeanMetric())
+        wrapped.update(jnp.asarray([1.0, 3.0]))
+        wrapped.update(jnp.asarray([5.0, 7.0]))
+        expect = {k: float(v) for k, v in wrapped.compute().items()}
+        mgr = SnapshotManager(tmp_path)
+        mgr.save(wrapped, step=1)
+        fresh = mt.MinMaxMetric(mt.MeanMetric())
+        mgr.restore(fresh)
+        assert {k: float(v) for k, v in fresh.compute().items()} == expect
+
+    def test_merge_path_refuses_unknown_state_like_direct_load(self, tmp_path):
+        """A config-mismatch restore must refuse on the MERGE path too:
+        guarded partials (with a _faults state) restored into an unguarded
+        metric would otherwise silently lose the fault evidence."""
+        mgr = SnapshotManager(tmp_path)
+        for rank in range(2):
+            m = mt.Accuracy(on_invalid="drop")
+            m.update(jnp.asarray([0.9, float("nan")]), jnp.asarray([1, 0]))
+            mgr.save(m, step=1, rank=rank, world_size=2)
+        with pytest.raises(ValueError, match="_faults"):
+            mgr.restore(mt.Accuracy(), rank=0, world_size=1)  # unguarded target
+
+    def test_header_bit_flip_fails_checksum(self, tmp_path):
+        """Integrity covers the header: a flipped `reduced` flag would change
+        restore SEMANTICS (load-on-rank-0-only), not just values."""
+        mgr = SnapshotManager(tmp_path)
+        path = mgr.save(_feed(mt.Accuracy(), np.arange(16)), step=1)
+        record = pickle.load(open(path, "rb"))
+        record["header"]["reduced"] = True
+        with open(path, "wb") as f:
+            pickle.dump(record, f)
+        with pytest.raises(SnapshotCorruptionError, match="header"):
+            mgr.load_file(path)
+
+    def test_unknown_state_key_raises_naming_it(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        mgr.save(_feed(mt.AUROC(capacity=N), np.arange(16)), step=1)
+        with pytest.raises(ValueError, match="unknown state"):
+            mgr.restore(mt.MeanSquaredError())
+
+    def test_rejected_collection_restore_is_transactional(self, tmp_path):
+        """A failing member must leave the WHOLE collection untouched —
+        a half-restored collection silently mixes epochs."""
+        src = mt.MetricCollection({"acc": mt.Accuracy(), "auroc": mt.AUROC(capacity=N)})
+        src["acc"].update(jnp.asarray(SCORES), jnp.asarray(LABELS))
+        src["auroc"].update(jnp.asarray(SCORES), jnp.asarray(LABELS))
+        mgr = SnapshotManager(tmp_path)
+        mgr.save(src, step=1)
+        # target's auroc has a different row shape -> its member payload is
+        # rejected; acc (alphabetically first) must NOT have been committed
+        target = mt.MetricCollection({"acc": mt.Accuracy(), "auroc": mt.AUROC(capacity=N, num_classes=3)})
+        with pytest.raises(ValueError, match="load_snapshot_state"):
+            mgr.restore(target)
+        assert target["acc"].update_count == 0
+        assert not np.asarray(target["acc"]._state["tp"]).any()
+
+    def test_snapshot_attr_override_warns(self, tmp_path):
+        """An attr that is both ctor config and data-downgradable (e.g.
+        subset flags / num_classes) restores to the snapshot's value —
+        loudly when it differs from the live instance's configuration."""
+        src = mt.PrecisionRecallCurve(num_classes=2)
+        src.update(jnp.asarray(np.tile(np.asarray([[0.7, 0.3]], np.float32), (8, 1))), jnp.asarray(LABELS[:8]))
+        mgr = SnapshotManager(tmp_path)
+        mgr.save(src, step=1)
+        target = mt.PrecisionRecallCurve(num_classes=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            mgr.restore(target)  # same config: silent
+        target2 = mt.PrecisionRecallCurve(num_classes=3)
+        with pytest.warns(UserWarning, match="overriding num_classes=3"):
+            mgr.restore(target2)
+        assert target2.num_classes == 2
+
+    def test_unknown_collection_member_raises_naming_it(self, tmp_path):
+        mgr = SnapshotManager(tmp_path)
+        mgr.save(mt.MetricCollection({"acc": mt.Accuracy()}), step=1)
+        with pytest.raises(ValueError, match="'acc'"):
+            mgr.restore(mt.MetricCollection({"mse": mt.MeanSquaredError()}))

@@ -1,0 +1,57 @@
+"""Steering to the compiled retrieval path (VERDICT r5 #8): `capacity=`
+auto-selects the compiled grouped compute, and the host-grouped eager
+default warns once per class at large N."""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.retrieval import base as retrieval_base
+
+
+@pytest.fixture(autouse=True)
+def _reset_warn_once(monkeypatch):
+    monkeypatch.setattr(retrieval_base, "_host_grouped_warned", set())
+    # keep the test fast: a tiny threshold instead of 50k real rows
+    monkeypatch.setattr(retrieval_base, "_HOST_GROUPED_WARN_N", 32)
+
+
+def _feed(metric, n=64, queries=8):
+    rng = np.random.default_rng(3)
+    metric.update(
+        jnp.asarray(rng.random(n, dtype=np.float32)),
+        jnp.asarray((rng.random(n) < 0.5).astype(np.int32)),
+        indexes=jnp.asarray(rng.integers(0, queries, n).astype(np.int32)),
+    )
+
+
+def test_capacity_auto_selects_compiled_grouped_compute():
+    m = mt.RetrievalMAP(capacity=64, num_queries=8)
+    assert m.jittable_update and m.jittable_compute
+    _feed(m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # compiled path must not warn
+        float(m.compute())
+
+
+def test_host_grouped_eager_warns_once_per_class_at_large_n():
+    m = mt.RetrievalMAP()
+    _feed(m)
+    with pytest.warns(UserWarning, match="host-grouped eager path"):
+        v1 = float(m.compute())
+    m2 = mt.RetrievalMAP()
+    _feed(m2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # second instance: already warned
+        assert float(m2.compute()) == v1
+
+
+def test_small_n_does_not_warn():
+    retrieval_base._HOST_GROUPED_WARN_N = 1_000_000
+    m = mt.RetrievalRecall()
+    _feed(m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        float(m.compute())

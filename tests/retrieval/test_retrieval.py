@@ -205,11 +205,10 @@ def _ragged_fixture(seed=5, n_queries=37, binary=True):
     ],
 )
 def test_ragged_parity_vs_reference(cls, ref_name, kwargs, binary):
-    import torch
-
     from tests.helpers.reference import import_reference
 
-    ref = import_reference()
+    ref = import_reference()  # skips when absent; a successful import implies torch
+    import torch
     idx, preds, target = _ragged_fixture(binary=binary)
 
     m = cls(**kwargs)
@@ -224,11 +223,10 @@ def test_ragged_parity_vs_reference(cls, ref_name, kwargs, binary):
 
 @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
 def test_ragged_pr_curve_vs_reference(action):
-    import torch
-
     from tests.helpers.reference import import_reference
 
-    ref = import_reference()
+    ref = import_reference()  # skips when absent; a successful import implies torch
+    import torch
     idx, preds, target = _ragged_fixture()
     m = RetrievalPrecisionRecallCurve(max_k=10, empty_target_action=action)
     ref_m = ref.RetrievalPrecisionRecallCurve(max_k=10, empty_target_action=action)
