@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun test-resilience
+.PHONY: test doctest bench dryrun test-resilience test-streaming
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -40,3 +40,8 @@ dryrun:
 # Fast feedback on the resilience subsystem only (snapshots + bootstrap).
 test-resilience:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/resilience/ -q -p no:cacheprovider
+
+# Fast feedback on the streaming subsystem only (windowed/decayed wrappers +
+# mergeable sketches; same tests the `streaming` pytest marker selects).
+test-streaming:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/streaming/ -q -p no:cacheprovider

@@ -854,6 +854,115 @@ def _phase_detection(jax, platform) -> None:
         print(f"bench: detection failed: {err}", file=sys.stderr)
 
 
+def _phase_streaming(jax, platform) -> None:
+    """Streaming subsystem (ISSUE 4): the windowed wrapper's compiled
+    fused update+compute step vs the unwindowed baseline (budget: ≤10%
+    overhead — the window must be nearly free before it can be the default
+    serving view), and the QuantileSketch at the 1M-row scale the
+    acceptance pins (one update folding 1M rows, one sketch merge).
+
+    ``vs_baseline`` on ``windowed_step_ms`` is unwindowed/windowed time
+    (≥ 1/1.1 ≈ 0.909 = inside the 10% budget, matching the explicit
+    ``overhead > 0.10`` stderr flag below).
+    """
+    _stamp("streaming start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, QuantileSketch, WindowedMetric, functionalize
+
+    rng = np.random.default_rng(13)
+    iters = 16 if platform == "tpu" else 6
+
+    try:
+        B, C, window, buckets = 8192, 16, 65536, 8
+        preds = jnp.asarray(rng.random((B, C)), jnp.float32)
+        # target stays a HOST array: inside the on-device loop's trace it is
+        # a closure constant, and the canonicalizer's concrete-only checks
+        # (checks.py) must keep running eagerly on it (same as the guard phase)
+        target = rng.integers(0, C, B).astype(np.int32)
+
+        def mk_iter(mdef):
+            state0 = jax.jit(mdef.update)(mdef.init(), preds, jnp.asarray(target))
+
+            def it(carry):
+                st, acc = carry
+                st = mdef.update(st, preds + acc * 1e-30, target)
+                return st, acc + mdef.compute(st)
+
+            return it, (state0, jnp.asarray(0.0))
+
+        variants = {
+            "plain": functionalize(Accuracy(num_classes=C)),
+            "windowed": functionalize(
+                WindowedMetric(Accuracy(num_classes=C), window=window, buckets=buckets)
+            ),
+        }
+        # interleaved min-of-2 (BASELINE.md discipline): box jitter at this
+        # kernel size reads as wrapper overhead in a single A-then-B pass
+        times = {k: float("inf") for k in variants}
+        iter_fns = {k: mk_iter(mdef) for k, mdef in variants.items()}
+        for _ in range(2):
+            for k, (it, carry) in iter_fns.items():
+                times[k] = min(times[k], _device_loop_ms(jax, it, carry, iters))
+        overhead = times["windowed"] / times["plain"] - 1.0
+        _emit(
+            "windowed_step_ms",
+            round(times["windowed"], 4),
+            f"ms/step (update+compute, WindowedMetric(Accuracy) W={window} buckets={buckets}, "
+            f"B={B} C={C}, {platform}); unwindowed same data: {times['plain']:.4f} ms "
+            f"({overhead * 100:+.1f}% overhead)",
+            round(times["plain"] / times["windowed"], 3),
+        )
+        if overhead > 0.10:
+            print(
+                f"bench: STREAMING-OVERHEAD windowed step exceeds the 10% budget: "
+                f"{overhead * 100:.1f}%",
+                file=sys.stderr,
+            )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: streaming windowed failed: {err}", file=sys.stderr)
+
+    try:
+        n = 1_048_576
+        x = jnp.asarray(rng.random(n).astype(np.float32))
+        mdef = functionalize(QuantileSketch(eps=0.01))
+
+        def upd_iter(carry):
+            st, acc = carry
+            st = mdef.update(st, x + acc * 1e-30)
+            return st, acc + st["sketch"].n_seen.astype(jnp.float32) * 0.0 + 1.0
+
+        state0 = jax.jit(mdef.update)(mdef.init(), x)
+        t_upd = _device_loop_ms(jax, upd_iter, (state0, jnp.asarray(0.0)), max(2, iters // 2))
+        geom = state0["sketch"]
+        _emit(
+            "qsketch_update_ms",
+            round(t_upd, 3),
+            f"ms/update (QuantileSketch eps=0.01, 1M rows/batch, "
+            f"{geom.items.shape[0]}x{geom.items.shape[1]} levels, {platform})",
+        )
+
+        other = jax.jit(mdef.update)(mdef.init(), 1.0 - x)
+        # merge timing: jit the merge directly (carry-independent inputs
+        # would be hoisted out of a fori_loop, so time it as a plain call)
+        merge_fn = jax.jit(lambda a, b: a.sketch_merge(b))
+        merged = merge_fn(state0["sketch"], other["sketch"])
+        jax.block_until_ready(merged)
+        t_merge = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(merge_fn(state0["sketch"], other["sketch"]))
+            t_merge = min(t_merge, time.perf_counter() - t0)
+        _emit(
+            "qsketch_merge_ms",
+            round(t_merge * 1e3, 3),
+            f"ms/merge (two 1M-row QuantileSketch states, eps=0.01, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: streaming qsketch failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -865,6 +974,7 @@ _PHASES = {
     "guard": (_phase_guard, 300),
     "checkpoint": (_phase_checkpoint, 240),
     "sync": (_phase_sync, 150),
+    "streaming": (_phase_streaming, 300),
 }
 
 _HEADLINE_METRIC = "fused_collection_step_ms"

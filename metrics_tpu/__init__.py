@@ -92,6 +92,16 @@ from metrics_tpu.image import (  # noqa: E402
     UniversalImageQualityIndex,
 )
 from metrics_tpu.pure import MetricDef, bootstrap_functionalize, functionalize  # noqa: E402
+from metrics_tpu.streaming import (  # noqa: E402
+    CountMinSketch,
+    CountMinState,
+    DecayedMetric,
+    HllState,
+    HyperLogLog,
+    QuantileSketch,
+    QuantileSketchState,
+    WindowedMetric,
+)
 from metrics_tpu.utilities.guard import FAULT_CLASSES, FaultCounters  # noqa: E402
 from metrics_tpu.retrieval import (  # noqa: E402
     RetrievalFallOut,
@@ -163,7 +173,10 @@ __all__ = [
     "CompositionalMetric",
     "ConfusionMatrix",
     "CosineSimilarity",
+    "CountMinSketch",
+    "CountMinState",
     "CoverageError",
+    "DecayedMetric",
     "Dice",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "ExplainedVariance",
@@ -175,6 +188,8 @@ __all__ = [
     "FrechetInceptionDistance",
     "HammingDistance",
     "HingeLoss",
+    "HllState",
+    "HyperLogLog",
     "InceptionScore",
     "JaccardIndex",
     "KLDivergence",
@@ -204,6 +219,8 @@ __all__ = [
     "PermutationInvariantTraining",
     "Precision",
     "PrecisionRecallCurve",
+    "QuantileSketch",
+    "QuantileSketchState",
     "R2Score",
     "ROC",
     "ROUGEScore",
@@ -238,6 +255,7 @@ __all__ = [
     "TweedieDevianceScore",
     "UniversalImageQualityIndex",
     "WeightedMeanAbsolutePercentageError",
+    "WindowedMetric",
     "WordErrorRate",
     "WordInfoLost",
     "WordInfoPreserved",

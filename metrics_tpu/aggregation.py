@@ -10,6 +10,22 @@ branchless graph as ``'ignore'``, the NaN count accumulates in the traced
 fully jitted/functionalizable instead of forcing the eager fallback. Only
 the ``'error'`` strategy still needs a concrete value check at update time
 (its contract is an immediate raise; it is for debugging, not the hot path).
+
+Streaming views (``metrics_tpu/streaming/``): every aggregator here except
+list-mode :class:`CatMetric` keeps fixed-shape sum/max/min states, so they
+wrap directly — ``WindowedMetric(MeanMetric(), window=N)`` is the weighted
+mean of the trailing ``N`` rows (bit-exact: both states are sum-reduced),
+``DecayedMetric(MeanMetric(), halflife=H)`` the exponentially-weighted
+mean; ``WindowedMetric(MaxMetric(), ...)`` gives the windowed max the
+since-reset accumulator cannot (a max cannot forget without buckets).
+
+    >>> import jax.numpy as jnp
+    >>> from metrics_tpu import MeanMetric, WindowedMetric
+    >>> windowed = WindowedMetric(MeanMetric(nan_strategy="ignore"), window=4, buckets=2)
+    >>> for batch in ([1.0, 1.0], [2.0, 2.0], [4.0, 4.0]):
+    ...     windowed.update(jnp.asarray(batch))
+    >>> float(windowed.compute())  # last 4 rows: 2, 2, 4, 4
+    3.0
 """
 from typing import Any, Callable, Optional, Union
 

@@ -221,6 +221,16 @@ class MetricCollection:
                 if not np.array_equal(np.asarray(state1.counts), np.asarray(state2.counts)):
                     return False
                 continue
+            if getattr(type(state1), "is_sketch_state", False):
+                leaves1 = jax.tree_util.tree_leaves(state1)
+                leaves2 = jax.tree_util.tree_leaves(state2)
+                if len(leaves1) != len(leaves2) or not all(
+                    np.asarray(l1).shape == np.asarray(l2).shape
+                    and np.array_equal(np.asarray(l1), np.asarray(l2))
+                    for l1, l2 in zip(leaves1, leaves2)
+                ):
+                    return False
+                continue
             if isinstance(state1, list):
                 if len(state1) != len(state2):
                     return False

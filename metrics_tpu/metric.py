@@ -34,6 +34,7 @@ the reference, by subsystem:
 """
 import functools
 import inspect
+import time
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -140,6 +141,9 @@ class Metric:
 
         self._update_count = 0
         self._update_called = False
+        # staleness channel (resilience/health.py): wall-clock + step of the
+        # most recent update, so a stalled stream is visible in health_report
+        self._last_update_unix: Optional[float] = None
         self._computed: Any = None
         self._forward_cache: Any = None
         self._is_synced = False
@@ -182,8 +186,11 @@ class Metric:
         from metrics_tpu.utilities.guard import FaultCounters
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
-        if isinstance(default, (CatBuffer, FaultCounters)):
-            pass  # static-shape pytree states (jittable cat / fault counters)
+        if isinstance(default, (CatBuffer, FaultCounters)) or getattr(
+            type(default), "is_sketch_state", False
+        ):
+            pass  # static-shape pytree states (jittable cat / fault counters /
+            #       mergeable sketches — see metrics_tpu/streaming/sketches.py)
         elif not isinstance(default, list) or default:
             if not isinstance(default, (jax.Array, np.ndarray, int, float)):
                 raise ValueError("state variable must be an array, a CatBuffer, or an empty list (any value)")
@@ -319,6 +326,7 @@ class Metric:
             self._computed = None
             self._update_count += 1
             self._update_called = True
+            self._last_update_unix = time.time()
             if self._is_synced:
                 raise MetricsTPUUserError(
                     "The Metric shouldn't be synced when performing ``update``. "
@@ -645,7 +653,11 @@ class Metric:
         merged: Dict[str, Any] = {}
         for name, reduce_fn in self._reductions.items():
             g, b = global_state[name], batch_state[name]
-            if reduce_fn == "sum":
+            if getattr(type(g), "is_sketch_state", False):
+                # mergeable sketches define their own associative+commutative
+                # union (streaming/sketches.py) — the tag is documentary
+                merged[name] = g.sketch_merge(b)
+            elif reduce_fn == "sum":
                 merged[name] = g + b
             elif reduce_fn == "mean":
                 if global_count == 0:
@@ -705,6 +717,24 @@ class Metric:
         # CatBuffer states: gather data and mask; the union of valid rows is
         # the stacked buffers (masked rows stay masked)
         for attr, value in list(input_dict.items()):
+            if getattr(type(value), "is_sketch_state", False):
+                # gather every leaf per rank, rebuild the per-rank sketches,
+                # fold them through the sketch's own merge — the process-level
+                # analogue of fused_sync's sketch handling
+                group = self.process_group if process_group is None else process_group
+                leaves, treedef = jax.tree_util.tree_flatten(value)
+                gathered = [dist_sync_fn(leaf, group) for leaf in leaves]
+                n_ranks = len(gathered[0])
+                ranks = [
+                    jax.tree_util.tree_unflatten(treedef, [g[r] for g in gathered])
+                    for r in range(n_ranks)
+                ]
+                merged = ranks[0]
+                for other in ranks[1:]:
+                    merged = merged.sketch_merge(other)
+                self._state[attr] = merged
+                del input_dict[attr]
+                continue
             if isinstance(value, FaultCounters):
                 group = self.process_group if process_group is None else process_group
                 gathered = dist_sync_fn(value.counts, group)
@@ -838,6 +868,9 @@ class Metric:
         """Restore default state (reference ``metric.py:539``)."""
         self._update_count = 0
         self._update_called = False
+        # staleness restarts with the epoch: a reset-but-unfed metric must
+        # read as never_updated, not as fed-at-step-0 with a stale clock
+        self._last_update_unix = None
         self._computed = None
         self._forward_cache = None
         self._restore_defaults()
@@ -875,6 +908,8 @@ class Metric:
             }
         if isinstance(current, FaultCounters):
             return np.asarray(current.counts)
+        if getattr(type(current), "is_sketch_state", False):
+            return current.to_primitives()
         return np.asarray(current)
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
@@ -916,6 +951,11 @@ class Metric:
             "states": {key: self._serialize_state_value(self._state[key]) for key in self._defaults},
             "update_count": self._update_count,
         }
+        if self._last_update_unix is not None:
+            # the staleness clock must survive crash recovery: a restored
+            # metric with 500 updates reporting "never updated" would tell
+            # operators the opposite of the truth (resilience/health.py)
+            out["last_update_unix"] = self._last_update_unix
         attrs = {
             name: getattr(self, name)
             for name in self._snapshot_attrs
@@ -972,6 +1012,7 @@ class Metric:
         return {
             "loaded": loaded,
             "update_count": int(payload.get("update_count", self._update_count)),
+            "last_update_unix": payload.get("last_update_unix"),
             "attrs": attrs,
             "children": children,
         }
@@ -980,6 +1021,12 @@ class Metric:
         self._state.update(prepared["loaded"])
         self._update_count = prepared["update_count"]
         self._update_called = self._update_count > 0
+        if prepared.get("last_update_unix") is not None:
+            self._last_update_unix = prepared["last_update_unix"]
+        elif self._update_count > 0 and self._last_update_unix is None:
+            # pre-staleness snapshot of a fed metric: "restored now" is the
+            # honest lower bound, never_updated would be the opposite
+            self._last_update_unix = time.time()
         self._computed = None
         self._is_synced = False
         self._cache = None
@@ -1017,6 +1064,10 @@ class Metric:
         if loaded:
             self._state.update(loaded)
             self._update_called = True
+            if self._last_update_unix is None:
+                # the state_dict format carries no clock; a just-restored
+                # accumulator reads as fed-at-restore, not never_updated
+                self._last_update_unix = time.time()
 
     def _check_ring_capacity_consistency(self, via: str, state: Dict[str, Any]) -> None:
         """Paired (lockstep) ring states must share ONE capacity — compute
@@ -1107,6 +1158,11 @@ class Metric:
             if arr.shape[0] < NUM_FAULT_CLASSES:
                 arr = np.concatenate([arr, np.zeros(NUM_FAULT_CLASSES - arr.shape[0], arr.dtype)])
             return FaultCounters(counts=jnp.asarray(arr[:NUM_FAULT_CLASSES], jnp.uint32))
+        if getattr(type(default), "is_sketch_state", False):
+            try:
+                return type(default).from_primitives(v, like=default)
+            except ValueError as err:
+                fail(f"failed sketch-state validation: {err}")
         if isinstance(default, list):
             if not isinstance(v, (list, tuple)):
                 fail(f"is a list ('cat') state and must load from a list (got {type(v).__name__})")
@@ -1128,6 +1184,7 @@ class Metric:
         self.__dict__.setdefault("on_invalid", "ignore")
         self.__dict__.setdefault("debug_checks", False)
         self.__dict__.setdefault("_faults_reported", 0)
+        self.__dict__.setdefault("_last_update_unix", None)
         self.__dict__["_state"] = jax.tree_util.tree_map(jnp.asarray, state["_state"])
         self.__dict__["_defaults"] = jax.tree_util.tree_map(jnp.asarray, state["_defaults"])
         object.__setattr__(self, "_original_update", self._maybe_guard(type(self).update.__get__(self)))

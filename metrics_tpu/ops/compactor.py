@@ -1,0 +1,192 @@
+"""Fixed-shape compactor kernels for the streaming quantile sketch.
+
+A KLL/MRL-style compactor keeps ``L`` levels of at most ``k`` sorted items
+each; an item at level ``l`` stands for ``2**l`` input rows. Textbook
+implementations compact *data-dependently* (only the level that overflows),
+which cannot live inside a fixed-shape XLA program. These kernels are the
+static-shape reformulation (the same stance as ``CatBuffer`` vs growing
+lists, SURVEY.md §7 hard part #1):
+
+- every level buffer is a fixed ``(k,)`` array, ascending-sorted with
+  ``+inf`` padding past the valid ``count`` prefix (the invariant every
+  kernel below preserves, so a plain value-only ``jnp.sort`` of a
+  concatenation re-establishes it for free);
+- a level fold is *unconditional* over all ``L`` levels — a level that did
+  not overflow passes through bitwise unchanged (sorting a sorted buffer is
+  the identity), so the cascade is a static Python loop of ``L`` cheap
+  ``(k + M,)`` value-only sorts, never a traced while-loop;
+- compaction keeps one element of each adjacent pair of the sorted buffer,
+  alternating the kept side per pair index (``2*j + (j & 1)``) — a pure
+  function of the sorted data, so merging two sketches is **bitwise
+  commutative**, and the alternation cancels the one-sided rank bias a
+  fixed offset would accumulate.
+
+Rank-error accounting (the ``eps`` contract of
+``metrics_tpu/streaming/sketches.py``): one compaction at level ``l``
+perturbs any rank by at most ``2**l``; at most ``~2n / (k * 2**l)``
+compactions happen at level ``l`` over ``n`` rows, so the total error is
+bounded by ``~2 * L * n / k`` (batch pre-compaction adds one more
+``~2n / k`` term). ``QuantileSketchState.create`` sizes ``k`` from the
+requested ``eps`` with this bound.
+
+The final quantile query reuses :func:`metrics_tpu.ops.bucketed_rank.
+ascending_order` — the one place the sketch needs a *permutation* (to carry
+per-item weights through the value sort) rather than sorted values.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.ops.bucketed_rank import ascending_order
+
+Array = jax.Array
+
+# plain python float, NOT a jnp scalar: module import must never create a
+# device array (the hang-proof bootstrap contract — utilities/backend.py)
+_INF = float("inf")
+
+
+def _masked_ascending(x: Array, count: Array) -> Array:
+    """Re-establish the level invariant: positions ``>= count`` forced to
+    ``+inf`` (dropped rows must not linger as maskable-but-present ghosts —
+    a later sort would pull them back into the counted prefix)."""
+    return jnp.where(jnp.arange(x.shape[0]) < count, x, _INF)
+
+
+def fold_level(
+    items: Array, count: Array, inc: Array, inc_count: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Fold ``inc`` (same level weight) into one level buffer.
+
+    ``items`` is ``(k,)`` sorted/+inf-padded with ``count`` valid; ``inc``
+    is ``(M,)`` in the same form (any static ``M``). Returns
+    ``(new_items (k,), new_count, promoted ((k + M) // 2,),
+    promoted_count)`` — when the combined count stays within ``k`` the
+    level absorbs everything and ``promoted`` is empty; on overflow the
+    whole buffer compacts (pairs of adjacent sorted items collapse to one
+    item of doubled weight, alternating kept side per pair) and at most one
+    unpaired leftover stays at the level. All shapes static; fully
+    jittable.
+    """
+    k = items.shape[0]
+    combined = jnp.sort(jnp.concatenate([items, inc]))  # (k + M,), +inf last
+    c = count + inc_count
+    overflow = c > k
+
+    # --- no-overflow branch: absorb, nothing promoted ------------------
+    keep_items = combined[:k]
+    # (invariant holds: exactly c valid reals occupy the prefix)
+
+    # --- overflow branch: compact the whole buffer ---------------------
+    pairs = c // 2
+    p_len = (k + inc.shape[0]) // 2
+    j = jnp.arange(p_len)
+    picked = combined[2 * j + (j & 1)]  # one per adjacent pair, alternating
+    promoted = jnp.where(j < pairs, picked, _INF)
+    leftover_count = c - 2 * pairs  # 0 or 1
+    leftover = jnp.where(jnp.arange(k) < leftover_count, combined[2 * pairs], _INF)
+
+    new_items = jnp.where(overflow, leftover, keep_items)
+    new_count = jnp.where(overflow, leftover_count, c)
+    promoted = jnp.where(overflow, promoted, _INF)
+    promoted_count = jnp.where(overflow, pairs, 0)
+    return new_items, new_count, promoted, promoted_count
+
+
+def precompact_batch(x: Array, valid: Array, k: int) -> Tuple[Array, Array, int]:
+    """Reduce a batch to at most ``k`` items of weight ``2**level``.
+
+    Sorts the batch once (invalid rows to ``+inf``), then applies static
+    halving rounds (the batch-local form of level compaction — same
+    alternating pair rule) until it fits a level buffer. Returns
+    ``(items (k,), count, level)`` with ``level`` a *static* int (it only
+    depends on the static batch size), so the caller's cascade can skip
+    the untouched lower levels at trace time. Odd-count rounds drop the
+    one unpaired (largest) item — bounded by the documented error term.
+    """
+    x = jnp.asarray(x, jnp.float32).reshape(-1)
+    valid = jnp.broadcast_to(jnp.asarray(valid, bool).reshape(-1), x.shape)
+    valid = valid & jnp.isfinite(x)
+    cur = jnp.sort(jnp.where(valid, x, _INF))
+    m = jnp.sum(valid.astype(jnp.int32))
+    level = 0
+    while cur.shape[0] > k:
+        half = cur.shape[0] // 2
+        j = jnp.arange(half)
+        cur = cur[2 * j + (j & 1)]
+        m = m // 2
+        cur = _masked_ascending(cur, m)
+        level += 1
+    if cur.shape[0] < k:
+        cur = jnp.concatenate([cur, jnp.full((k - cur.shape[0],), _INF)])
+    return cur, m, level
+
+
+def fold_cascade(
+    items: Array, counts: Array, inc: Array, inc_count: Array, start_level: int
+) -> Tuple[Array, Array]:
+    """Run ``inc`` (weight ``2**start_level``) up the level cascade.
+
+    ``items``/``counts`` are the full ``(L, k)``/``(L,)`` sketch buffers.
+    The loop over levels is static: levels below ``start_level`` are
+    untouched, levels above fold unconditionally (a non-overflowing fold
+    is the bitwise identity). A promotion that would leave the top level
+    is folded back into it — losing half that weight's resolution, which
+    ``QuantileSketchState.create`` makes unreachable by sizing ``L`` for
+    ``max_items``.
+    """
+    L, k = items.shape
+    rows = []
+    cnts = []
+    for lvl in range(L):
+        if lvl < start_level:
+            rows.append(items[lvl])
+            cnts.append(counts[lvl])
+            continue
+        if lvl == L - 1:
+            # top level never promotes: absorb (and saturate — see docstring)
+            combined = jnp.sort(jnp.concatenate([items[lvl], inc]))
+            c = jnp.minimum(counts[lvl] + inc_count, k)
+            rows.append(_masked_ascending(combined[:k], c))
+            cnts.append(c)
+            inc = jnp.full_like(inc, _INF)
+            inc_count = jnp.zeros((), jnp.int32)
+            continue
+        new_items, new_count, inc, inc_count = fold_level(
+            items[lvl], counts[lvl], inc, inc_count
+        )
+        rows.append(new_items)
+        cnts.append(new_count)
+    return jnp.stack(rows), jnp.stack(cnts).astype(jnp.int32)
+
+
+def level_weights(items: Array, counts: Array) -> Array:
+    """Per-slot row weights ``2**level`` (float32; zero past each level's
+    valid prefix)."""
+    L, k = items.shape
+    slot_valid = jnp.arange(k)[None, :] < counts[:, None]
+    w = jnp.exp2(jnp.arange(L, dtype=jnp.float32))[:, None]
+    return jnp.where(slot_valid, w, 0.0)
+
+
+def weighted_quantiles(items: Array, counts: Array, qs: Array) -> Array:
+    """Quantile values from the level buffers: one packed-radix value sort
+    over all ``L * k`` slots with weights carried through the permutation
+    (``ascending_order``), then a cumulative-weight lookup. ``+inf``
+    padding sorts last with zero weight, so no compaction is needed."""
+    vals = items.ravel()
+    w = level_weights(items, counts).ravel()
+    order = ascending_order(vals)
+    sv = vals[order]
+    cw = jnp.cumsum(w[order])
+    total = cw[-1]
+    targets = jnp.maximum(jnp.asarray(qs, jnp.float32) * total, 1.0)
+    idx = jnp.clip(jnp.searchsorted(cw, targets, side="left"), 0, sv.shape[0] - 1)
+    return jnp.where(total > 0, sv[idx], jnp.nan)
+
+
+def weighted_rank(items: Array, counts: Array, v: Array) -> Array:
+    """Estimated number of inserted rows ``<= v`` (float32)."""
+    w = level_weights(items, counts)
+    return jnp.sum(jnp.where(items <= jnp.asarray(v, jnp.float32), w, 0.0))

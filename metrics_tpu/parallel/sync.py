@@ -93,6 +93,31 @@ def _all_gather_invariant(value: Array, axis_name: str) -> Array:
     return jax.lax.psum(out, axis_name)
 
 
+def _is_sketch_state(value: Any) -> bool:
+    """Mergeable sketch states (streaming/sketches.py), recognized
+    structurally so this module never imports the streaming package."""
+    return getattr(type(value), "is_sketch_state", False)
+
+
+def sync_sketch_state(value: Any, axis_name: str) -> Any:
+    """Cross-device union of one sketch state.
+
+    Elementwise-mergeable sketches (CountMin sum, HyperLogLog max) are one
+    collective on their single leaf; compaction-merged sketches (the
+    quantile sketch) gather their packed payload once and fold the
+    per-device sketches through ``sketch_merge`` on-device — every device
+    computes the identical global sketch.
+    """
+    er = value.elementwise_reduction
+    if er is not None:
+        return type(value)(*[sync_leaf(leaf, er, axis_name) for leaf in value])
+    gathered = _all_gather_invariant(value.pack(), axis_name)  # (ndev, P)
+    merged = type(value).unpack_like(gathered[0], value)
+    for d in range(1, gathered.shape[0]):
+        merged = merged.sketch_merge(type(value).unpack_like(gathered[d], value))
+    return merged
+
+
 def sync_cat_buffer(buffer: Any, axis_name: str) -> Any:
     """Cross-device union of a :class:`CatBuffer`: gather data and mask and
     stack along capacity — masked rows stay masked, so the result is a valid
@@ -141,6 +166,9 @@ def sync_state(
     out = {}
     for name, value in state.items():
         fx = reductions[name]
+        if _is_sketch_state(value):
+            out[name] = sync_sketch_state(value, axis_name)
+            continue
         if isinstance(value, CatBuffer):
             out[name] = sync_cat_buffer(value, axis_name)
             continue
@@ -175,7 +203,12 @@ def fused_sync(
     Fault-counter states (:class:`FaultCounters`, ``utilities/guard.py``)
     fold their uint32 counts vector into the sum bucket, so the whole
     collection's fault channel syncs inside the same fused collective
-    family — robustness costs no per-metric collective.
+    family — robustness costs no per-metric collective. Mergeable sketch
+    states (``streaming/sketches.py``) ride the same lanes: CountMin
+    counters join the sum bucket, HyperLogLog registers the max bucket,
+    and every quantile sketch in the collection packs into ONE fused
+    gather-merge payload — a guarded collection with sketch states still
+    syncs in ≤2 all-reduces (HLO-pinned in ``tests/streaming``).
 
     ``defaults`` (optional, one dict per metric) supplies templates for
     empty list states, as in :func:`sync_state`.
@@ -185,6 +218,12 @@ def fused_sync(
 
     buckets: Dict[Tuple[str, Any], List[Tuple[int, str, Array]]] = {}
     fault_slots: set = set()
+    # single-leaf sketch states with an elementwise merge (CountMin sum,
+    # HyperLogLog max) flatten into the matching bucket like FaultCounters —
+    # streaming sketches cost a guarded collection no extra collective
+    struct_slots: Dict[Tuple[int, str], Any] = {}
+    # compaction-merged sketches (quantile) share ONE fused gather payload
+    gather_merge: List[Tuple[int, str, Any]] = []
     passthrough: List[Tuple[int, str, Array, Reduction]] = []
     for i, (state, reds) in enumerate(zip(states, reductions)):
         for name, value in state.items():
@@ -192,20 +231,60 @@ def fused_sync(
             if isinstance(value, FaultCounters):
                 fault_slots.add((i, name))
                 buckets.setdefault(("sum", value.counts.dtype), []).append((i, name, value.counts))
+            elif _is_sketch_state(value):
+                er = value.elementwise_reduction
+                if er is not None:
+                    leaf = value[0]  # elementwise sketches are single-leaf
+                    struct_slots[(i, name)] = type(value)
+                    buckets.setdefault((er, leaf.dtype), []).append((i, name, leaf))
+                else:
+                    gather_merge.append((i, name, value))
             elif fx in ("sum", "mean", "max", "min") and isinstance(value, jax.Array):
                 buckets.setdefault((fx, value.dtype), []).append((i, name, value))
             else:
                 passthrough.append((i, name, value, fx))
 
+    if gather_merge:
+        # all quantile-style sketches of the whole collection ride ONE
+        # gathered payload — and the gather itself is expressed as
+        # scatter-into-zeros + psum (exactly what `_all_gather_invariant`
+        # emits), so it JOINS the float32 sum bucket: a collection with
+        # float sum states pays zero extra collectives for its sketches
+        payload = jnp.concatenate([v.pack() for (_, _, v) in gather_merge])
+        ndev = jax.lax.psum(1, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        wide = jnp.zeros((ndev * payload.shape[0],), payload.dtype)
+        wide = jax.lax.dynamic_update_slice(wide, payload, (idx * payload.shape[0],))
+        buckets.setdefault(("sum", wide.dtype), []).append((-1, "__sketch_gather__", wide))
+
     out: List[Dict[str, Any]] = [dict(s) for s in states]
+    gathered_payload: Optional[Array] = None
     for (fx, _dtype), leaves in buckets.items():
         flat = jnp.concatenate([v.ravel() for (_, _, v) in leaves])
         synced = sync_leaf(flat, fx, axis_name)
         offset = 0
         for (i, name, v) in leaves:
             leaf = jax.lax.dynamic_slice_in_dim(synced, offset, v.size).reshape(v.shape)
-            out[i][name] = FaultCounters(counts=leaf) if (i, name) in fault_slots else leaf
+            if i < 0:  # the fused sketch-gather payload, not a state slot
+                gathered_payload = leaf
+            elif (i, name) in fault_slots:
+                out[i][name] = FaultCounters(counts=leaf)
+            elif (i, name) in struct_slots:
+                out[i][name] = struct_slots[(i, name)](leaf)
+            else:
+                out[i][name] = leaf
             offset += v.size
+    if gather_merge:
+        per_dev = gathered_payload.reshape(-1, sum(v.packed_size for (_, _, v) in gather_merge))
+        offset = 0
+        for (i, name, v) in gather_merge:
+            size = v.packed_size
+            merged = None
+            for d in range(per_dev.shape[0]):
+                s = type(v).unpack_like(per_dev[d, offset : offset + size], v)
+                merged = s if merged is None else merged.sketch_merge(s)
+            out[i][name] = merged
+            offset += size
     for (i, name, value, fx) in passthrough:
         if isinstance(value, CatBuffer):
             out[i][name] = sync_cat_buffer(value, axis_name)

@@ -314,7 +314,9 @@ def _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, owner_na
     merged: Dict[str, Any] = {}
     for name, fx in reductions.items():
         a, b = state_a[name], state_b[name]
-        if isinstance(a, CatBuffer):
+        if getattr(type(a), "is_sketch_state", False):
+            merged[name] = a.sketch_merge(b)
+        elif isinstance(a, CatBuffer):
             merged[name] = cat_concat(a, b)
         elif fx == "sum":
             merged[name] = a + b

@@ -80,8 +80,17 @@ def record_degradation(kind: str, message: str, **details: Any) -> Dict[str, Any
     return registry.record(kind, message, **details)
 
 
+_DEGRADED_KEYS = ("faults", "overflow_dropped")
+
+
 def _metric_health(metric: Any) -> Dict[str, Any]:
-    """Fault/overflow view of one ``Metric`` (host-side reads only)."""
+    """Fault/overflow/staleness view of one ``Metric`` (host-side reads
+    only). Staleness — the last-update step and wall-clock, plus the age in
+    seconds — makes a *stalled* stream visible next to the fault counters:
+    a metric whose faults are clean but whose ``staleness_s`` keeps growing
+    is not being fed. Staleness alone does not flip the report's
+    ``degraded`` flag (only the :data:`_DEGRADED_KEYS` do) — how stale is
+    too stale is a deployment question, not a library one."""
     entry: Dict[str, Any] = {}
     faults = getattr(metric, "fault_counts", None)
     if faults:
@@ -91,6 +100,13 @@ def _metric_health(metric: Any) -> Dict[str, Any]:
     dropped = getattr(metric, "dropped_count", None)
     if dropped:
         entry["overflow_dropped"] = dropped
+    last = getattr(metric, "_last_update_unix", None)
+    if last is not None:
+        entry["last_update_unix"] = last
+        entry["last_update_step"] = getattr(metric, "update_count", None)
+        entry["staleness_s"] = max(0.0, time.time() - last)
+    elif hasattr(metric, "_last_update_unix"):
+        entry["never_updated"] = True
     return entry
 
 
@@ -105,11 +121,15 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
         {"backend": {...bootstrap state...},
          "events": [...degradation events, oldest first...],
          "event_counts": {kind: n},
-         "metrics": {name: {"faults": {...}, "overflow_dropped": n}},
+         "metrics": {name: {"faults": {...}, "overflow_dropped": n,
+                            "last_update_unix": t, "last_update_step": s,
+                            "staleness_s": age}},
          "degraded": bool}
 
     ``degraded`` is True when any registry event OR any reported metric
-    fault/overflow exists.
+    fault/overflow exists. Staleness (``last_update_*``/``staleness_s``,
+    or ``never_updated``) is informational — a stalled stream is visible
+    but does not flip the flag by itself.
     """
     from metrics_tpu.utilities.backend import backend_status
 
@@ -136,5 +156,7 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
                 # second would silently overwrite the first's faults)
                 seen[name] = seen.get(name, 0) + 1
                 report["metrics"][name if seen[name] == 1 else f"{name}#{seen[name]}"] = entry
-    report["degraded"] = bool(report["event_counts"]) or bool(report["metrics"])
+    report["degraded"] = bool(report["event_counts"]) or any(
+        any(k in entry for k in _DEGRADED_KEYS) for entry in report["metrics"].values()
+    )
     return report

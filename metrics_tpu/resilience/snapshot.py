@@ -117,6 +117,14 @@ def _merge_state_values(values: List[Any], fx: Any, default: Any, key: str, owne
 
     if len(values) == 1 and not isinstance(default, CatBuffer):
         return values[0]
+    if getattr(type(default), "is_sketch_state", False):
+        # per-rank sketches re-merge through their own associative union —
+        # the same path a live sync runs, so 8->4->1 restores value-parity
+        states = [type(default).from_primitives(v, like=default) for v in values]
+        merged = states[0]
+        for s in states[1:]:
+            merged = merged.sketch_merge(s)
+        return merged.to_primitives()
     if isinstance(default, FaultCounters):
         n = max(np.asarray(v).reshape(-1).shape[0] for v in values)
         total = np.zeros((n,), np.uint64)
@@ -189,6 +197,9 @@ def _merge_metric_payloads(metric: Any, payloads: List[Dict[str, Any]]) -> Dict[
         "states": states,
         "update_count": sum(int(p.get("update_count", 0)) for p in payloads),
     }
+    clocks = [p["last_update_unix"] for p in payloads if p.get("last_update_unix") is not None]
+    if clocks:
+        out["last_update_unix"] = max(clocks)  # freshest rank wins
     attrs: Dict[str, Any] = {}
     for p in payloads:  # data-inferred attrs are rank-invariant; first wins
         for k, v in p.get("attrs", {}).items():

@@ -1,0 +1,29 @@
+"""Streaming subsystem: windowed/decayed metric views + mergeable sketches.
+
+The online-monitoring layer over the epoch accumulators (see
+``windowed.py`` and ``sketches.py`` module docstrings, and the streaming
+section of DESIGN.md): "accuracy over the last 10k requests", "p99 score
+quantile right now", "distinct users today" — all from fixed-size, pure,
+jittable pytree state that rides the existing fused sync, snapshot, and
+fault channels.
+"""
+from metrics_tpu.streaming.sketches import (  # noqa: F401
+    CountMinSketch,
+    CountMinState,
+    HllState,
+    HyperLogLog,
+    QuantileSketch,
+    QuantileSketchState,
+)
+from metrics_tpu.streaming.windowed import DecayedMetric, WindowedMetric  # noqa: F401
+
+__all__ = [
+    "CountMinSketch",
+    "CountMinState",
+    "DecayedMetric",
+    "HllState",
+    "HyperLogLog",
+    "QuantileSketch",
+    "QuantileSketchState",
+    "WindowedMetric",
+]

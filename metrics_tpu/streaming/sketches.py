@@ -1,0 +1,597 @@
+"""Mergeable streaming sketches: quantiles, frequencies, distinct counts.
+
+Every accumulator in the framework so far answers *exact* questions over a
+since-reset epoch. Online monitoring asks different questions — "p99 score
+quantile right now", "how often has this id been seen", "how many distinct
+users" — whose exact answers need per-row storage. The sketches here answer
+them approximately in **fixed-size, pure pytree state**, with a merge that
+is associative + commutative, so they ride every channel the framework
+already has:
+
+- **state registry**: each sketch state is a NamedTuple pytree a metric
+  registers via ``add_state`` (like :class:`FaultCounters`), recognized
+  structurally via the ``is_sketch_state`` class marker — no import cycles;
+- **distributed sync**: CountMin counts fold into ``fused_sync``'s uint32
+  *sum* bucket, HyperLogLog registers into the *max* bucket — a guarded
+  collection gains frequency/distinct monitoring for zero extra
+  collectives; the quantile sketch packs into ONE fused gather-merge
+  payload (its merge is compaction, not elementwise) — the same fused
+  computation-collective stance as EQuARX-style compressed all-reduce
+  payloads (PAPERS.md): fixed sketch bytes move, never raw rows;
+- **persistence**: ``to_primitives``/``from_primitives`` give the
+  ``state_dict`` primitive forms, and ``SnapshotManager``'s elastic
+  restore re-merges per-rank sketches through ``sketch_merge`` (8→4→1
+  parity like CatBuffer);
+- **fault channel**: the metric classes mask non-finite rows in-graph and
+  report them through :class:`FaultCounters` under ``on_invalid='drop'``.
+
+Error contracts: :class:`QuantileSketch` rank error ``<= eps * n``
+(see ``ops/compactor.py`` for the accounting); :class:`CountMinSketch`
+overestimates by at most ``2n/width`` with probability ``1 - 2**-depth``;
+:class:`HyperLogLog` relative error ``~1.04 / sqrt(2**precision)``.
+"""
+import math
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import _TRACE_ERRORS, Metric
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.ops.compactor import (
+    fold_cascade,
+    precompact_batch,
+    weighted_quantiles,
+    weighted_rank,
+)
+
+Array = jax.Array
+
+__all__ = [
+    "QuantileSketchState",
+    "CountMinState",
+    "HllState",
+    "QuantileSketch",
+    "CountMinSketch",
+    "HyperLogLog",
+]
+
+
+def is_sketch_state(value: Any) -> bool:
+    """Structural test every integration point uses (no streaming import)."""
+    return getattr(type(value), "is_sketch_state", False)
+
+
+def _hash_keys(values: Array) -> Array:
+    """Canonical uint32 keys for hashing: floats bitcast (with ``-0.0``
+    collapsed onto ``+0.0`` so equal values hash equally), ints truncated."""
+    x = jnp.asarray(values).reshape(-1)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32) + jnp.float32(0.0)  # -0.0 -> +0.0
+        return jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def _fmix32(h: Array) -> Array:
+    """murmur3 finalizer: avalanche mix of a uint32 lane."""
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> jnp.uint32(16))
+
+
+# --------------------------------------------------------------------------
+# QuantileSketch — KLL/compactor levels (ops/compactor.py kernels)
+# --------------------------------------------------------------------------
+
+
+class QuantileSketchState(NamedTuple):
+    """Compactor quantile sketch: ``(L, k)`` sorted level buffers (item at
+    level ``l`` = ``2**l`` rows; ``+inf`` past each level's ``counts``
+    prefix) plus the exact inserted-row counter. Fixed shape, jittable,
+    merge bitwise-commutative (see ``ops/compactor.py``)."""
+
+    items: Array  # (L, k) float32
+    counts: Array  # (L,) int32
+    n_seen: Array  # () int32 — exact rows inserted (diagnostics; quantile
+    #                normalization uses the level weights, which drift from
+    #                n_seen by at most the documented eps-term)
+
+    is_sketch_state = True
+    # merge is compaction, not elementwise: syncs via ONE fused gather-merge
+    # payload (parallel/sync.py), not a psum/pmax bucket
+    elementwise_reduction = None
+
+    @classmethod
+    def create(
+        cls,
+        eps: float = 0.01,
+        max_items: int = 1 << 30,
+        k: Optional[int] = None,
+        levels: Optional[int] = None,
+    ) -> "QuantileSketchState":
+        if not (0 < eps < 1):
+            raise ValueError(f"`eps` must be in (0, 1), got {eps}")
+        if k is None:
+            # worst-case rank error ~ 2 * (L + 1) * n / k (ops/compactor.py)
+            guess_levels = max(4, int(math.ceil(math.log2(max(max_items, 2)))) + 2)
+            k = int(math.ceil(2.0 * (guess_levels + 1) / eps))
+        k = max(8, k + (k % 2))  # even, so pair compaction has no odd tail bias
+        if levels is None:
+            levels = max(4, int(math.ceil(math.log2(max(max_items / k, 2.0)))) + 2)
+        return cls(
+            items=jnp.full((levels, k), jnp.inf, jnp.float32),
+            counts=jnp.zeros((levels,), jnp.int32),
+            n_seen=jnp.zeros((), jnp.int32),
+        )
+
+    # -- streaming ------------------------------------------------------
+
+    def insert(self, values: Array, valid: Optional[Array] = None) -> "QuantileSketchState":
+        """Fold one batch in (non-finite rows always excluded). Fully
+        jittable; the cascade depth is static in the batch size."""
+        x = jnp.asarray(values, jnp.float32).reshape(-1)
+        v = jnp.ones(x.shape, bool) if valid is None else jnp.asarray(valid, bool).reshape(-1)
+        inc, inc_count, level = precompact_batch(x, v, self.items.shape[1])
+        items, counts = fold_cascade(self.items, self.counts, inc, inc_count, level)
+        n = jnp.sum((v & jnp.isfinite(x)).astype(jnp.int32))
+        return QuantileSketchState(items=items, counts=counts, n_seen=self.n_seen + n)
+
+    def sketch_merge(self, other: "QuantileSketchState") -> "QuantileSketchState":
+        """Associative-within-eps, bitwise-commutative union."""
+        if self.items.shape != other.items.shape:
+            raise ValueError(
+                f"cannot merge QuantileSketchState of shape {self.items.shape} with "
+                f"{other.items.shape}; construct both with the same eps/k/levels"
+            )
+        L, k = self.items.shape
+        items, counts = self.items, self.counts
+        carry = jnp.full((2 * k,), jnp.inf, jnp.float32)
+        carry_count = jnp.zeros((), jnp.int32)
+        rows, cnts = [], []
+        from metrics_tpu.ops.compactor import fold_level
+
+        for lvl in range(L):
+            inc = jnp.concatenate([other.items[lvl], carry])  # (3k,), sorted below
+            inc_count = other.counts[lvl] + carry_count
+            if lvl == L - 1:
+                combined = jnp.sort(jnp.concatenate([items[lvl], inc]))
+                c = jnp.minimum(counts[lvl] + inc_count, k)
+                rows.append(jnp.where(jnp.arange(k) < c, combined[:k], jnp.inf))
+                cnts.append(c)
+                break
+            ni, nc, carry, carry_count = fold_level(items[lvl], counts[lvl], inc, inc_count)
+            rows.append(ni)
+            cnts.append(nc)
+        return QuantileSketchState(
+            items=jnp.stack(rows),
+            counts=jnp.stack(cnts).astype(jnp.int32),
+            n_seen=self.n_seen + other.n_seen,
+        )
+
+    # -- queries --------------------------------------------------------
+
+    def quantile(self, qs: Any) -> Array:
+        return weighted_quantiles(self.items, self.counts, jnp.atleast_1d(jnp.asarray(qs)))
+
+    def rank(self, v: Any) -> Array:
+        """Estimated rows ``<= v`` (error ``<= eps * n``)."""
+        return weighted_rank(self.items, self.counts, v)
+
+    @property
+    def eps_bound(self) -> float:
+        """Worst-case rank-error fraction of this geometry."""
+        L, k = self.items.shape
+        return 2.0 * (L + 1) / k
+
+    # -- serialization / transport --------------------------------------
+
+    def to_primitives(self) -> Dict[str, np.ndarray]:
+        return {
+            "items": np.asarray(self.items),
+            "counts": np.asarray(self.counts),
+            "n_seen": np.asarray(self.n_seen),
+        }
+
+    @classmethod
+    def from_primitives(cls, prim: Any, like: "QuantileSketchState") -> "QuantileSketchState":
+        if isinstance(prim, cls):
+            prim = prim.to_primitives()
+        if not isinstance(prim, dict) or not {"items", "counts"} <= set(prim):
+            raise ValueError(
+                "QuantileSketchState loads from an {'items', 'counts', 'n_seen'} mapping, "
+                f"got {type(prim).__name__}"
+            )
+        items = np.asarray(prim["items"])
+        if items.shape != tuple(like.items.shape):
+            raise ValueError(
+                f"QuantileSketchState items shape {items.shape} != expected "
+                f"{tuple(like.items.shape)} (eps/k/levels config mismatch?)"
+            )
+        counts = np.asarray(prim["counts"]).reshape(-1)
+        if counts.shape[0] != like.counts.shape[0]:
+            raise ValueError(
+                f"QuantileSketchState counts length {counts.shape[0]} != expected "
+                f"{like.counts.shape[0]}"
+            )
+        return cls(
+            items=jnp.asarray(items, jnp.float32),
+            counts=jnp.asarray(counts, jnp.int32),
+            n_seen=jnp.asarray(prim.get("n_seen", 0), jnp.int32).reshape(()),
+        )
+
+    def pack(self) -> Array:
+        """One flat float32 vector for the fused gather-merge sync payload.
+        ``counts`` entries are ``<= k < 2**24``, exact in f32; ``n_seen``
+        is an unbounded int32, so it rides as TWO 12-bit-split lanes
+        (``hi*4096 + lo``, each ``< 2**19`` — exact in f32 for the whole
+        int32 range, preserving the counter's exactness contract)."""
+        n = self.n_seen.astype(jnp.int32)
+        return jnp.concatenate(
+            [
+                self.items.ravel(),
+                self.counts.astype(jnp.float32),
+                (n // 4096).astype(jnp.float32)[None],
+                (n % 4096).astype(jnp.float32)[None],
+            ]
+        )
+
+    @classmethod
+    def unpack_like(cls, flat: Array, like: "QuantileSketchState") -> "QuantileSketchState":
+        L, k = like.items.shape
+        n = flat[L * k + L].astype(jnp.int32) * 4096 + flat[L * k + L + 1].astype(jnp.int32)
+        return cls(
+            items=flat[: L * k].reshape(L, k),
+            counts=flat[L * k : L * k + L].astype(jnp.int32),
+            n_seen=n,
+        )
+
+    @property
+    def packed_size(self) -> int:
+        L, k = self.items.shape
+        return L * k + L + 2
+
+
+# --------------------------------------------------------------------------
+# CountMinSketch — frequency estimates, psum-mergeable
+# --------------------------------------------------------------------------
+
+_CM_SEED = 0x9E3779B9
+
+
+def _cm_hash_params(depth: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic per-row multiply-shift constants — a pure function of
+    ``depth``, so equal-shape sketches are merge-compatible by
+    construction (no seeds in state)."""
+    rng = np.random.default_rng(_CM_SEED)
+    a = (rng.integers(0, 1 << 32, depth, dtype=np.uint64).astype(np.uint32)) | np.uint32(1)
+    b = rng.integers(0, 1 << 32, depth, dtype=np.uint64).astype(np.uint32)
+    return a, b
+
+
+class CountMinState(NamedTuple):
+    """Count–min frequency sketch: ``(depth, width)`` uint32 counters whose
+    merge is elementwise **sum** — it rides ``fused_sync``'s uint32 sum
+    bucket (with the fault counters) for zero extra collectives."""
+
+    counts: Array  # (depth, width) uint32
+
+    is_sketch_state = True
+    elementwise_reduction = "sum"
+
+    @classmethod
+    def create(cls, depth: int = 4, width: int = 2048) -> "CountMinState":
+        if width & (width - 1) or width < 2:
+            raise ValueError(f"`width` must be a power of two >= 2, got {width}")
+        if depth < 1:
+            raise ValueError(f"`depth` must be >= 1, got {depth}")
+        return cls(counts=jnp.zeros((depth, width), jnp.uint32))
+
+    def _indices(self, values: Array) -> Array:
+        depth, width = self.counts.shape
+        a, b = _cm_hash_params(depth)
+        keys = _hash_keys(values)  # (n,)
+        h = _fmix32(keys[None, :] * jnp.asarray(a)[:, None] + jnp.asarray(b)[:, None])
+        return (h & jnp.uint32(width - 1)).astype(jnp.int32)  # (depth, n)
+
+    def insert(self, values: Array, valid: Optional[Array] = None) -> "CountMinState":
+        idx = self._indices(values)
+        inc = jnp.ones(idx.shape[1], jnp.uint32)
+        if valid is not None:
+            inc = jnp.where(jnp.asarray(valid, bool).reshape(-1), inc, jnp.uint32(0))
+        rows = jnp.broadcast_to(jnp.arange(idx.shape[0])[:, None], idx.shape)
+        counts = self.counts.at[rows, idx].add(jnp.broadcast_to(inc, idx.shape))
+        return CountMinState(counts=counts)
+
+    def query(self, values: Array) -> Array:
+        """Estimated occurrence counts (never under-counts)."""
+        idx = self._indices(values)
+        rows = jnp.broadcast_to(jnp.arange(idx.shape[0])[:, None], idx.shape)
+        return jnp.min(self.counts[rows, idx], axis=0)
+
+    def sketch_merge(self, other: "CountMinState") -> "CountMinState":
+        if self.counts.shape != other.counts.shape:
+            raise ValueError(
+                f"cannot merge CountMinState of shape {self.counts.shape} with "
+                f"{other.counts.shape}; construct both with the same depth/width"
+            )
+        return CountMinState(counts=self.counts + other.counts)
+
+    def to_primitives(self) -> Dict[str, np.ndarray]:
+        return {"counts": np.asarray(self.counts)}
+
+    @classmethod
+    def from_primitives(cls, prim: Any, like: "CountMinState") -> "CountMinState":
+        if isinstance(prim, cls):
+            prim = prim.to_primitives()
+        if not isinstance(prim, dict) or "counts" not in prim:
+            raise ValueError(
+                f"CountMinState loads from a {{'counts'}} mapping, got {type(prim).__name__}"
+            )
+        counts = np.asarray(prim["counts"])
+        if counts.shape != tuple(like.counts.shape):
+            raise ValueError(
+                f"CountMinState counts shape {counts.shape} != expected "
+                f"{tuple(like.counts.shape)} (depth/width config mismatch?)"
+            )
+        return cls(counts=jnp.asarray(counts, jnp.uint32))
+
+
+# --------------------------------------------------------------------------
+# HyperLogLog — distinct counts, pmax-mergeable
+# --------------------------------------------------------------------------
+
+
+class HllState(NamedTuple):
+    """HyperLogLog registers: ``(2**precision,)`` int32 whose merge is
+    elementwise **max** — it rides ``fused_sync``'s max bucket."""
+
+    registers: Array  # (m,) int32
+
+    is_sketch_state = True
+    elementwise_reduction = "max"
+
+    @classmethod
+    def create(cls, precision: int = 11) -> "HllState":
+        if not (4 <= precision <= 18):
+            raise ValueError(f"`precision` must be in [4, 18], got {precision}")
+        return cls(registers=jnp.zeros((1 << precision,), jnp.int32))
+
+    @property
+    def precision(self) -> int:
+        return int(self.registers.shape[0]).bit_length() - 1
+
+    def insert(self, values: Array, valid: Optional[Array] = None) -> "HllState":
+        p = self.precision
+        h = _fmix32(_hash_keys(values))
+        idx = (h >> jnp.uint32(32 - p)).astype(jnp.int32)
+        w = h << jnp.uint32(p)
+        rho = jnp.where(w == 0, jnp.int32(32 - p + 1), jax.lax.clz(w).astype(jnp.int32) + 1)
+        if valid is not None:
+            v = jnp.asarray(valid, bool).reshape(-1)
+            rho = jnp.where(v, rho, 0)  # max with 0 = no-op
+            idx = jnp.where(v, idx, 0)
+        return HllState(registers=self.registers.at[idx].max(rho))
+
+    def estimate(self) -> Array:
+        """Distinct-count estimate with the standard small/large-range
+        corrections (32-bit hash)."""
+        m = self.registers.shape[0]
+        alpha = 0.7213 / (1.0 + 1.079 / m) if m >= 128 else {16: 0.673, 32: 0.697, 64: 0.709}.get(m, 0.7213 / (1.0 + 1.079 / m))
+        reg = self.registers.astype(jnp.float32)
+        raw = alpha * m * m / jnp.sum(jnp.exp2(-reg))
+        zeros = jnp.sum(self.registers == 0).astype(jnp.float32)
+        linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+        est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+        two32 = jnp.float32(2.0**32)
+        est = jnp.where(est > two32 / 30.0, -two32 * jnp.log1p(-est / two32), est)
+        return est
+
+    def sketch_merge(self, other: "HllState") -> "HllState":
+        if self.registers.shape != other.registers.shape:
+            raise ValueError(
+                f"cannot merge HllState with {self.registers.shape[0]} registers and "
+                f"{other.registers.shape[0]}; construct both with the same precision"
+            )
+        return HllState(registers=jnp.maximum(self.registers, other.registers))
+
+    def to_primitives(self) -> Dict[str, np.ndarray]:
+        return {"registers": np.asarray(self.registers)}
+
+    @classmethod
+    def from_primitives(cls, prim: Any, like: "HllState") -> "HllState":
+        if isinstance(prim, cls):
+            prim = prim.to_primitives()
+        if not isinstance(prim, dict) or "registers" not in prim:
+            raise ValueError(
+                f"HllState loads from a {{'registers'}} mapping, got {type(prim).__name__}"
+            )
+        registers = np.asarray(prim["registers"]).reshape(-1)
+        if registers.shape != tuple(like.registers.shape):
+            raise ValueError(
+                f"HllState registers shape {registers.shape} != expected "
+                f"{tuple(like.registers.shape)} (precision config mismatch?)"
+            )
+        return cls(registers=jnp.asarray(registers, jnp.int32))
+
+
+# --------------------------------------------------------------------------
+# Metric shells — the sketches as ordinary metrics (guarded, synced,
+# snapshot-able, functionalize-able)
+# --------------------------------------------------------------------------
+
+
+class _SketchMetric(Metric):
+    """Shared shell: one sketch state, non-finite rows masked in-graph
+    (counted as ``dropped_rows`` by the fault channel when guarded)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    # the update body itself neutralizes invalid rows (validity masks into
+    # the sketch insert), so the guard's drop policy only counts
+    _guard_handles_drop = True
+    nan_strategy = "ignore"  # read by guard._body_neutralizes; sketches
+    #                           always mask, there is nothing to configure
+
+    def _valid_rows(self, values: Array) -> Array:
+        x = jnp.asarray(values).reshape(-1)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return jnp.isfinite(x)
+        return jnp.ones(x.shape, bool)
+
+
+class QuantileSketch(_SketchMetric):
+    """Streaming quantiles over a value stream at fixed state size.
+
+    ``compute()`` returns the configured ``quantiles`` of everything seen
+    since reset, with rank error at most ``eps * n`` — including after
+    distributed sync and elastic snapshot restore (the sketch merge is what
+    both channels run). Values stream in through ``update(values)``; no
+    per-row storage exists anywhere.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import QuantileSketch
+        >>> m = QuantileSketch(eps=0.05, max_items=4096, quantiles=(0.5,))
+        >>> m.update(jnp.arange(1000.0))
+        >>> bool(abs(float(m.compute()) - 500.0) <= 0.05 * 1000)
+        True
+    """
+
+    def __init__(
+        self,
+        eps: float = 0.01,
+        max_items: int = 1 << 30,
+        quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+        k: Optional[int] = None,
+        levels: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.eps = float(eps)
+        self.quantiles = tuple(float(q) for q in quantiles)
+        if not self.quantiles or not all(0.0 <= q <= 1.0 for q in self.quantiles):
+            raise ValueError(f"`quantiles` must be fractions in [0, 1], got {quantiles}")
+        self.add_state(
+            "sketch",
+            default=QuantileSketchState.create(eps=eps, max_items=max_items, k=k, levels=levels),
+            dist_reduce_fx="cat",  # documentary: every sync/merge path
+            #                        special-cases sketch states structurally
+        )
+
+    def update(self, values: Array) -> None:
+        x = jnp.asarray(values, jnp.float32).reshape(-1)
+        self.sketch = self.sketch.insert(x, self._valid_rows(x))
+
+    def compute(self) -> Array:
+        from metrics_tpu.utilities.data import _squeeze_if_scalar
+
+        return _squeeze_if_scalar(self.sketch.quantile(jnp.asarray(self.quantiles)))
+
+    def _check_cat_overflow(self) -> None:
+        """Saturation is never silent (the sketch analogue of ring-buffer
+        overflow, same ``on_overflow`` policy): past ``k * (2**L - 1)``
+        rows the top level clamps and the eps contract degrades — which
+        only happens when ``max_items`` was configured below the actual
+        stream length."""
+        if self.on_overflow == "ignore":
+            return
+        st = self._state.get("sketch")
+        if st is None:
+            return
+        try:
+            n = int(np.asarray(st.n_seen))
+        except _TRACE_ERRORS:
+            return  # traced compute: the eager caller re-checks
+        L, k = st.items.shape
+        capacity = k * ((1 << L) - 1)  # total representable row weight
+        if n <= capacity:
+            return
+        msg = (
+            f"{type(self).__name__}: the stream ({n} rows) exceeded this sketch's "
+            f"~{capacity}-row design capacity (max_items was configured too small); the top "
+            "compactor level has saturated and rank error can exceed the eps contract. "
+            "Construct with a larger `max_items`, or pass `on_overflow='ignore'` to silence "
+            "this."
+        )
+        if self.on_overflow == "error":
+            raise MetricsTPUUserError(msg)
+        if not self.__dict__.get("_saturation_warned"):
+            object.__setattr__(self, "_saturation_warned", True)
+            rank_zero_warn(msg, UserWarning)
+
+    def quantile(self, qs: Any) -> Array:
+        """Ad-hoc quantile query against the current (local) state."""
+        from metrics_tpu.utilities.data import _squeeze_if_scalar
+
+        return _squeeze_if_scalar(self.sketch.quantile(qs))
+
+
+class CountMinSketch(_SketchMetric):
+    """Streaming per-item frequency estimates (count–min).
+
+    ``update(values)`` hashes each row into ``depth`` counter rows;
+    ``query(values)`` returns occurrence estimates that never under-count
+    and over-count by at most ``2n/width`` with probability
+    ``1 - 2**-depth``. The counter matrix merges by elementwise sum, so a
+    distributed sync costs no collective beyond the shared sum bucket.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CountMinSketch
+        >>> m = CountMinSketch(depth=4, width=256)
+        >>> m.update(jnp.asarray([7, 7, 7, 3]))
+        >>> int(m.query(jnp.asarray([7]))[0])
+        3
+    """
+
+    def __init__(self, depth: int = 4, width: int = 2048, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.depth = int(depth)
+        self.width = int(width)
+        self.add_state("sketch", default=CountMinState.create(depth, width), dist_reduce_fx="sum")
+
+    def update(self, values: Array) -> None:
+        self.sketch = self.sketch.insert(values, self._valid_rows(values))
+
+    def compute(self) -> Array:
+        """The (synced) counter matrix — feed it to ``CountMinState.query``
+        via :meth:`query` for per-item estimates."""
+        return self.sketch.counts
+
+    def query(self, values: Array) -> Array:
+        return self.sketch.query(values)
+
+
+class HyperLogLog(_SketchMetric):
+    """Streaming distinct-count estimate (HyperLogLog).
+
+    ``compute()`` estimates the number of distinct values seen since reset
+    with relative error ``~1.04 / sqrt(2**precision)`` from ``2**precision``
+    int32 registers. Registers merge by elementwise max, so sync rides the
+    fused max bucket.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HyperLogLog
+        >>> m = HyperLogLog(precision=11)
+        >>> m.update(jnp.arange(5000) % 1000)
+        >>> bool(abs(float(m.compute()) - 1000) / 1000 < 0.1)
+        True
+    """
+
+    def __init__(self, precision: int = 11, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.precision = int(precision)
+        self.add_state("sketch", default=HllState.create(precision), dist_reduce_fx="max")
+
+    def update(self, values: Array) -> None:
+        self.sketch = self.sketch.insert(values, self._valid_rows(values))
+
+    def compute(self) -> Array:
+        return self.sketch.estimate()

@@ -1,0 +1,417 @@
+"""Sliding-window and exponentially-decayed views of any accumulating metric.
+
+Every metric in the framework accumulates since-reset; production
+monitoring asks "accuracy over the last 10k requests" — a question the
+epoch accumulators cannot answer without per-row storage. These wrappers
+answer it with **fixed-size time-bucketed sub-accumulator rings**: each
+update's state *delta* (the wrapped metric's update run on a fresh default
+state — the same state-swap trick ``functionalize`` uses) is folded into
+the current bucket of a ``(buckets, *leaf)`` ring, and old buckets expire
+whole. No per-row storage, fully jittable, donate-friendly (fixed input →
+output shapes), and the rings are plain sum/max/min-reduced array states
+that ride ``fused_sync``'s existing buckets and ``SnapshotManager``'s
+elastic merge unchanged.
+
+Window semantics (:class:`WindowedMetric`): the window holds ``buckets``
+buckets of ``window // buckets`` rows each; a bucket rotates out (lazily,
+at the start of the next update) once it has absorbed its row quota. Rows
+are attributed at *update-call* granularity — every row of one update
+lands in the bucket current at call start — so the covered span is exactly
+the trailing ``window`` rows whenever update batches align with bucket
+boundaries (``bucket_len % batch == 0``), and quantizes to
+``max(bucket_len, batch)`` granularity otherwise. In particular a batch
+LARGER than ``bucket_len`` fills a whole bucket by itself, growing the
+covered span toward ``buckets * batch`` rows — the wrapper warns once when
+it sees one (size ``buckets`` so ``window / buckets`` is at least your
+batch size, or pass ``buckets=1`` for whole-batch buckets);
+``window_rows`` always reports the span actually covered. Supported
+wrapped states: fixed-shape arrays reduced by
+``sum``/``mean``/``max``/``min``, plus :class:`FaultCounters` (summed per
+bucket, so the fault channel is windowed too). ``CatBuffer`` rings, list
+states, and sketch states are refused — they have no per-bucket identity
+to expire.
+
+Decay semantics (:class:`DecayedMetric`): sum-reduced accumulators (and
+the mean numerator/denominator pair) are scaled by ``2**(-n / halflife)``
+before each ``n``-row update folds in, giving every past row weight
+``2**(-age_rows / halflife)`` (rows within one update share an age).
+Decayed accumulators are kept in float32 regardless of the wrapped state's
+dtype — a decayed count is fractional by construction; every ratio-style
+compute handles that, exact-count consumers should window instead.
+``max``/``min`` states cannot decay without per-row storage and are
+refused; fault counters are deliberately NOT decayed (evidence of faults
+should not fade).
+"""
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import _TRACE_ERRORS, Metric
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+__all__ = ["WindowedMetric", "DecayedMetric"]
+
+
+def _leading_rows(args: tuple, kwargs: dict) -> int:
+    """Rows contributed by one update call: the leading dim of the first
+    array-like argument (static under trace), 1 for scalar updates."""
+    for a in list(args) + list(kwargs.values()):
+        if isinstance(a, (jax.Array, np.ndarray)) and getattr(a, "ndim", 0) >= 1:
+            return int(a.shape[0])
+    return 1
+
+
+class _StreamingWrapper(Metric):
+    """Shared machinery: child state-delta extraction, spec validation,
+    child compute on a rebuilt state, windowed fault-channel surfacing."""
+
+    is_differentiable = False
+    full_state_update = True  # batch-vs-global merge has no ring-aware rule
+    _wrapper_trace_safe = True  # functionalize swaps the whole tree as state
+
+    _KIND_NAME = "streaming wrapper"
+
+    def __init__(self, metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(
+                f"Expected the wrapped metric to be a `metrics_tpu.Metric`, got {metric!r}"
+            )
+        self.wrapped = metric
+
+    def _child_state_specs(self, allow_minmax: bool) -> Dict[str, str]:
+        """``{state_name: kind}`` with kind in sum/mean/max/min/faults;
+        raises for states with no bucket/decay semantics."""
+        from metrics_tpu.utilities.guard import FaultCounters
+        from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+        specs: Dict[str, str] = {}
+        for name, default in self.wrapped._defaults.items():
+            fx = self.wrapped._reductions[name]
+            child = type(self.wrapped).__name__
+            if isinstance(default, FaultCounters):
+                specs[name] = "faults"
+            elif isinstance(default, (list, CatBuffer)) or getattr(
+                type(default), "is_sketch_state", False
+            ):
+                raise ValueError(
+                    f"{type(self).__name__} cannot wrap {child}: state {name!r} is a "
+                    "per-row/list/sketch state with no per-bucket identity to expire. "
+                    "Wrap sum/mean/max/min-reduced metrics (use the standalone sketches "
+                    "for windowed distributional views)."
+                )
+            elif fx == "sum":
+                specs[name] = "sum"
+            elif fx == "mean":
+                specs[name] = "mean"
+            elif fx in ("max", "min") and allow_minmax:
+                specs[name] = fx
+            else:
+                raise ValueError(
+                    f"{type(self).__name__} cannot wrap {child}: state {name!r} has "
+                    f"dist_reduce_fx={fx!r}, which has no {self._KIND_NAME} rule."
+                )
+        return specs
+
+    def _delta_state(self, args: tuple, kwargs: dict) -> Dict[str, Any]:
+        """The wrapped metric's update applied to a fresh default state —
+        the batch's state contribution, guard included (its fault counters
+        land in the delta's ``_faults``)."""
+        child = self.wrapped
+        prev = child.__dict__["_state"]
+        object.__setattr__(child, "_state", dict(child._defaults))
+        try:
+            child._original_update(*args, **kwargs)
+            return dict(child.__dict__["_state"])
+        finally:
+            object.__setattr__(child, "_state", prev)
+
+    def _run_child_compute(self, state: Dict[str, Any]) -> Any:
+        child = self.wrapped
+        prev = child.__dict__["_state"]
+        object.__setattr__(child, "_state", state)
+        try:
+            return child._original_compute()
+        finally:
+            object.__setattr__(child, "_state", prev)
+
+    # -- fault channel over the wrapper's aggregated counters -----------
+
+    def _aggregated_fault_counts(self) -> Optional[Array]:
+        raise NotImplementedError
+
+    @property
+    def fault_counts(self) -> Optional[Dict[str, int]]:
+        """The wrapped metric's fault counters under this wrapper's
+        aggregation (windowed counters expire with their bucket; decayed
+        counters never decay). ``None`` when the child is unguarded or the
+        state is traced — same contract as ``Metric.fault_counts``."""
+        from metrics_tpu.utilities.guard import FAULT_CLASSES
+
+        counts = self._aggregated_fault_counts()
+        if counts is None:
+            return None
+        try:
+            host = np.asarray(counts)
+        except _TRACE_ERRORS:
+            return None
+        return {name: int(host[i]) for i, name in enumerate(FAULT_CLASSES)}
+
+    def _check_faults(self) -> None:
+        """Apply the CHILD's ``on_invalid`` policy at this wrapper's eager
+        compute boundary, from the aggregated counters."""
+        policy = getattr(self.wrapped, "on_invalid", "ignore")
+        if policy in ("ignore", "drop"):
+            return
+        counts = self._aggregated_fault_counts()
+        if counts is None:
+            return
+        try:
+            host = np.asarray(counts).astype(np.int64)
+        except _TRACE_ERRORS:
+            return
+        total = int(host.sum())
+        from metrics_tpu.utilities.guard import format_fault_report
+
+        owner = f"{type(self).__name__}({type(self.wrapped).__name__})"
+        if policy == "error":
+            if total > 0:
+                raise MetricsTPUUserError(format_fault_report(host, owner))
+            return
+        if total <= self._faults_reported:
+            return
+        self._faults_reported = total
+        rank_zero_warn(format_fault_report(host, owner), UserWarning)
+
+    def reset(self) -> None:
+        super().reset()
+        self.wrapped.reset()
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.wrapped!r})"
+
+
+class WindowedMetric(_StreamingWrapper):
+    """Sliding-window view of a sum/mean/max/min-reduced metric.
+
+    ``WindowedMetric(Accuracy(), window=8192, buckets=8)`` reports accuracy
+    over (at most) the trailing 8192 rows from eight 1024-row
+    sub-accumulator buckets — exactly the trailing 8192 whenever update
+    batches align with bucket boundaries (see the module docstring for the
+    attribution rule). State is ``buckets`` copies of the wrapped metric's
+    fixed-shape states; update and compute are one fused XLA program each.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric, WindowedMetric
+        >>> m = WindowedMetric(SumMetric(), window=4, buckets=2)
+        >>> for v in (1.0, 2.0, 3.0, 4.0):
+        ...     m.update(jnp.asarray([v, v]))
+        >>> float(m.compute())  # last 4 rows: two 2-row updates of 3s, 4s
+        14.0
+    """
+
+    def __init__(self, metric: Metric, window: int, buckets: int = 8, **kwargs: Any) -> None:
+        super().__init__(metric, **kwargs)
+        if not (isinstance(window, int) and window >= 1):
+            raise ValueError(f"`window` must be a positive number of rows, got {window}")
+        if not (isinstance(buckets, int) and 1 <= buckets <= window):
+            raise ValueError(f"`buckets` must be an int in [1, window], got {buckets}")
+        if window % buckets:
+            raise ValueError(
+                f"`window` ({window}) must be divisible by `buckets` ({buckets}) so every "
+                "bucket covers the same row quota"
+            )
+        self.window = window
+        self.buckets = buckets
+        self.bucket_len = window // buckets
+        self._specs = self._child_state_specs(allow_minmax=True)
+        self._identities: Dict[str, Array] = {}
+
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES
+
+        B = buckets
+        for name, kind in self._specs.items():
+            if kind == "faults":
+                identity = jnp.zeros((NUM_FAULT_CLASSES,), jnp.uint32)
+                fx = "sum"
+            else:
+                identity = jnp.asarray(self.wrapped._defaults[name])
+                fx = {"sum": "sum", "mean": "sum", "max": "max", "min": "min"}[kind]
+            self._identities[name] = identity
+            ring = jnp.broadcast_to(identity[None], (B,) + identity.shape) + jnp.zeros_like(
+                identity
+            )
+            self.add_state(f"win__{name}", default=ring, dist_reduce_fx=fx)
+        # bucket bookkeeping: head/fill are SPMD-replicated (max = identity
+        # across equal ranks); per-bucket update/row tallies sum globally
+        self.add_state("win__head", default=jnp.zeros((), jnp.int32), dist_reduce_fx="max")
+        self.add_state("win__fill", default=jnp.zeros((), jnp.int32), dist_reduce_fx="max")
+        self.add_state("win__n_updates", default=jnp.zeros((B,), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("win__rows", default=jnp.zeros((B,), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        n = _leading_rows(args, kwargs)
+        if n > self.bucket_len and not self.__dict__.get("_batch_span_warned"):
+            # n is static (a shape), so this fires at trace/call time, once:
+            # oversized batches make the covered span buckets*batch instead
+            # of `window` — defined behavior, but never silent
+            object.__setattr__(self, "_batch_span_warned", True)
+            rank_zero_warn(
+                f"{type(self).__name__}({type(self.wrapped).__name__}): update batches of "
+                f"{n} rows exceed the {self.bucket_len}-row bucket quota (window={self.window}, "
+                f"buckets={self.buckets}); each batch fills a whole bucket, so the covered span "
+                f"grows toward {self.buckets * n} rows instead of {self.window}. Size `buckets` "
+                "so window/buckets is at least the batch size (check `window_rows` for the span "
+                "actually covered).",
+                UserWarning,
+            )
+        delta = self._delta_state(args, kwargs)
+        B = self.buckets
+        head = self.win__head
+        fill = self.win__fill
+        # lazy rotation: the bucket that reached its quota stays readable
+        # until the next update needs a slot (so a just-filled window
+        # computes over ALL buckets, i.e. exactly `window` rows)
+        rotate = fill >= self.bucket_len
+        head = jnp.where(rotate, (head + 1) % B, head)
+        onehot = jnp.arange(B) == head
+
+        def roll(ring: Array, identity: Array, add: Callable[[Array, Array], Array], leaf: Array) -> Array:
+            mask = (rotate & onehot).reshape((B,) + (1,) * (ring.ndim - 1))
+            ring = jnp.where(mask, identity, ring)  # expire the reused slot
+            return add(ring, leaf)
+
+        for name, kind in self._specs.items():
+            ring_name = f"win__{name}"
+            leaf = delta[name].counts if kind == "faults" else jnp.asarray(delta[name])
+            if kind == "max":
+                add = lambda r, v: r.at[head].max(v)
+            elif kind == "min":
+                add = lambda r, v: r.at[head].min(v)
+            else:
+                add = lambda r, v: r.at[head].add(v)
+            setattr(self, ring_name, roll(getattr(self, ring_name), self._identities[name], add, leaf))
+        self.win__n_updates = roll(
+            self.win__n_updates, jnp.zeros((), jnp.int32), lambda r, v: r.at[head].add(v), jnp.int32(1)
+        )
+        self.win__rows = roll(
+            self.win__rows, jnp.zeros((), jnp.int32), lambda r, v: r.at[head].add(v), jnp.int32(n)
+        )
+        self.win__fill = jnp.where(rotate, 0, fill) + n
+        self.win__head = head
+
+    def _window_child_state(self) -> Dict[str, Any]:
+        from metrics_tpu.utilities.guard import FaultCounters
+
+        state: Dict[str, Any] = {}
+        for name, kind in self._specs.items():
+            ring = getattr(self, f"win__{name}")
+            if kind == "sum":
+                state[name] = ring.sum(axis=0)
+            elif kind == "mean":
+                total = jnp.maximum(self.win__n_updates.sum(), 1)
+                state[name] = ring.sum(axis=0) / total
+            elif kind == "max":
+                state[name] = ring.max(axis=0)
+            elif kind == "min":
+                state[name] = ring.min(axis=0)
+            else:  # faults
+                state[name] = FaultCounters(counts=ring.sum(axis=0))
+        return state
+
+    def compute(self) -> Any:
+        return self._run_child_compute(self._window_child_state())
+
+    @property
+    def window_rows(self) -> Optional[int]:
+        """Rows currently covered by the window (None while traced)."""
+        try:
+            return int(self.win__rows.sum())
+        except _TRACE_ERRORS:
+            return None
+
+    def _aggregated_fault_counts(self) -> Optional[Array]:
+        ring = self._state.get("win___faults")
+        return None if ring is None else ring.sum(axis=0)
+
+
+class DecayedMetric(_StreamingWrapper):
+    """Exponentially-decayed view of a sum/mean-reduced metric.
+
+    Each accumulated row's weight halves every ``halflife`` rows, so the
+    value tracks the recent stream with smooth forgetting — the
+    infinite-window complement of :class:`WindowedMetric`'s hard cutoff.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import DecayedMetric, MeanMetric
+        >>> m = DecayedMetric(MeanMetric(nan_strategy="ignore"), halflife=1.0)
+        >>> for v in (0.0, 0.0, 1.0):
+        ...     m.update(jnp.asarray([v]))
+        >>> round(float(m.compute()), 4)  # weights 2^-2, 2^-1, 1 -> 4/7
+        0.5714
+
+    """
+
+    _KIND_NAME = "decay"
+
+    def __init__(self, metric: Metric, halflife: float, **kwargs: Any) -> None:
+        super().__init__(metric, **kwargs)
+        if not (float(halflife) > 0):
+            raise ValueError(f"`halflife` must be a positive number of rows, got {halflife}")
+        self.halflife = float(halflife)
+        self._specs = self._child_state_specs(allow_minmax=False)
+
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES
+
+        for name, kind in self._specs.items():
+            if kind == "faults":
+                default = jnp.zeros((NUM_FAULT_CLASSES,), jnp.uint32)
+            else:
+                # decayed accumulators are fractional by construction
+                default = jnp.zeros(jnp.shape(self.wrapped._defaults[name]), jnp.float32)
+            self.add_state(f"dec__{name}", default=default, dist_reduce_fx="sum")
+        self.add_state("dec__n_updates", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        n = _leading_rows(args, kwargs)
+        delta = self._delta_state(args, kwargs)
+        factor = jnp.float32(2.0 ** (-n / self.halflife))  # n is static
+        for name, kind in self._specs.items():
+            dec_name = f"dec__{name}"
+            if kind == "faults":
+                # fault evidence does not fade
+                setattr(self, dec_name, getattr(self, dec_name) + delta[name].counts)
+            else:
+                setattr(
+                    self,
+                    dec_name,
+                    getattr(self, dec_name) * factor + jnp.asarray(delta[name], jnp.float32),
+                )
+        self.dec__n_updates = self.dec__n_updates * factor + 1.0
+
+    def _decayed_child_state(self) -> Dict[str, Any]:
+        from metrics_tpu.utilities.guard import FaultCounters
+
+        state: Dict[str, Any] = {}
+        for name, kind in self._specs.items():
+            dec = getattr(self, f"dec__{name}")
+            if kind == "faults":
+                state[name] = FaultCounters(counts=dec)
+            elif kind == "mean":
+                state[name] = dec / jnp.maximum(self.dec__n_updates, jnp.float32(1e-30))
+            else:
+                state[name] = dec
+        return state
+
+    def compute(self) -> Any:
+        return self._run_child_compute(self._decayed_child_state())
+
+    def _aggregated_fault_counts(self) -> Optional[Array]:
+        return self._state.get("dec___faults")

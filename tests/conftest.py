@@ -30,3 +30,9 @@ def pytest_configure(config):
         "tier-1 fast lane (select with -m faults); the heavy repeat-seed sweep "
         "is additionally marked slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "streaming: the streaming subsystem (metrics_tpu/streaming/ — windowed/"
+        "decayed wrappers and mergeable sketches); select with -m streaming, "
+        "or run the directory via `make test-streaming`",
+    )

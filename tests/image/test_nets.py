@@ -103,6 +103,9 @@ def test_inception_resize_matches_torch_bilinear():
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+@pytest.mark.slow  # heavyweight twin construction (~23s: a full torch
+#                    InceptionV3 init just to corrupt one key) — the
+#                    loader's happy path stays in the fast lane
 def test_inception_loader_rejects_shape_mismatch():
     twin = TorchInceptionV3(variant="fid")
     sd = twin.state_dict()

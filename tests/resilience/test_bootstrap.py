@@ -228,7 +228,14 @@ class TestHealthRegistry:
         )
         coll["acc"].update(jnp.asarray([0.9, float("nan")]), jnp.asarray([1, 0]))
         rep = metrics_tpu.health_report(coll)
-        assert "acc" in rep["metrics"] and "mse" not in rep["metrics"]
+        assert "faults" in rep["metrics"]["acc"]
+        # staleness (ISSUE 4 satellite) surfaces for EVERY member — a fed
+        # member carries its last-update step/wall-clock, an unfed one says
+        # so — but only faults/overflow flip the degraded flag
+        assert rep["metrics"]["acc"]["last_update_step"] == 1
+        assert rep["metrics"]["acc"]["staleness_s"] >= 0.0
+        assert rep["metrics"]["mse"] == {"never_updated": True}
+        assert rep["degraded"] is True
 
     def test_clean_process_reports_not_degraded(self):
         rep = metrics_tpu.health_report()

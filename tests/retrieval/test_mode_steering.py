@@ -55,3 +55,32 @@ def test_small_n_does_not_warn():
     with warnings.catch_warnings():
         warnings.simplefilter("error", UserWarning)
         float(m.compute())
+
+
+def test_env_var_overrides_warn_threshold(monkeypatch):
+    # module default says warn at 32 rows; the env var raises it past the
+    # fed 64 rows, so no warning fires
+    monkeypatch.setenv("METRICS_TPU_EAGER_WARN_ROWS", "1000000")
+    m = mt.RetrievalMAP()
+    _feed(m)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        float(m.compute())
+    # and lowering it below the module default re-enables the warn
+    monkeypatch.setenv("METRICS_TPU_EAGER_WARN_ROWS", "1")
+    monkeypatch.setattr(retrieval_base, "_HOST_GROUPED_WARN_N", 1_000_000)
+    m2 = mt.RetrievalMAP()
+    _feed(m2)
+    with pytest.warns(UserWarning, match="host-grouped eager path"):
+        float(m2.compute())
+
+
+def test_env_var_malformed_warns_once_and_uses_default(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_EAGER_WARN_ROWS", "not-a-number")
+    m = mt.RetrievalMAP()
+    _feed(m)  # 64 rows >= the patched 32-row default -> steering warn fires
+    with pytest.warns(UserWarning) as caught:
+        float(m.compute())
+    messages = [str(w.message) for w in caught]
+    assert any("METRICS_TPU_EAGER_WARN_ROWS" in msg for msg in messages)
+    assert any("host-grouped eager path" in msg for msg in messages)

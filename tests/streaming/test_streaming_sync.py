@@ -1,0 +1,205 @@
+"""Distributed + resilience surfaces of the streaming subsystem.
+
+Pins the ISSUE 4 HLO acceptance — a guarded collection containing sketch
+states syncs in <= 2 all-reduces through ``fused_sync`` (the quantile
+sketch's gather payload joins the float32 sum bucket as scatter+psum; the
+CountMin counters ride the uint32 sum bucket with the fault counters) —
+plus 8-device global-vs-single-stream value parity, the process-level
+gather path, and the health_report staleness satellite.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+
+pytestmark = pytest.mark.streaming
+
+# 4 of the conftest mesh's 8 devices: the gather-merge fold unrolls
+# (ndev - 1) per sketch, so compile time halves while the collective
+# structure under test is identical (8-device parity is pinned by the
+# dryrun_multichip acceptance step)
+NDEV = 4
+
+# small sketch geometry everywhere: compile cost scales with levels x folds,
+# and the collective structure under test is geometry-independent (the
+# error-bound contract itself is pinned at scale in test_sketches.py)
+QS = dict(eps=0.1, k=64, levels=6)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+
+def test_guarded_collection_with_sketches_syncs_in_two_all_reduces():
+    coll = mt.MetricCollection(
+        {
+            "mean": mt.MeanMetric(nan_strategy="warn"),  # guarded: uint32 faults
+            "q": mt.QuantileSketch(on_invalid="drop", quantiles=(0.5, 0.99), **QS),
+            "cm": mt.CountMinSketch(width=256),
+        }
+    )
+    cdef = mt.functionalize(coll, axis_name="data")
+
+    def step(v):
+        s = cdef.init()
+        s = cdef.update(s, v)
+        return cdef.compute(s)
+
+    fn = jax.jit(jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"),), out_specs=P()))
+    vals = jnp.asarray(np.random.default_rng(0).random(64 * NDEV).astype(np.float32))
+    hlo = fn.lower(vals).compile().as_text()
+    n = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+    assert n <= 2, f"guarded collection with sketch states took {n} all-reduces, expected <= 2"
+    # and the fused path is VALUE-correct: the synced quantiles cover the
+    # whole cross-device stream, not one shard
+    out = fn(vals)
+    x = np.asarray(vals)
+    for v, q in zip(np.asarray(out["q"]), (0.5, 0.99)):
+        err = max(float(np.mean(x < v)) - q, q - float(np.mean(x <= v)), 0.0)
+        assert err <= 0.1, f"synced quantile rank err {err} at q={q}"
+    np.testing.assert_allclose(float(out["mean"]), x.mean(), rtol=1e-5)
+
+
+def test_sharded_sketch_sync_matches_single_stream():
+    """Per-device shards synced through the fused buckets equal ONE sketch
+    fed the concatenated stream — BITWISE, since CountMin/HLL merges are
+    elementwise. (The quantile sketch's sharded gather-merge parity is
+    pinned by the HLO-collection test above, which computes its synced
+    quantiles, and by the 8-device dryrun acceptance step.)"""
+    rng = np.random.default_rng(1)
+    vals = jnp.asarray(rng.random(128 * NDEV).astype(np.float32))
+
+    cdef = mt.functionalize(mt.CountMinSketch(width=256), axis_name="data")
+    hdef = mt.functionalize(mt.HyperLogLog(precision=8), axis_name="data")
+
+    def step(v):
+        states = [d.init() for d in (cdef, hdef)]
+        states = [
+            jax.tree_util.tree_map(lambda x: jax.lax.pcast(x, ("data",), to="varying"), s)
+            for s in states
+        ]
+        c, h = (d.update(s, v) for d, s in zip((cdef, hdef), states))
+        return cdef.compute(c), hdef.compute(h)
+
+    cm_g, hll_g = jax.jit(
+        jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"),), out_specs=P())
+    )(vals)
+
+    cm_s = mt.CountMinSketch(width=256)
+    cm_s.update(vals)
+    hll_s = mt.HyperLogLog(precision=8)
+    hll_s.update(vals)
+
+    assert np.array_equal(np.asarray(cm_g), np.asarray(cm_s.compute()))
+    assert float(hll_g) == float(hll_s.compute())
+
+
+def test_process_level_gather_folds_sketches():
+    """``Metric._sync_dist`` with an injected transport: per-rank sketch
+    leaves gather and fold through sketch_merge (2 simulated ranks)."""
+    rng = np.random.default_rng(2)
+    a_rows = jnp.asarray(rng.random(64).astype(np.float32))
+    b_rows = jnp.asarray(rng.random(64).astype(np.float32))
+
+    other = mt.QuantileSketch(quantiles=(0.5,), **QS)
+    other.update(b_rows)
+    other_leaves = jax.tree_util.tree_leaves(other.metric_state["sketch"])
+    calls = {"i": 0}
+
+    def fake_gather(x, group=None):
+        # pair each gathered leaf with the peer's corresponding leaf, in
+        # tree_flatten order (the order _sync_dist gathers them)
+        peer = other_leaves[calls["i"] % len(other_leaves)]
+        calls["i"] += 1
+        return [jnp.asarray(x), jnp.asarray(peer)]
+
+    m = mt.QuantileSketch(quantiles=(0.5,), **QS)
+    m.update(a_rows)
+    m.sync(dist_sync_fn=fake_gather, distributed_available_fn=lambda: True)
+    merged = m.metric_state["sketch"]
+    assert int(merged.n_seen) == 128
+    both = np.concatenate([np.asarray(a_rows), np.asarray(b_rows)])
+    v = float(merged.quantile(0.5)[0])
+    err = max(float(np.mean(both < v)) - 0.5, 0.5 - float(np.mean(both <= v)), 0.0)
+    assert err <= 0.1
+    m.unsync()
+    assert int(m.metric_state["sketch"].n_seen) == 64
+
+
+def test_fused_sync_inside_collection_sync_states():
+    """The eager ``MetricCollection.sync_states`` fused path under
+    shard_map handles sketches next to plain states."""
+    coll = mt.MetricCollection(
+        {"q": mt.QuantileSketch(quantiles=(0.5,), **QS), "hll": mt.HyperLogLog(precision=8)}
+    )
+    rng = np.random.default_rng(3)
+    vals = rng.random(64 * NDEV).astype(np.float32)
+    from metrics_tpu.parallel.sync import fused_sync
+
+    # MetricCollection sorts dict keys: members arrive as (hll, q)
+    names = list(coll.keys(keep_base=True))
+    members = [coll._modules[name] for name in names]
+    iq, ih = names.index("q"), names.index("hll")
+
+    def step(v):
+        states = []
+        for m in members:
+            s = {k: jax.tree_util.tree_map(lambda x: jax.lax.pcast(x, ("data",), to="varying"), val)
+                 for k, val in m._defaults.items()}
+            states.append(s)
+        # simulate per-device accumulation via the pure insert
+        states[iq]["sketch"] = states[iq]["sketch"].insert(v)
+        states[ih]["sketch"] = states[ih]["sketch"].insert(v)
+        synced = fused_sync(states, [m._reductions for m in members], "data")
+        return synced[iq]["sketch"].n_seen, synced[ih]["sketch"].estimate()
+
+    n_seen, est = jax.jit(
+        jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"),), out_specs=P())
+    )(jnp.asarray(vals))
+    assert int(n_seen) == 64 * NDEV
+    distinct = len(np.unique(vals))
+    assert abs(float(est) - distinct) / distinct < 0.15
+
+
+def test_health_report_staleness_and_never_updated():
+    m = mt.QuantileSketch(quantiles=(0.5,), **QS)
+    m.update(jnp.arange(8.0))
+    fresh = mt.CountMinSketch(width=256)
+    report = mt.health_report(m, fresh)
+    entry = report["metrics"]["QuantileSketch"]
+    assert entry["last_update_step"] == 1
+    assert entry["staleness_s"] >= 0.0
+    assert "last_update_unix" in entry
+    assert report["metrics"]["CountMinSketch"] == {"never_updated": True}
+    # staleness alone must not flip the degraded flag
+    assert report["degraded"] is False
+    # faults still do
+    g = mt.QuantileSketch(quantiles=(0.5,), on_invalid="drop", **QS)
+    g.update(jnp.asarray([1.0, np.nan]))
+    report2 = mt.health_report(g)
+    assert report2["metrics"]["QuantileSketch"]["faults"]["dropped_rows"] == 1
+    assert report2["degraded"] is True
+
+
+def test_staleness_clock_survives_snapshot_restore(tmp_path):
+    """A restored metric must not read as never_updated — the snapshot
+    carries the staleness clock (and elastic merges keep the freshest
+    rank's)."""
+    from metrics_tpu.resilience.snapshot import SnapshotManager
+
+    mgr = SnapshotManager(str(tmp_path), keep=2)
+    saved_clock = None
+    for rank in range(2):
+        part = mt.HyperLogLog(precision=8)
+        part.update(jnp.arange(rank * 100, rank * 100 + 100))
+        saved_clock = max(saved_clock or 0.0, part._last_update_unix)
+        mgr.save(part, step=1, rank=rank, world_size=2)
+    restored = mt.HyperLogLog(precision=8)
+    mgr.restore(restored, rank=0, world_size=1)  # elastic 2 -> 1 merge
+    entry = mt.health_report(restored)["metrics"]["HyperLogLog"]
+    assert entry.get("never_updated") is None
+    assert entry["last_update_unix"] == saved_clock
+    assert entry["last_update_step"] == 2  # summed update counts

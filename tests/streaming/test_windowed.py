@@ -1,0 +1,223 @@
+"""Windowed/decayed wrapper contracts: window parity vs exact recompute of
+the trailing W rows (bit-exact for sum-reduced states, across bucket
+boundaries, window wrap-around, and reset()), decayed-mean closed-form
+parity, jitted-stream behavior, the windowed fault channel, and the
+refusal surface for states with no bucket/decay semantics."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu as mt
+
+pytestmark = pytest.mark.streaming
+
+
+def _acc_stream(seed=11, total=400, classes=4):
+    rng = np.random.default_rng(seed)
+    preds = rng.random((total, classes)).astype(np.float32)
+    target = rng.integers(0, classes, total).astype(np.int32)
+    return preds, target
+
+
+# --------------------------------------------------------------------------
+# window parity
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("batch", [8])  # bucket_len=16: two updates per bucket
+#            (batch == bucket_len is covered by the full-coverage test below)
+def test_window_parity_vs_exact_trailing_recompute(batch):
+    """After every aligned update the windowed value equals a bit-exact
+    fresh recompute over the covered trailing rows — including long after
+    the ring wrapped."""
+    W, B, classes = 64, 4, 4
+    preds, target = _acc_stream(total=10 * W // 4)  # 2.5 window wraps
+    wm = mt.WindowedMetric(mt.Accuracy(num_classes=classes), window=W, buckets=B)
+    exact = mt.Accuracy(num_classes=classes)  # ONE instance: reset() keeps
+    #                                           its jit cache, a fresh
+    #                                           instance per step recompiles
+    seen = 0
+    for i in range(0, len(preds) - batch + 1, batch):
+        wm.update(jnp.asarray(preds[i : i + batch]), jnp.asarray(target[i : i + batch]))
+        seen = i + batch
+        covered = wm.window_rows
+        assert covered == min(seen, W) or covered == min(seen, W - wm.bucket_len + batch)
+        exact.reset()
+        exact.update(jnp.asarray(preds[seen - covered : seen]), jnp.asarray(target[seen - covered : seen]))
+        assert float(wm.compute()) == float(exact.compute())
+        wm._computed = None  # stream continues; drop the compute cache
+
+
+def test_window_full_coverage_is_exactly_w_rows_after_wraps():
+    W, B = 32, 4
+    preds, target = _acc_stream(total=10 * W)
+    wm = mt.WindowedMetric(mt.Accuracy(num_classes=4), window=W, buckets=B)
+    L = wm.bucket_len
+    for i in range(0, 10 * W, L):  # one full bucket per update
+        wm.update(jnp.asarray(preds[i : i + L]), jnp.asarray(target[i : i + L]))
+    assert wm.window_rows == W  # hard cutoff: exactly the trailing window
+    exact = mt.Accuracy(num_classes=4)
+    exact.update(jnp.asarray(preds[-W:]), jnp.asarray(target[-W:]))
+    assert float(wm.compute()) == float(exact.compute())
+
+
+def test_window_reset_restarts_the_stream():
+    W, B = 32, 4
+    preds, target = _acc_stream(seed=12, total=2 * W)
+    wm = mt.WindowedMetric(mt.Accuracy(num_classes=4), window=W, buckets=B)
+    wm.update(jnp.asarray(preds[:W]), jnp.asarray(target[:W]))
+    wm.reset()
+    assert wm.window_rows == 0
+    wm.update(jnp.asarray(preds[W:]), jnp.asarray(target[W:]))
+    exact = mt.Accuracy(num_classes=4)
+    exact.update(jnp.asarray(preds[W:]), jnp.asarray(target[W:]))
+    assert float(wm.compute()) == float(exact.compute())
+
+
+def test_windowed_jitted_stream_via_functionalize():
+    """The acceptance stream shape: a long fully-jitted update loop whose
+    windowed value equals the exact recompute of the trailing W rows."""
+    W, B, batch = 64, 4, 16
+    preds, target = _acc_stream(seed=13, total=400)
+    mdef = mt.functionalize(mt.WindowedMetric(mt.Accuracy(num_classes=4), window=W, buckets=B))
+    upd = jax.jit(mdef.update)
+    state = mdef.init()
+    for i in range(0, 400, batch):
+        state = upd(state, jnp.asarray(preds[i : i + batch]), jnp.asarray(target[i : i + batch]))
+    exact = mt.Accuracy(num_classes=4)
+    exact.update(jnp.asarray(preds[-W:]), jnp.asarray(target[-W:]))
+    assert float(mdef.compute(state)) == float(exact.compute())
+
+
+def test_windowed_mean_and_minmax_states():
+    # mean-reduced child state: windowed value averages update deltas of
+    # the covered buckets only
+    wm = mt.WindowedMetric(mt.MeanMetric(nan_strategy="ignore"), window=4, buckets=2)
+    for batch in ([1.0, 1.0], [2.0, 2.0], [8.0, 8.0]):
+        wm.update(jnp.asarray(batch))
+    assert float(wm.compute()) == 5.0  # rows 2,2,8,8
+    # max-reduced: an old spike must expire with its bucket
+    mm = mt.WindowedMetric(mt.MaxMetric(nan_strategy="ignore"), window=4, buckets=2)
+    for batch in ([9.0, 9.0], [1.0, 1.0], [2.0, 2.0]):
+        mm.update(jnp.asarray(batch))
+    assert float(mm.compute()) == 2.0  # the 9s rotated out
+
+
+# --------------------------------------------------------------------------
+# decay
+# --------------------------------------------------------------------------
+
+
+def test_decayed_mean_closed_form_parity():
+    """DecayedMetric(MeanMetric) == the closed-form exponentially weighted
+    mean with per-row weight 2**(-age_rows / halflife)."""
+    rng = np.random.default_rng(14)
+    xs = rng.random(64).astype(np.float32)
+    h = 7.0
+    m = mt.DecayedMetric(mt.MeanMetric(nan_strategy="ignore"), halflife=h)
+    for v in xs:
+        m.update(jnp.asarray([v]))
+    ages = np.arange(len(xs) - 1, -1, -1, dtype=np.float64)
+    w = 2.0 ** (-ages / h)
+    expect = float((w * xs).sum() / w.sum())
+    np.testing.assert_allclose(float(m.compute()), expect, rtol=1e-5)
+
+
+def test_decayed_sum_tracks_recent_distribution():
+    m = mt.DecayedMetric(mt.Accuracy(num_classes=2), halflife=8.0)
+    ones = jnp.ones((16,), jnp.int32)
+    p_right = jnp.stack([jnp.zeros(16), jnp.ones(16)], axis=1)
+    p_wrong = p_right[:, ::-1]
+    m.update(p_wrong, ones)  # old: all wrong
+    for _ in range(4):
+        m.update(p_right, ones)  # recent: all right
+    assert float(m.compute()) > 0.9  # the wrong epoch has decayed away
+
+
+def test_decayed_jitted_stream():
+    mdef = mt.functionalize(mt.DecayedMetric(mt.MeanMetric(nan_strategy="ignore"), halflife=4.0))
+    upd = jax.jit(mdef.update)
+    state = mdef.init()
+    for v in (1.0, 2.0, 3.0, 4.0):
+        state = upd(state, jnp.full((4,), v))
+    eager = mt.DecayedMetric(mt.MeanMetric(nan_strategy="ignore"), halflife=4.0)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        eager.update(jnp.full((4,), v))
+    np.testing.assert_allclose(float(mdef.compute(state)), float(eager.compute()), rtol=1e-6)
+
+
+# --------------------------------------------------------------------------
+# fault channel through the wrappers
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_windowed_fault_counters_expire_with_their_bucket():
+    wm = mt.WindowedMetric(mt.MeanMetric(nan_strategy="warn"), window=4, buckets=2)
+    bad = jnp.asarray([1.0, np.nan])
+    good = jnp.asarray([1.0, 2.0])
+    with pytest.warns(UserWarning, match="faults detected"):
+        wm.update(bad)
+        float(wm.compute())
+    assert wm.fault_counts["dropped_rows"] == 1
+    wm._computed = None
+    for _ in range(3):  # the NaN bucket rotates out of the window
+        wm.update(good)
+    assert wm.fault_counts["dropped_rows"] == 0
+    assert np.isfinite(float(wm.compute()))
+
+
+@pytest.mark.faults
+def test_decayed_fault_counters_do_not_decay():
+    dm = mt.DecayedMetric(mt.MeanMetric(nan_strategy="warn"), halflife=1.0)
+    dm.update(jnp.asarray([1.0, np.nan]))
+    for _ in range(10):
+        dm.update(jnp.asarray([1.0, 2.0]))
+    assert dm.fault_counts["dropped_rows"] == 1  # evidence does not fade
+    dm._computed = None
+    dm_err = mt.DecayedMetric(mt.MeanMetric(nan_strategy="error"), halflife=1.0)
+    with pytest.raises(RuntimeError, match="nan"):
+        dm_err.update(jnp.asarray([np.nan]))
+
+
+# --------------------------------------------------------------------------
+# refusal surface + config validation
+# --------------------------------------------------------------------------
+
+
+def test_wrappers_refuse_rowful_and_unsupported_states():
+    with pytest.raises(ValueError, match="per-row/list/sketch"):
+        mt.WindowedMetric(mt.AUROC(capacity=64), window=8, buckets=2)
+    with pytest.raises(ValueError, match="per-row/list/sketch"):
+        mt.WindowedMetric(mt.CatMetric(), window=8, buckets=2)
+    with pytest.raises(ValueError, match="per-row/list/sketch"):
+        mt.WindowedMetric(mt.QuantileSketch(eps=0.1, max_items=1 << 12), window=8, buckets=2)
+    with pytest.raises(ValueError, match="no decay rule"):
+        mt.DecayedMetric(mt.MaxMetric(), halflife=4.0)
+
+
+def test_oversized_batches_warn_once_and_report_true_span():
+    import warnings
+
+    wm = mt.WindowedMetric(mt.SumMetric(nan_strategy="ignore"), window=8, buckets=4)
+    batch = jnp.full((5,), 1.0)  # 5 > bucket_len=2: every update fills a bucket
+    with pytest.warns(UserWarning, match="exceed the 2-row bucket quota"):
+        wm.update(batch)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)  # once per instance
+        for _ in range(7):
+            wm.update(batch)
+    assert wm.window_rows == 4 * 5  # buckets * batch, honestly reported
+    assert float(wm.compute()) == 20.0
+
+
+def test_window_config_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        mt.WindowedMetric(mt.SumMetric(), window=10, buckets=4)
+    with pytest.raises(ValueError, match="window"):
+        mt.WindowedMetric(mt.SumMetric(), window=0, buckets=1)
+    with pytest.raises(ValueError, match="halflife"):
+        mt.DecayedMetric(mt.SumMetric(), halflife=0.0)
+    with pytest.raises(ValueError, match="Metric"):
+        mt.WindowedMetric(object(), window=8, buckets=2)  # type: ignore[arg-type]

@@ -75,6 +75,9 @@ def test_bert_torch_weight_parity_all_layers():
         np.testing.assert_allclose(np.asarray(g), w.numpy(), atol=1e-4, err_msg=f"layer {i}")
 
 
+@pytest.mark.slow  # heavyweight twin construction (~38s: two full BERT
+#                    inits) — the same class of test PR 1 moved out of the
+#                    tier-1 lane; unmasked parity keeps fast-lane coverage
 def test_bert_parity_with_padding_mask():
     """Masked (padding) keys must not influence valid positions — compared
     on the valid positions only (HF computes garbage at padded queries;
