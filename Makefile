@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun test-resilience test-streaming
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -36,6 +36,18 @@ bench:
 # The multichip dry run on the 8-device virtual CPU mesh.
 dryrun:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# Static analysis (ISSUE 5): graft-lint AST rules (import purity, trace
+# safety, state discipline — failures print path:line:col + rule id) plus
+# the compiled-graph budget audit over the entry-point registry. CPU-only
+# by construction; new findings (not in lint_baseline.txt) fail the build.
+lint:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m metrics_tpu.analysis all
+
+# Fast feedback on the analysis subsystem itself (same tests the `analysis`
+# pytest marker selects; the compile-heavy full-registry audit is `slow`).
+test-analysis:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/analysis/ -q -m 'not slow' -p no:cacheprovider
 
 # Fast feedback on the resilience subsystem only (snapshots + bootstrap).
 test-resilience:

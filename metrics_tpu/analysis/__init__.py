@@ -1,0 +1,50 @@
+"""Graft-lint: static analysis that keeps the repo's load-bearing invariants
+mechanically checked instead of reviewer-enforced.
+
+Two complementary passes (ISSUE 5):
+
+- **AST lint** (:mod:`metrics_tpu.analysis.lint` + ``analysis/rules/``):
+  visitor-based rules over the package source — import purity (the PR-4
+  ``jnp.float32`` module-constant bug class that nearly re-broke the
+  hang-proof bootstrap), trace safety on jitted ``update`` paths, and state
+  discipline (``add_state`` declarations, ``template=`` on list states).
+  Per-line suppressions (``# graft-lint: disable=GL102``) and a checked-in
+  baseline file grandfather legacy findings.
+- **Compiled-graph audit** (:mod:`metrics_tpu.analysis.graph_audit` +
+  ``analysis/registry.py``): lowers representative jitted entry points and
+  asserts structural budgets on the optimized HLO — all-reduce/all-gather
+  counts, no f64, no host callbacks, no dynamic shapes — plus a
+  recompilation detector. The premise is the EQuARX/T3 one: a collective
+  budget you cannot mechanically measure is a budget you cannot preserve.
+
+Run both from the CLI (``python -m metrics_tpu.analysis``) or ``make lint``.
+This module imports no jax at module scope — the lint pass is pure AST and
+stays usable even when the accelerator runtime is wedged; the graph audit
+imports jax lazily when invoked.
+"""
+from metrics_tpu.analysis.baseline import (
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    save_baseline,
+)
+from metrics_tpu.analysis.lint import (
+    Finding,
+    lint_package,
+    lint_paths,
+    lint_source,
+)
+from metrics_tpu.analysis.rules import ALL_RULES, rule_catalog
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "apply_baseline",
+    "default_baseline_path",
+    "lint_package",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "rule_catalog",
+    "save_baseline",
+]

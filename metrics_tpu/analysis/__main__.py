@@ -1,0 +1,118 @@
+"""CLI for the graft-lint passes: ``python -m metrics_tpu.analysis``.
+
+Subcommands::
+
+    python -m metrics_tpu.analysis lint    # AST rules over metrics_tpu/
+    python -m metrics_tpu.analysis audit   # compiled-graph budget registry
+    python -m metrics_tpu.analysis all     # both (the `make lint` target)
+
+Lint findings print as ``path:line:col: RULEID message`` (clickable,
+CI-greppable); exit code 1 when any NEW finding (not in the baseline) or
+budget violation exists. ``--write-baseline`` regenerates the baseline from
+the current findings — an escape hatch for landing the linter against
+legacy debt, not a place to park new violations.
+
+The audit pass needs a multi-device jax backend; run under
+``JAX_PLATFORMS=cpu`` (it forces an 8-virtual-device CPU mesh exactly like
+``tests/conftest.py``).
+"""
+import argparse
+import sys
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    from metrics_tpu.analysis.baseline import (
+        apply_baseline,
+        default_baseline_path,
+        load_baseline,
+        save_baseline,
+    )
+    from metrics_tpu.analysis.lint import lint_package
+
+    findings = lint_package()
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        save_baseline(baseline_path, findings)
+        print(f"graft-lint: wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+    new, stale = apply_baseline(findings, load_baseline(baseline_path))
+    for f in new:
+        print(f.format())
+    if stale:
+        print(
+            f"graft-lint: {sum(stale.values())} stale baseline entr(y/ies) — debt paid "
+            f"down; prune {baseline_path}:",
+            file=sys.stderr,
+        )
+        for fp in sorted(stale):
+            print(f"  {fp}", file=sys.stderr)
+    grandfathered = len(findings) - len(new)
+    print(
+        f"graft-lint: {len(new)} new finding(s), {grandfathered} grandfathered "
+        f"(baseline: {baseline_path})"
+    )
+    return 1 if new else 0
+
+
+def _cmd_audit(args: argparse.Namespace) -> int:
+    # the audit lowers shard_mapped entries: force the virtual CPU mesh
+    # before any jax backend initializes (same bootstrap as tests/conftest.py)
+    from metrics_tpu.utilities.backend import force_cpu_backend
+
+    force_cpu_backend(max(args.ndev, args.mesh_ndev))
+
+    from metrics_tpu.analysis.registry import REGISTRY, run_graph_audit
+
+    violations = run_graph_audit(ndev=args.mesh_ndev)
+    for v in violations:
+        print(v.format())
+    print(
+        f"graph-audit: {len(violations)} violation(s) across {len(REGISTRY)} "
+        f"registry entr(y/ies) on a {args.mesh_ndev}-device mesh"
+    )
+    return 1 if violations else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m metrics_tpu.analysis",
+        description="graft-lint: AST purity/trace-safety lint + compiled-graph budget audit",
+    )
+    parser.add_argument(
+        "command",
+        nargs="?",
+        default="all",
+        choices=("lint", "audit", "all", "rules"),
+        help="which pass to run (default: all); `rules` prints the rule catalog",
+    )
+    parser.add_argument("--baseline", help="baseline file path (default: <repo>/lint_baseline.txt)")
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="regenerate the baseline from current findings instead of failing on them",
+    )
+    parser.add_argument(
+        "--ndev", type=int, default=8, help="virtual CPU devices to force for the audit (default 8)"
+    )
+    parser.add_argument(
+        "--mesh-ndev", type=int, default=4, help="mesh size for sharded audit entries (default 4)"
+    )
+    args = parser.parse_args(argv)
+
+    if args.command == "rules":
+        from metrics_tpu.analysis.rules import ALL_RULES
+
+        for rule in ALL_RULES:
+            print(f"{rule.rule_id}  {rule.name}\n    {rule.description}")
+        return 0
+
+    rc = 0
+    if args.command in ("lint", "all"):
+        rc |= _cmd_lint(args)
+    if args.command in ("audit", "all"):
+        rc |= _cmd_audit(args)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

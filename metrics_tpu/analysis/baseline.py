@@ -1,0 +1,91 @@
+"""Baseline file: grandfathered lint findings, checked in at the repo root.
+
+The baseline lets the linter land strict while legacy findings are burned
+down incrementally: ``apply_baseline`` subtracts known findings so only NEW
+violations fail the build. Fingerprints are ``rule_id | path | stripped
+source line`` — deliberately line-number-free, so editing an unrelated part
+of a file does not stale the baseline — with a count per fingerprint to
+handle identical lines appearing more than once in one file.
+
+Format (one entry per line, ``|``-separated, ``#`` comments)::
+
+    GL102|metrics_tpu/foo.py|1|HALF = jnp.float32(0.5)
+
+The shipped baseline (``lint_baseline.txt``) is empty: ISSUE 5's self-clean
+satellite fixed every real finding on the first full-package run. Keep it
+that way — ``--write-baseline`` exists for emergencies, not as a landfill.
+"""
+import os
+from collections import Counter
+from typing import Dict, Iterable, List, Tuple
+
+from metrics_tpu.analysis.lint import Finding, package_root
+
+BASELINE_FILENAME = "lint_baseline.txt"
+_HEADER = (
+    "# graft-lint baseline: grandfathered findings (rule_id|path|count|snippet).\n"
+    "# Entries here are known debt — new findings still fail `make lint`.\n"
+    "# Regenerate with: python -m metrics_tpu.analysis lint --write-baseline\n"
+)
+
+
+def default_baseline_path() -> str:
+    return os.path.join(package_root(), BASELINE_FILENAME)
+
+
+def fingerprint(finding: Finding) -> str:
+    # collapse internal whitespace so formatting-only edits don't stale entries
+    snippet = " ".join(finding.snippet.split())
+    return f"{finding.rule_id}|{finding.path}|{snippet}"
+
+
+def load_baseline(path: str) -> Counter:
+    """Fingerprint -> grandfathered occurrence count. Missing file = empty."""
+    counts: Counter = Counter()
+    if not os.path.exists(path):
+        return counts
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("|", 3)
+            if len(parts) != 4:
+                raise ValueError(f"malformed baseline entry in {path}: {line!r}")
+            rule_id, rel, count, snippet = parts
+            # same normalization as fingerprint(): a hand-copied entry with
+            # the source's real spacing must still match
+            snippet = " ".join(snippet.split())
+            counts[f"{rule_id}|{rel}|{snippet}"] += int(count)
+    return counts
+
+
+def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    counts = Counter(fingerprint(f) for f in findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(_HEADER)
+        for fp in sorted(counts):
+            rule_id, rel, snippet = fp.split("|", 2)
+            fh.write(f"{rule_id}|{rel}|{counts[fp]}|{snippet}\n")
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], Dict[str, int]]:
+    """Split findings into (new, grandfathered-count-by-fingerprint).
+
+    Each baseline occurrence absorbs one matching finding; the remainder are
+    new and should fail the run. Also usable to spot STALE baseline entries:
+    leftover counts in the returned dict mean the debt was paid down and the
+    entry can be deleted.
+    """
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        fp = fingerprint(f)
+        if remaining[fp] > 0:
+            remaining[fp] -= 1
+        else:
+            new.append(f)
+    stale = {fp: n for fp, n in remaining.items() if n > 0}
+    return new, stale

@@ -1,0 +1,253 @@
+"""Compiled-graph budget auditor: structural assertions on lowered HLO.
+
+The communication budget this repo is built around — a guarded
+``MetricCollection`` (sketches included) syncs in **≤ 2 all-reduces**
+through ``fused_sync`` — was until this PR enforced by ad-hoc
+``hlo.count("all-reduce(")`` string pins scattered across four test files.
+This module is the single definition of that measurement (EQuARX/T3
+premise: a budget you cannot mechanically measure is one you cannot
+preserve):
+
+- :func:`hlo_of` — lower + compile any jittable callable to optimized HLO
+  text (accepts already-jitted / shard_mapped functions).
+- :func:`collective_counts` — one counting rule for every collective op
+  (sync and async ``-start`` forms both count once).
+- :func:`audit_hlo` / :func:`assert_graph_budget` — check a
+  :class:`GraphBudget` (collective ceilings, no f64, no host callbacks, no
+  dynamic shapes) and raise :class:`GraphBudgetError` naming each overrun.
+- :func:`audit_recompilation` — the cache-miss detector: the same entry
+  point traced at two batch sizes must produce batch-size-INDEPENDENT state
+  avals (a state shape that leaks the batch size recompiles every
+  downstream consumer), and a second call at identical avals must hit the
+  jit cache.
+
+jax is imported lazily so ``metrics_tpu.analysis`` stays importable (and
+the AST lint runnable) without touching the accelerator runtime.
+"""
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# custom-call targets (and legacy ops) that mean "the compiled graph calls
+# back into the host python" — forbidden in metric hot paths by default
+HOST_CALLBACK_MARKERS = (
+    "xla_python_cpu_callback",
+    "xla_ffi_python_cpu_callback",
+    "xla_python_gpu_callback",
+    "xla_ffi_python_gpu_callback",
+    "CustomCall(\"xla_python",
+    "infeed(",
+    "outfeed(",
+)
+
+_F64_RE = re.compile(r"\b(f64|c128)\[")
+_DYNAMIC_SHAPE_RE = re.compile(r"\[[^\]]*<=")
+
+
+@dataclass(frozen=True)
+class GraphBudget:
+    """Structural ceilings for one compiled entry point.
+
+    ``max_*`` of ``None`` means "don't care"; the boolean ``allow_*`` knobs
+    default to the repo-wide invariants (no f64, no host callbacks, no
+    dynamic shapes in compiled metric paths).
+    """
+
+    max_all_reduce: Optional[int] = None
+    max_all_gather: Optional[int] = None
+    max_reduce_scatter: Optional[int] = None
+    max_collective_permute: Optional[int] = None
+    max_all_to_all: Optional[int] = None
+    allow_f64: bool = False
+    allow_host_callback: bool = False
+    allow_dynamic_shapes: bool = False
+
+    def collective_ceilings(self) -> Dict[str, Optional[int]]:
+        return {
+            "all-reduce": self.max_all_reduce,
+            "all-gather": self.max_all_gather,
+            "reduce-scatter": self.max_reduce_scatter,
+            "collective-permute": self.max_collective_permute,
+            "all-to-all": self.max_all_to_all,
+        }
+
+
+@dataclass(frozen=True)
+class GraphViolation:
+    entry: str
+    kind: str  # "collective-budget" | "f64" | "host-callback" | "dynamic-shape" | "recompilation"
+    detail: str
+
+    def format(self) -> str:
+        return f"{self.entry}: [{self.kind}] {self.detail}"
+
+
+class GraphBudgetError(AssertionError):
+    """A compiled entry point exceeded its structural budget."""
+
+    def __init__(self, violations: Sequence[GraphViolation]) -> None:
+        self.violations = list(violations)
+        super().__init__(
+            "compiled-graph budget violated:\n"
+            + "\n".join(f"  - {v.format()}" for v in self.violations)
+        )
+
+
+def hlo_of(fn: Callable, *args: Any, **kwargs: Any) -> str:
+    """Optimized HLO text of ``fn(*args, **kwargs)``, jitting if needed."""
+    import jax
+
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    return fn.lower(*args, **kwargs).compile().as_text()
+
+
+def collective_counts(hlo: str) -> Dict[str, int]:
+    """Cross-device collective ops in one HLO module, by op name.
+
+    Counts instruction forms only (``op(`` / ``op-start(``): an async pair
+    (``-start`` + ``-done``) is ONE collective on the wire, and result
+    names like ``%all-reduce.3`` never carry the open paren.
+    """
+    return {op: hlo.count(f"{op}(") + hlo.count(f"{op}-start(") for op in COLLECTIVE_OPS}
+
+
+def find_host_callbacks(hlo: str) -> List[str]:
+    return [marker for marker in HOST_CALLBACK_MARKERS if marker in hlo]
+
+
+def audit_hlo(hlo: str, budget: GraphBudget, entry: str = "<fn>") -> List[GraphViolation]:
+    """Check one HLO module against a budget; returns violations (no raise)."""
+    violations: List[GraphViolation] = []
+    counts = collective_counts(hlo)
+    for op, ceiling in budget.collective_ceilings().items():
+        if ceiling is not None and counts[op] > ceiling:
+            violations.append(
+                GraphViolation(
+                    entry,
+                    "collective-budget",
+                    f"{counts[op]} {op} ops, budget allows {ceiling}",
+                )
+            )
+    if not budget.allow_f64 and _F64_RE.search(hlo):
+        violations.append(
+            GraphViolation(
+                entry,
+                "f64",
+                "f64/c128 values in the compiled graph — an accidental double-precision "
+                "promotion (TPUs emulate f64 at ~100x cost)",
+            )
+        )
+    if not budget.allow_host_callback:
+        hits = find_host_callbacks(hlo)
+        if hits:
+            violations.append(
+                GraphViolation(
+                    entry,
+                    "host-callback",
+                    f"host callback in compiled graph ({', '.join(hits)}) — every step "
+                    "round-trips to python",
+                )
+            )
+    if not budget.allow_dynamic_shapes and _DYNAMIC_SHAPE_RE.search(hlo):
+        violations.append(
+            GraphViolation(
+                entry,
+                "dynamic-shape",
+                "bounded-dynamic dimension (`[<=N]`) in the compiled graph — dynamic "
+                "shapes block fusion and force padding on TPU",
+            )
+        )
+    return violations
+
+
+def assert_graph_budget(
+    fn: Callable,
+    args: Tuple = (),
+    kwargs: Optional[Dict[str, Any]] = None,
+    budget: GraphBudget = GraphBudget(),
+    entry: Optional[str] = None,
+) -> Dict[str, int]:
+    """Lower ``fn`` and enforce ``budget``; returns the collective counts.
+
+    The one call every "≤ N all-reduces" test pins through — raising
+    :class:`GraphBudgetError` with the per-violation breakdown on overrun.
+    """
+    name = entry or getattr(fn, "__name__", None) or type(fn).__name__
+    hlo = hlo_of(fn, *args, **(kwargs or {}))
+    violations = audit_hlo(hlo, budget, entry=name)
+    if violations:
+        raise GraphBudgetError(violations)
+    return collective_counts(hlo)
+
+
+def _aval_tree(fn: Callable, args: Tuple) -> Any:
+    import jax
+
+    shapes = jax.eval_shape(fn, *args)
+    return jax.tree_util.tree_map(lambda x: (tuple(x.shape), str(x.dtype)), shapes)
+
+
+def audit_recompilation(
+    fn: Callable,
+    make_args: Callable[[int], Tuple],
+    batch_sizes: Tuple[int, int] = (4, 8),
+    entry: str = "<fn>",
+) -> List[GraphViolation]:
+    """Detect avoidable recompilation of a metric ``update`` entry point.
+
+    Two checks:
+
+    1. **Batch-size-independent state avals** (via ``eval_shape`` — no
+       compile): tracing at each batch size must produce identical output
+       shapes/dtypes. A state whose shape leaks the batch size forces every
+       downstream ``compute``/``merge``/sync graph to recompile per batch
+       size — the classic avoidable cache-miss factory.
+    2. **Cache hit at identical avals**: two calls with same-shaped inputs
+       must trace exactly once (a second trace at unchanged avals means
+       something unstable — weak types, non-hashable statics — is defeating
+       the jit cache).
+    """
+    import jax
+
+    violations: List[GraphViolation] = []
+    b0, b1 = batch_sizes
+    avals0 = _aval_tree(fn, make_args(b0))
+    avals1 = _aval_tree(fn, make_args(b1))
+    if avals0 != avals1:
+        violations.append(
+            GraphViolation(
+                entry,
+                "recompilation",
+                f"output avals depend on the batch size (batch {b0}: {avals0} != "
+                f"batch {b1}: {avals1}) — every downstream graph recompiles per batch size",
+            )
+        )
+
+    traces = {"n": 0}
+
+    def counted(*args: Any) -> Any:
+        traces["n"] += 1
+        return fn(*args)
+
+    jitted = jax.jit(counted)
+    jax.block_until_ready(jitted(*make_args(b0)))
+    jax.block_until_ready(jitted(*make_args(b0)))  # fresh args, identical avals
+    if traces["n"] != 1:
+        violations.append(
+            GraphViolation(
+                entry,
+                "recompilation",
+                f"{traces['n']} traces for two calls at identical avals — the jit cache "
+                "is being missed (unstable weak types or non-hashable statics?)",
+            )
+        )
+    return violations

@@ -1,0 +1,251 @@
+"""Registry of representative compiled entry points and their budgets.
+
+Each entry names a flagship compiled path of the framework, a builder that
+lowers it (constructing its own mesh from the live devices), and the
+structural budget it must satisfy. ``run_graph_audit`` drives the whole
+registry — the CLI's ``audit`` pass, ``make lint``'s second half, and the
+``tests/analysis`` auditor suite all consume this one table, so the budget
+numbers live in exactly one place:
+
+==============================  =============================================
+entry                           budget
+==============================  =============================================
+``fused_stat_collection``       4-metric StatScores collection syncs in **1**
+                                all-reduce (the fused_sync north star)
+``guarded_collection``          guarded (fault-channel) collection: **≤ 2**
+                                (int32 states bucket + uint32 fault bucket)
+``sketch_guarded_collection``   guarded collection WITH sketch states: **≤ 2**
+                                (quantile gather-merge joins the f32 sum
+                                bucket — the ISSUE 4/5 acceptance budget)
+``auroc_capacity_step``         single-device jitted update+compute: **0**
+                                collectives, no f64/callbacks/dynamic shapes
+``mean_update_stability``       recompilation detector on a guarded update:
+                                state avals batch-size independent, cache hit
+                                at equal avals
+==============================  =============================================
+"""
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+from metrics_tpu.analysis.graph_audit import (
+    GraphBudget,
+    GraphViolation,
+    audit_hlo,
+    audit_recompilation,
+    hlo_of,
+)
+
+# small sketch geometry: the collective structure under audit is
+# geometry-independent and compile time scales with levels x folds (same
+# rationale as tests/streaming/test_streaming_sync.py)
+_QS = dict(eps=0.1, k=64, levels=6)
+
+
+@dataclass(frozen=True)
+class AuditEntry:
+    name: str
+    budget: Optional[GraphBudget]
+    # () -> (fn, args): fn is lowered and checked against `budget`
+    build: Optional[Callable[[int], Tuple[Callable, Tuple]]] = None
+    # () -> (fn, make_args): handed to audit_recompilation
+    build_recompile: Optional[Callable[[], Tuple[Callable, Callable[[int], Tuple]]]] = None
+
+
+def _mesh(ndev: int):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()[:ndev]
+    if len(devices) < ndev:
+        raise RuntimeError(
+            f"graph audit needs {ndev} devices, have {len(devices)} — run under "
+            "force_cpu_backend(n) / JAX_PLATFORMS=cpu (see tests/conftest.py)"
+        )
+    return Mesh(np.array(devices), ("data",))
+
+
+def _build_fused_stat_collection(ndev: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    coll = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=4),
+            "prec": mt.Precision(num_classes=4, average="macro"),
+            "rec": mt.Recall(num_classes=4, average="macro"),
+            "f1": mt.F1Score(num_classes=4, average="macro"),
+        }
+    )
+    cdef = mt.functionalize(coll, axis_name="data")
+
+    def step(p, t):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), cdef.init()
+        )
+        return cdef.compute(cdef.update(s, p, t))
+
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.random((8 * ndev, 4), dtype=np.float32))
+    t = jnp.asarray(rng.integers(0, 4, 8 * ndev).astype(np.int32))
+    fn = jax.jit(
+        jax.shard_map(step, mesh=_mesh(ndev), in_specs=(P("data"), P("data")), out_specs=P())
+    )
+    return fn, (p, t)
+
+
+def _build_guarded_collection(ndev: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    coll = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=4, on_invalid="warn"),
+            "f1": mt.F1Score(num_classes=4, average="macro", on_invalid="warn"),
+        }
+    )
+    cdef = mt.functionalize(coll, axis_name="data")
+
+    def step(p, t):
+        s = cdef.update(cdef.init(), p, t)
+        return cdef.compute(s), cdef.faults(s)
+
+    rng = np.random.default_rng(1)
+    p = jnp.asarray(rng.random((4 * ndev, 4), dtype=np.float32))
+    t = jnp.asarray(rng.integers(0, 4, 4 * ndev).astype(np.int32))
+    fn = jax.jit(
+        jax.shard_map(step, mesh=_mesh(ndev), in_specs=(P("data"), P("data")), out_specs=(P(), P()))
+    )
+    return fn, (p, t)
+
+
+def _build_sketch_guarded_collection(ndev: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    coll = mt.MetricCollection(
+        {
+            "mean": mt.MeanMetric(nan_strategy="warn"),
+            "q": mt.QuantileSketch(on_invalid="drop", quantiles=(0.5, 0.99), **_QS),
+            "cm": mt.CountMinSketch(width=256),
+        }
+    )
+    cdef = mt.functionalize(coll, axis_name="data")
+
+    def step(v):
+        return cdef.compute(cdef.update(cdef.init(), v))
+
+    vals = jnp.asarray(np.random.default_rng(2).random(64 * ndev).astype(np.float32))
+    fn = jax.jit(jax.shard_map(step, mesh=_mesh(ndev), in_specs=(P("data"),), out_specs=P()))
+    return fn, (vals,)
+
+
+def _build_auroc_capacity_step(ndev: int):
+    import jax
+
+    # same graph as the recompile check of this entry — ONE construction,
+    # so the budget audit and the recompilation audit cannot drift apart
+    return jax.jit(_build_auroc_raw_step()), _auroc_make_args(32)
+
+
+def _build_mean_update_stability():
+    import jax.numpy as jnp
+    import numpy as np
+
+    import metrics_tpu as mt
+
+    mdef = mt.functionalize(mt.MeanMetric(nan_strategy="warn"))
+
+    def update(v):
+        return mdef.update(mdef.init(), v)
+
+    def make_args(batch: int):
+        return (jnp.asarray(np.linspace(0.0, 1.0, batch, dtype=np.float32)),)
+
+    return update, make_args
+
+
+REGISTRY: Tuple[AuditEntry, ...] = (
+    AuditEntry(
+        name="fused_stat_collection",
+        budget=GraphBudget(max_all_reduce=1, max_all_gather=0),
+        build=_build_fused_stat_collection,
+    ),
+    AuditEntry(
+        name="guarded_collection",
+        budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
+        build=_build_guarded_collection,
+    ),
+    AuditEntry(
+        name="sketch_guarded_collection",
+        budget=GraphBudget(max_all_reduce=2),
+        build=_build_sketch_guarded_collection,
+    ),
+    AuditEntry(
+        name="auroc_capacity_step",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_auroc_capacity_step,
+        build_recompile=lambda: (_build_auroc_raw_step(), _auroc_make_args),
+    ),
+    AuditEntry(
+        name="mean_update_stability",
+        budget=None,
+        build_recompile=_build_mean_update_stability,
+    ),
+)
+
+
+def _build_auroc_raw_step():
+    import metrics_tpu as mt
+
+    mdef = mt.functionalize(mt.AUROC(capacity=64, on_invalid="drop"))
+
+    def step(p, t):
+        s = mdef.update(mdef.init(), p, t)
+        return mdef.compute(s), mdef.faults(s)
+
+    return step
+
+
+def _auroc_make_args(batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(batch)
+    return (
+        jnp.asarray(rng.random(batch, dtype=np.float32)),
+        jnp.asarray((rng.random(batch) > 0.5).astype(np.int32)),
+    )
+
+
+def run_graph_audit(
+    entries: Optional[Tuple[AuditEntry, ...]] = None, ndev: int = 4
+) -> List[GraphViolation]:
+    """Audit every registry entry; returns all violations (empty = pass)."""
+    violations: List[GraphViolation] = []
+    for entry in entries if entries is not None else REGISTRY:
+        if entry.build is not None and entry.budget is not None:
+            fn, args = entry.build(ndev)
+            violations.extend(audit_hlo(hlo_of(fn, *args), entry.budget, entry=entry.name))
+        if entry.build_recompile is not None:
+            fn, make_args = entry.build_recompile()
+            violations.extend(audit_recompilation(fn, make_args, entry=entry.name))
+    return violations

@@ -1,0 +1,50 @@
+"""Rule registry for the graft-lint AST pass.
+
+Every rule object exposes ``rule_id`` / ``name`` / ``description`` and a
+``check(module: ModuleSource) -> Iterable[Finding]``. IDs are stable API —
+suppression comments and baseline entries reference them:
+
+==========  ==================  ====================================================
+rule id     family              what it catches
+==========  ==================  ====================================================
+``GL000``   (engine)            file failed to parse (syntax error)
+``GL101``   import purity       module-scope device-discovery call (``jax.devices``
+                                and friends) — dials the backend at import
+``GL102``   import purity       module-scope ``jnp``/``jax.numpy``/``jax.random``
+                                call — creates an array, initializing the backend
+                                at import (the PR-4 ``jnp.float32`` bug class)
+``GL201``   trace safety        ``float()``/``int()``/``bool()`` concretization of
+                                a traced value inside a jitted ``update`` path
+``GL202``   trace safety        ``.item()``/``.tolist()`` inside a jitted
+                                ``update`` path
+``GL203``   trace safety        wall-clock / host RNG (``time.time`` ...) inside a
+                                jitted ``update`` path
+``GL301``   state discipline    direct ``_state``/``_defaults`` writes outside
+                                ``add_state``
+``GL302``   state discipline    list ('cat') state declared without ``template=``
+==========  ==================  ====================================================
+"""
+from typing import Dict, Tuple
+
+from metrics_tpu.analysis.rules.import_purity import DeviceDiscoveryAtImport, JnpCallAtImport
+from metrics_tpu.analysis.rules.state_discipline import DirectStateWrite, ListStateWithoutTemplate
+from metrics_tpu.analysis.rules.trace_safety import (
+    HostClockInUpdatePath,
+    ItemCallInUpdatePath,
+    PythonCastInUpdatePath,
+)
+
+ALL_RULES: Tuple = (
+    DeviceDiscoveryAtImport(),
+    JnpCallAtImport(),
+    PythonCastInUpdatePath(),
+    ItemCallInUpdatePath(),
+    HostClockInUpdatePath(),
+    DirectStateWrite(),
+    ListStateWithoutTemplate(),
+)
+
+
+def rule_catalog() -> Dict[str, str]:
+    """rule_id -> one-line description (the CLI ``--rules`` listing)."""
+    return {rule.rule_id: rule.description for rule in ALL_RULES}

@@ -1,0 +1,65 @@
+"""Helpers shared across graft-lint rules (one definition per AST pattern,
+so trace-safety and state-discipline cannot drift apart on what counts as a
+host-side class or a declared state)."""
+import ast
+from typing import List, Optional, Set, Tuple
+
+
+def dotted_parts(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """``a.b.c`` -> ("a", "b", "c"); None for non-Name-rooted expressions.
+    The one attribute-chain walker every rule family shares."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def class_opts_out_of_jit(node: ast.ClassDef) -> bool:
+    """True when the class body sets ``jittable_update = False`` (the
+    repo's host-side opt-out, ``metric.py``) — via plain or annotated
+    assignment."""
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        if (
+            any(isinstance(t, ast.Name) and t.id == "jittable_update" for t in targets)
+            and isinstance(value, ast.Constant)
+            and value.value is False
+        ):
+            return True
+    return False
+
+
+def declared_state_names(root: ast.AST) -> Set[str]:
+    """State leaves declared via ``self.add_state("name", ...)`` anywhere
+    under ``root`` (a ClassDef or a whole Module; literal first arg or
+    ``name=`` kwarg). Attribute reads of these names on ``self`` resolve to
+    metric STATE — traced arrays inside compiled updates — not
+    python-scalar config. The lint engine unions these across every module
+    in a run (``ModuleSource.package_state_names``) because states are
+    routinely declared in a base class in another module."""
+    names: Set[str] = set()
+    for node in ast.walk(root):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_state"
+        ):
+            continue
+        arg = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                arg = kw.value
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            names.add(arg.value)
+    return names

@@ -1,0 +1,266 @@
+"""Import-purity rules: ``import metrics_tpu`` must stay pure Python.
+
+The hang-proof bootstrap (PR 3, ``utilities/backend.py``) guarantees that
+importing the package never touches device discovery — during a TPU-tunnel
+wedge, discovery itself hangs, so any import-time jax array construction or
+``jax.devices()`` call re-opens the >280 s import hang the bootstrap closed.
+PR 4 nearly shipped exactly that: a module-scope ``jnp.float32(...)``
+constant, caught in review. These rules make that bug class mechanical:
+
+- ``GL101``: module-scope call to a discovery function (``jax.devices``,
+  ``jax.device_count``, ...).
+- ``GL102``: module-scope call through ``jnp`` / ``jax.numpy`` /
+  ``jax.random`` / a name imported from them — every such call produces a
+  committed array, which initializes the backend.
+
+"Module scope" is everything that executes at import: top-level statements,
+class bodies, decorator expressions, and function-argument defaults — but
+not function bodies, and not ``if __name__ == "__main__"`` blocks. A bare
+dtype *reference* (``DTYPE = jnp.float32``) is fine; only calls are flagged.
+"""
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from metrics_tpu.analysis.lint import Finding, ModuleSource
+
+# jax functions whose mere call performs device discovery / backend init
+DISCOVERY_FUNCS = frozenset(
+    {
+        "devices",
+        "local_devices",
+        "device_count",
+        "local_device_count",
+        "default_backend",
+        "process_count",
+        "process_index",
+        "live_arrays",
+    }
+)
+# jax.<name> calls that commit an array (backend init) without being jnp
+ARRAY_COMMITTING_JAX_FUNCS = frozenset({"device_put", "block_until_ready"})
+
+
+from metrics_tpu.analysis.rules._common import dotted_parts as _dotted
+
+
+class ImportAliases:
+    """Names bound to jax / jax.numpy / jax.random by this module's imports."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.jax: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.jax_random: Set[str] = set()
+        self.jnp_members: Set[str] = set()  # from jax.numpy import zeros
+        self.jax_discovery_members: Set[str] = set()  # from jax import devices
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "jax":
+                        self.jax.add(bound)
+                    elif alias.name == "jax.numpy" and alias.asname:
+                        self.jnp.add(alias.asname)
+                    elif alias.name == "jax.random" and alias.asname:
+                        self.jax_random.add(alias.asname)
+                    elif alias.name.startswith("jax.") and alias.asname is None:
+                        self.jax.add("jax")  # `import jax.numpy` binds `jax`
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    if node.module == "jax":
+                        if alias.name == "numpy":
+                            self.jnp.add(bound)
+                        elif alias.name == "random":
+                            self.jax_random.add(bound)
+                        elif alias.name in DISCOVERY_FUNCS:
+                            self.jax_discovery_members.add(bound)
+                    elif node.module == "jax.numpy":
+                        self.jnp_members.add(bound)
+                    elif node.module == "jax.random":
+                        self.jnp_members.add(bound)  # same severity: array call
+
+    def classify_call(self, func: ast.AST) -> Optional[str]:
+        """'discovery' | 'array' | None for a module-scope call target."""
+        dotted = _dotted(func)
+        if dotted is None:
+            return None
+        root, rest = dotted[0], dotted[1:]
+        if not rest:
+            if root in self.jax_discovery_members:
+                return "discovery"
+            if root in self.jnp_members:
+                return "array"
+            return None
+        if root in self.jnp or root in self.jax_random:
+            return "array"
+        if root in self.jax:
+            if rest[0] == "numpy" or rest[0] == "random":
+                return "array"
+            if len(rest) == 1 and rest[0] in DISCOVERY_FUNCS:
+                return "discovery"
+            if len(rest) == 1 and rest[0] in ARRAY_COMMITTING_JAX_FUNCS:
+                return "array"
+        return None
+
+
+def _main_guard_kind(node: ast.If) -> Optional[str]:
+    """'eq' for ``if __name__ == "__main__"`` (body skipped at import),
+    'ne' for ``if __name__ != "__main__"`` (body RUNS at import, else
+    skipped), None for anything else — operator and comparand both matter:
+    treating every ``__name__`` comparison as a main guard would invert
+    the scope for the ``!=`` form."""
+    t = node.test
+    if not (
+        isinstance(t, ast.Compare)
+        and isinstance(t.left, ast.Name)
+        and t.left.id == "__name__"
+        and len(t.ops) == 1
+        and len(t.comparators) == 1
+        and isinstance(t.comparators[0], ast.Constant)
+        and t.comparators[0].value == "__main__"
+    ):
+        return None
+    if isinstance(t.ops[0], ast.Eq):
+        return "eq"
+    if isinstance(t.ops[0], ast.NotEq):
+        return "ne"
+    return None
+
+
+def iter_import_scope_calls(tree: ast.Module) -> Iterator[ast.Call]:
+    """Every Call node that executes at import time.
+
+    Recurses through module-level compound statements and class bodies;
+    function/lambda *bodies* are skipped but their decorators and argument
+    defaults (which evaluate at import) are walked.
+    """
+
+    def walk_stmts(stmts) -> Iterator[ast.Call]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in stmt.decorator_list:
+                    yield from _calls_in_expr(dec)
+                for default in list(stmt.args.defaults) + [
+                    d for d in stmt.args.kw_defaults if d is not None
+                ]:
+                    yield from _calls_in_expr(default)
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                for dec in stmt.decorator_list:
+                    yield from _calls_in_expr(dec)
+                for base in stmt.bases + [kw.value for kw in stmt.keywords]:
+                    yield from _calls_in_expr(base)
+                yield from walk_stmts(stmt.body)
+                continue
+            if isinstance(stmt, ast.If):
+                guard = _main_guard_kind(stmt)
+                if guard == "eq":
+                    yield from walk_stmts(stmt.orelse)
+                    continue
+                if guard == "ne":
+                    yield from walk_stmts(stmt.body)
+                    continue
+                yield from _calls_in_expr(stmt.test)
+                yield from walk_stmts(stmt.body)
+                yield from walk_stmts(stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                yield from _calls_in_expr(stmt.iter)
+                yield from walk_stmts(stmt.body)
+                yield from walk_stmts(stmt.orelse)
+                continue
+            if isinstance(stmt, ast.While):
+                yield from _calls_in_expr(stmt.test)
+                yield from walk_stmts(stmt.body)
+                yield from walk_stmts(stmt.orelse)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    yield from _calls_in_expr(item.context_expr)
+                yield from walk_stmts(stmt.body)
+                continue
+            if isinstance(stmt, ast.Try):
+                yield from walk_stmts(stmt.body)
+                for handler in stmt.handlers:
+                    yield from walk_stmts(handler.body)
+                yield from walk_stmts(stmt.orelse)
+                yield from walk_stmts(stmt.finalbody)
+                continue
+            yield from _calls_in_expr(stmt)
+
+    def _calls_in_expr(node: ast.AST) -> Iterator[ast.Call]:
+        # lambda/function/class BODIES don't execute at import — prune them
+        # (ast.walk cannot skip subtrees, hence the manual recursion). Defs
+        # nested inside compound statements walk_stmts has no case for
+        # (e.g. a module-scope `match`) fall through to this walk, so they
+        # get the same treatment as top-level ones: decorators, argument
+        # defaults, and class bases/bodies still evaluate at import.
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            yield from walk_stmts([node])
+            return
+        if isinstance(node, ast.Call):
+            yield node
+        for child in ast.iter_child_nodes(node):
+            yield from _calls_in_expr(child)
+
+    yield from walk_stmts(tree.body)
+
+
+def _classified_import_scope_calls(module: ModuleSource) -> List[Tuple[ast.Call, Optional[str]]]:
+    """(call, 'discovery'|'array'|None) for every import-scope call —
+    computed once per module and shared by GL101/GL102 via the module's
+    analysis cache."""
+    cached = module.cache.get("import_scope_calls")
+    if cached is None:
+        aliases = ImportAliases(module.tree)
+        cached = [
+            (call, aliases.classify_call(call.func))
+            for call in iter_import_scope_calls(module.tree)
+        ]
+        module.cache["import_scope_calls"] = cached
+    return cached
+
+
+class DeviceDiscoveryAtImport:
+    rule_id = "GL101"
+    name = "import-purity-device-discovery"
+    description = (
+        "module-scope call to a jax device-discovery function; `import metrics_tpu` "
+        "must never dial the backend (hang-proof bootstrap, utilities/backend.py)"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        for call, kind in _classified_import_scope_calls(module):
+            if kind == "discovery":
+                dotted = _dotted(call.func)
+                yield module.finding(
+                    self.rule_id,
+                    call,
+                    f"module-scope `{'.'.join(dotted)}()` triggers device discovery at "
+                    "import — during a backend wedge this hangs `import metrics_tpu`; "
+                    "move the call inside a function (see utilities/backend.py)",
+                )
+
+
+class JnpCallAtImport:
+    rule_id = "GL102"
+    name = "import-purity-array-construction"
+    description = (
+        "module-scope jnp/jax.numpy/jax.random call creates an array and initializes "
+        "the backend at import (the PR-4 `jnp.float32(...)` bug class)"
+    )
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        for call, kind in _classified_import_scope_calls(module):
+            if kind == "array":
+                dotted = _dotted(call.func)
+                yield module.finding(
+                    self.rule_id,
+                    call,
+                    f"module-scope `{'.'.join(dotted)}(...)` commits a jax array, "
+                    "initializing the backend at import — use a python constant or "
+                    "construct it lazily inside a function (a bare dtype reference "
+                    "like `jnp.float32` without the call is fine)",
+                )

@@ -1,0 +1,408 @@
+"""Trace-safety rules: jitted ``update`` paths must not concretize tracers.
+
+Scope — "functions reachable from jitted update paths", resolved per module:
+
+- **roots**: methods literally named ``update`` (skipped when the class body
+  sets ``jittable_update = False`` — host-side metrics like the text family
+  run eagerly by contract, see ``metric.py``), and module-level functions
+  matching ``_*_update`` (the functional-kernel naming convention,
+  e.g. ``_stat_scores_update``).
+- **edges**: module-local calls — bare-name calls to module-level functions
+  and ``self.method(...)`` calls to same-class methods. Cross-module
+  reachability is intentionally out of scope: each module's kernels are
+  linted where they live.
+- **excluded modules**: the text and detection families are host-side by
+  contract ("host-side metrics (text, detection) cannot run inside compiled
+  code", ``pure.py``) — their kernels churn python strings and per-image
+  dicts, so none of these rules apply there.
+
+The repo's sanctioned eager-guard idiom is recognized and exempted
+POLARITY-AWARE: an ``if`` whose test mentions ``_is_concrete`` positively
+(directly, or via a variable assigned from ``_is_concrete(...)``) has an
+eager-only test+body — but its ``else`` branch still runs under trace and
+stays linted; a NEGATED guard (``if not _is_concrete(x):``, or a ``Tracer``
+isinstance check) is the reverse: the body is the tracing path and is
+linted, the ``else`` is eager-only (``utilities/checks.py`` documents the
+idiom). Anything else needs a ``# graft-lint: disable=GL20x`` with a
+justification or a real fix.
+
+Rules:
+
+- ``GL201``: ``float()``/``int()``/``bool()``/``complex()`` on a value that
+  is not statically known. Exempt: literals, ``len(...)``, aval properties
+  (``x.shape[i]``/``x.ndim``), and ``self``-CONFIG attribute reads — python
+  scalars under trace. ``self.<state>`` reads of ``add_state``-declared
+  leaves are traced arrays (the state registry, ``metric.py``) and are NOT
+  exempt.
+- ``GL202``: ``.item()`` / ``.tolist()`` calls.
+- ``GL203``: wall-clock / host RNG calls (``time.time``, ``datetime.now``,
+  ``np.random.*``, ``random.*``) — host side effects that bake a constant
+  into the trace.
+"""
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from metrics_tpu.analysis.lint import Finding, ModuleSource
+
+_UPDATE_KERNEL_RE = re.compile(r"^_\w+_update$")
+# host-side-by-contract domains: text and detection metrics "cannot run
+# inside compiled code" (pure.py docstring) — their update kernels operate
+# on python strings / per-image dicts, so concretization there is the norm
+HOST_SIDE_PATH_PREFIXES = (
+    "metrics_tpu/text/",
+    "metrics_tpu/functional/text/",
+    "metrics_tpu/detection/",
+    "metrics_tpu/functional/detection/",
+)
+CAST_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+CONCRETIZING_METHODS = frozenset({"item", "tolist"})
+_CLOCK_PATTERNS = (
+    re.compile(r"^time\.(time|monotonic|perf_counter|process_time|time_ns)$"),
+    re.compile(r"^datetime(\.datetime)?\.(now|utcnow|today)$"),
+    re.compile(r"^(np|numpy)\.random\.\w+$"),
+    re.compile(r"^random\.\w+$"),
+)
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    from metrics_tpu.analysis.rules._common import dotted_parts
+
+    parts = dotted_parts(node)
+    return ".".join(parts) if parts is not None else None
+
+
+class _FunctionEntry:
+    def __init__(
+        self, node: ast.AST, name: str, class_node: Optional[ast.ClassDef]
+    ) -> None:
+        self.node = node
+        self.name = name
+        self.class_node = class_node  # enclosing class for direct methods
+        self.class_name = class_node.name if class_node is not None else None
+        self.calls: Set[Tuple[str, str]] = set()  # ("local"|"self", callee)
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Module-level functions, direct class methods, their local call edges,
+    and per-class ``jittable_update = False`` opt-outs."""
+
+    def __init__(self) -> None:
+        self.functions: Dict[Tuple[Optional[str], str], _FunctionEntry] = {}
+        self.unjittable_classes: Set[str] = set()
+        self._class_stack: List[ast.ClassDef] = []
+        self._func_stack: List[_FunctionEntry] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        from metrics_tpu.analysis.rules._common import class_opts_out_of_jit
+
+        if class_opts_out_of_jit(node):
+            self.unjittable_classes.add(node.name)
+        self._class_stack.append(node)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        # only register top-level functions and direct methods; nested
+        # functions belong to their enclosing function's body walk
+        if not self._func_stack:
+            class_node = self._class_stack[-1] if self._class_stack else None
+            entry = _FunctionEntry(node, node.name, class_node)
+            self.functions[(entry.class_name, node.name)] = entry
+            self._func_stack.append(entry)
+            self.generic_visit(node)
+            self._func_stack.pop()
+        else:
+            self.generic_visit(node)
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._func_stack:
+            entry = self._func_stack[-1]
+            if isinstance(node.func, ast.Name):
+                entry.calls.add(("local", node.func.id))
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                entry.calls.add(("self", node.func.attr))
+        self.generic_visit(node)
+
+
+def _update_path_functions(tree: ast.Module) -> List[_FunctionEntry]:
+    """Root update functions plus module-local reachability closure."""
+    index = _ModuleIndex()
+    index.visit(tree)
+    roots: List[Tuple[Optional[str], str]] = []
+    for (class_name, name), entry in index.functions.items():
+        if class_name is not None and name == "update":
+            if class_name not in index.unjittable_classes:
+                roots.append((class_name, name))
+        elif class_name is None and _UPDATE_KERNEL_RE.match(name):
+            roots.append((class_name, name))
+    reachable: Set[Tuple[Optional[str], str]] = set()
+    frontier = list(roots)
+    while frontier:
+        key = frontier.pop()
+        if key in reachable or key not in index.functions:
+            continue
+        reachable.add(key)
+        entry = index.functions[key]
+        for kind, callee in entry.calls:
+            if kind == "self" and entry.class_name is not None:
+                nxt = (entry.class_name, callee)
+            else:
+                nxt = (None, callee)
+            if nxt in index.functions and nxt not in reachable:
+                frontier.append(nxt)
+    return [index.functions[key] for key in sorted(reachable, key=lambda k: (k[0] or "", k[1]))]
+
+
+def _concrete_guard_names(func_node: ast.AST) -> Set[str]:
+    """Local names assigned from ``_is_concrete(...)`` within this function."""
+    names: Set[str] = {"_is_concrete"}
+    for node in ast.walk(func_node):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and isinstance(node.value.func, ast.Name)
+            and node.value.func.id == "_is_concrete"
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+    return names
+
+
+def _contains_tracer_check(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id == "Tracer":
+            return True
+        if isinstance(node, ast.Attribute) and node.attr == "Tracer":
+            return True
+    return False
+
+
+def _guard_polarity(
+    test: ast.AST, guard_names: Set[str]
+) -> Optional[Tuple[str, bool]]:
+    """(polarity, exact) for an ``if`` test, or None when unknown.
+
+    Polarity is what a TRUE test implies: ``'concrete'`` (body eager-only),
+    ``'traced'`` (body tracing-only — negated guard or ``Tracer``
+    isinstance). ``exact`` records whether a FALSE test implies the
+    opposite regime: true only for a bare guard / its direct negation. A
+    conjunction (``flag and not _is_concrete(x)``) keeps the body
+    implication — all conjuncts must hold — but its ``else`` runs whenever
+    ANY conjunct fails, which says nothing about tracing, so
+    exact=False and the else gets no exemption."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _guard_polarity(test.operand, guard_names)
+        if inner is None:
+            return None
+        polarity, exact = inner
+        return ("traced" if polarity == "concrete" else "concrete", exact)
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        # the body runs only when EVERY conjunct holds, so one known
+        # conjunct decides the body's regime — but never the else's
+        for value in test.values:
+            pol = _guard_polarity(value, guard_names)
+            if pol is not None:
+                return (pol[0], False)
+        return None
+    if isinstance(test, ast.Name) and test.id in guard_names:
+        return ("concrete", True)
+    if (
+        isinstance(test, ast.Call)
+        and isinstance(test.func, ast.Name)
+        and test.func.id in guard_names
+    ):
+        return ("concrete", True)
+    if _contains_tracer_check(test):
+        return ("traced", True)
+    return None
+
+
+def _iter_trace_scope(func_node: ast.AST, guard_names: Set[str]) -> Iterator[ast.AST]:
+    """Nodes of a reachable function that execute under trace.
+
+    ``if``-statements guarded on concreteness keep only their traced side:
+    a positive guard (``if concrete and ...:``) exempts the test and body
+    but still lints the ``else`` branch; an EXACT negated guard
+    (``if not _is_concrete(x):`` / a ``Tracer`` isinstance check) lints the
+    body and exempts the ``else`` — but a conjunction containing the
+    negated guard only proves the BODY traced (its else can still run
+    under trace when another conjunct fails), so everything stays linted.
+    Unknown tests get no exemption."""
+
+    def walk(node: ast.AST) -> Iterator[ast.AST]:
+        if isinstance(node, ast.If):
+            guard = _guard_polarity(node.test, guard_names)
+            if guard is not None:
+                polarity, exact = guard
+                if polarity == "concrete":
+                    # body eager whenever reached (all conjuncts concrete);
+                    # the else proves nothing either way → lint it
+                    for stmt in node.orelse:
+                        yield from walk(stmt)
+                    return
+                if polarity == "traced" and exact:
+                    yield from walk(node.test)
+                    for stmt in node.body:
+                        yield from walk(stmt)
+                    return
+                # ('traced', inexact): the body is traced (lint it) AND the
+                # else may be too — fall through to the full walk
+        yield node
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child)
+
+    for stmt in func_node.body:
+        yield from walk(stmt)
+
+
+def _cast_arg_is_static(arg: ast.AST, state_names: Set[str] = frozenset()) -> bool:
+    """Casts of statically-known python scalars are trace-legal: literals,
+    ``len(...)``, aval properties (``x.shape[i]``/``x.ndim``/``x.size`` are
+    python ints under trace), and ``self``/``cls`` CONFIG attributes.
+    ``state_names`` holds the class's ``add_state``-declared leaves —
+    ``self.<state>`` routes through the state registry to a traced jax
+    array (``metric.py``), so those attribute reads are NOT static."""
+    if isinstance(arg, ast.Constant):
+        return True
+    if isinstance(arg, ast.UnaryOp) and isinstance(arg.operand, ast.Constant):
+        return True
+    if isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) and arg.func.id == "len":
+        return True
+    if isinstance(arg, ast.Subscript) and isinstance(arg.value, ast.Attribute) and arg.value.attr == "shape":
+        return True
+    if isinstance(arg, ast.Attribute) and arg.attr in ("ndim", "size"):
+        return True
+    node, first_attr = arg, None
+    while isinstance(node, ast.Attribute):
+        first_attr = node.attr
+        node = node.value
+    if isinstance(node, ast.Name) and node.id in ("self", "cls"):
+        return first_attr is not None and first_attr not in state_names
+    return False
+
+
+class _TraceSafetyRule:
+    """Shared scope machinery; subclasses implement ``match(node)``."""
+
+    rule_id = "GL2xx"
+    name = "trace-safety"
+    description = ""
+
+    def check(self, module: ModuleSource) -> Iterator[Finding]:
+        if module.relpath.startswith(HOST_SIDE_PATH_PREFIXES):
+            return
+        # the engine-provided cross-file union: states are routinely
+        # declared in a base class in another module (Accuracy's `tp`
+        # lives in StatScores), so a per-class view would wrongly exempt
+        # `float(self.tp)` in the subclass as a "config" read
+        state_names = module.package_state_names
+        # the module index + reachability closure + guard names are shared
+        # by all three GL20x rules via the module's analysis cache
+        indexed = module.cache.get("trace_safety_scope")
+        if indexed is None:
+            indexed = [
+                (entry, _concrete_guard_names(entry.node))
+                for entry in _update_path_functions(module.tree)
+            ]
+            module.cache["trace_safety_scope"] = indexed
+        for entry, guard_names in indexed:
+            owner = f"{entry.class_name}.{entry.name}" if entry.class_name else entry.name
+            for node in _iter_trace_scope(entry.node, guard_names):
+                finding = self.match(module, node, owner, state_names)
+                if finding is not None:
+                    yield finding
+
+    def match(
+        self, module: ModuleSource, node: ast.AST, owner: str, state_names: Set[str]
+    ) -> Optional[Finding]:
+        raise NotImplementedError
+
+
+class PythonCastInUpdatePath(_TraceSafetyRule):
+    rule_id = "GL201"
+    name = "trace-safety-python-cast"
+    description = (
+        "float()/int()/bool() on a traced value inside a jitted update path "
+        "concretizes the tracer (ConcretizationTypeError under jit, or a silent "
+        "host sync eagerly)"
+    )
+
+    def match(
+        self, module: ModuleSource, node: ast.AST, owner: str, state_names: Set[str]
+    ) -> Optional[Finding]:
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in CAST_BUILTINS
+            and node.args
+        ):
+            return None
+        if all(_cast_arg_is_static(a, state_names) for a in node.args):
+            return None
+        return module.finding(
+            self.rule_id,
+            node,
+            f"`{node.func.id}(...)` in update path `{owner}` concretizes its argument — "
+            "keep the value as a jax array, or guard the branch with `_is_concrete(...)` "
+            "if it is genuinely eager-only",
+        )
+
+
+class ItemCallInUpdatePath(_TraceSafetyRule):
+    rule_id = "GL202"
+    name = "trace-safety-item-call"
+    description = ".item()/.tolist() inside a jitted update path forces a host transfer"
+
+    def match(
+        self, module: ModuleSource, node: ast.AST, owner: str, state_names: Set[str]
+    ) -> Optional[Finding]:
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in CONCRETIZING_METHODS
+            and not node.args
+        ):
+            return module.finding(
+                self.rule_id,
+                node,
+                f"`.{node.func.attr}()` in update path `{owner}` forces a device→host "
+                "transfer and breaks under trace — stay in jnp, or guard with "
+                "`_is_concrete(...)`",
+            )
+        return None
+
+
+class HostClockInUpdatePath(_TraceSafetyRule):
+    rule_id = "GL203"
+    name = "trace-safety-host-clock"
+    description = (
+        "wall-clock/host-RNG call inside a jitted update path bakes a trace-time "
+        "constant into the compiled graph"
+    )
+
+    def match(
+        self, module: ModuleSource, node: ast.AST, owner: str, state_names: Set[str]
+    ) -> Optional[Finding]:
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = _dotted_name(node.func)
+        if dotted is None:
+            return None
+        if any(p.match(dotted) for p in _CLOCK_PATTERNS):
+            return module.finding(
+                self.rule_id,
+                node,
+                f"`{dotted}()` in update path `{owner}` is a host side effect: under jit "
+                "it runs once at trace time and its result is frozen into the graph — "
+                "hoist it to the eager wrapper, or use `jax.random` with an explicit key",
+            )
+        return None

@@ -50,8 +50,9 @@ class AUC(Metric):
             self.add_state("x", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
             self.add_state("y", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
         else:
-            self.add_state("x", default=[], dist_reduce_fx="cat")
-            self.add_state("y", default=[], dist_reduce_fx="cat")
+            tpl = jnp.zeros((0,), jnp.float32)
+            self.add_state("x", default=[], dist_reduce_fx="cat", template=tpl)
+            self.add_state("y", default=[], dist_reduce_fx="cat", template=tpl)
 
     def update(self, x: Array, y: Array, valid: Optional[Array] = None) -> None:
         """``valid`` (bool ``(N,)``) is accepted in capacity mode only — the

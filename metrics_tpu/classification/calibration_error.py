@@ -72,8 +72,9 @@ class CalibrationError(Metric):
             self.add_state("bin_conf", default=zeros, dist_reduce_fx="sum")
             self.add_state("bin_acc", default=zeros, dist_reduce_fx="sum")
         else:
-            self.add_state("confidences", default=[], dist_reduce_fx="cat")
-            self.add_state("accuracies", default=[], dist_reduce_fx="cat")
+            tpl = jnp.zeros((0,), jnp.float32)
+            self.add_state("confidences", default=[], dist_reduce_fx="cat", template=tpl)
+            self.add_state("accuracies", default=[], dist_reduce_fx="cat", template=tpl)
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         """``valid`` (bool ``(N,)``) is accepted in binned mode only — the

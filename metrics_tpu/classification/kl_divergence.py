@@ -67,7 +67,9 @@ class KLDivergence(Metric):
                 "measures", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat"
             )
         else:
-            self.add_state("measures", default=[], dist_reduce_fx="cat")
+            self.add_state(
+                "measures", default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.float32)
+            )
         self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
     def update(self, p: Array, q: Array, valid: Optional[Array] = None) -> None:

@@ -267,6 +267,8 @@ class MetricCollection:
                         m0_state = m0._state[state]
                         if copy:
                             m0_state = list(m0_state) if isinstance(m0_state, list) else m0_state
+                        # graft-lint: disable=GL301 — compute-group aliasing of
+                        # ALREADY-declared states (collection infra, not a new leaf)
                         mi._state[state] = m0_state
                     mi._computed = None
         self._state_is_copy = copy

@@ -110,11 +110,20 @@ class MeanAveragePrecision(Metric):
             "large": (96**2, int(1e5**2)),
         }
 
-        self.add_state("detections", default=[], dist_reduce_fx=None)
-        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
-        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruths", default=[], dist_reduce_fx=None)
-        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        # detections/groundtruths rows are ragged by construction — (n, 4)
+        # boxes or (h, w) masks depending on `iou_type` — so they declare
+        # template=None; scores/labels have a static scalar row
+        self.add_state("detections", default=[], dist_reduce_fx=None, template=None)
+        self.add_state(
+            "detection_scores", default=[], dist_reduce_fx=None, template=jnp.zeros((0,), jnp.float32)
+        )
+        self.add_state(
+            "detection_labels", default=[], dist_reduce_fx=None, template=jnp.zeros((0,), jnp.int32)
+        )
+        self.add_state("groundtruths", default=[], dist_reduce_fx=None, template=None)
+        self.add_state(
+            "groundtruth_labels", default=[], dist_reduce_fx=None, template=jnp.zeros((0,), jnp.int32)
+        )
 
     def update(self, preds: Sequence[Dict[str, Any]], target: Sequence[Dict[str, Any]]) -> None:
         _input_validator(preds, target, iou_type=self.iou_type)

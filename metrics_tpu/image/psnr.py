@@ -48,8 +48,10 @@ class PeakSignalNoiseRatio(Metric):
             self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
             self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
         else:
-            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
-            self.add_state("total", default=[], dist_reduce_fx="cat")
+            # per-update rows keep the dims `dim` does NOT reduce over —
+            # data-dependent trailing shape, so no static template exists
+            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("total", default=[], dist_reduce_fx="cat", template=None)
 
         if data_range is None:
             if dim is not None:

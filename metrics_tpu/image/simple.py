@@ -70,8 +70,10 @@ class UniversalImageQualityIndex(Metric):
                 )
             stream_init(self, reduction, "UQI")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            # rows are whole image batches -- ragged (data-dependent
+            # trailing shape), so template=None by declaration
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
         self.kernel_size = kernel_size
         self.sigma = sigma
         self.data_range = data_range
@@ -114,8 +116,10 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
         if self.streaming:
             stream_init(self, reduction, "ERGAS")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            # rows are whole image batches -- ragged (data-dependent
+            # trailing shape), so template=None by declaration
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
         self.ratio = ratio
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
@@ -161,8 +165,10 @@ class SpectralAngleMapper(Metric):
         if self.streaming:
             stream_init(self, reduction, "SAM")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            # rows are whole image batches -- ragged (data-dependent
+            # trailing shape), so template=None by declaration
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         preds, target = _sam_update(preds, target)
@@ -215,8 +221,9 @@ class SpectralDistortionIndex(Metric):
         if reduction not in allowed_reductions:
             raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
         self.reduction = reduction
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        # ragged image-batch rows: template=None by declaration
+        self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+        self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _spectral_distortion_index_update(preds, target)

@@ -81,8 +81,10 @@ class StructuralSimilarityIndexMeasure(Metric):
                 )
             stream_init(self, reduction, "SSIM")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            # rows are whole image batches -- ragged (data-dependent
+            # trailing shape), so template=None by declaration
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
         self.gaussian_kernel = gaussian_kernel
         self.sigma = sigma
         self.kernel_size = kernel_size
@@ -170,8 +172,10 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
                 )
             stream_init(self, reduction, "MS-SSIM")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            # rows are whole image batches -- ragged (data-dependent
+            # trailing shape), so template=None by declaration
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
 
         if not (isinstance(kernel_size, (Sequence, int))):
             raise ValueError("Argument `kernel_size` expected to be an sequence or an int")

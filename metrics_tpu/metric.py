@@ -181,7 +181,12 @@ class Metric:
         ``template`` (list states only) is an empty ``(0, *row)`` array
         declaring the entries' dtype/trailing shape, so a sync of an
         *empty* list state can gather with the declared dtype instead of
-        collapsing to float32 ``(0,)`` (see ``parallel/sync.py``).
+        collapsing to float32 ``(0,)`` (see ``parallel/sync.py``). Passing
+        an explicit ``template=None`` declares the rows RAGGED (data-
+        dependent trailing shape — e.g. whole image batches): no static
+        template exists, and the graft-lint state-discipline rule (GL302,
+        ``metrics_tpu/analysis``) treats the explicit ``None`` as that
+        declaration while flagging list states that omit the kwarg.
         """
         from metrics_tpu.utilities.guard import FaultCounters
         from metrics_tpu.utilities.ringbuffer import CatBuffer

@@ -71,8 +71,10 @@ class CosineSimilarity(Metric):
                     "sims", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat"
                 )
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            # rows are (d,) embeddings with data-dependent d — ragged,
+            # so template=None by declaration
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=None)
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         """``valid`` (bool ``(N,)``) is accepted in capacity mode only — the

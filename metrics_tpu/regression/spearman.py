@@ -51,8 +51,9 @@ class SpearmanCorrCoef(Metric):
             self.add_state("preds", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
             self.add_state("target", default=CatBuffer.zeros(capacity, (), jnp.float32), dist_reduce_fx="cat")
         else:
-            self.add_state("preds", default=[], dist_reduce_fx="cat")
-            self.add_state("target", default=[], dist_reduce_fx="cat")
+            tpl = jnp.zeros((0,), jnp.float32)
+            self.add_state("preds", default=[], dist_reduce_fx="cat", template=tpl)
+            self.add_state("target", default=[], dist_reduce_fx="cat", template=tpl)
 
     def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
         if self.capacity is not None:

@@ -1,0 +1,162 @@
+"""Suppression-comment and baseline-file round trips, plus the full-package
+self-check: the shipped baseline is EMPTY and must stay that way."""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis.baseline import (
+    apply_baseline,
+    default_baseline_path,
+    load_baseline,
+    save_baseline,
+)
+from metrics_tpu.analysis.lint import lint_package, lint_source
+
+pytestmark = pytest.mark.analysis
+
+_BAD = """
+import jax.numpy as jnp
+
+HALF = jnp.float32(0.5)
+"""
+
+
+class TestSuppression:
+    def test_trailing_comment_suppresses_named_rule(self):
+        src = "import jax.numpy as jnp\nHALF = jnp.float32(0.5)  # graft-lint: disable=GL102\n"
+        assert lint_source(src) == []
+
+    def test_disable_all(self):
+        src = "import jax.numpy as jnp\nHALF = jnp.float32(0.5)  # graft-lint: disable=all\n"
+        assert lint_source(src) == []
+
+    def test_other_rule_id_does_not_suppress(self):
+        src = "import jax.numpy as jnp\nHALF = jnp.float32(0.5)  # graft-lint: disable=GL101\n"
+        assert [f.rule_id for f in lint_source(src)] == ["GL102"]
+
+    def test_comment_block_above_suppresses(self):
+        src = textwrap.dedent(
+            """
+            import jax.numpy as jnp
+
+            # graft-lint: disable=GL102 — justified: fixture constant for tests
+            # (second comment line keeps the block contiguous)
+            HALF = jnp.float32(0.5)
+            """
+        )
+        assert lint_source(src) == []
+
+    def test_comment_block_must_be_contiguous(self):
+        src = textwrap.dedent(
+            """
+            import jax.numpy as jnp
+
+            # graft-lint: disable=GL102
+            OTHER = 1
+            HALF = jnp.float32(0.5)
+            """
+        )
+        assert [f.rule_id for f in lint_source(src)] == ["GL102"]
+
+    def test_space_separated_justification_after_id_still_suppresses(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "HALF = jnp.float32(0.5)  # graft-lint: disable=GL102 justified by fixture use\n"
+        )
+        assert lint_source(src) == []
+
+    def test_justification_after_id_list_does_not_eat_ids(self):
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "X = (jax.device_count(), jnp.zeros(3))  # graft-lint: disable=GL101, GL102 eager-only\n"
+        )
+        assert lint_source(src) == []
+
+    def test_marker_inside_string_literal_does_not_suppress(self):
+        """Only real COMMENT tokens suppress — a disable marker inside a
+        string literal on the offending line must not swallow the finding."""
+        src = (
+            "import jax.numpy as jnp\n"
+            'A = jnp.float32(0.5); S = "# graft-lint: disable=GL102"\n'
+        )
+        assert [f.rule_id for f in lint_source(src)] == ["GL102"]
+
+    def test_multiple_ids_one_comment(self):
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "X = (jax.device_count(), jnp.zeros(3))  # graft-lint: disable=GL101,GL102\n"
+        )
+        assert lint_source(src) == []
+
+
+class TestBaseline:
+    def test_round_trip_absorbs_findings(self, tmp_path):
+        findings = lint_source(textwrap.dedent(_BAD), relpath="metrics_tpu/x.py")
+        assert len(findings) == 1
+        path = str(tmp_path / "baseline.txt")
+        save_baseline(path, findings)
+        new, stale = apply_baseline(findings, load_baseline(path))
+        assert new == [] and stale == {}
+
+    def test_line_shift_does_not_stale_baseline(self, tmp_path):
+        findings = lint_source(textwrap.dedent(_BAD), relpath="metrics_tpu/x.py")
+        path = str(tmp_path / "baseline.txt")
+        save_baseline(path, findings)
+        shifted = lint_source(
+            "import jax.numpy as jnp\n\n\n\n\nHALF = jnp.float32(0.5)\n",
+            relpath="metrics_tpu/x.py",
+        )
+        assert shifted[0].line != findings[0].line
+        new, stale = apply_baseline(shifted, load_baseline(path))
+        assert new == [] and stale == {}
+
+    def test_partial_coverage_keeps_remainder_new(self, tmp_path):
+        # two identical offending lines, baseline grandfathers only one
+        src = "import jax.numpy as jnp\nA = jnp.zeros(3)\nB = jnp.float32(0.5)\n"
+        findings = lint_source(src, relpath="metrics_tpu/x.py")
+        assert len(findings) == 2
+        path = str(tmp_path / "baseline.txt")
+        save_baseline(path, findings[:1])
+        new, stale = apply_baseline(findings, load_baseline(path))
+        assert len(new) == 1 and new[0].snippet == "B = jnp.float32(0.5)"
+        assert stale == {}
+
+    def test_paid_down_debt_reported_stale(self, tmp_path):
+        findings = lint_source(textwrap.dedent(_BAD), relpath="metrics_tpu/x.py")
+        path = str(tmp_path / "baseline.txt")
+        save_baseline(path, findings)
+        new, stale = apply_baseline([], load_baseline(path))
+        assert new == [] and sum(stale.values()) == 1
+
+    def test_hand_copied_entry_with_source_spacing_matches(self, tmp_path):
+        """fingerprint() collapses whitespace; a baseline entry hand-copied
+        with the source's real spacing must normalize the same way."""
+        findings = lint_source(
+            "import jax.numpy as jnp\nHALF  =  jnp.float32(0.5)\n", relpath="metrics_tpu/x.py"
+        )
+        path = tmp_path / "baseline.txt"
+        path.write_text("GL102|metrics_tpu/x.py|1|HALF  =  jnp.float32(0.5)\n")
+        new, stale = apply_baseline(findings, load_baseline(str(path)))
+        assert new == [] and stale == {}
+
+    def test_malformed_entry_raises(self, tmp_path):
+        path = tmp_path / "baseline.txt"
+        path.write_text("GL102|too|few\n")
+        with pytest.raises(ValueError, match="malformed baseline entry"):
+            load_baseline(str(path))
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / "nope.txt")) == {}
+
+
+class TestFullPackage:
+    def test_package_is_lint_clean_against_shipped_baseline(self):
+        """The `make lint` gate in test form: every finding on the real
+        package is covered by the checked-in baseline — which is EMPTY
+        after the ISSUE 5 self-clean, so this asserts zero findings."""
+        findings = lint_package()
+        baseline = load_baseline(default_baseline_path())
+        new, stale = apply_baseline(findings, baseline)
+        assert new == [], "new lint findings:\n" + "\n".join(f.format() for f in new)
+        assert stale == {}, f"stale baseline entries to prune: {stale}"
+        assert sum(baseline.values()) == 0, "shipped baseline must stay (near-)empty"

@@ -1,0 +1,185 @@
+"""Compiled-graph auditor: budget pass/fail on real lowered HLO (including
+the ISSUE 5 seeded regression — a third all-reduce injected next to
+``fused_sync`` must fail the ≤2 budget), structural detectors on synthetic
+HLO text, and the recompilation detector."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.analysis.graph_audit import (
+    GraphBudget,
+    GraphBudgetError,
+    assert_graph_budget,
+    audit_hlo,
+    audit_recompilation,
+    collective_counts,
+    hlo_of,
+)
+from metrics_tpu.parallel.sync import fused_sync
+
+pytestmark = pytest.mark.analysis
+
+NDEV = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+
+def _states():
+    states = [
+        {"tp": jnp.ones((8,), jnp.int32), "fp": jnp.ones((8,), jnp.int32)},
+        {"correct": jnp.ones((), jnp.int32), "total": jnp.ones((), jnp.int32)},
+    ]
+    reductions = [{k: "sum" for k in s} for s in states]
+    return states, reductions
+
+
+def _fused_step(extra_psum: bool):
+    states, reductions = _states()
+
+    def sync_all(*ss):
+        out = tuple(fused_sync(list(ss), reductions, "data"))
+        if extra_psum:
+            # the seeded regression: a stray per-metric collective next to
+            # the fused path — exactly what the budget exists to catch
+            leak = jax.lax.psum(ss[0]["tp"].astype(jnp.float32), "data")
+            out = out + (leak,)
+        return out
+
+    specs = tuple(P() for _ in states)
+    out_specs = specs + ((P(),) if extra_psum else ())
+    fn = jax.jit(
+        jax.shard_map(sync_all, mesh=_mesh(), in_specs=specs, out_specs=out_specs)
+    )
+    return fn, tuple(states)
+
+
+class TestBudgets:
+    def test_fused_sync_passes_its_budget(self):
+        fn, states = _fused_step(extra_psum=False)
+        counts = assert_graph_budget(
+            fn, states, budget=GraphBudget(max_all_reduce=1, max_all_gather=0)
+        )
+        assert counts["all-reduce"] == 1
+
+    def test_seeded_third_all_reduce_fails_budget(self):
+        fn, states = _fused_step(extra_psum=True)
+        with pytest.raises(GraphBudgetError, match="collective-budget"):
+            assert_graph_budget(fn, states, budget=GraphBudget(max_all_reduce=1))
+        # and the message names the entry and the overrun
+        with pytest.raises(GraphBudgetError, match="2 all-reduce ops, budget allows 1"):
+            assert_graph_budget(fn, states, budget=GraphBudget(max_all_reduce=1))
+
+    def test_violation_lists_are_returned_without_raise(self):
+        fn, states = _fused_step(extra_psum=True)
+        violations = audit_hlo(hlo_of(fn, *states), GraphBudget(max_all_reduce=1), entry="x")
+        assert [v.kind for v in violations] == ["collective-budget"]
+        assert violations[0].entry == "x"
+
+    def test_single_device_step_has_zero_collectives(self):
+        mdef = mt.functionalize(mt.MeanMetric())
+
+        def step(v):
+            return mdef.compute(mdef.update(mdef.init(), v))
+
+        counts = assert_graph_budget(
+            step,
+            (jnp.arange(8.0),),
+            budget=GraphBudget(
+                max_all_reduce=0,
+                max_all_gather=0,
+                max_reduce_scatter=0,
+                max_collective_permute=0,
+                max_all_to_all=0,
+            ),
+        )
+        assert sum(counts.values()) == 0
+
+
+class TestStructuralDetectors:
+    """Pure-text checks: the detectors must fire on the HLO patterns the
+    real compiler emits, without paying a compile per case."""
+
+    def test_f64_detected(self):
+        hlo = "ENTRY main { %p = f64[4]{0} parameter(0) ROOT %a = f64[4]{0} add(%p, %p) }"
+        kinds = [v.kind for v in audit_hlo(hlo, GraphBudget())]
+        assert kinds == ["f64"]
+        assert audit_hlo(hlo, GraphBudget(allow_f64=True)) == []
+
+    def test_f32_not_mistaken_for_f64(self):
+        hlo = "ENTRY main { ROOT %a = f32[64]{0} parameter(0) }"
+        assert audit_hlo(hlo, GraphBudget()) == []
+
+    def test_host_callback_detected(self):
+        hlo = (
+            'ENTRY main { ROOT %c = f32[] custom-call(), '
+            'custom_call_target="xla_python_cpu_callback" }'
+        )
+        kinds = [v.kind for v in audit_hlo(hlo, GraphBudget())]
+        assert kinds == ["host-callback"]
+        assert audit_hlo(hlo, GraphBudget(allow_host_callback=True)) == []
+
+    def test_dynamic_shape_detected(self):
+        hlo = "ENTRY main { ROOT %p = f32[<=128]{0} parameter(0) }"
+        kinds = [v.kind for v in audit_hlo(hlo, GraphBudget())]
+        assert kinds == ["dynamic-shape"]
+        assert audit_hlo(hlo, GraphBudget(allow_dynamic_shapes=True)) == []
+
+    def test_async_pair_counts_once(self):
+        hlo = (
+            "%ar0 = f32[4] all-reduce-start(f32[4] %p), replica_groups={}\n"
+            "%ar1 = f32[4] all-reduce-done(f32[4] %ar0)\n"
+        )
+        assert collective_counts(hlo)["all-reduce"] == 1
+
+    def test_real_host_callback_flagged(self):
+        """A real jax.pure_callback lowered on CPU trips the detector."""
+
+        def step(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v) * 2, jax.ShapeDtypeStruct((4,), jnp.float32), x
+            )
+
+        with pytest.raises(GraphBudgetError, match="host-callback"):
+            assert_graph_budget(step, (jnp.ones(4, jnp.float32),))
+
+
+class TestRecompilation:
+    def test_batch_independent_update_passes(self):
+        mdef = mt.functionalize(mt.MeanMetric(nan_strategy="warn"))
+
+        def update(v):
+            return mdef.update(mdef.init(), v)
+
+        assert audit_recompilation(update, lambda b: (jnp.arange(float(b)),)) == []
+
+    def test_batch_dependent_state_shape_fails(self):
+        def bad_update(v):
+            return {"rows": v * 2.0}  # state shape leaks the batch size
+
+        violations = audit_recompilation(bad_update, lambda b: (jnp.arange(float(b)),))
+        assert [v.kind for v in violations] == ["recompilation"]
+        assert "batch size" in violations[0].detail
+
+    def test_registry_auroc_entry_is_stable(self):
+        from metrics_tpu.analysis.registry import REGISTRY
+
+        entry = next(e for e in REGISTRY if e.name == "auroc_capacity_step")
+        fn, make_args = entry.build_recompile()
+        assert audit_recompilation(fn, make_args, entry=entry.name) == []
+
+
+@pytest.mark.slow
+class TestFullRegistry:
+    def test_run_graph_audit_clean(self):
+        """The `make lint` audit half in test form: every registry entry
+        meets its budget on the virtual mesh (compile-heavy → slow lane;
+        the same pass runs in CI via `make lint`)."""
+        from metrics_tpu.analysis.registry import run_graph_audit
+
+        violations = run_graph_audit(ndev=NDEV)
+        assert violations == [], "\n".join(v.format() for v in violations)

@@ -1,0 +1,698 @@
+"""Per-rule good/bad fixture snippets for the graft-lint AST pass.
+
+Every rule gets at least one fixture that MUST fire and one twin that must
+stay silent — including the ISSUE 5 seeded regression: the exact PR-4
+module-scope ``jnp.float32(...)`` constant that nearly re-broke the
+hang-proof bootstrap.
+"""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis.lint import lint_source
+
+pytestmark = pytest.mark.analysis
+
+
+def _ids(src):
+    return [f.rule_id for f in lint_source(textwrap.dedent(src))]
+
+
+# --------------------------------------------------------------------------
+# GL101/GL102 — import purity
+# --------------------------------------------------------------------------
+
+
+class TestImportPurity:
+    def test_seeded_regression_module_scope_jnp_float32(self):
+        """The PR-4 bug class, verbatim: a module-scope jnp dtype CALL."""
+        findings = lint_source(
+            textwrap.dedent(
+                """
+                import jax.numpy as jnp
+
+                _HALF_EPS = jnp.float32(0.5)
+                """
+            ),
+            relpath="metrics_tpu/ops/compactor.py",
+        )
+        assert [f.rule_id for f in findings] == ["GL102"]
+        f = findings[0]
+        # lint failures must name file:line and the rule id (CI contract)
+        assert "metrics_tpu/ops/compactor.py" in f.format()
+        assert f.line == 4 and "GL102" in f.format()
+
+    def test_dtype_reference_without_call_is_fine(self):
+        assert _ids("import jax.numpy as jnp\nDTYPE = jnp.float32\n") == []
+
+    def test_call_inside_function_is_fine(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                def make():
+                    return jnp.float32(0.5)
+                """
+            )
+            == []
+        )
+
+    def test_class_body_executes_at_import(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                class C:
+                    ZERO = jnp.zeros(3)
+                """
+            )
+            == ["GL102"]
+        )
+
+    def test_default_arg_executes_at_import(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                def f(x=jnp.zeros(3)):
+                    return x
+                """
+            )
+            == ["GL102"]
+        )
+
+    def test_from_import_member_call(self):
+        assert _ids("from jax.numpy import zeros\nZ = zeros(3)\n") == ["GL102"]
+
+    def test_jax_numpy_attribute_chain(self):
+        assert _ids("import jax\nZ = jax.numpy.zeros(3)\n") == ["GL102"]
+
+    def test_jax_random_at_import(self):
+        assert _ids("import jax\nKEY = jax.random.PRNGKey(0)\n") == ["GL102"]
+
+    def test_device_discovery_at_import(self):
+        assert _ids("import jax\nN = jax.device_count()\n") == ["GL101"]
+        assert _ids("import jax\nDEVS = jax.devices()\n") == ["GL101"]
+        assert _ids("from jax import devices\nDEVS = devices()\n") == ["GL101"]
+
+    def test_discovery_inside_function_is_fine(self):
+        assert (
+            _ids(
+                """
+                import jax
+
+                def n_devices():
+                    return jax.device_count()
+                """
+            )
+            == []
+        )
+
+    def test_main_guard_block_is_exempt(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                if __name__ == "__main__":
+                    print(jnp.zeros(3))
+                """
+            )
+            == []
+        )
+
+    def test_lambda_body_does_not_run_at_import(self):
+        assert _ids("import jax.numpy as jnp\nF = lambda: jnp.zeros(3)\n") == []
+
+    def test_not_main_guard_body_runs_at_import(self):
+        """`if __name__ != "__main__"` is the INVERSE guard: its body
+        executes on every import and must be linted; its else must not."""
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                if __name__ != "__main__":
+                    HALF = jnp.float32(0.5)
+                """
+            )
+            == ["GL102"]
+        )
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                if __name__ != "__main__":
+                    pass
+                else:
+                    HALF = jnp.float32(0.5)
+                """
+            )
+            == []
+        )
+
+    def test_other_name_comparison_is_not_a_main_guard(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                if __name__ == "metrics_tpu.foo":
+                    HALF = jnp.float32(0.5)
+                """
+            )
+            == ["GL102"]
+        )
+
+    def test_non_jax_module_scope_call_is_fine(self):
+        assert _ids("import numpy as np\nZ = np.zeros(3)\n") == []
+
+    def test_default_arg_of_def_nested_in_match_still_flagged(self):
+        """A def reached through an unhandled compound statement keeps the
+        top-level treatment: its BODY is pruned but its argument defaults
+        (which evaluate at import) stay covered."""
+        assert (
+            _ids(
+                """
+                import sys
+                import jax.numpy as jnp
+
+                match sys.platform:
+                    case "linux":
+                        def make(x=jnp.zeros(3)):
+                            return x
+                """
+            )
+            == ["GL102"]
+        )
+
+    def test_def_nested_in_unhandled_compound_statement_is_not_import_scope(self):
+        """A function body reached through a statement type walk_stmts has
+        no case for (module-scope `match`) must still be pruned — only the
+        match/case machinery itself runs at import."""
+        assert (
+            _ids(
+                """
+                import sys
+                import jax.numpy as jnp
+
+                match sys.platform:
+                    case "linux":
+                        def make():
+                            return jnp.zeros(3)
+                    case _:
+                        HALF = jnp.float32(0.5)
+                """
+            )
+            == ["GL102"]
+        )
+
+
+# --------------------------------------------------------------------------
+# GL201/GL202/GL203 — trace safety on update paths
+# --------------------------------------------------------------------------
+
+
+class TestTraceSafety:
+    def test_cast_of_traced_value_in_update_method(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def update(self, preds):
+                        self.total = float(preds.mean())
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_cast_in_update_kernel_function(self):
+        assert (
+            _ids(
+                """
+                def _accuracy_update(preds, target):
+                    return int(preds.sum())
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_cast_outside_update_path_is_fine(self):
+        assert (
+            _ids(
+                """
+                def helper(x):
+                    return float(x.mean())
+                """
+            )
+            == []
+        )
+
+    def test_reachability_through_local_helper(self):
+        assert (
+            _ids(
+                """
+                def _prep(x):
+                    return x.item()
+
+                def _stat_update(preds):
+                    return _prep(preds)
+                """
+            )
+            == ["GL202"]
+        )
+
+    def test_self_method_reachability(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def _ingest(self, x):
+                        return float(x.max())
+
+                    def update(self, preds):
+                        return self._ingest(preds)
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_jittable_update_false_class_is_exempt(self):
+        assert (
+            _ids(
+                """
+                class HostSide:
+                    jittable_update = False
+
+                    def update(self, text):
+                        return float(text.score())
+                """
+            )
+            == []
+        )
+
+    def test_is_concrete_guard_exempts_branch(self):
+        assert (
+            _ids(
+                """
+                from metrics_tpu.utilities.checks import _is_concrete
+
+                def _guarded_update(preds):
+                    if _is_concrete(preds):
+                        bad = float(preds.max())
+                    return preds
+                """
+            )
+            == []
+        )
+
+    def test_is_concrete_via_variable_exempts_branch(self):
+        assert (
+            _ids(
+                """
+                from metrics_tpu.utilities.checks import _is_concrete
+
+                def _guarded_update(preds):
+                    concrete = _is_concrete(preds)
+                    if concrete and bool((preds < 0).any()):
+                        raise ValueError("negative")
+                    return preds
+                """
+            )
+            == []
+        )
+
+    def test_negated_guard_body_is_the_traced_path(self):
+        """`if not _is_concrete(x):` — the body runs under trace, so a
+        concretization inside it must be flagged (polarity matters)."""
+        assert (
+            _ids(
+                """
+                from metrics_tpu.utilities.checks import _is_concrete
+
+                def _neg_update(preds):
+                    if not _is_concrete(preds):
+                        return float(preds.max())
+                    return preds
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_else_of_positive_guard_is_still_traced(self):
+        assert (
+            _ids(
+                """
+                from metrics_tpu.utilities.checks import _is_concrete
+
+                def _else_update(preds):
+                    if _is_concrete(preds):
+                        return 1.0
+                    else:
+                        return float(preds.max())
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_else_of_negated_guard_is_eager(self):
+        assert (
+            _ids(
+                """
+                from metrics_tpu.utilities.checks import _is_concrete
+
+                def _neg_else_update(preds):
+                    if not _is_concrete(preds):
+                        return preds
+                    else:
+                        return float(preds.max())
+                """
+            )
+            == []
+        )
+
+    def test_else_of_compound_negated_guard_stays_linted(self):
+        """`if flag and not _is_concrete(x): ... else: float(x)` — the else
+        runs under trace whenever `flag` is falsy while x is a tracer, so
+        only an EXACT negated guard may exempt its else branch."""
+        assert (
+            _ids(
+                """
+                from metrics_tpu.utilities.checks import _is_concrete
+
+                def _cmp_update(preds, flag):
+                    if flag and not _is_concrete(preds):
+                        return preds
+                    else:
+                        return float(preds.max())
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_tracer_isinstance_body_is_traced(self):
+        assert (
+            _ids(
+                """
+                import jax
+
+                def _tr_update(preds):
+                    if isinstance(preds, jax.core.Tracer):
+                        return float(preds.max())
+                    return preds
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_self_state_attribute_cast_is_flagged(self):
+        """`self.<state>` routes to a traced array via the state registry —
+        the config-attribute exemption must not cover declared states."""
+        assert (
+            _ids(
+                """
+                class M:
+                    def __init__(self):
+                        self.add_state("total", default=0, dist_reduce_fx="sum")
+
+                    def update(self, preds):
+                        return float(self.total)
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_inherited_state_attribute_cast_is_flagged(self):
+        """States are routinely declared in a base class in ANOTHER module
+        (Accuracy's `tp` lives in StatScores) — the cross-file state-name
+        union must catch `float(self.<parent state>)` in the subclass."""
+        from metrics_tpu.analysis.lint import lint_paths
+
+        import os
+        import tempfile
+
+        base = textwrap.dedent(
+            """
+            class StatScores:
+                def __init__(self):
+                    self.add_state("tp", default=0, dist_reduce_fx="sum")
+            """
+        )
+        child = textwrap.dedent(
+            """
+            from base import StatScores
+
+            class Accuracy(StatScores):
+                def update(self, preds):
+                    return float(self.tp)
+            """
+        )
+        with tempfile.TemporaryDirectory() as d:
+            for name, src in (("base.py", base), ("child.py", child)):
+                with open(os.path.join(d, name), "w") as fh:
+                    fh.write(src)
+            findings = lint_paths(
+                [os.path.join(d, "base.py"), os.path.join(d, "child.py")], root=d
+            )
+        assert [f.rule_id for f in findings] == ["GL201"]
+        assert findings[0].path == "child.py"
+
+    def test_static_shape_casts_are_fine(self):
+        assert (
+            _ids(
+                """
+                def _shape_update(preds):
+                    n = int(preds.shape[0])
+                    d = int(preds.ndim)
+                    k = float(len(preds))
+                    return n + d + k
+                """
+            )
+            == []
+        )
+
+    def test_self_config_cast_is_fine(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def update(self, preds):
+                        return preds * float(self.beta)
+                """
+            )
+            == []
+        )
+
+    def test_host_clock_in_update_path(self):
+        assert (
+            _ids(
+                """
+                import time
+
+                class M:
+                    def update(self, preds):
+                        self.t = time.time()
+                """
+            )
+            == ["GL203"]
+        )
+
+    def test_np_random_in_update_path(self):
+        assert (
+            _ids(
+                """
+                import numpy as np
+
+                def _resample_update(preds):
+                    return preds[np.random.permutation(4)]
+                """
+            )
+            == ["GL203"]
+        )
+
+    def test_text_family_module_is_host_side_by_contract(self):
+        src = """
+        def _bleu_score_update(preds, target):
+            return float(len(preds) == len(target))
+        """
+        assert (
+            lint_source(textwrap.dedent(src), relpath="metrics_tpu/functional/text/bleu.py") == []
+        )
+
+
+# --------------------------------------------------------------------------
+# GL301/GL302 — state discipline
+# --------------------------------------------------------------------------
+
+
+class TestStateDiscipline:
+    def test_direct_state_write_flagged(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def __init__(self):
+                        self._state["total"] = 0
+                """
+            )
+            == ["GL301"]
+        )
+
+    def test_tuple_unpack_state_write_flagged(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def poke(self, v):
+                        self._state["x"], self.other = v, 1
+                """
+            )
+            == ["GL301"]
+        )
+
+    def test_nested_subscript_state_write_flagged(self):
+        """`self._state["x"][0] = ...` is an in-place row write that
+        bypasses add_state just as fully as the single-subscript form."""
+        assert (
+            _ids(
+                """
+                class M:
+                    def poke(self):
+                        self._state["x"][0] = 1
+                """
+            )
+            == ["GL301"]
+        )
+
+    def test_defaults_write_flagged(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def __init__(self):
+                        self._defaults["total"] = 0
+                """
+            )
+            == ["GL301"]
+        )
+
+    def test_metric_base_module_is_owner(self):
+        src = """
+        class Metric:
+            def add_state(self, name, default):
+                self._state[name] = default
+        """
+        assert lint_source(textwrap.dedent(src), relpath="metrics_tpu/metric.py") == []
+
+    def test_add_state_is_the_sanctioned_path(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def __init__(self):
+                        self.add_state("total", default=0, dist_reduce_fx="sum")
+                """
+            )
+            == []
+        )
+
+    def test_list_state_without_template_flagged(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def __init__(self):
+                        self.add_state("xs", default=[], dist_reduce_fx="cat")
+                """
+            )
+            == ["GL302"]
+        )
+
+    def test_list_state_with_template_ok(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                class M:
+                    def __init__(self):
+                        self.add_state(
+                            "xs", default=[], dist_reduce_fx="cat",
+                            template=jnp.zeros((0,), jnp.float32),
+                        )
+                """
+            )
+            == []
+        )
+
+    def test_explicit_template_none_declares_ragged_rows(self):
+        assert (
+            _ids(
+                """
+                class M:
+                    def __init__(self):
+                        self.add_state("preds", default=[], dist_reduce_fx="cat", template=None)
+                """
+            )
+            == []
+        )
+
+    def test_array_state_needs_no_template(self):
+        assert (
+            _ids(
+                """
+                import jax.numpy as jnp
+
+                class M:
+                    def __init__(self):
+                        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+                """
+            )
+            == []
+        )
+
+    def test_host_side_class_list_states_exempt(self):
+        assert (
+            _ids(
+                """
+                class TextMetric:
+                    jittable_update = False
+
+                    def __init__(self):
+                        self.add_state("tokens", default=[], dist_reduce_fx="cat")
+                """
+            )
+            == []
+        )
+
+
+# --------------------------------------------------------------------------
+# engine behaviors
+# --------------------------------------------------------------------------
+
+
+class TestEngine:
+    def test_findings_sorted_and_formatted(self):
+        findings = lint_source(
+            "import jax\nimport jax.numpy as jnp\nN = jax.device_count()\nZ = jnp.zeros(3)\n",
+            relpath="metrics_tpu/x.py",
+        )
+        assert [f.rule_id for f in findings] == ["GL101", "GL102"]
+        assert findings[0].format().startswith("metrics_tpu/x.py:3:")
+
+    def test_syntax_error_surfaces_as_gl000(self):
+        from metrics_tpu.analysis.lint import lint_paths
+
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            bad = os.path.join(d, "bad.py")
+            with open(bad, "w") as fh:
+                fh.write("def broken(:\n")
+            findings = lint_paths([bad], root=d)
+        assert [f.rule_id for f in findings] == ["GL000"]

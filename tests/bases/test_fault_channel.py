@@ -417,9 +417,16 @@ class TestFunctional:
         res, counts = fn(jnp.asarray(p), jnp.asarray(t))
         # both guarded members counted the same 2 bad label rows
         assert _counts(counts)[_cls("label_out_of_range")] == 4
-        hlo = fn.lower(jnp.asarray(p), jnp.asarray(t)).compile().as_text()
-        n_all_reduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
-        assert n_all_reduce <= 2, f"fault channel must ride fused_sync, got {n_all_reduce} all-reduces"
+        # fault channel must ride fused_sync: <= 2 all-reduces, enforced by
+        # the shared compiled-graph auditor
+        from metrics_tpu.analysis.graph_audit import GraphBudget, assert_graph_budget
+
+        assert_graph_budget(
+            fn,
+            (jnp.asarray(p), jnp.asarray(t)),
+            budget=GraphBudget(max_all_reduce=2),
+            entry="guarded_collection_fused_sync",
+        )
 
     def test_merge_sums_counters(self):
         mdef = mt.functionalize(mt.SumMetric(nan_strategy="warn"))

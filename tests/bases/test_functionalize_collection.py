@@ -79,9 +79,11 @@ def test_sharded_fused_collection():
     for k, v in _expected().items():
         np.testing.assert_allclose(float(out[k]), v, atol=1e-5, err_msg=k)
 
-    # the whole 4-metric collection syncs with ONE all-reduce (fused_sync)
-    hlo = fn.lower(p_dev, t_dev).compile().as_text()
-    n_all_reduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+    # the whole 4-metric collection syncs with ONE all-reduce (fused_sync);
+    # the shared auditor owns the counting rule
+    from metrics_tpu.analysis.graph_audit import collective_counts, hlo_of
+
+    n_all_reduce = collective_counts(hlo_of(fn, p_dev, t_dev))["all-reduce"]
     assert n_all_reduce == 1, f"expected 1 fused all-reduce for the collection, got {n_all_reduce}"
 
 

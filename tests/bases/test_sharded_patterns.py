@@ -158,8 +158,10 @@ def test_fused_sync_single_collective_hlo():
     fn = jax.jit(
         jax.shard_map(sync_all, mesh=_mesh(), in_specs=tuple(P() for _ in states), out_specs=tuple(P() for _ in states))
     )
-    hlo = fn.lower(*states).compile().as_text()
-    n_all_reduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+    # the shared auditor is the single definition of the collective count
+    from metrics_tpu.analysis.graph_audit import collective_counts, hlo_of
+
+    n_all_reduce = collective_counts(hlo_of(fn, *states))["all-reduce"]
     assert n_all_reduce == 1, f"expected 1 fused all-reduce, compiled HLO has {n_all_reduce}"
 
     out = fn(*states)
@@ -181,8 +183,9 @@ def test_fused_sync_mixed_dtypes_two_collectives():
     fn = jax.jit(
         jax.shard_map(sync_all, mesh=_mesh(), in_specs=(P(), P()), out_specs=(P(), P()))
     )
-    hlo = fn.lower(*states).compile().as_text()
-    n_all_reduce = hlo.count("all-reduce(") + hlo.count("all-reduce-start(")
+    from metrics_tpu.analysis.graph_audit import collective_counts, hlo_of
+
+    n_all_reduce = collective_counts(hlo_of(fn, *states))["all-reduce"]
     assert n_all_reduce == 2, f"expected 2 all-reduces (one per dtype), got {n_all_reduce}"
 
 

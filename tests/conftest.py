@@ -36,3 +36,11 @@ def pytest_configure(config):
         "decayed wrappers and mergeable sketches); select with -m streaming, "
         "or run the directory via `make test-streaming`",
     )
+    config.addinivalue_line(
+        "markers",
+        "analysis: the static-analysis subsystem (metrics_tpu/analysis/ — "
+        "graft-lint AST rules + compiled-graph budget auditor); select with "
+        "-m analysis, or run the directory via `make test-analysis` (the "
+        "compile-heavy full-registry audit is additionally marked slow and "
+        "runs in CI through `make lint`)",
+    )
