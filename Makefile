@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -57,3 +57,10 @@ test-resilience:
 # mergeable sketches; same tests the `streaming` pytest marker selects).
 test-streaming:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/streaming/ -q -p no:cacheprovider
+
+# Fast feedback on the kernel layer (ops/ — dispatch registry, binned sketch
+# precompaction, packed-radix orders, pallas kernels via interpret-mode
+# parity; same tests the `ops` pytest marker selects; 1M-row variants are
+# additionally marked slow).
+test-ops:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ops/ -q -m 'not slow' -p no:cacheprovider

@@ -943,6 +943,42 @@ def _phase_streaming(jax, platform) -> None:
             f"{geom.items.shape[0]}x{geom.items.shape[1]} levels, {platform})",
         )
 
+        # ISSUE 6 sub-timings: where the update milliseconds go — the
+        # binning pre-compaction vs the level-fold cascade
+        from metrics_tpu.ops import fold_cascade, precompact_batch
+
+        k = geom.items.shape[1]
+        ones = jnp.ones(x.shape, bool)
+        inc0, cnt0, level = precompact_batch(x, ones, k)  # eager: level is static
+
+        def best_of(f, *args, reps=3):
+            jax.block_until_ready(f(*args))
+            t = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(*args))
+                t = min(t, time.perf_counter() - t0)
+            return t * 1e3
+
+        bin_fn = jax.jit(lambda v: precompact_batch(v, jnp.ones(v.shape, bool), k))
+        t_bin = best_of(bin_fn, x)
+        compact_fn = jax.jit(
+            lambda it, c, i, n: fold_cascade(it, c, i, n, level)
+        )
+        sk = state0["sketch"]
+        t_compact = best_of(compact_fn, sk.items, sk.counts, inc0, cnt0)
+        _emit(
+            "qsketch_bin_ms",
+            round(t_bin, 3),
+            f"ms/binned pre-compaction (1M rows -> {inc0.shape[0]} items at level "
+            f"{level}, dispatched sketch_precompact kernel, {platform})",
+        )
+        _emit(
+            "qsketch_compact_ms",
+            round(t_compact, 3),
+            f"ms/fold cascade (level {level} entry, cond-short-circuited, {platform})",
+        )
+
         other = jax.jit(mdef.update)(mdef.init(), 1.0 - x)
         # merge timing: jit the merge directly (carry-independent inputs
         # would be hoisted out of a fori_loop, so time it as a plain call)
@@ -963,6 +999,87 @@ def _phase_streaming(jax, platform) -> None:
         print(f"bench: streaming qsketch failed: {err}", file=sys.stderr)
 
 
+def _phase_compactor(jax, platform) -> None:
+    """ISSUE 6 A/B: the QuantileSketch 1M-row jitted update through the
+    legacy full-sort pre-compaction vs the binned-key pass — interleaved
+    min-of-2 per variant (BASELINE.md discipline), state parity asserted
+    bitwise before timing. A FRESH metric + jit is built per variant: the
+    dispatch choice is baked in at trace time, and a shared jit cache
+    would silently time one variant twice. Plus the small-batch (512-row)
+    update that the cond-short-circuited cascade unlocks (the seed code
+    paid the full 20-level fold cascade: ~39 ms measured pre-change)."""
+    _stamp("compactor start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu import QuantileSketch, functionalize
+    from metrics_tpu.ops import dispatch as kdispatch
+
+    rng = np.random.default_rng(21)
+    n = 1_048_576
+    x = jnp.asarray(rng.random(n).astype(np.float32))
+
+    try:
+        def mk(impl):
+            with kdispatch.kernel_override(sketch_precompact=impl):
+                mdef = functionalize(QuantileSketch(eps=0.01))
+                upd = jax.jit(mdef.update)
+                state = upd(mdef.init(), x)  # trace happens under the override
+                jax.block_until_ready(state)
+
+            def run(upd=upd, mdef=mdef):
+                t0 = time.perf_counter()
+                jax.block_until_ready(upd(mdef.init(), x))
+                return time.perf_counter() - t0
+
+            return run, state
+
+        runners, states = {}, {}
+        for impl in ("sort", "binned"):
+            runners[impl], states[impl] = mk(impl)
+        same = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(states["sort"]),
+                jax.tree_util.tree_leaves(states["binned"]),
+            )
+        )
+        if not same:
+            print("bench: PARITY-MISMATCH compactor sort vs binned state", file=sys.stderr)
+        times = {impl: float("inf") for impl in runners}
+        for _ in range(2):  # interleaved min-of-2
+            for impl, run in runners.items():
+                times[impl] = min(times[impl], run())
+        _emit(
+            "qsketch_update_binned_ms",
+            round(times["binned"] * 1e3, 2),
+            f"ms/update (QuantileSketch eps=0.01, 1M rows, binned-key pre-compaction, "
+            f"{platform}); legacy full-sort path same data: {times['sort'] * 1e3:.1f} ms",
+            round(times["sort"] / times["binned"], 2),
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: compactor A/B failed: {err}", file=sys.stderr)
+
+    try:
+        xs = jnp.asarray(rng.random(512).astype(np.float32))
+        mdef = functionalize(QuantileSketch(eps=0.01))
+        upd = jax.jit(mdef.update)
+        jax.block_until_ready(upd(mdef.init(), xs))
+        t_small = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(upd(mdef.init(), xs))
+            t_small = min(t_small, time.perf_counter() - t0)
+        _emit(
+            "qsketch_smallbatch_update_ms",
+            round(t_small * 1e3, 3),
+            f"ms/update (QuantileSketch eps=0.01, 512-row batch, cond-short-circuited "
+            f"cascade + unpadded precompact, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: compactor small-batch failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -975,6 +1092,7 @@ _PHASES = {
     "checkpoint": (_phase_checkpoint, 240),
     "sync": (_phase_sync, 150),
     "streaming": (_phase_streaming, 300),
+    "compactor": (_phase_compactor, 420),
 }
 
 _HEADLINE_METRIC = "fused_collection_step_ms"

@@ -22,6 +22,15 @@ entry                           budget
 ``mean_update_stability``       recompilation detector on a guarded update:
                                 state avals batch-size independent, cache hit
                                 at equal avals
+``qsketch_update_step``         jitted QuantileSketch update (the ISSUE 6
+                                binned precompaction + cond cascade): **0**
+                                collectives, no f64/callbacks/dynamic shapes,
+                                AND recompile-stable — sketch state avals are
+                                batch-size independent, cache hit at equal
+                                avals (``audit_recompilation``)
+``bucketed_rank_step``          the bucketed-rank kernel step (dispatched
+                                descending order + inverse ranks): **0**
+                                collectives, no f64/callbacks/dynamic shapes
 ==============================  =============================================
 """
 from dataclasses import dataclass
@@ -177,6 +186,46 @@ def _build_mean_update_stability():
     return update, make_args
 
 
+def _build_qsketch_raw_update():
+    import metrics_tpu as mt
+
+    mdef = mt.functionalize(mt.QuantileSketch(quantiles=(0.5, 0.99), **_QS))
+
+    def update(v):
+        return mdef.update(mdef.init(), v)
+
+    return update
+
+
+def _qsketch_make_args(batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    return (jnp.asarray(np.linspace(0.0, 1.0, batch, dtype=np.float32)),)
+
+
+def _build_qsketch_update_step(ndev: int):
+    import jax
+
+    # ONE construction for budget + recompile audits (the auroc stance)
+    return jax.jit(_build_qsketch_raw_update()), _qsketch_make_args(96)
+
+
+def _build_bucketed_rank_step(ndev: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.ops import descending_order, inverse_permutation
+
+    def step(x):
+        order = descending_order(x)
+        return inverse_permutation(order)  # per-element descending ranks
+
+    x = jnp.asarray(np.random.default_rng(3).random(256, np.float32))
+    return jax.jit(step), (x,)
+
+
 REGISTRY: Tuple[AuditEntry, ...] = (
     AuditEntry(
         name="fused_stat_collection",
@@ -209,6 +258,29 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         name="mean_update_stability",
         budget=None,
         build_recompile=_build_mean_update_stability,
+    ),
+    AuditEntry(
+        name="qsketch_update_step",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_qsketch_update_step,
+        build_recompile=lambda: (_build_qsketch_raw_update(), _qsketch_make_args),
+    ),
+    AuditEntry(
+        name="bucketed_rank_step",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_bucketed_rank_step,
     ),
 )
 

@@ -63,3 +63,76 @@ def declared_state_names(root: ast.AST) -> Set[str]:
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             names.add(arg.value)
     return names
+
+
+def _pallas_callee_of(node: ast.AST) -> Optional[str]:
+    """The kernel-body (or kernel-factory) name a ``pl.pallas_call(...)``
+    call references, or None. Three idioms (bare ``pallas_call`` or any
+    dotted form):
+
+    - ``pallas_call(kernel, ...)`` -> ``kernel``
+    - ``pallas_call(functools.partial(kernel, ...), ...)`` -> ``kernel``
+    - ``pallas_call(make_kernel(...), ...)`` -> ``make_kernel`` — the
+      factory idiom (``ops/pallas_kernels.py::_make_fold_kernel``): the
+      kernel body is a def nested inside the factory, so exempting the
+      factory exempts it."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    parts = dotted_parts(node.func)
+    if parts is None or parts[-1] != "pallas_call":
+        return None
+    fn = node.args[0]
+    if isinstance(fn, ast.Call):
+        fn_parts = dotted_parts(fn.func)
+        if fn_parts is not None and fn_parts[-1] == "partial" and fn.args:
+            fn = fn.args[0]  # partial(kernel, ...) -> kernel
+        else:
+            fn = fn.func  # make_kernel(...) -> the factory
+    return fn.id if isinstance(fn, ast.Name) else None
+
+
+def pallas_callee_names(root: ast.AST) -> Set[str]:
+    """Names of functions handed to ``pl.pallas_call`` as the kernel body
+    anywhere under ``root``. Pallas kernel bodies execute inside the
+    pallas tracing machinery where Ref indexing and scalar reads are the
+    programming model — they are exempt-by-contract from the trace-safety
+    rules, the same stance as the host-side text/detection families.
+    Bare-name matching: callers pass the scope the names are resolvable
+    from (a single function for nested kernels;
+    :func:`module_level_pallas_callee_names` for module-level ones)."""
+    names: Set[str] = set()
+    for node in ast.walk(root):
+        name = _pallas_callee_of(node)
+        if name is not None:
+            names.add(name)
+    return names
+
+
+def module_level_pallas_callee_names(tree: ast.Module) -> Set[str]:
+    """Pallas callee names that resolve to MODULE-LEVEL defs.
+
+    A ``pallas_call`` site whose enclosing function (any level) also
+    contains a nested def of the referenced name is referencing that
+    NESTED kernel under python scoping — it must not exempt an unrelated
+    same-named module-level function (and vice versa: the nested case is
+    handled per-function by the trace-safety walker)."""
+    names: Set[str] = set()
+
+    def nested_def_names(fn: ast.AST) -> Set[str]:
+        return {
+            n.name
+            for n in ast.walk(fn)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n is not fn
+        }
+
+    def visit(node: ast.AST, shadowed: Set[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            shadowed = shadowed | nested_def_names(node)
+        name = _pallas_callee_of(node)
+        if name is not None and name not in shadowed:
+            names.add(name)
+        for child in ast.iter_child_nodes(node):
+            visit(child, shadowed)
+
+    visit(tree, set())
+    return names

@@ -15,6 +15,11 @@ Scope — "functions reachable from jitted update paths", resolved per module:
   contract ("host-side metrics (text, detection) cannot run inside compiled
   code", ``pure.py``) — their kernels churn python strings and per-image
   dicts, so none of these rules apply there.
+- **excluded functions**: pallas kernel bodies — any function handed to
+  ``pl.pallas_call`` as the kernel (module-level or nested inside an update
+  method) — are exempt-by-contract: they execute inside the pallas tracing
+  machinery where Ref indexing/scalar reads are the programming model, not
+  a host sync (``rules/_common.py::pallas_callee_names``).
 
 The repo's sanctioned eager-guard idiom is recognized and exempted
 POLARITY-AWARE: an ``if`` whose test mentions ``_is_concrete`` positively
@@ -226,7 +231,9 @@ def _guard_polarity(
     return None
 
 
-def _iter_trace_scope(func_node: ast.AST, guard_names: Set[str]) -> Iterator[ast.AST]:
+def _iter_trace_scope(
+    func_node: ast.AST, guard_names: Set[str], pallas_callees: Set[str] = frozenset()
+) -> Iterator[ast.AST]:
     """Nodes of a reachable function that execute under trace.
 
     ``if``-statements guarded on concreteness keep only their traced side:
@@ -236,9 +243,20 @@ def _iter_trace_scope(func_node: ast.AST, guard_names: Set[str]) -> Iterator[ast
     body and exempts the ``else`` — but a conjunction containing the
     negated guard only proves the BODY traced (its else can still run
     under trace when another conjunct fails), so everything stays linted.
-    Unknown tests get no exemption."""
+    Unknown tests get no exemption. Nested defs named in
+    ``pallas_callees`` (pallas kernel bodies) are skipped whole —
+    exempt-by-contract (module docstring). The caller passes callee names
+    collected from THIS function only: a nested def is referenceable only
+    from its enclosing scope, so a same-named module-level kernel elsewhere
+    must not exempt an unrelated nested helper here."""
 
     def walk(node: ast.AST) -> Iterator[ast.AST]:
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node is not func_node
+            and node.name in pallas_callees
+        ):
+            return
         if isinstance(node, ast.If):
             guard = _guard_polarity(node.test, guard_names)
             if guard is not None:
@@ -309,14 +327,31 @@ class _TraceSafetyRule:
         # by all three GL20x rules via the module's analysis cache
         indexed = module.cache.get("trace_safety_scope")
         if indexed is None:
+            from metrics_tpu.analysis.rules._common import (
+                module_level_pallas_callee_names,
+                pallas_callee_names,
+            )
+
+            # only callee names that RESOLVE to module level exclude roots:
+            # a nested kernel sharing a name with an unrelated module-level
+            # update function must not exempt the latter (review finding)
+            module_callees = module_level_pallas_callee_names(module.tree)
             indexed = [
-                (entry, _concrete_guard_names(entry.node))
+                # per-entry callee names: a nested kernel def is only
+                # referenceable from its enclosing function, so the
+                # nested-skip consults THAT function's pallas_call sites —
+                # a same-named module-level kernel elsewhere must not
+                # exempt an unrelated nested helper (review finding)
+                (entry, _concrete_guard_names(entry.node), pallas_callee_names(entry.node))
                 for entry in _update_path_functions(module.tree)
+                # module-level kernels handed to pl.pallas_call are
+                # exempt-by-contract even if reachable / `_*_update`-named
+                if entry.name not in module_callees
             ]
             module.cache["trace_safety_scope"] = indexed
-        for entry, guard_names in indexed:
+        for entry, guard_names, pallas_callees in indexed:
             owner = f"{entry.class_name}.{entry.name}" if entry.class_name else entry.name
-            for node in _iter_trace_scope(entry.node, guard_names):
+            for node in _iter_trace_scope(entry.node, guard_names, pallas_callees):
                 finding = self.match(module, node, owner, state_names)
                 if finding is not None:
                     yield finding

@@ -82,8 +82,11 @@ class BinnedPrecisionRecallCurve(Metric):
         super().__init__(**kwargs)
         self.num_classes = num_classes
         # the hand-tiled VMEM kernel (ops/binned_counters.py) avoids the
-        # (N, C, T) HBM intermediate; default on for real TPU backends
-        self.use_pallas = jax.default_backend() == "tpu" if use_pallas is None else use_pallas
+        # (N, C, T) HBM intermediate. `use_pallas=None` defers to the kernel
+        # dispatch layer (`ops/dispatch.py`: pallas on TPU, XLA elsewhere,
+        # `METRICS_TPU_KERNEL_BACKEND` overrides); the explicit bool stays
+        # honored as a per-instance force (True runs the interpreter off-TPU)
+        self.use_pallas = use_pallas
         if isinstance(thresholds, int):
             self.num_thresholds = thresholds
             self.thresholds = jnp.linspace(0, 1.0, thresholds)
@@ -110,24 +113,20 @@ class BinnedPrecisionRecallCurve(Metric):
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
 
-        if self.use_pallas:
-            from metrics_tpu.ops.binned_counters import binned_counter_update
+        from metrics_tpu.ops import binned_counter_update
 
-            tps, fps, fns = binned_counter_update(
-                preds,
-                (target == 1).astype(jnp.float32),
-                self.thresholds,
-                interpret=jax.default_backend() != "tpu",
-            )
-            self.TPs += tps
-            self.FPs += fps
-            self.FNs += fns
-            return
-        tgt = (target == 1)[..., None]  # (N, C, 1)
-        pred = preds[..., None] >= self.thresholds  # (N, C, T)
-        self.TPs += jnp.sum(tgt & pred, axis=0).astype(jnp.float32)
-        self.FPs += jnp.sum((~tgt) & pred, axis=0).astype(jnp.float32)
-        self.FNs += jnp.sum(tgt & (~pred), axis=0).astype(jnp.float32)
+        if self.use_pallas is None:
+            backend = None  # one switch for every caller: ops/dispatch.py
+        elif self.use_pallas:
+            backend = "pallas" if jax.default_backend() == "tpu" else "pallas-interpret"
+        else:
+            backend = "xla"
+        tps, fps, fns = binned_counter_update(
+            preds, (target == 1).astype(jnp.float32), self.thresholds, backend=backend
+        )
+        self.TPs += tps
+        self.FPs += fps
+        self.FNs += fns
 
     def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
         """Reference ``binned_precision_recall.py:159-172``."""

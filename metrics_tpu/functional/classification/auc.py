@@ -6,7 +6,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.ops.bucketed_rank import ascending_order
+from metrics_tpu.ops import ascending_order
 from metrics_tpu.utilities.compute import _auc_compute
 
 Array = jax.Array

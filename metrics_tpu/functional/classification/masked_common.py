@@ -17,7 +17,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.ops.bucketed_rank import descending_order
+from metrics_tpu.ops import descending_order
 
 Array = jax.Array
 

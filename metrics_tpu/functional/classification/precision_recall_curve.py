@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.classification.masked_common import masked_curve_prologue
-from metrics_tpu.ops.bucketed_rank import descending_order, partition_order
+from metrics_tpu.ops import descending_order, partition_order
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array

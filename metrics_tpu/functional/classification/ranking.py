@@ -12,7 +12,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.ops.bucketed_rank import ascending_ranks
+from metrics_tpu.ops import ascending_ranks
 
 Array = jax.Array
 

@@ -13,7 +13,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _binary_clf_curve,
     _precision_recall_curve_update,
 )
-from metrics_tpu.ops.bucketed_rank import partition_order
+from metrics_tpu.ops import partition_order
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array

@@ -11,7 +11,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.ops.bucketed_rank import descending_order
+from metrics_tpu.ops import descending_order
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
 
 Array = jax.Array

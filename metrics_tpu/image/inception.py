@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.ops.bucketed_rank import ascending_order, inverse_permutation
+from metrics_tpu.ops import ascending_order, inverse_permutation
 from metrics_tpu.utilities.data import dim_zero_cat
 from metrics_tpu.utilities.ringbuffer import CatBuffer, cat_append, reject_valid_kwarg
 

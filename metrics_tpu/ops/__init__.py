@@ -1,10 +1,18 @@
-"""Hand-written TPU kernels (pallas) for hot metric ops.
+"""Hand-written kernels (pallas + packed-radix XLA) for hot metric ops.
 
 XLA handles most fusion; these kernels cover the few update paths where the
-default lowering materializes a large intermediate (see each module's
-docstring). Every kernel has an identical-semantics XLA fallback and runs in
-pallas interpret mode off-TPU, so parity tests execute everywhere.
+default lowering materializes a large intermediate or serializes (see each
+module's docstring). Since ISSUE 6 every kernel choice goes through ONE
+dispatch layer (``ops/dispatch.py``): per-op ``xla | pallas | auto``
+selection via ``METRICS_TPU_KERNEL_BACKEND`` with a warn-once fallback to
+the XLA path when pallas is unavailable or the shape is unsupported — so
+callers (`_binary_clf_curve`, capacity-mode compactions, retrieval
+``_group_layout``, ``streaming/sketches.py``, the binned PR metrics) import
+this surface instead of hardcoding a kernel. Every pallas kernel has an
+identical-semantics XLA fallback and runs in pallas interpret mode off-TPU,
+so parity tests execute everywhere (``tests/ops/``).
 """
+from metrics_tpu.ops import dispatch  # noqa: F401
 from metrics_tpu.ops.binned_counters import binned_counter_update  # noqa: F401
 from metrics_tpu.ops.bucketed_rank import (  # noqa: F401
     ascending_order,
@@ -15,4 +23,21 @@ from metrics_tpu.ops.bucketed_rank import (  # noqa: F401
     partition_order,
     sharded_descending_ranks,
     stable_key_order,
+)
+from metrics_tpu.ops.binning import halving_map, key_to_float32  # noqa: F401
+from metrics_tpu.ops.compactor import (  # noqa: F401
+    fold_cascade,
+    fold_level,
+    precompact_batch,
+    weighted_quantiles,
+    weighted_rank,
+)
+from metrics_tpu.ops.dispatch import (  # noqa: F401
+    kernel_override,
+    registered_ops,
+    set_kernel_override,
+)
+from metrics_tpu.ops.pallas_kernels import (  # noqa: F401
+    compactor_fold_pallas,
+    histogram_pallas,
 )

@@ -9,13 +9,20 @@ against all thresholds inside VMEM and accumulates straight into the
 ``(C, T)`` counters, so HBM sees only the inputs once and the counters once.
 
 Off-TPU the same kernel runs in pallas interpret mode (slow, correct), which
-is how the CPU test suite checks parity against the XLA path.
+is how the CPU test suite checks parity against the XLA path. Impl
+selection goes through the dispatched ``binned_counters`` op
+(``ops/dispatch.py``): ``auto`` picks the kernel on TPU and the
+straightforward XLA reduction elsewhere; ``METRICS_TPU_KERNEL_BACKEND``
+overrides per-op.
 """
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import dispatch as _dispatch
 
 Array = jax.Array
 
@@ -39,19 +46,79 @@ def _counter_kernel(preds_ref, tgt_ref, thr_ref, tps_ref, fps_ref, fns_ref):
     fns_ref[:] += jnp.sum(t3 * (1.0 - ge), axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def binned_counter_update(preds: Array, target_onehot: Array, thresholds: Array, interpret: bool = False):
-    """TP/FP/FN counts per (class, threshold) for one batch.
+_BINNED = _dispatch.register_op("binned_counters", default="xla")
+
+
+@_BINNED.impl("xla")
+def _binned_counter_xla(preds: Array, target_onehot: Array, thresholds: Array):
+    """The straightforward XLA form: materializes the ``(N, C, T)``
+    comparison tensor (what the pallas kernel exists to avoid)."""
+    tgt = (target_onehot == 1)[..., None]  # (N, C, 1)
+    pred = preds[..., None] >= thresholds  # (N, C, T)
+    tps = jnp.sum(tgt & pred, axis=0).astype(jnp.float32)
+    fps = jnp.sum((~tgt) & pred, axis=0).astype(jnp.float32)
+    fns = jnp.sum(tgt & (~pred), axis=0).astype(jnp.float32)
+    return tps, fps, fns
+
+
+def _binned_pallas_guard(*args, **kwargs):
+    from metrics_tpu.ops.pallas_kernels import _pallas_guard
+
+    return _pallas_guard()
+
+
+@_BINNED.impl("pallas", guard=_binned_pallas_guard)
+def _binned_counter_pallas(preds: Array, target_onehot: Array, thresholds: Array):
+    return _binned_counter_kernel_call(preds, target_onehot, thresholds, interpret=False)
+
+
+@_BINNED.impl("pallas-interpret")
+def _binned_counter_pallas_interpret(preds: Array, target_onehot: Array, thresholds: Array):
+    return _binned_counter_kernel_call(preds, target_onehot, thresholds, interpret=True)
+
+
+@_BINNED.auto_rule
+def _binned_auto(*args, **kwargs) -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "xla"
+
+
+def binned_counter_update(
+    preds: Array,
+    target_onehot: Array,
+    thresholds: Array,
+    interpret: Optional[bool] = None,
+    backend: Optional[str] = None,
+):
+    """TP/FP/FN counts per (class, threshold) for one batch — dispatched
+    (op ``binned_counters``: pallas on TPU, XLA elsewhere, overridable via
+    ``METRICS_TPU_KERNEL_BACKEND``).
 
     Args:
         preds: ``(N, C)`` scores.
         target_onehot: ``(N, C)`` 0/1 ground truth.
         thresholds: ``(T,)`` decision thresholds.
-        interpret: run the pallas interpreter (required off-TPU).
+        interpret: legacy knob — ``True`` forces the pallas interpreter,
+            ``False`` the compiled pallas kernel; ``None`` defers to the
+            dispatch layer.
+        backend: explicit impl name (``xla | pallas | pallas-interpret``);
+            wins over ``interpret``.
 
     Returns:
         ``(tps, fps, fns)`` — each ``(C, T)`` float32.
     """
+    if backend is None and interpret is not None:
+        backend = "pallas-interpret" if interpret else "pallas"
+    if backend is not None:
+        # per-call force: call_as, NOT the shared override table — this is
+        # a library hot path and must stay reentrant/thread-safe
+        return _dispatch.call_as("binned_counters", backend, preds, target_onehot, thresholds)
+    return _dispatch.call("binned_counters", preds, target_onehot, thresholds)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _binned_counter_kernel_call(
+    preds: Array, target_onehot: Array, thresholds: Array, interpret: bool = False
+):
     n, num_classes = preds.shape
     num_thr = thresholds.shape[0]
     if n == 0:

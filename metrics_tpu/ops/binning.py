@@ -1,0 +1,98 @@
+"""Binned-key precompaction: the O(n)-bandwidth sketch batch pass.
+
+``QuantileSketch.update`` must reduce an arbitrary batch to at most ``k``
+items of weight ``2**level`` before folding it into the level cascade. The
+reference formulation (``ops/compactor.py::precompact_batch``'s ``sort``
+path) runs a full ``jnp.sort`` of the batch under XLA's *float* comparator
+— measured 530 ms for 1M float32 rows on the CPU backend, ~90% of the
+entire ``qsketch_update_ms`` wall (BASELINE.md).
+
+This pass re-uses ``bucketed_rank``'s orderable-key grid instead: every
+float32 maps through ``_float32_ascending_word`` onto a monotone uint32
+"bucket id" at full 32-bit resolution — the same grid construction the
+histogram-rank kernel bins with, including its edge handling (non-finite
+and invalid rows route to the TOP key, exactly where the sort path's
+``+inf`` fill ties them; ``-0.0``/denormals collapse onto ``+0.0``'s
+bucket just as the XLA comparator collapses them when ordering). Binning
+the batch by key is then a *value-only unsigned* sort — which XLA lowers
+~6.4x cheaper than the NaN-aware float comparator (83 ms vs 530 ms at 1M
+on this CPU; on ints the lowering is a branch-free radix-style loop, so
+the pass is bandwidth-bound) — and the level-buffer-sized run the
+compaction keeps costs ONE static gather: the alternating-pair halving
+cascade is a pure index map, so all ``level`` rounds compose at trace time
+into a single ``(<=k,)`` gather of the binned keys (`_halving_map`),
+replacing the ~n gathered elements of the round-by-round chain.
+
+Output contract: **bit-identical to the sort path** — same kept values at
+the same slots, same count, same static level — except that ``-0.0`` and
+float32 denormals canonicalize to ``+0.0`` (the key map is the XLA
+comparator's own equivalence, so rank semantics are untouched; pinned in
+``tests/ops/test_binning.py`` across adversarial distributions). The
+bit-parity argument: element ``j`` of the compacted run is the sorted
+batch at static position ``P(j)`` (the composed halving map), and
+``j < m >> level`` implies every intermediate halving index stayed inside
+its round's valid prefix, so the one-shot gather sees exactly the value
+the round-by-round chain saw.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ops import dispatch as _dispatch
+from metrics_tpu.ops.bucketed_rank import _float32_ascending_word
+
+Array = jax.Array
+
+_INF = float("inf")
+# past every finite key AND the +inf key (0xFF800000); equals the NaN key,
+# where the sort path's invalid fill also lands (jax sorts NaNs last)
+_INVALID_KEY = 0xFFFFFFFF
+
+
+def key_to_float32(key: Array) -> Array:
+    """Invert ``_float32_ascending_word``: monotone uint32 key -> float32.
+
+    Only keys in the forward map's image appear here; the collapsed
+    ``-0.0``/denormal keys invert to ``+0.0`` (canonicalization, see module
+    docstring) and the ``0xFFFFFFFF`` invalid key inverts to a NaN."""
+    key = jnp.asarray(key, jnp.uint32)
+    neg = key < jnp.uint32(0x80000000)  # negative floats were stored as ~u
+    u = jnp.where(neg, ~key, key & jnp.uint32(0x7FFFFFFF))
+    return jax.lax.bitcast_convert_type(u, jnp.float32)
+
+
+def halving_map(n: int, k: int) -> Tuple[np.ndarray, int]:
+    """Compose the alternating-pair halving rounds into one static index
+    map: ``idx[j]`` is the sorted-batch position the ``j``-th kept item of
+    ``precompact`` comes from, ``level`` the number of rounds (item weight
+    ``2**level``). Pure numpy at trace time — the map depends only on the
+    static batch size."""
+    idx = np.arange(n, dtype=np.int64)
+    level = 0
+    while idx.shape[0] > k:
+        j = np.arange(idx.shape[0] // 2)
+        idx = idx[2 * j + (j & 1)]
+        level += 1
+    return idx.astype(np.int32), level
+
+
+_PRECOMPACT = _dispatch.register_op("sketch_precompact", default="binned")
+
+
+@_PRECOMPACT.impl("binned")
+def _precompact_binned(x: Array, valid: Array, k: int) -> Tuple[Array, Array, int]:
+    """The binned-key pass (see module docstring). Same contract as the
+    ``sort`` impl in ``ops/compactor.py``."""
+    x = jnp.asarray(x, jnp.float32).reshape(-1)
+    valid = jnp.broadcast_to(jnp.asarray(valid, bool).reshape(-1), x.shape)
+    valid = valid & jnp.isfinite(x)
+    keys = jnp.where(valid, _float32_ascending_word(x), jnp.uint32(_INVALID_KEY))
+    m = jnp.sum(valid.astype(jnp.int32))
+    binned = jnp.sort(keys)  # value-only unsigned binning pass
+    idx, level = halving_map(x.shape[0], k)
+    kept = key_to_float32(binned[jnp.asarray(idx)]) if idx.size else jnp.zeros((0,), jnp.float32)
+    count = m >> level
+    cur = jnp.where(jnp.arange(idx.shape[0]) < count, kept, _INF)
+    return cur, count.astype(jnp.int32), level

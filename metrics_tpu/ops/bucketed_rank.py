@@ -46,6 +46,8 @@ from typing import List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops import dispatch as _dispatch
+
 Array = jax.Array
 
 _U32_MAX = 0xFFFFFFFF
@@ -153,12 +155,47 @@ def _radix_order_words(words: List[Array], total_bits: int) -> Array:
     return perm.astype(jnp.int32)
 
 
+# --------------------------------------------------------------------------
+# Dispatched order ops (ops/dispatch.py): the packed-radix kernels are the
+# default `radix` impls; the plain `jnp.argsort` forms stay registered as
+# the `argsort` escape hatch / A-B reference, so every caller
+# (_binary_clf_curve, capacity-mode compactions, retrieval _group_layout,
+# the sketch quantile query) selects through ONE switch instead of
+# hardcoding a kernel.
+# --------------------------------------------------------------------------
+
+_ASC = _dispatch.register_op("ascending_order", default="radix")
+_DESC = _dispatch.register_op("descending_order", default="radix")
+_PART = _dispatch.register_op("partition_order", default="radix")
+_KEYORD = _dispatch.register_op("stable_key_order", default="radix")
+
+
+@_ASC.impl("radix")
+def _ascending_order_radix(x: Array) -> Array:
+    words, bits = _key_words_ascending(jnp.asarray(x))
+    return _radix_order_words(words, bits)
+
+
+@_ASC.impl("argsort")
+def _ascending_order_argsort(x: Array) -> Array:
+    return jnp.argsort(jnp.asarray(x), stable=True).astype(jnp.int32)
+
+
 def ascending_order(x: Array) -> Array:
     """Exact stable ascending order: bitwise equal to
     ``jnp.argsort(x, stable=True)`` (see comparator notes in the module
     docstring), at a fraction of the variadic-sort cost for large ``n``."""
-    words, bits = _key_words_ascending(jnp.asarray(x))
-    return _radix_order_words(words, bits)
+    return _dispatch.call("ascending_order", x)
+
+
+@_DESC.impl("radix")
+def _descending_order_radix(x: Array) -> Array:
+    return _ascending_order_radix(-jnp.asarray(x))
+
+
+@_DESC.impl("argsort")
+def _descending_order_argsort(x: Array) -> Array:
+    return jnp.argsort(-jnp.asarray(x)).astype(jnp.int32)
 
 
 def descending_order(x: Array) -> Array:
@@ -170,10 +207,16 @@ def descending_order(x: Array) -> Array:
     map exactly as the comparator collapses them) and integer INT_MIN
     wraparound.
     """
-    return ascending_order(-jnp.asarray(x))
+    return _dispatch.call("descending_order", x)
 
 
-def stable_key_order(keys: Array, num_buckets: int) -> Array:
+@_KEYORD.impl("argsort")
+def _stable_key_order_argsort(keys: Array, num_buckets: int) -> Array:
+    return jnp.argsort(jnp.asarray(keys), stable=True).astype(jnp.int32)
+
+
+@_KEYORD.impl("radix")
+def _stable_key_order_radix(keys: Array, num_buckets: int) -> Array:
     """Stable ascending order for integer keys in ``[0, num_buckets)`` —
     the counting-sort form used for retrieval query-id grouping. Equal to
     ``jnp.argsort(keys, stable=True)`` but needs only
@@ -205,11 +248,28 @@ def stable_key_order(keys: Array, num_buckets: int) -> Array:
     return _radix_order_words([word], bits)
 
 
+def stable_key_order(keys: Array, num_buckets: int) -> Array:
+    """Stable ascending order for integer keys in ``[0, num_buckets)`` —
+    the counting-sort form used for retrieval query-id grouping (see the
+    ``radix`` impl above for the precondition and cost model)."""
+    return _dispatch.call("stable_key_order", keys, num_buckets)
+
+
+@_PART.impl("radix")
+def _partition_order_radix(first: Array) -> Array:
+    return _radix_order_words([(~jnp.asarray(first, bool)).astype(jnp.uint32)], 1)
+
+
+@_PART.impl("argsort")
+def _partition_order_argsort(first: Array) -> Array:
+    return jnp.argsort(~jnp.asarray(first, bool), stable=True).astype(jnp.int32)
+
+
 def partition_order(first: Array) -> Array:
     """Stable order with ``first``-flagged rows compacted to the front —
     the single-pass (1-bit bucket) replacement for
     ``jnp.argsort(~first, stable=True)`` boundary compactions."""
-    return _radix_order_words([(~jnp.asarray(first, bool)).astype(jnp.uint32)], 1)
+    return _dispatch.call("partition_order", first)
 
 
 def inverse_permutation(perm: Array) -> Array:
@@ -232,6 +292,15 @@ def ascending_ranks(x: Array) -> Array:
 # --------------------------------------------------------------------------
 # Histogram pass (pass 1) + sharded exact ranks
 # --------------------------------------------------------------------------
+
+_HIST = _dispatch.register_op("histogram", default="xla")
+
+
+@_HIST.impl("xla")
+def _histogram_xla(bucket_ids: Array, num_buckets: int) -> Array:
+    """Scatter-add histogram — XLA lowers it as a serialized write loop,
+    which is still the right default off-TPU for large grids."""
+    return jnp.zeros(num_buckets, jnp.int32).at[jnp.asarray(bucket_ids)].add(1)
 
 
 def bucket_counts(
@@ -280,7 +349,9 @@ def bucket_counts(
     b = jnp.where(jnp.isnan(scores), num_buckets + 2, b)
     if valid is not None:
         b = jnp.where(jnp.asarray(valid, bool), b, num_buckets + 2)
-    counts = jnp.zeros(num_buckets + 3, jnp.int32).at[b].add(1)
+    # the dispatched histogram op: XLA scatter-add here, the pallas one-hot
+    # accumulator (ops/pallas_kernels.py) on TPU / under interpret parity
+    counts = _dispatch.call("histogram", b, num_buckets + 3)
     return counts, b
 
 

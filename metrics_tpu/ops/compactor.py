@@ -11,15 +11,21 @@ lists, SURVEY.md §7 hard part #1):
   ``+inf`` padding past the valid ``count`` prefix (the invariant every
   kernel below preserves, so a plain value-only ``jnp.sort`` of a
   concatenation re-establishes it for free);
-- a level fold is *unconditional* over all ``L`` levels — a level that did
-  not overflow passes through bitwise unchanged (sorting a sorted buffer is
-  the identity), so the cascade is a static Python loop of ``L`` cheap
-  ``(k + M,)`` value-only sorts, never a traced while-loop;
+- a level fold is shape-unconditional over all ``L`` levels — a level that
+  did not overflow passes through bitwise unchanged — but since ISSUE 6 the
+  cascade SHORT-CIRCUITS at runtime: each level's fold sits behind a
+  ``lax.cond`` on "anything to fold here?", so levels the promotion never
+  reaches cost a scalar compare instead of a ``(k + M,)`` sort. A 512-row
+  update dropped from ~39 ms (20 unconditional folds) to the cost of the
+  one fold that can actually spill (bench notes in BASELINE.md);
 - compaction keeps one element of each adjacent pair of the sorted buffer,
   alternating the kept side per pair index (``2*j + (j & 1)``) — a pure
   function of the sorted data, so merging two sketches is **bitwise
   commutative**, and the alternation cancels the one-sided rank bias a
-  fixed offset would accumulate.
+  fixed offset would accumulate. The post-sort compact/select stage is the
+  dispatched ``compactor_fold`` op (``ops/dispatch.py``): the XLA impl
+  below everywhere, the fused pallas kernel
+  (``ops/pallas_kernels.py``) on TPU / under interpret-mode parity tests.
 
 Rank-error accounting (the ``eps`` contract of
 ``metrics_tpu/streaming/sketches.py``): one compaction at level ``l``
@@ -28,6 +34,12 @@ compactions happen at level ``l`` over ``n`` rows, so the total error is
 bounded by ``~2 * L * n / k`` (batch pre-compaction adds one more
 ``~2n / k`` term). ``QuantileSketchState.create`` sizes ``k`` from the
 requested ``eps`` with this bound.
+
+Batch pre-compaction is the dispatched ``sketch_precompact`` op: the
+default ``binned`` impl (``ops/binning.py``) bins the batch through
+``bucketed_rank``'s orderable-key grid — a value-only unsigned sort, ~6x
+cheaper than this module's legacy full float sort, which stays registered
+as the ``sort`` impl for A/B benching (`bench.py` ``compactor`` phase).
 
 The final quantile query reuses :func:`metrics_tpu.ops.bucketed_rank.
 ascending_order` — the one place the sketch needs a *permutation* (to carry
@@ -38,6 +50,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops import dispatch as _dispatch
 from metrics_tpu.ops.bucketed_rank import ascending_order
 
 Array = jax.Array
@@ -54,24 +67,15 @@ def _masked_ascending(x: Array, count: Array) -> Array:
     return jnp.where(jnp.arange(x.shape[0]) < count, x, _INF)
 
 
-def fold_level(
-    items: Array, count: Array, inc: Array, inc_count: Array
-) -> Tuple[Array, Array, Array, Array]:
-    """Fold ``inc`` (same level weight) into one level buffer.
+_FOLD = _dispatch.register_op("compactor_fold", default="xla")
 
-    ``items`` is ``(k,)`` sorted/+inf-padded with ``count`` valid; ``inc``
-    is ``(M,)`` in the same form (any static ``M``). Returns
-    ``(new_items (k,), new_count, promoted ((k + M) // 2,),
-    promoted_count)`` — when the combined count stays within ``k`` the
-    level absorbs everything and ``promoted`` is empty; on overflow the
-    whole buffer compacts (pairs of adjacent sorted items collapse to one
-    item of doubled weight, alternating kept side per pair) and at most one
-    unpaired leftover stays at the level. All shapes static; fully
-    jittable.
-    """
-    k = items.shape[0]
-    combined = jnp.sort(jnp.concatenate([items, inc]))  # (k + M,), +inf last
-    c = count + inc_count
+
+@_FOLD.impl("xla")
+def _compactor_fold_xla(
+    combined: Array, c: Array, k: int
+) -> Tuple[Array, Array, Array, Array]:
+    """Post-sort compact/select stage: ``combined`` is the sorted
+    ``(k + M,)`` concatenation with ``c`` valid reals in its prefix."""
     overflow = c > k
 
     # --- no-overflow branch: absorb, nothing promoted ------------------
@@ -80,7 +84,7 @@ def fold_level(
 
     # --- overflow branch: compact the whole buffer ---------------------
     pairs = c // 2
-    p_len = (k + inc.shape[0]) // 2
+    p_len = combined.shape[0] // 2
     j = jnp.arange(p_len)
     picked = combined[2 * j + (j & 1)]  # one per adjacent pair, alternating
     promoted = jnp.where(j < pairs, picked, _INF)
@@ -94,17 +98,34 @@ def fold_level(
     return new_items, new_count, promoted, promoted_count
 
 
-def precompact_batch(x: Array, valid: Array, k: int) -> Tuple[Array, Array, int]:
-    """Reduce a batch to at most ``k`` items of weight ``2**level``.
+def fold_level(
+    items: Array, count: Array, inc: Array, inc_count: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Fold ``inc`` (same level weight) into one level buffer.
 
-    Sorts the batch once (invalid rows to ``+inf``), then applies static
-    halving rounds (the batch-local form of level compaction — same
-    alternating pair rule) until it fits a level buffer. Returns
-    ``(items (k,), count, level)`` with ``level`` a *static* int (it only
-    depends on the static batch size), so the caller's cascade can skip
-    the untouched lower levels at trace time. Odd-count rounds drop the
-    one unpaired (largest) item — bounded by the documented error term.
+    ``items`` is ``(k,)`` sorted/+inf-padded with ``count`` valid; ``inc``
+    is ``(M,)`` in the same form (any static ``M``). Returns
+    ``(new_items (k,), new_count, promoted ((k + M) // 2,),
+    promoted_count)`` — when the combined count stays within ``k`` the
+    level absorbs everything and ``promoted`` is empty; on overflow the
+    whole buffer compacts (pairs of adjacent sorted items collapse to one
+    item of doubled weight, alternating kept side per pair) and at most one
+    unpaired leftover stays at the level. All shapes static; fully
+    jittable. The sort runs here; the compact/select stage dispatches
+    (``compactor_fold``: XLA everywhere, the fused pallas kernel on TPU).
     """
+    k = items.shape[0]
+    combined = jnp.sort(jnp.concatenate([items, inc]))  # (k + M,), +inf last
+    return _dispatch.call("compactor_fold", combined, count + inc_count, k)
+
+
+_PRECOMPACT = _dispatch.register_op("sketch_precompact", default="binned")
+
+
+@_PRECOMPACT.impl("sort")
+def _precompact_sort(x: Array, valid: Array, k: int) -> Tuple[Array, Array, int]:
+    """Legacy full-sort pre-compaction (the A/B baseline): one float sort
+    of the whole batch, then round-by-round halving gathers."""
     x = jnp.asarray(x, jnp.float32).reshape(-1)
     valid = jnp.broadcast_to(jnp.asarray(valid, bool).reshape(-1), x.shape)
     valid = valid & jnp.isfinite(x)
@@ -118,9 +139,28 @@ def precompact_batch(x: Array, valid: Array, k: int) -> Tuple[Array, Array, int]
         m = m // 2
         cur = _masked_ascending(cur, m)
         level += 1
-    if cur.shape[0] < k:
-        cur = jnp.concatenate([cur, jnp.full((k - cur.shape[0],), _INF)])
     return cur, m, level
+
+
+def precompact_batch(x: Array, valid: Array, k: int) -> Tuple[Array, Array, int]:
+    """Reduce a batch to at most ``k`` items of weight ``2**level``.
+
+    Applies static halving rounds (the batch-local form of level compaction
+    — same alternating pair rule) to the value-ordered batch until it fits
+    a level buffer. Returns ``(items (min(n', k),), count, level)`` with
+    ``level`` a *static* int (it only depends on the static batch size), so
+    the caller's cascade can skip the untouched lower levels at trace time.
+    Batches smaller than ``k`` come back at their own (static) length — no
+    ``+inf`` padding to ``k``, so every downstream fold sorts ``k + n``
+    instead of ``2k`` elements (the ISSUE 6 small-batch fix). Odd-count
+    rounds drop the one unpaired (largest) item — bounded by the documented
+    error term.
+
+    Dispatched (``sketch_precompact``): the default ``binned`` impl bins by
+    ``bucketed_rank``'s orderable uint32 key (``ops/binning.py``, ~6x
+    cheaper); ``sort`` is the legacy full float sort.
+    """
+    return _dispatch.call("sketch_precompact", x, valid, k)
 
 
 def fold_cascade(
@@ -129,10 +169,14 @@ def fold_cascade(
     """Run ``inc`` (weight ``2**start_level``) up the level cascade.
 
     ``items``/``counts`` are the full ``(L, k)``/``(L,)`` sketch buffers.
-    The loop over levels is static: levels below ``start_level`` are
-    untouched, levels above fold unconditionally (a non-overflowing fold
-    is the bitwise identity). A promotion that would leave the top level
-    is folded back into it — losing half that weight's resolution, which
+    The loop over levels is static — levels below ``start_level`` are
+    untouched at trace time — and every fold above sits behind a
+    ``lax.cond`` on ``inc_count > 0``: a fold whose incoming buffer is
+    empty is the bitwise identity, so the cond skips its ``(k + M,)`` sort
+    at RUNTIME and only the levels the promotion actually reaches pay
+    anything (the ISSUE 6 short-circuit; bitwise-identical outputs either
+    way). A promotion that would leave the top level is folded back into
+    it — losing half that weight's resolution, which
     ``QuantileSketchState.create`` makes unreachable by sizing ``L`` for
     ``max_items``.
     """
@@ -146,15 +190,38 @@ def fold_cascade(
             continue
         if lvl == L - 1:
             # top level never promotes: absorb (and saturate — see docstring)
-            combined = jnp.sort(jnp.concatenate([items[lvl], inc]))
-            c = jnp.minimum(counts[lvl] + inc_count, k)
-            rows.append(_masked_ascending(combined[:k], c))
+            def _absorb(level_items, level_count, inc_, inc_count_):
+                combined = jnp.sort(jnp.concatenate([level_items, inc_]))
+                c = jnp.minimum(level_count + inc_count_, k)
+                return _masked_ascending(combined[:k], c), c
+
+            def _skip_top(level_items, level_count, inc_, inc_count_):
+                return level_items, jnp.minimum(level_count, k)
+
+            row, c = jax.lax.cond(
+                inc_count > 0, _absorb, _skip_top, items[lvl], counts[lvl], inc, inc_count
+            )
+            rows.append(row)
             cnts.append(c)
             inc = jnp.full_like(inc, _INF)
             inc_count = jnp.zeros((), jnp.int32)
             continue
-        new_items, new_count, inc, inc_count = fold_level(
-            items[lvl], counts[lvl], inc, inc_count
+
+        p_len = (k + inc.shape[0]) // 2
+
+        def _fold(level_items, level_count, inc_, inc_count_):
+            return fold_level(level_items, level_count, inc_, inc_count_)
+
+        def _skip(level_items, level_count, inc_, inc_count_):
+            return (
+                level_items,
+                level_count,
+                jnp.full((p_len,), _INF, jnp.float32),
+                jnp.zeros((), jnp.int32),
+            )
+
+        new_items, new_count, inc, inc_count = jax.lax.cond(
+            inc_count > 0, _fold, _skip, items[lvl], counts[lvl], inc, inc_count
         )
         rows.append(new_items)
         cnts.append(new_count)

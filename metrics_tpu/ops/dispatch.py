@@ -1,0 +1,334 @@
+"""Kernel dispatch: ONE switch between implementations of every hot op.
+
+Prior rounds hardcoded their kernel choices at each call site:
+``binned_precision_recall.py`` probed ``jax.default_backend()`` inline to
+pick pallas vs XLA (and again to pick interpret mode), every curve /
+retrieval path imported the packed-radix order directly, and the quantile
+sketch had exactly one precompaction strategy. This registry gives each hot
+op a named set of implementations and one resolution rule shared by every
+caller::
+
+    choice := programmatic override   (set_kernel_override / kernel_override)
+            | per-op env token        (METRICS_TPU_KERNEL_BACKEND="histogram=pallas")
+            | global env token        (METRICS_TPU_KERNEL_BACKEND=pallas)
+            | "auto"
+
+``auto`` asks the op's chooser (typically: pallas on TPU when the shape is
+supported, the XLA path everywhere else; the chooser may inspect the call's
+arguments). A forced choice that cannot run — pallas off-TPU without
+interpret mode, an unknown implementation name, an impl guard rejecting the
+shape — WARNS ONCE per (op, reason) and falls back to the op's default
+path: a bad env var degrades performance, never correctness. A *global*
+token that simply does not name an implementation of some op (e.g.
+``pallas`` applied to an op with no pallas kernel) leaves that op on
+``auto`` silently — it is a blanket preference, not a per-op demand.
+
+Resolution happens at call time — under ``jax.jit`` that is trace time, so
+the choice is baked into the compiled graph and changing the env var does
+NOT invalidate already-cached jits (the same stance as every other
+``METRICS_TPU_*`` perf knob; tests and benches build fresh jits per
+choice). Module import registers pure python dicts only — no jax calls, no
+device arrays (the hang-proof bootstrap contract, ``utilities/backend.py``).
+
+Registered ops (impl modules self-register at import; ``resolve`` lazily
+imports them all so partial imports cannot hide an implementation):
+
+==================  ============================  ==========================
+op                  implementations               callers through the switch
+==================  ============================  ==========================
+ascending_order     radix | argsort               AUC reorder, retrieval
+                                                  ``_group_layout``, FID
+                                                  shuffle, sketch quantile
+descending_order    radix | argsort               ``_binary_clf_curve``,
+                                                  capacity curve prologue,
+                                                  retrieval kernels
+partition_order     radix | argsort               ROC/PRC boundary
+                                                  compactions
+stable_key_order    radix | argsort               retrieval grouping
+histogram           xla | pallas |                ``bucket_counts`` (sharded
+                    pallas-interpret              ranks pass 1)
+compactor_fold      xla | pallas |                sketch level folds
+                    pallas-interpret              (``ops/compactor.py``)
+sketch_precompact   binned | sort                 ``QuantileSketch.update``
+binned_counters     xla | pallas |                binned precision/recall
+                    pallas-interpret              metrics
+==================  ============================  ==========================
+"""
+import contextlib
+import importlib
+import os
+from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
+
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+__all__ = [
+    "KernelOp",
+    "register_op",
+    "resolve",
+    "call",
+    "registered_ops",
+    "set_kernel_override",
+    "clear_kernel_overrides",
+    "kernel_override",
+    "reset_dispatch_state",
+]
+
+_ENV_VAR = "METRICS_TPU_KERNEL_BACKEND"
+
+# modules that self-register implementations at import; ``resolve`` imports
+# them lazily so a caller that imported only ``ops.compactor`` still sees
+# the pallas kernels when it forces them
+_IMPL_MODULES = (
+    "metrics_tpu.ops.bucketed_rank",
+    "metrics_tpu.ops.compactor",
+    "metrics_tpu.ops.binning",
+    "metrics_tpu.ops.pallas_kernels",
+    "metrics_tpu.ops.binned_counters",
+)
+
+
+class KernelOp:
+    """One dispatched op: named impls, optional per-impl guards, an optional
+    ``auto`` chooser, and the default (always-runnable) implementation."""
+
+    def __init__(self, name: str, default: str) -> None:
+        self.name = name
+        self.default = default
+        self.impls: Dict[str, Callable] = {}
+        self.guards: Dict[str, Callable[..., Optional[str]]] = {}
+        self.chooser: Optional[Callable[..., str]] = None
+
+    def impl(self, impl_name: str, guard: Optional[Callable[..., Optional[str]]] = None):
+        """Decorator registering an implementation. ``guard(*args, **kw)``
+        returns ``None`` when the impl can run, else a human-readable reason
+        (triggering the warn-once fallback to the default path)."""
+
+        def deco(fn: Callable) -> Callable:
+            self.impls[impl_name] = fn
+            if guard is not None:
+                self.guards[impl_name] = guard
+            return fn
+
+        return deco
+
+    def auto_rule(self, fn: Callable[..., str]) -> Callable[..., str]:
+        """Decorator registering the ``auto`` chooser. It must only return
+        implementation names that can actually run for the given args (its
+        guards are not re-consulted)."""
+        self.chooser = fn
+        return fn
+
+
+_OPS: Dict[str, KernelOp] = {}
+_OVERRIDES: Dict[str, str] = {}
+_WARNED: Set[Tuple[Any, ...]] = set()
+_IMPLS_ENSURED = False
+
+
+def register_op(name: str, default: str) -> KernelOp:
+    """Get-or-create an op. The first registration pins the default impl
+    name (later calls with a different default are a programming error)."""
+    op = _OPS.get(name)
+    if op is None:
+        op = _OPS[name] = KernelOp(name, default)
+    elif op.default != default:
+        raise ValueError(
+            f"kernel op {name!r} already registered with default {op.default!r}, "
+            f"refusing to re-register with default {default!r}"
+        )
+    return op
+
+
+def registered_ops() -> Dict[str, KernelOp]:
+    _ensure_impls()
+    return dict(_OPS)
+
+
+def _ensure_impls() -> None:
+    global _IMPLS_ENSURED
+    if _IMPLS_ENSURED:
+        return
+    _IMPLS_ENSURED = True  # set first: the impl modules themselves resolve
+    for mod in _IMPL_MODULES:
+        importlib.import_module(mod)
+
+
+def _warn_once(key: Tuple[Any, ...], msg: str) -> None:
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    rank_zero_warn(msg, UserWarning)
+
+
+_ENV_CACHE: Tuple[str, Dict[str, str]] = ("", {})
+
+
+def _env_choices() -> Dict[str, str]:
+    """Parse ``METRICS_TPU_KERNEL_BACKEND``: comma-separated tokens, bare
+    token = global choice (key ``"*"``), ``op=choice`` = per-op. Malformed
+    tokens warn once and are ignored (same stance as
+    ``METRICS_TPU_EAGER_WARN_ROWS``). The parse is memoized on the raw
+    string — dispatch runs on eager hot paths, and re-tokenizing an
+    unchanged var per call buys nothing."""
+    global _ENV_CACHE
+    raw = os.environ.get(_ENV_VAR, "").strip()
+    if not raw:
+        return {}
+    if raw == _ENV_CACHE[0]:
+        return _ENV_CACHE[1]
+    choices: Dict[str, str] = {}
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" in tok:
+            op_name, _, val = tok.partition("=")
+            op_name, val = op_name.strip(), val.strip()
+            if op_name and val:
+                # _OPS is fully populated here (resolve() runs
+                # _ensure_impls before consulting the env): a typo'd op
+                # name would otherwise be stored-but-never-consulted —
+                # the same silent-self-comparison trap the programmatic
+                # override guards against by raising
+                if op_name not in _OPS:
+                    _warn_once(
+                        ("env-unknown-op", op_name),
+                        f"{_ENV_VAR}: {op_name!r} is not a registered kernel "
+                        f"op (have {sorted(_OPS)}); token {tok!r} ignored",
+                    )
+                else:
+                    choices[op_name] = val
+            else:
+                _warn_once(
+                    ("env-malformed", tok),
+                    f"{_ENV_VAR}: malformed token {tok!r} ignored "
+                    "(expected `choice` or `op=choice`)",
+                )
+        else:
+            choices["*"] = tok
+    _ENV_CACHE = (raw, choices)
+    return choices
+
+
+def _requested(op_name: str) -> Tuple[str, str]:
+    """(choice, source) with source in {'override', 'env', 'global-env',
+    'auto'} — the source decides how loudly a non-applicable choice fails."""
+    if op_name in _OVERRIDES:
+        return _OVERRIDES[op_name], "override"
+    env = _env_choices()
+    if op_name in env:
+        return env[op_name], "env"
+    if "*" in env:
+        return env["*"], "global-env"
+    return "auto", "auto"
+
+
+def resolve(op_name: str, *args: Any, **kwargs: Any) -> Tuple[str, Callable]:
+    """Pick the implementation for one call. Returns ``(impl_name, fn)``;
+    never raises for a bad *choice* (warn-once + default), only for an
+    unknown *op*."""
+    op = _get_op(op_name)
+    choice, source = _requested(op_name)
+    return _resolve_choice(op, choice, source, args, kwargs)
+
+
+def _get_op(op_name: str) -> KernelOp:
+    _ensure_impls()
+    op = _OPS.get(op_name)
+    if op is None:
+        raise KeyError(f"unknown kernel op {op_name!r} (have {sorted(_OPS)})")
+    return op
+
+
+def _resolve_choice(
+    op: KernelOp, choice: str, source: str, args: Tuple, kwargs: Dict
+) -> Tuple[str, Callable]:
+    op_name = op.name
+    if choice != "auto":
+        if choice not in op.impls:
+            if source == "global-env":
+                choice = "auto"  # blanket preference; this op has no such impl
+            else:
+                _warn_once(
+                    (op_name, choice, "unknown-impl"),
+                    f"kernel backend {choice!r} ({source}) is not an implementation "
+                    f"of op {op_name!r} (have {sorted(op.impls)}); using the "
+                    f"default {op.default!r} path",
+                )
+                choice = op.default
+        if choice != "auto":
+            guard = op.guards.get(choice)
+            reason = guard(*args, **kwargs) if guard is not None else None
+            if reason is not None:
+                _warn_once(
+                    (op_name, choice, reason),
+                    f"kernel backend {choice!r} for op {op_name!r} is unavailable "
+                    f"({reason}); falling back to the {op.default!r} path",
+                )
+                choice = op.default
+    if choice == "auto":
+        choice = op.chooser(*args, **kwargs) if op.chooser is not None else op.default
+    return choice, op.impls[choice]
+
+
+def call(op_name: str, *args: Any, **kwargs: Any) -> Any:
+    """Resolve and run: the one entry point every caller goes through."""
+    _, fn = resolve(op_name, *args, **kwargs)
+    return fn(*args, **kwargs)
+
+
+def call_as(op_name: str, choice: str, *args: Any, **kwargs: Any) -> Any:
+    """Run a specific implementation for ONE call — same guard / warn-once
+    fallback semantics as an env-forced choice, but without touching the
+    process-global override table, so per-call forces (e.g. a metric's
+    ``use_pallas=`` ctor knob) stay reentrant and thread-safe."""
+    name, fn = _resolve_choice(_get_op(op_name), choice, "call", args, kwargs)
+    return fn(*args, **kwargs)
+
+
+def _check_override_op(op_name: str) -> None:
+    """Overrides are test/bench hooks: a typo'd OP name would otherwise be
+    stored-but-never-consulted, making an A/B silently compare an impl
+    against itself — so unknown ops raise here (typo'd IMPL names are the
+    env var's territory and warn-once instead)."""
+    _ensure_impls()
+    if op_name not in _OPS:
+        raise KeyError(f"unknown kernel op {op_name!r} (have {sorted(_OPS)})")
+
+
+def set_kernel_override(op_name: str, choice: str) -> None:
+    """Programmatic per-op choice — wins over the env var. Applies to jits
+    traced AFTER the call (resolution is trace-time). Raises on unknown op
+    names (see ``_check_override_op``)."""
+    _check_override_op(op_name)
+    _OVERRIDES[op_name] = choice
+
+
+def clear_kernel_overrides() -> None:
+    _OVERRIDES.clear()
+
+
+@contextlib.contextmanager
+def kernel_override(**choices: str) -> Iterator[None]:
+    """``with kernel_override(sketch_precompact="sort"): ...`` — scoped
+    programmatic choices (the bench A/B and parity-test hook). Raises on
+    unknown op names (see ``_check_override_op``)."""
+    for op_name in choices:
+        _check_override_op(op_name)
+    saved = dict(_OVERRIDES)
+    _OVERRIDES.update(choices)
+    try:
+        yield
+    finally:
+        _OVERRIDES.clear()
+        _OVERRIDES.update(saved)
+
+
+def reset_dispatch_state() -> None:
+    """Clear overrides, the warn-once memory, AND the memoized env parse
+    (test isolation — the fallback warning must be observable per test,
+    not per process, and a cached parse would skip its warn-once)."""
+    global _ENV_CACHE
+    _OVERRIDES.clear()
+    _WARNED.clear()
+    _ENV_CACHE = ("", {})

@@ -1,0 +1,232 @@
+"""Pallas TPU kernels for the radix-histogram and compactor-fold inner loops.
+
+Two hot inner loops get hand kernels here, both behind the ``ops/dispatch``
+switch (``auto`` selects them on TPU, the XLA paths everywhere else; the
+``pallas-interpret`` impls run the SAME kernel bodies through the pallas
+interpreter, which is how the CPU test suite pins bit parity —
+``tests/ops/test_pallas_kernels.py``):
+
+- **histogram** — per-bucket counts of integer bucket ids (pass 1 of
+  ``bucketed_rank.sharded_descending_ranks`` and any grid binning). XLA
+  lowers the ``.at[b].add(1)`` scatter as a serialized loop of random
+  writes (measured ~119 ms for 1M rows on CPU — slower than sorting the
+  ids); on TPU the scatter lowering is similarly serial. The kernel
+  instead streams row tiles through VMEM and accumulates a one-hot
+  compare against the bucket lanes with the VPU — ``num_buckets`` extra
+  compares per element, traded for zero serialized writes, which is the
+  right trade for the modest grids the rank kernels use (the ``auto``
+  rule caps it at ``num_buckets <= 8192``).
+
+- **compactor_fold** — the post-sort compact/select stage of a sketch
+  level fold (``ops/compactor.py::fold_level``): alternating-pair picks,
+  odd-leftover extraction, overflow select. Pure bandwidth; XLA
+  materializes each ``where``/gather as its own HBM pass, the kernel
+  fuses them into one VMEM-resident block.
+
+Both kernels follow the in-repo pallas idiom (``ops/binned_counters.py``):
+grid accumulation via an output block revisited per step, ``pl.when`` for
+first-step init. Native-TPU numbers are pending the next TPU window
+(TPU_STATUS.md); everything here is exercised in interpret mode on CPU.
+"""
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from metrics_tpu.ops import dispatch as _dispatch
+
+Array = jax.Array
+
+_INF = float("inf")
+_HIST_TILE_ROWS = 4  # 4 x 128 ids per grid step (keeps the one-hot in VMEM)
+_PALLAS_MAX_BUCKETS = 8192
+
+
+def _pallas_guard(*args, **kwargs):
+    """Shared impl guard: the compiled kernels need a real TPU."""
+    if jax.default_backend() != "tpu":
+        return (
+            "pallas kernels compile only on the TPU backend; use "
+            "'pallas-interpret' for the (slow) interpreter"
+        )
+    return None
+
+
+# --------------------------------------------------------------------------
+# histogram
+# --------------------------------------------------------------------------
+
+
+def _histogram_kernel(ids_ref, out_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    ids = ids_ref[:].reshape(-1, 1)  # (TILE_ROWS * 128, 1)
+    buckets = jax.lax.broadcasted_iota(jnp.int32, (1, out_ref.shape[1]), 1)
+    out_ref[:] += jnp.sum((ids == buckets).astype(jnp.int32), axis=0, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("num_buckets", "interpret"))
+def histogram_pallas(bucket_ids: Array, num_buckets: int, interpret: bool = False) -> Array:
+    """Per-bucket counts of ``bucket_ids`` over ``[0, num_buckets)``.
+
+    PRECONDITION (same stance as ``stable_key_order``): ids outside
+    ``[0, num_buckets)`` are silently not counted — callers produce
+    clipped/edge-routed ids (``bucket_counts`` does).
+    """
+    ids = jnp.asarray(bucket_ids, jnp.int32).reshape(-1)
+    n = ids.shape[0]
+    if n == 0:
+        # an empty grid never runs the kernel body (binned_counters.py)
+        return jnp.zeros((num_buckets,), jnp.int32)
+    tile = _HIST_TILE_ROWS * 128
+    pad = (-n) % tile
+    if pad:
+        # the dump lane: one past the last real bucket, sliced off below
+        ids = jnp.concatenate([ids, jnp.full((pad,), num_buckets, jnp.int32)])
+    nb_pad = -(-(num_buckets + 1) // 128) * 128
+    ids2 = ids.reshape(-1, 128)
+    out = pl.pallas_call(
+        _histogram_kernel,
+        grid=(ids2.shape[0] // _HIST_TILE_ROWS,),
+        in_specs=[pl.BlockSpec((_HIST_TILE_ROWS, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, nb_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, nb_pad), jnp.int32),
+        interpret=interpret,
+    )(ids2)
+    return out[0, :num_buckets]
+
+
+def _hist_shape_guard(bucket_ids, num_buckets, **kwargs):
+    if num_buckets > _PALLAS_MAX_BUCKETS:
+        return (
+            f"histogram kernel supports up to {_PALLAS_MAX_BUCKETS} buckets "
+            f"(one-hot lanes must fit VMEM), got {num_buckets}"
+        )
+    return None
+
+
+def _hist_pallas_guard(bucket_ids, num_buckets, **kwargs):
+    return _pallas_guard() or _hist_shape_guard(bucket_ids, num_buckets)
+
+
+_HIST = _dispatch.register_op("histogram", default="xla")
+
+
+@_HIST.impl("pallas", guard=_hist_pallas_guard)
+def _histogram_pallas_native(bucket_ids: Array, num_buckets: int) -> Array:
+    return histogram_pallas(bucket_ids, num_buckets, interpret=False)
+
+
+@_HIST.impl("pallas-interpret", guard=_hist_shape_guard)
+def _histogram_pallas_interpret(bucket_ids: Array, num_buckets: int) -> Array:
+    return histogram_pallas(bucket_ids, num_buckets, interpret=True)
+
+
+@_HIST.auto_rule
+def _histogram_auto(bucket_ids, num_buckets, **kwargs) -> str:
+    if jax.default_backend() == "tpu" and num_buckets <= _PALLAS_MAX_BUCKETS:
+        return "pallas"
+    return "xla"
+
+
+# --------------------------------------------------------------------------
+# compactor fold
+# --------------------------------------------------------------------------
+
+
+def _make_fold_kernel(k: int, total: int, p_pad: int, k_pad: int):
+    def _fold_kernel(comb_ref, cnt_ref, items_ref, count_ref, prom_ref, pcount_ref):
+        comb = comb_ref[:]  # (1, P) sorted, +inf beyond the real total
+        c = cnt_ref[0, 0]
+        overflow = c > k
+        pairs = c // 2
+        # alternating-pair pick: one survivor per adjacent sorted pair
+        two = comb.reshape(-1, 2)  # (P // 2, 2)
+        j = jax.lax.broadcasted_iota(jnp.int32, (1, two.shape[0]), 1)
+        picked = jnp.where(
+            (j & 1) == 1, two[:, 1].reshape(1, -1), two[:, 0].reshape(1, -1)
+        )
+        prom = jnp.where((j < pairs) & overflow, picked, _INF)
+        # odd leftover: the single element at position 2 * pairs (one-hot
+        # select — buffers hold finite values or +inf padding only)
+        pos = jax.lax.broadcasted_iota(jnp.int32, (1, total), 1)
+        leftover_count = c - 2 * pairs
+        leftover_val = jnp.sum(jnp.where(pos == 2 * pairs, comb[:, :total], 0.0))
+        kidx = jax.lax.broadcasted_iota(jnp.int32, (1, k_pad), 1)
+        leftover_row = jnp.where(kidx < leftover_count, leftover_val, _INF)
+        keep_row = jnp.where(kidx < k, comb[:, :k_pad], _INF)
+        items_ref[:] = jnp.where(overflow, leftover_row, keep_row)
+        count_ref[0, 0] = jnp.where(overflow, leftover_count, c)
+        prom_ref[:] = prom[:, :p_pad]
+        pcount_ref[0, 0] = jnp.where(overflow, pairs, 0)
+
+    return _fold_kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def compactor_fold_pallas(
+    combined: Array, c: Array, k: int, interpret: bool = False
+) -> Tuple[Array, Array, Array, Array]:
+    """Pallas form of the fold's post-sort stage — same contract as the
+    ``xla`` impl in ``ops/compactor.py`` (``combined`` is the sorted
+    ``(k + M,)`` concatenation, ``c`` the combined valid count)."""
+    total = combined.shape[0]
+    p_len = total // 2
+    pad128 = lambda v: max(128, -(-v // 128) * 128)  # noqa: E731
+    k_pad, p_pad = pad128(k), pad128(p_len)
+    # the kernel reshapes (1, P) -> (P//2, 2) and writes (1, p_pad)/(1, k_pad)
+    # slices of it, so P must cover both
+    P = max(pad128(total + (total % 2)), 2 * p_pad, k_pad)
+    comb = jnp.full((1, P), _INF, jnp.float32).at[0, :total].set(
+        jnp.asarray(combined, jnp.float32)
+    )
+    cnt = jnp.asarray(c, jnp.int32).reshape(1, 1)
+    items, count, prom, pcount = pl.pallas_call(
+        _make_fold_kernel(k, total, p_pad, k_pad),
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((1, P), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, p_pad), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, p_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(comb, cnt)
+    return (
+        items[0, :k],
+        count[0, 0],
+        prom[0, :p_len],
+        pcount[0, 0],
+    )
+
+
+_FOLD = _dispatch.register_op("compactor_fold", default="xla")
+
+
+@_FOLD.impl("pallas", guard=lambda *a, **k: _pallas_guard())
+def _compactor_fold_pallas_native(combined, c, k):
+    return compactor_fold_pallas(combined, c, k, interpret=False)
+
+
+@_FOLD.impl("pallas-interpret")
+def _compactor_fold_pallas_interpret(combined, c, k):
+    return compactor_fold_pallas(combined, c, k, interpret=True)
+
+
+@_FOLD.auto_rule
+def _compactor_fold_auto(combined, c, k, **kwargs) -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "xla"

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.ops.bucketed_rank import ascending_order, stable_key_order
+from metrics_tpu.ops import ascending_order, stable_key_order
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
 from metrics_tpu.utilities.data import dim_zero_cat
 

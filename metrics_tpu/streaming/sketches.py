@@ -132,7 +132,16 @@ class QuantileSketchState(NamedTuple):
 
     def insert(self, values: Array, valid: Optional[Array] = None) -> "QuantileSketchState":
         """Fold one batch in (non-finite rows always excluded). Fully
-        jittable; the cascade depth is static in the batch size."""
+        jittable; the cascade depth is static in the batch size.
+
+        The batch pre-compaction is the dispatched ``sketch_precompact``
+        kernel (``ops/dispatch.py``): the default ``binned`` impl bins by
+        ``bucketed_rank``'s orderable-key grid instead of running the
+        full float sort (~6x on 1M-row CPU batches, bit-identical state
+        up to ``-0.0``/denormal canonicalization — ``ops/binning.py``),
+        and the fold cascade ``lax.cond``-skips every level the promotion
+        does not reach, so small (sub-``k``) batches pay one fold, not
+        ``L`` (``ops/compactor.py``)."""
         x = jnp.asarray(values, jnp.float32).reshape(-1)
         v = jnp.ones(x.shape, bool) if valid is None else jnp.asarray(valid, bool).reshape(-1)
         inc, inc_count, level = precompact_batch(x, v, self.items.shape[1])

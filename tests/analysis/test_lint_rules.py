@@ -523,6 +523,168 @@ class TestTraceSafety:
             lint_source(textwrap.dedent(src), relpath="metrics_tpu/functional/text/bleu.py") == []
         )
 
+    # -- pallas kernel bodies: exempt-by-contract (ISSUE 6) ----------------
+
+    def test_pallas_kernel_body_nested_in_update_is_exempt(self):
+        """GOOD fixture: a kernel def'd inside `update` and handed to
+        pl.pallas_call is the pallas programming model, not a host sync —
+        no findings even though its body would trip GL201/GL202."""
+        assert (
+            _ids(
+                """
+                import jax
+                from jax.experimental import pallas as pl
+
+                class ScaledSum:
+                    def update(self, x):
+                        def _scale_kernel(x_ref, o_ref):
+                            lo = float(x_ref[0, 0])
+                            o_ref[:] = x_ref[:] - lo
+                        return pl.pallas_call(
+                            _scale_kernel,
+                            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        )(x)
+                """
+            )
+            == []
+        )
+
+    def test_same_nested_body_without_pallas_call_is_flagged(self):
+        """BAD twin: the identical nested function invoked directly stays
+        inside the jitted update path and is linted."""
+        assert (
+            _ids(
+                """
+                class ScaledSum:
+                    def update(self, x):
+                        def _scale_kernel(v):
+                            return float(v)
+                        return _scale_kernel(x)
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_module_level_pallas_kernel_named_like_update_root_is_exempt(self):
+        """A module-level `_*_update` kernel body would be a trace-safety
+        ROOT by naming convention; being a pallas_call callee exempts it
+        (functools.partial wrappers unwrap too)."""
+        assert (
+            _ids(
+                """
+                import functools
+                import jax
+                from jax.experimental import pallas as pl
+
+                def _binned_update(x_ref, o_ref):
+                    o_ref[:] = x_ref[:] * float(x_ref[0, 0])
+
+                def run(x):
+                    return pl.pallas_call(
+                        functools.partial(_binned_update),
+                        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    )(x)
+                """
+            )
+            == []
+        )
+
+    def test_module_level_update_kernel_without_pallas_call_still_roots(self):
+        assert (
+            _ids(
+                """
+                def _binned_update(x):
+                    return float(x)
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_kernel_factory_idiom_is_exempt(self):
+        """`pl.pallas_call(make_kernel(...))` — the factory idiom the
+        repo's own `_make_fold_kernel` uses: the kernel body nests inside
+        the factory, so the factory (reachable from update via the call
+        edge) is exempt along with its nested defs."""
+        assert (
+            _ids(
+                """
+                import jax
+                from jax.experimental import pallas as pl
+
+                def _make_scale_kernel(k):
+                    def _kernel(x_ref, o_ref):
+                        lo = float(x_ref[0, 0])
+                        o_ref[:] = x_ref[:] - lo
+                    return _kernel
+
+                class M:
+                    def update(self, x):
+                        return pl.pallas_call(
+                            _make_scale_kernel(4),
+                            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        )(x)
+                """
+            )
+            == []
+        )
+
+    def test_module_level_root_not_exempted_by_same_named_nested_kernel(self):
+        """The mirror collision: a genuine module-level `_*_update` root
+        must stay linted when an unrelated NESTED pallas kernel elsewhere
+        shares its name (python scoping: the pallas_call inside that
+        method references the nested def, not the module-level root)."""
+        assert (
+            _ids(
+                """
+                import jax
+                from jax.experimental import pallas as pl
+
+                def _scale_update(x):
+                    return float(x)
+
+                class M:
+                    def update(self, x):
+                        def _scale_update(x_ref, o_ref):
+                            o_ref[:] = x_ref[:]
+                        return pl.pallas_call(
+                            _scale_update,
+                            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        )(x)
+                """
+            )
+            == ["GL201"]
+        )
+
+    def test_same_named_nested_helper_not_exempted_by_module_level_kernel(self):
+        """A nested def is only referenceable from its enclosing scope: a
+        module-level pallas kernel named `_scale_kernel` must NOT exempt an
+        unrelated nested helper with the same name inside `update` (review
+        finding on the first draft of the exemption)."""
+        assert (
+            _ids(
+                """
+                import jax
+                from jax.experimental import pallas as pl
+
+                def _scale_kernel(x_ref, o_ref):
+                    o_ref[:] = x_ref[:]
+
+                def run(x):
+                    return pl.pallas_call(
+                        _scale_kernel,
+                        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    )(x)
+
+                class M:
+                    def update(self, x):
+                        def _scale_kernel(v):
+                            return float(v)
+                        return _scale_kernel(x)
+                """
+            )
+            == ["GL201"]
+        )
+
 
 # --------------------------------------------------------------------------
 # GL301/GL302 — state discipline

@@ -38,6 +38,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "ops: the kernel layer (metrics_tpu/ops/ — dispatch registry, "
+        "packed-radix orders, binned sketch precompaction, pallas kernels "
+        "with interpret-mode parity); select with -m ops, or run the "
+        "directory via `make test-ops` (1M-row variants additionally "
+        "marked slow)",
+    )
+    config.addinivalue_line(
+        "markers",
         "analysis: the static-analysis subsystem (metrics_tpu/analysis/ — "
         "graft-lint AST rules + compiled-graph budget auditor); select with "
         "-m analysis, or run the directory via `make test-analysis` (the "

@@ -1,0 +1,256 @@
+"""Adversarial-distribution parity for the binned sketch precompaction.
+
+The ``binned`` impl of ``sketch_precompact`` (``ops/binning.py``) must be
+BIT-IDENTICAL to the legacy full-sort path — same kept values at the same
+slots, same count, same static level — on every distribution that stresses
+a binning scheme: all-equal values, tie-heavy grids, ``±inf`` rows,
+NaN-with-guard, already-sorted streams, adversarially skewed mass. The one
+documented divergence is ``-0.0``/denormal canonicalization onto ``+0.0``
+(the XLA comparator's own equivalence), pinned explicitly below.
+
+On top of the bitwise pin, the ISSUE 6 acceptance: rank error of the
+binned-path :class:`QuantileSketch` stays ``<= eps * n`` on tie-heavy and
+skewed streams (fast sizes here; the 1M-row variants and the 8-way merge
+parity are ``slow``-marked).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu import QuantileSketch, functionalize
+from metrics_tpu.ops import dispatch as kdispatch
+from metrics_tpu.ops import fold_cascade, halving_map, precompact_batch
+from metrics_tpu.ops.bucketed_rank import _float32_ascending_word
+from metrics_tpu.streaming.sketches import QuantileSketchState
+
+pytestmark = pytest.mark.ops
+
+RNG = np.random.default_rng(60)
+
+
+def _dist(name: str, n: int) -> np.ndarray:
+    rng = np.random.default_rng(abs(hash(name)) % (1 << 32))
+    if name == "all_equal":
+        return np.full(n, 3.25, np.float32)
+    if name == "tie_heavy":
+        return rng.integers(0, 7, n).astype(np.float32)
+    if name == "pm_inf":
+        x = rng.random(n).astype(np.float32)
+        x[rng.random(n) < 0.02] = np.inf
+        x[rng.random(n) < 0.02] = -np.inf
+        return x
+    if name == "already_sorted":
+        return np.sort(rng.random(n).astype(np.float32))
+    if name == "adversarially_skewed":
+        # lognormal mass spread over ~50 decades: any value-uniform grid
+        # collapses; the key-domain binning must not. Clipped inside the
+        # NORMAL float32 range so this stays a bitwise-parity case
+        # (denormal/overflow canonicalization has its own dedicated test).
+        return np.clip(rng.lognormal(0.0, 20.0, n), 1e-35, 1e35).astype(np.float32)
+    if name == "uniform":
+        return rng.random(n).astype(np.float32)
+    raise AssertionError(name)
+
+
+_DISTS = ("all_equal", "tie_heavy", "pm_inf", "already_sorted", "adversarially_skewed", "uniform")
+
+
+def _both_impls(x, valid, k):
+    out = {}
+    for impl in ("sort", "binned"):
+        with kdispatch.kernel_override(sketch_precompact=impl):
+            out[impl] = precompact_batch(jnp.asarray(x), valid, k)
+    return out["sort"], out["binned"]
+
+
+@pytest.mark.parametrize("name", _DISTS)
+@pytest.mark.parametrize("n,k", [(16_384, 256), (100, 256)])
+def test_precompact_bitwise_parity(name, n, k):
+    x = _dist(name, n)
+    (a_items, a_cnt, a_lvl), (b_items, b_cnt, b_lvl) = _both_impls(
+        x, jnp.ones(x.shape, bool), k
+    )
+    assert a_lvl == b_lvl
+    assert int(a_cnt) == int(b_cnt)
+    np.testing.assert_array_equal(np.asarray(a_items), np.asarray(b_items))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", _DISTS)
+def test_precompact_bitwise_parity_large(name):
+    x = _dist(name, 262_144)
+    (a_items, a_cnt, a_lvl), (b_items, b_cnt, b_lvl) = _both_impls(
+        x, jnp.ones(x.shape, bool), 512
+    )
+    assert a_lvl == b_lvl
+    assert int(a_cnt) == int(b_cnt)
+    np.testing.assert_array_equal(np.asarray(a_items), np.asarray(b_items))
+
+
+def test_precompact_parity_nan_with_guard():
+    n, k = 8192, 128
+    x = RNG.random(n).astype(np.float32)
+    x[::7] = np.nan
+    valid = jnp.asarray(RNG.random(n) < 0.8)
+    (a_items, a_cnt, _), (b_items, b_cnt, _) = _both_impls(x, valid, k)
+    assert int(a_cnt) == int(b_cnt)
+    np.testing.assert_array_equal(np.asarray(a_items), np.asarray(b_items))
+
+
+def test_precompact_negzero_denormals_canonicalize():
+    """The documented divergence: the key map collapses -0.0 and float32
+    denormals onto +0.0 — the same equivalence the XLA float comparator
+    applies — so the two paths are key-equal, not bit-equal, here."""
+    x = np.array([-0.0, 0.0, 1e-40, -1e-41, 1.0, -1.0] * 50, np.float32)
+    (a_items, a_cnt, _), (b_items, b_cnt, _) = _both_impls(x, jnp.ones(x.shape, bool), 64)
+    assert int(a_cnt) == int(b_cnt)
+    ka = np.asarray(_float32_ascending_word(a_items))
+    kb = np.asarray(_float32_ascending_word(b_items))
+    np.testing.assert_array_equal(ka, kb)
+    # and the binned path's values are the canonical representatives
+    b = np.asarray(b_items)
+    assert not np.any(np.signbit(b[b == 0.0]))
+
+
+def test_full_update_state_parity():
+    """The whole jitted QuantileSketch update — precompact + cond-guarded
+    cascade — lands the identical state through either impl, for every
+    adversarial distribution. One jitted update per impl, shared across
+    distributions (same shape), so the sweep costs two compiles total."""
+    upds = {}
+    for impl in ("sort", "binned"):
+        with kdispatch.kernel_override(sketch_precompact=impl):
+            mdef = functionalize(QuantileSketch(eps=0.05, max_items=1 << 20))
+            upd = jax.jit(mdef.update)
+            jax.block_until_ready(upd(mdef.init(), jnp.zeros(16_384)))  # trace here
+        upds[impl] = (mdef, upd)
+    for name in _DISTS:
+        x = _dist(name, 16_384)
+        states = {}
+        for impl, (mdef, upd) in upds.items():
+            s = upd(mdef.init(), jnp.asarray(x))
+            s = upd(s, jnp.asarray(x[::-1].copy()))  # second fold: overflow paths
+            states[impl] = s
+        flat_a = jax.tree_util.tree_leaves(states["sort"])
+        flat_b = jax.tree_util.tree_leaves(states["binned"])
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+
+
+def _true_rank_error(sketch: QuantileSketchState, data: np.ndarray) -> float:
+    finite = data[np.isfinite(data)]
+    n = finite.size
+    s = np.sort(finite)
+    worst = 0.0
+    for q in (0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99):
+        v = s[min(n - 1, int(q * n))]
+        est = float(sketch.rank(v))
+        true = float(np.searchsorted(s, v, side="right"))
+        worst = max(worst, abs(est - true))
+    return worst
+
+
+@pytest.mark.parametrize("name", ("tie_heavy", "adversarially_skewed", "pm_inf"))
+def test_rank_error_within_eps(name):
+    n, eps = 65_536, 0.05
+    x = _dist(name, n)
+    m = QuantileSketch(eps=eps, max_items=1 << 20)
+    m.update(jnp.asarray(x))
+    assert _true_rank_error(m.sketch, x) <= eps * n
+
+
+def test_small_batch_unpadded_and_short_circuited():
+    """ISSUE 6 small fix: a sub-``k`` batch comes back at its own static
+    length (no +inf padding to k), the level is 0, and the fold cascade
+    still lands the exact state the padded path used to produce."""
+    k = 256
+    x = RNG.random(100).astype(np.float32)
+    items, cnt, level = precompact_batch(jnp.asarray(x), jnp.ones(100, bool), k)
+    assert items.shape == (100,) and level == 0 and int(cnt) == 100
+    np.testing.assert_array_equal(np.asarray(items), np.sort(x))
+    # a fresh sketch absorbing it equals the batch itself at level 0
+    st = QuantileSketchState.create(eps=0.05, max_items=4096)
+    st2 = st.insert(jnp.asarray(x))
+    assert int(st2.counts[0]) == 100
+    np.testing.assert_array_equal(np.asarray(st2.items[0, :100]), np.sort(x))
+
+
+def test_cascade_cond_matches_unconditional_reference():
+    """The lax.cond short-circuit must be bitwise-invisible: drive a state
+    through many overflow-triggering inserts and compare against a
+    python-level reference cascade built from fold_level directly."""
+    from metrics_tpu.ops.compactor import _masked_ascending, fold_level
+
+    k = 16
+    st = QuantileSketchState.create(eps=0.4, k=k, levels=5)
+
+    def reference_insert(state, x):
+        with kdispatch.kernel_override(sketch_precompact="sort"):
+            inc, inc_count, level = precompact_batch(x, jnp.ones(x.shape, bool), k)
+        L = state.items.shape[0]
+        rows, cnts = [], []
+        for lvl in range(L):
+            if lvl < level:
+                rows.append(state.items[lvl])
+                cnts.append(state.counts[lvl])
+                continue
+            if lvl == L - 1:
+                combined = jnp.sort(jnp.concatenate([state.items[lvl], inc]))
+                c = jnp.minimum(state.counts[lvl] + inc_count, k)
+                rows.append(_masked_ascending(combined[:k], c))
+                cnts.append(c)
+                continue
+            ni, nc, inc, inc_count = fold_level(state.items[lvl], state.counts[lvl], inc, inc_count)
+            rows.append(ni)
+            cnts.append(nc)
+        n = jnp.sum(jnp.isfinite(x).astype(jnp.int32))
+        return QuantileSketchState(
+            items=jnp.stack(rows), counts=jnp.stack(cnts).astype(jnp.int32), n_seen=state.n_seen + n
+        )
+
+    insert = jax.jit(lambda s, v: s.insert(v))  # one trace for all rounds
+    got, want = st, st
+    for i in range(8):
+        batch = jnp.asarray(RNG.random(24).astype(np.float32))
+        got = insert(got, batch)
+        want = reference_insert(want, batch)
+        for a, b in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_halving_map_matches_round_by_round():
+    for n in (0, 1, 7, 100, 1024, 12345):
+        k = 64
+        idx, level = halving_map(n, k)
+        ref = np.arange(n)
+        lv = 0
+        while ref.shape[0] > k:
+            j = np.arange(ref.shape[0] // 2)
+            ref = ref[2 * j + (j & 1)]
+            lv += 1
+        assert level == lv
+        np.testing.assert_array_equal(idx, ref)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ("tie_heavy", "adversarially_skewed"))
+def test_rank_error_1m_and_8way_merge(name):
+    """The acceptance scale: 1M rows through the binned path stays inside
+    eps*n, and the 8-way sharded merge matches the single-stream sketch's
+    contract (merge parity unchanged by the new precompaction)."""
+    n, eps = 1_048_576, 0.01
+    x = _dist(name, n)
+    m = QuantileSketch(eps=eps)
+    m.update(jnp.asarray(x))
+    assert _true_rank_error(m.sketch, x) <= eps * n
+
+    shards = [QuantileSketch(eps=eps) for _ in range(8)]
+    for i, sh in enumerate(shards):
+        sh.update(jnp.asarray(x[i::8].copy()))
+    merged = shards[0].sketch
+    for sh in shards[1:]:
+        merged = merged.sketch_merge(sh.sketch)
+    assert int(merged.n_seen) == int(m.sketch.n_seen)
+    assert _true_rank_error(merged, x) <= eps * n

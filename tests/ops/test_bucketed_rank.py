@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+pytestmark = pytest.mark.ops
+
 from metrics_tpu.ops.bucketed_rank import (
     ascending_order,
     ascending_ranks,

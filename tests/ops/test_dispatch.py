@@ -1,0 +1,177 @@
+"""Dispatch-layer behavior: env/override resolution and the warn-once
+degrade-never-crash fallback (ISSUE 6 CI satellite).
+
+The load-bearing contract: forcing ``pallas`` on a CPU-only box (no
+interpret) must WARN ONCE, take the XLA path, and produce the exact same
+numbers — a bad ``METRICS_TPU_KERNEL_BACKEND`` can cost performance but
+can never cost correctness or crash a serving loop.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu.ops import bucket_counts, precompact_batch
+from metrics_tpu.ops import dispatch as kdispatch
+
+pytestmark = pytest.mark.ops
+
+RNG = np.random.default_rng(62)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dispatch(monkeypatch):
+    """Each test sees a clean override table and a re-armed warn-once
+    memory, and leaves no env behind."""
+    monkeypatch.delenv("METRICS_TPU_KERNEL_BACKEND", raising=False)
+    kdispatch.reset_dispatch_state()
+    yield
+    kdispatch.reset_dispatch_state()
+
+
+def _caught(fn):
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = fn()
+    return out, [str(w.message) for w in caught]
+
+
+def test_auto_defaults_on_cpu():
+    ids = jnp.asarray(RNG.integers(0, 16, 100).astype(np.int32))
+    assert kdispatch.resolve("histogram", ids, 16)[0] == "xla"
+    assert kdispatch.resolve("sketch_precompact", ids, jnp.ones(100, bool), 8)[0] == "binned"
+    assert kdispatch.resolve("descending_order", ids)[0] == "radix"
+    assert kdispatch.resolve("compactor_fold", ids, jnp.int32(0), 16)[0] == "xla"
+
+
+def test_forced_pallas_on_cpu_warns_once_and_falls_back(monkeypatch):
+    """THE fallback contract: pallas forced without a TPU (and without
+    interpret) -> one warning, XLA path, identical result, no crash."""
+    monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", "pallas")
+    scores = jnp.asarray(RNG.random(500).astype(np.float32))
+    lo, hi = jnp.min(scores), jnp.max(scores)
+
+    def run():
+        return bucket_counts(scores, lo, hi, 32)[0]
+
+    counts, msgs = _caught(run)
+    fallbacks = [m for m in msgs if "falling back" in m and "pallas" in m]
+    assert len(fallbacks) == 1, msgs
+    # warn-once: a second call is silent
+    counts2, msgs2 = _caught(run)
+    assert not [m for m in msgs2 if "falling back" in m]
+    with kdispatch.kernel_override(histogram="xla"):
+        expected = bucket_counts(scores, lo, hi, 32)[0]
+    np.testing.assert_array_equal(np.asarray(counts), np.asarray(expected))
+    np.testing.assert_array_equal(np.asarray(counts2), np.asarray(expected))
+
+
+def test_global_env_token_skips_ops_without_that_impl(monkeypatch):
+    """A blanket `pallas` preference must not warn for ops that simply
+    have no pallas impl (sketch_precompact) — they stay on auto."""
+    monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", "pallas")
+    x = jnp.asarray(RNG.random(64).astype(np.float32))
+
+    def run():
+        return kdispatch.resolve("sketch_precompact", x, jnp.ones(64, bool), 16)[0]
+
+    name, msgs = _caught(run)
+    assert name == "binned"
+    assert not msgs
+
+
+def test_per_op_unknown_impl_warns_and_uses_default(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", "sketch_precompact=typo")
+    x = jnp.asarray(RNG.random(64).astype(np.float32))
+
+    def run():
+        return kdispatch.resolve("sketch_precompact", x, jnp.ones(64, bool), 16)[0]
+
+    name, msgs = _caught(run)
+    assert name == "binned"
+    assert any("typo" in m and "sketch_precompact" in m for m in msgs)
+
+
+def test_typoed_env_op_name_warns_once_and_is_ignored(monkeypatch):
+    """A per-op env token naming an unregistered op would otherwise be
+    stored-but-never-consulted (the silent self-comparison trap); it must
+    warn once and be dropped."""
+    monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", "compactorfold=pallas")
+    ids = jnp.asarray(RNG.integers(0, 8, 32).astype(np.int32))
+
+    def run():
+        return kdispatch.resolve("compactor_fold", ids, jnp.int32(0), 16)[0]
+
+    name, msgs = _caught(run)
+    assert name == "xla"
+    assert any("compactorfold" in m and "not a registered" in m for m in msgs)
+    _, msgs2 = _caught(run)
+    assert not [m for m in msgs2 if "not a registered" in m]
+
+
+def test_malformed_env_token_warns_once_and_is_ignored(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", "=nonsense, ,histogram=xla")
+    ids = jnp.asarray(RNG.integers(0, 8, 32).astype(np.int32))
+
+    def run():
+        return kdispatch.resolve("histogram", ids, 8)[0]
+
+    name, msgs = _caught(run)
+    assert name == "xla"
+    assert any("malformed" in m for m in msgs)
+    _, msgs2 = _caught(run)
+    assert not [m for m in msgs2 if "malformed" in m]
+
+
+def test_override_wins_over_env(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", "sketch_precompact=binned")
+    x = jnp.asarray(RNG.random(64).astype(np.float32))
+    with kdispatch.kernel_override(sketch_precompact="sort"):
+        assert kdispatch.resolve("sketch_precompact", x, jnp.ones(64, bool), 16)[0] == "sort"
+    assert kdispatch.resolve("sketch_precompact", x, jnp.ones(64, bool), 16)[0] == "binned"
+
+
+def test_precompact_impls_agree_under_forced_env(monkeypatch):
+    """Behavioral (not just resolution) check of the env switch: the two
+    precompact impls produce the same (bitwise) result when selected via
+    the env var."""
+    x = RNG.random(4096).astype(np.float32)
+    outs = {}
+    for impl in ("sort", "binned"):
+        monkeypatch.setenv("METRICS_TPU_KERNEL_BACKEND", f"sketch_precompact={impl}")
+        outs[impl] = precompact_batch(jnp.asarray(x), jnp.ones(4096, bool), 64)
+    np.testing.assert_array_equal(np.asarray(outs["sort"][0]), np.asarray(outs["binned"][0]))
+    assert int(outs["sort"][1]) == int(outs["binned"][1])
+
+
+def test_unknown_op_raises():
+    with pytest.raises(KeyError):
+        kdispatch.resolve("no_such_op")
+
+
+def test_override_with_typoed_op_name_raises():
+    """Overrides are test/bench hooks; a typo'd op key would silently make
+    an A/B compare an impl against itself, so it must raise instead."""
+    with pytest.raises(KeyError):
+        kdispatch.set_kernel_override("sketchprecompact", "sort")
+    with pytest.raises(KeyError):
+        with kdispatch.kernel_override(sketchprecompact="sort"):
+            pass
+
+
+def test_binned_counters_dispatch_parity():
+    """The binned PR metrics' op: XLA vs interpreted pallas through the
+    public entry point, plus the legacy `interpret` knob."""
+    from metrics_tpu.ops import binned_counter_update
+
+    preds = jnp.asarray(RNG.random((300, 3)).astype(np.float32))
+    onehot = jnp.asarray((RNG.random((300, 3)) < 0.4).astype(np.float32))
+    thr = jnp.linspace(0.0, 1.0, 11)
+    a = binned_counter_update(preds, onehot, thr, backend="xla")
+    b = binned_counter_update(preds, onehot, thr, backend="pallas-interpret")
+    c = binned_counter_update(preds, onehot, thr, interpret=True)
+    for xa, xb, xc in zip(a, b, c):
+        np.testing.assert_allclose(np.asarray(xa), np.asarray(xb), rtol=0, atol=0)
+        np.testing.assert_allclose(np.asarray(xa), np.asarray(xc), rtol=0, atol=0)
