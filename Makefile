@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -64,3 +64,10 @@ test-streaming:
 # additionally marked slow).
 test-ops:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ops/ -q -m 'not slow' -p no:cacheprovider
+
+# Fast feedback on the serving-hardening layer (serving/ ServeLoop + the
+# ops/padding.py capacity ladder): multi-thread ragged-traffic stress with
+# fault injection, overload shedding, recompile budgets, snapshot round
+# trips (the padding tests also ride the `ops` lane via their directory).
+test-serving:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/serving/ tests/ops/test_padding.py -q -m 'not slow' -p no:cacheprovider

@@ -1080,6 +1080,124 @@ def _phase_compactor(jax, platform) -> None:
         print(f"bench: compactor small-batch failed: {err}", file=sys.stderr)
 
 
+def _phase_serving(jax, platform) -> None:
+    """Serving hardening (ISSUE 7): per-request update latency of the
+    padding-tier ladder under mixed ragged traffic (p50/p99 — tails matter
+    on a request path, means hide them), and ``report()`` latency for the
+    stale view (the never-blocking serving read) vs a fresh forced reduce.
+    Ladder tier graphs are compiled up front, as a warm serving process
+    would have them; the jit cache is then asserted to hold exactly
+    ``len(ladder)`` entries — the no-unbounded-recompilation contract this
+    phase exists to price."""
+    _stamp("serving start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu.ops import padding
+
+    LADDER = (64, 256, 1024)
+    os.environ["METRICS_TPU_PAD_LADDER"] = ",".join(str(t) for t in LADDER)
+    padding.reset_padding_state()
+    rng = np.random.default_rng(17)
+
+    def batch(n):
+        return (
+            jnp.asarray(rng.random((n, 8), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 8, n).astype(np.int32)),
+        )
+
+    try:
+        m = mt.Accuracy(num_classes=8, on_invalid="drop", pad_batches=True)
+        for tier in LADDER:  # warm every tier graph (a warm serving process)
+            p, t = batch(tier)
+            m.update(p, t)
+            jax.block_until_ready(jax.tree_util.tree_leaves(m.metric_state))
+
+        tiers = {t: [] for t in LADDER}
+        spans = {64: (1, 64), 256: (65, 256), 1024: (257, 1024)}
+        all_lat = []
+        for _ in range(120):
+            tier = LADDER[int(rng.integers(0, len(LADDER)))]
+            lo, hi = spans[tier]
+            p, t = batch(int(rng.integers(lo, hi + 1)))
+            t0 = time.perf_counter()
+            m.update(p, t)
+            jax.block_until_ready(jax.tree_util.tree_leaves(m.metric_state))
+            dt = time.perf_counter() - t0
+            tiers[tier].append(dt)
+            all_lat.append(dt)
+        if m._update_jit._cache_size() != len(LADDER):
+            print(
+                f"bench: PARITY-MISMATCH serving jit cache {m._update_jit._cache_size()} "
+                f"graphs != len(ladder) {len(LADDER)}",
+                file=sys.stderr,
+            )
+        per_tier = ", ".join(
+            f"tier {t}: p50 {np.percentile(v, 50) * 1e3:.2f} ms" for t, v in tiers.items()
+        )
+        _emit(
+            "serving_update_p50_ms",
+            round(float(np.percentile(all_lat, 50)) * 1e3, 3),
+            f"ms/request (guarded padded Accuracy, mixed ragged 1-1024 rows, "
+            f"ladder {LADDER}, {platform}; {per_tier})",
+        )
+        _emit(
+            "serving_update_p99_ms",
+            round(float(np.percentile(all_lat, 99)) * 1e3, 3),
+            f"ms/request p99 (same traffic; tail == the request-path promise, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: serving update-latency failed: {err}", file=sys.stderr)
+
+    try:
+        # reduce_every_s idles the cadence reducer: fresh reads below must
+        # price the FORCED reduce, and a cadence pass covering the last
+        # publish first would let report(fresh=True) take its covered-view
+        # short circuit and time ~nothing
+        with mt.ServeLoop(
+            mt.Accuracy(num_classes=8, on_invalid="drop", pad_batches=True),
+            workers=2,
+            reduce_every_s=3600.0,
+        ) as loop:
+            for _ in range(100):
+                p, t = batch(int(rng.integers(1, 257)))
+                loop.offer(p, t)
+            loop.drain(120)
+            loop.report(fresh=True, deadline_s=10.0)  # materialize a view
+            # stale read: the serving-path answer (never blocks on a reduce)
+            stale = []
+            for _ in range(200):
+                t0 = time.perf_counter()
+                loop.report()
+                stale.append(time.perf_counter() - t0)
+            fresh = []
+            for _ in range(20):
+                # a fresh publish per read: the view is genuinely behind, so
+                # each timing covers the full clone+fold+compute pass
+                p, t = batch(int(rng.integers(1, 257)))
+                loop.offer(p, t)
+                loop.drain(120)
+                t0 = time.perf_counter()
+                view = loop.report(fresh=True, deadline_s=10.0)
+                fresh.append(time.perf_counter() - t0)
+            loop.stop()
+        _emit(
+            "serve_report_stale_ms",
+            round(float(np.percentile(stale, 50)) * 1e3, 4),
+            f"ms/report (stale view, p50 of 200; p99 {np.percentile(stale, 99) * 1e3:.3f} ms, "
+            f"{platform})",
+        )
+        _emit(
+            "serve_report_fresh_ms",
+            round(float(np.percentile(fresh, 50)) * 1e3, 3),
+            f"ms/report (fresh=True forced reduce+compute, p50 of 20, 2 workers, "
+            f"{platform}; last fresh={view['fresh']})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: serving report-latency failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -1093,6 +1211,7 @@ _PHASES = {
     "sync": (_phase_sync, 150),
     "streaming": (_phase_streaming, 300),
     "compactor": (_phase_compactor, 420),
+    "serving": (_phase_serving, 300),
 }
 
 _HEADLINE_METRIC = "fused_collection_step_ms"

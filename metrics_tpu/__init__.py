@@ -26,6 +26,7 @@ from metrics_tpu.utilities.backend import apply_force_cpu_escape_hatch as _apply
 _apply_force_cpu()
 
 from metrics_tpu.resilience import SnapshotManager, health_report  # noqa: E402
+from metrics_tpu.serving import ServeLoop  # noqa: E402
 from metrics_tpu.utilities.backend import ensure_backend  # noqa: E402
 
 from metrics_tpu.audio import (  # noqa: E402
@@ -264,4 +265,5 @@ __all__ = [
     "ensure_backend",
     "functionalize",
     "health_report",
+    "ServeLoop",
 ]

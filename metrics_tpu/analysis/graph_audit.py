@@ -201,6 +201,8 @@ def audit_recompilation(
     make_args: Callable[[int], Tuple],
     batch_sizes: Tuple[int, int] = (4, 8),
     entry: str = "<fn>",
+    sweep_sizes: Optional[Sequence[int]] = None,
+    max_graphs: Optional[int] = None,
 ) -> List[GraphViolation]:
     """Detect avoidable recompilation of a metric ``update`` entry point.
 
@@ -215,6 +217,17 @@ def audit_recompilation(
        must trace exactly once (a second trace at unchanged avals means
        something unstable — weak types, non-hashable statics — is defeating
        the jit cache).
+
+    Optional third check — the **ragged-traffic graph budget**
+    (``sweep_sizes`` + ``max_graphs``): feed every sweep size through one
+    jit and count TOTAL distinct traces (including the check-2 warmup).
+    For a ladder-padded entry (``ops/padding.py``) whose ``make_args`` pads
+    each size to its tier, the count is bounded by ``len(ladder)``; an
+    unpadded entry retraces per distinct size and blows the budget — the
+    "no unbounded recompilation under ragged serving traffic" enforcement.
+    A sweep covering every tier pins the count EXACTLY by auditing twice:
+    ``max_graphs=N`` passing and ``max_graphs=N-1`` failing proves the
+    sweep compiled exactly N graphs.
     """
     import jax
 
@@ -250,4 +263,20 @@ def audit_recompilation(
                 "is being missed (unstable weak types or non-hashable statics?)",
             )
         )
+    if sweep_sizes is not None:
+        if max_graphs is None:
+            raise ValueError("`sweep_sizes` needs a `max_graphs` budget")
+        for n in sweep_sizes:
+            jax.block_until_ready(jitted(*make_args(n)))
+        if traces["n"] > max_graphs:
+            violations.append(
+                GraphViolation(
+                    entry,
+                    "recompilation",
+                    f"{traces['n']} graphs compiled for a sweep of "
+                    f"{len(tuple(sweep_sizes))} ragged batch sizes (budget: "
+                    f"{max_graphs}) — serving traffic would recompile unboundedly; "
+                    "pad batches to a capacity ladder (ops/padding.py)",
+                )
+            )
     return violations

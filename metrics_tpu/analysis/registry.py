@@ -31,6 +31,14 @@ entry                           budget
 ``bucketed_rank_step``          the bucketed-rank kernel step (dispatched
                                 descending order + inverse ranks): **0**
                                 collectives, no f64/callbacks/dynamic shapes
+``ladder_served_update``        ladder-padded guarded serving update (ISSUE 7
+                                — ``ops/padding.py``): **0** collectives, no
+                                f64/callbacks/dynamic shapes, AND a ragged
+                                batch-size sweep covering every tier compiles
+                                at most ``len(ladder)`` graphs
+                                (``audit_recompilation``'s sweep budget — the
+                                "no unbounded recompilation under serving
+                                traffic" enforcement)
 ==============================  =============================================
 """
 from dataclasses import dataclass
@@ -58,6 +66,10 @@ class AuditEntry:
     build: Optional[Callable[[int], Tuple[Callable, Tuple]]] = None
     # () -> (fn, make_args): handed to audit_recompilation
     build_recompile: Optional[Callable[[], Tuple[Callable, Callable[[int], Tuple]]]] = None
+    # ragged-traffic graph budget: total traces over this sweep of batch
+    # sizes must stay <= max_graphs (audit_recompilation's third check)
+    sweep_sizes: Optional[Tuple[int, ...]] = None
+    max_graphs: Optional[int] = None
 
 
 def _mesh(ndev: int):
@@ -226,6 +238,47 @@ def _build_bucketed_rank_step(ndev: int):
     return jax.jit(step), (x,)
 
 
+# the serving ladder under audit: pinned programmatically (not via the env
+# var) so the audit result cannot depend on ambient METRICS_TPU_PAD_LADDER
+_SERVE_LADDER = (8, 32, 128)
+
+
+def _build_ladder_raw_step():
+    import metrics_tpu as mt
+
+    # the serving-shaped path: guarded stat-scores-family metric, row drop
+    # in-graph (`_valid_mask_always`), pad mask AND-ed with the guard's own
+    mdef = mt.functionalize(mt.Accuracy(num_classes=4, on_invalid="drop"))
+
+    def update(p, t, valid):
+        s = mdef.update(mdef.init(), p, t, valid=valid)
+        return mdef.compute(s), mdef.faults(s)
+
+    return update
+
+
+def _ladder_make_args(batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.ops.padding import pad_rows
+
+    rng = np.random.default_rng(batch)
+    p = jnp.asarray(rng.random((batch, 4), dtype=np.float32))
+    t = jnp.asarray(rng.integers(0, 4, batch).astype(np.int32))
+    # padding happens OUTSIDE the jit (the module runtime's pad_batches
+    # stance) — the audited graph only ever sees _SERVE_LADDER tiers
+    (p, t), valid = pad_rows((p, t), ladder=_SERVE_LADDER)
+    return (p, t, valid)
+
+
+def _build_ladder_served_step(ndev: int):
+    import jax
+
+    # ONE construction for budget + recompile audits (the auroc stance)
+    return jax.jit(_build_ladder_raw_step()), _ladder_make_args(32)
+
+
 REGISTRY: Tuple[AuditEntry, ...] = (
     AuditEntry(
         name="fused_stat_collection",
@@ -282,6 +335,23 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         ),
         build=_build_bucketed_rank_step,
     ),
+    AuditEntry(
+        name="ladder_served_update",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_ladder_served_step,
+        build_recompile=lambda: (_build_ladder_raw_step(), _ladder_make_args),
+        # every tier of _SERVE_LADDER appears in the sweep, so the budget is
+        # exact: len(ladder) graphs, never one per distinct batch size (the
+        # check-2 warmup at batch 4 pads to tier 8 — no extra graph)
+        sweep_sizes=(1, 3, 7, 8, 9, 20, 31, 32, 33, 57, 100, 127, 128),
+        max_graphs=3,  # == len(_SERVE_LADDER)
+    ),
 )
 
 
@@ -319,5 +389,13 @@ def run_graph_audit(
             violations.extend(audit_hlo(hlo_of(fn, *args), entry.budget, entry=entry.name))
         if entry.build_recompile is not None:
             fn, make_args = entry.build_recompile()
-            violations.extend(audit_recompilation(fn, make_args, entry=entry.name))
+            violations.extend(
+                audit_recompilation(
+                    fn,
+                    make_args,
+                    entry=entry.name,
+                    sweep_sizes=entry.sweep_sizes,
+                    max_graphs=entry.max_graphs,
+                )
+            )
     return violations

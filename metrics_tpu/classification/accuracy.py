@@ -44,6 +44,15 @@ class Accuracy(StatScores):
     higher_is_better = True
     full_state_update = False
 
+    @property
+    def _valid_mask_always(self) -> bool:
+        # exact-match subset accuracy has no masked counting rule; while the
+        # flag is (still) set the update would reject `valid`, so the guard/
+        # ladder must treat this config as mask-refusing
+        if self.subset_accuracy:
+            return False
+        return super()._valid_mask_always
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -95,8 +104,12 @@ class Accuracy(StatScores):
             self.add_state("correct", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
             self.add_state("total", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
 
-    def update(self, preds: Array, target: Array) -> None:
-        """Reference ``accuracy.py:209-263``."""
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """Reference ``accuracy.py:209-263``.
+
+        ``valid`` is an optional bool ``(N,)`` row mask (masked rows
+        contribute nothing — the traced drop/padding path); exact-match
+        ``subset_accuracy`` has no masked counting rule and rejects it."""
         mode = _mode(preds, target, self.threshold, self.top_k, self.num_classes, self.multiclass, self.ignore_index)
 
         if not self.mode:
@@ -108,6 +121,8 @@ class Accuracy(StatScores):
             self.subset_accuracy = False
 
         if self.subset_accuracy:
+            if valid is not None:
+                raise ValueError("`valid` row masks are not supported with `subset_accuracy`")
             correct, total = _subset_accuracy_update(
                 preds, target, threshold=self.threshold, top_k=self.top_k, ignore_index=self.ignore_index
             )
@@ -125,6 +140,7 @@ class Accuracy(StatScores):
                 multiclass=self.multiclass,
                 ignore_index=self.ignore_index,
                 mode=self.mode,
+                valid=valid,
             )
             if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
                 self.tp += tp

@@ -36,6 +36,20 @@ class StatScores(Metric):
     higher_is_better: Optional[bool] = None
     full_state_update: bool = False
 
+    @property
+    def _valid_mask_always(self) -> bool:
+        """Whether THIS instance's update consumes `valid` row masks (the
+        traced row-drop/padding contract — utilities/guard.py::
+        _consumes_valid_mask, ops/padding.py). A property, not a class
+        flag: per-sample reductions keep one output row per input row and
+        negative ``ignore_index`` drops rows by concrete indexing, so those
+        configs refuse masks and must fall back to the eager drop path."""
+        if self.reduce == "samples" or self.mdmc_reduce == "samplewise":
+            return False
+        if self.ignore_index is not None and self.ignore_index < 0:
+            return False
+        return True
+
     def __init__(
         self,
         threshold: float = 0.5,
@@ -73,8 +87,13 @@ class StatScores(Metric):
             for s in ("tp", "fp", "tn", "fn"):
                 self.add_state(s, default=[], dist_reduce_fx="cat", template=jnp.zeros((0,), jnp.int32))
 
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate stat scores for a batch (reference ``stat_scores.py:170-192``)."""
+    def update(self, preds: Array, target: Array, valid: Optional[Array] = None) -> None:
+        """Accumulate stat scores for a batch (reference ``stat_scores.py:170-192``).
+
+        ``valid`` is an optional bool ``(N,)`` row mask: masked rows
+        contribute to no counter — the in-graph row-drop path
+        (``on_invalid='drop'``) and the padding ladder
+        (``pad_batches=True``) both ride it."""
         tp, fp, tn, fn = _stat_scores_update(
             preds,
             target,
@@ -85,6 +104,7 @@ class StatScores(Metric):
             top_k=self.top_k,
             multiclass=self.multiclass,
             ignore_index=self.ignore_index,
+            valid=valid,
         )
         if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
             self.tp += tp

@@ -55,8 +55,11 @@ def _accuracy_update(
     multiclass: Optional[bool],
     ignore_index: Optional[int],
     mode: DataType,
+    valid: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
-    """Reference ``accuracy.py:71-119``."""
+    """Reference ``accuracy.py:71-119``; ``valid`` row masks thread through
+    to :func:`_stat_scores_update` (``_input_squeeze`` preserves the batch
+    axis, so the mask stays row-aligned)."""
     if mode == DataType.MULTILABEL and top_k:
         raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
     preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
@@ -71,6 +74,7 @@ def _accuracy_update(
         multiclass=multiclass,
         ignore_index=ignore_index,
         mode=mode,
+        valid=valid,
     )
 
 

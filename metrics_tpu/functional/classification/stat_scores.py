@@ -50,10 +50,19 @@ def _stat_scores(
     preds: Array,
     target: Array,
     reduce: Optional[str] = "micro",
+    valid: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
     """Count tp/fp/tn/fn over canonical ``(N, C)`` / ``(N, C, X)`` binary
     inputs (reference ``stat_scores.py:63-107``); output shape per ``reduce``
-    as documented there."""
+    as documented there.
+
+    ``valid`` is an optional bool ``(N,)`` row mask: False rows contribute
+    to NO counter — the traced row-drop path the fault channel's
+    ``on_invalid='drop'`` and the padding ladder (``ops/padding.py``) ride
+    for the stat-scores family. Only the row-reducing modes support it
+    (micro/macro); per-sample outputs keep one row per input row, so a mask
+    there would misalign downstream.
+    """
     if reduce == "micro":
         dim = (0, 1) if preds.ndim == 2 else (1, 2)
     elif reduce == "macro":
@@ -64,10 +73,17 @@ def _stat_scores(
     true_pred = target == preds
     pos_pred = preds == 1
 
-    tp = jnp.sum(true_pred & pos_pred, axis=dim)
-    fp = jnp.sum((~true_pred) & pos_pred, axis=dim)
-    tn = jnp.sum(true_pred & ~pos_pred, axis=dim)
-    fn = jnp.sum((~true_pred) & ~pos_pred, axis=dim)
+    if valid is not None:
+        if reduce == "samples":
+            raise ValueError("`valid` row masks are not supported with reduce='samples'")
+        v = jnp.asarray(valid, bool).reshape((preds.shape[0],) + (1,) * (preds.ndim - 1))
+    else:
+        v = True  # broadcasts away
+
+    tp = jnp.sum(true_pred & pos_pred & v, axis=dim)
+    fp = jnp.sum((~true_pred) & pos_pred & v, axis=dim)
+    tn = jnp.sum(true_pred & ~pos_pred & v, axis=dim)
+    fn = jnp.sum((~true_pred) & ~pos_pred & v, axis=dim)
     # int64 counters (the reference uses long) when x64 is enabled; under
     # JAX's default x64-off config int64 silently downcasts, so int32 is the
     # honest dtype there — accumulators overflow past ~2.1B counts per entry.
@@ -86,9 +102,23 @@ def _stat_scores_update(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
     mode: Optional[DataType] = None,
+    valid: Optional[Array] = None,
 ) -> Tuple[Array, Array, Array, Array]:
     """Canonicalize inputs and count tp/fp/tn/fn
-    (reference ``stat_scores.py:110-193``)."""
+    (reference ``stat_scores.py:110-193``).
+
+    ``valid`` is an optional bool ``(N,)`` row mask — masked rows contribute
+    to no counter (see :func:`_stat_scores`); the canonicalization below
+    preserves row order/count, so the mask stays aligned through it.
+    """
+    if valid is not None and ignore_index is not None and ignore_index < 0:
+        # the negative-ignore path drops rows by concrete boolean indexing,
+        # which would misalign the mask; no caller combines the two
+        raise ValueError("`valid` row masks are not supported with a negative `ignore_index`")
+    if valid is not None and (reduce == "samples" or mdmc_reduce == "samplewise"):
+        # per-sample outputs keep one row per input row — a row mask cannot
+        # remove its row from the downstream cat state
+        raise ValueError("`valid` row masks are not supported with per-sample reductions")
     _negative_index_dropped = False
     if ignore_index is not None and ignore_index < 0:
         # resolve the case statically if the caller didn't pass it — without
@@ -123,6 +153,10 @@ def _stat_scores_update(
                 "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
             )
         if mdmc_reduce == "global":
+            if valid is not None:
+                # rows expand (N, C, X) -> (N*X, C) in n-major order: each
+                # input row's mask bit covers its X extra-dim samples
+                valid = jnp.repeat(jnp.asarray(valid, bool), preds.shape[2])
             preds = jnp.moveaxis(preds, 1, 2).reshape(-1, preds.shape[1])
             target = jnp.moveaxis(target, 1, 2).reshape(-1, target.shape[1])
 
@@ -130,7 +164,7 @@ def _stat_scores_update(
         preds = _del_column(preds, ignore_index)
         target = _del_column(target, ignore_index)
 
-    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce, valid=valid)
 
     if ignore_index is not None and reduce == "macro" and not _negative_index_dropped:
         # mark the ignored class with the -1 sentinel (reference ``:187-191``)

@@ -58,6 +58,10 @@ _TRACE_ERRORS = tuple(
         "TracerArrayConversionError",
         "TracerBoolConversionError",
         "TracerIntegerConversionError",
+        # boolean-indexing with a traced mask (e.g. the negative-ignore_index
+        # row drop) is the same "needs concrete values" family, but subclasses
+        # JAXIndexError, not ConcretizationTypeError
+        "NonConcreteBooleanIndexError",
     )
     if hasattr(jax.errors, name)
 )
@@ -66,6 +70,32 @@ _TRACE_ERRORS = tuple(
 def jit_distributed_available() -> bool:
     """Reference ``metric.py:40-41``."""
     return distributed_available()
+
+
+def _migrate_fault_vectors(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Zero-pad fault-class vectors from builds with fewer fault classes up
+    to the current ``NUM_FAULT_CLASSES`` (the appends-only contract —
+    ``utilities/guard.py::FAULT_CLASSES``): ``FaultCounters`` leaves, and
+    the streaming wrappers' RAW per-bucket/decayed fault rings (state keys
+    ``win___faults``/``dec___faults``, plain class-trailing arrays). The
+    checkpoint loaders migrate through ``_validated_state_value``; this
+    covers the pickle path, where the pytrees are rebuilt leaf-for-leaf."""
+    from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES, FaultCounters
+
+    def fix(k: str, v: Any) -> Any:
+        if isinstance(v, FaultCounters) and v.counts.shape[0] < NUM_FAULT_CLASSES:
+            pad = jnp.zeros((NUM_FAULT_CLASSES - v.counts.shape[0],), v.counts.dtype)
+            return FaultCounters(counts=jnp.concatenate([v.counts, pad]))
+        if (
+            k.endswith("___faults")
+            and getattr(v, "ndim", 0) >= 1
+            and v.shape[-1] < NUM_FAULT_CLASSES
+        ):
+            pad = jnp.zeros(v.shape[:-1] + (NUM_FAULT_CLASSES - v.shape[-1],), v.dtype)
+            return jnp.concatenate([v, pad], axis=-1)
+        return v
+
+    return {k: fix(k, v) for k, v in state.items()}
 
 
 class Metric:
@@ -110,6 +140,7 @@ class Metric:
         on_overflow: str = "warn",
         on_invalid: str = "ignore",
         debug_checks: bool = False,
+        pad_batches: bool = False,
         **kwargs: Any,
     ) -> None:
         from metrics_tpu.utilities.guard import VALID_POLICIES, FaultCounters
@@ -131,10 +162,16 @@ class Metric:
             raise ValueError(f"`on_invalid` must be one of {VALID_POLICIES}, got {on_invalid!r}")
         self.on_invalid = on_invalid
         self.debug_checks = debug_checks
+        # serving hardening (ops/padding.py): pad every update batch up to a
+        # ladder tier so ragged traffic compiles at most len(ladder) graphs;
+        # pad rows are masked through the `valid` machinery and counted in
+        # the fault channel's informational `padded_rows` class
+        self.pad_batches = bool(pad_batches)
         self._faults_reported = 0
-        if on_invalid != "ignore":
+        if on_invalid != "ignore" or self.pad_batches:
             # the in-graph fault channel: per-class uint32 counters carried
-            # as ordinary sum-reduced metric state (see utilities/guard.py)
+            # as ordinary sum-reduced metric state (see utilities/guard.py);
+            # padding rides it too so padded_rows merge/sync/snapshot for free
             self.add_state("_faults", default=FaultCounters.zeros(), dist_reduce_fx="sum")
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
@@ -337,6 +374,13 @@ class Metric:
                     "The Metric shouldn't be synced when performing ``update``. "
                     "HINT: Did you forget to call ``unsync``?"
                 )
+            n_padded = 0
+            if self.pad_batches:
+                # pad OUTSIDE the jit boundary: the compiled update only ever
+                # sees ladder-tier shapes, so ragged traffic reuses graphs
+                from metrics_tpu.ops.padding import pad_update_args
+
+                args, kwargs, n_padded = pad_update_args(self, args, kwargs)
             if self._can_jit_update() and not self.compute_on_cpu:
                 if self._update_jit is None:
                     self._update_jit = self._make_update_jit()
@@ -352,6 +396,14 @@ class Metric:
                     object.__setattr__(self, "_state", new_state)
             else:
                 update(*args, **kwargs)
+            if n_padded:
+                # the pad count is static (a shape delta), so it accumulates
+                # with one tiny eager add instead of riding the jitted graph
+                from metrics_tpu.utilities.guard import FaultCounters
+
+                self._state["_faults"] = self._state["_faults"] + FaultCounters.single(
+                    padded_rows=n_padded
+                )
             if self.compute_on_cpu:
                 self._move_list_states_to_host()
 
@@ -475,8 +527,11 @@ class Metric:
         counts[_IDX["nonfinite_state"]] += nan_state_leaves(
             {k: v for k, v in self._state.items() if k != "_faults"}
         )
-        total = int(counts.sum())
-        from metrics_tpu.utilities.guard import format_fault_report
+        # informational classes (padded_rows) record normal operation and
+        # never trip the warn/error policies
+        from metrics_tpu.utilities.guard import actionable_fault_total, format_fault_report
+
+        total = actionable_fault_total(counts)
 
         if self.on_invalid == "error":
             # no warn-once watermark for errors: poisoned accumulators must
@@ -652,9 +707,18 @@ class Metric:
             c._deep_merge(cs)
 
     def _reduce_states(
-        self, global_state: Dict[str, Any], batch_state: Dict[str, Any], global_count: int
+        self,
+        global_state: Dict[str, Any],
+        batch_state: Dict[str, Any],
+        global_count: int,
+        batch_count: int = 1,
     ) -> Dict[str, Any]:
-        """Merge rules keyed by reduction tag (reference ``metric.py:319-346``)."""
+        """Merge rules keyed by reduction tag (reference ``metric.py:319-346``).
+
+        ``batch_count`` is the number of updates ``batch_state`` accumulated
+        (1 for the forward protocol's single-batch merge; serving replica
+        merges — ``metrics_tpu/serving`` — pass each replica's update count
+        so 'mean' states weight correctly)."""
         merged: Dict[str, Any] = {}
         for name, reduce_fn in self._reductions.items():
             g, b = global_state[name], batch_state[name]
@@ -668,7 +732,7 @@ class Metric:
                 if global_count == 0:
                     merged[name] = b
                 else:
-                    merged[name] = (g * global_count + b) / (global_count + 1)
+                    merged[name] = (g * global_count + b * batch_count) / (global_count + batch_count)
             elif reduce_fn == "max":
                 merged[name] = jnp.maximum(g, b)
             elif reduce_fn == "min":
@@ -1185,13 +1249,18 @@ class Metric:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
-        # pickles from before the fault channel lack its knobs
+        # pickles from before the fault channel / padding ladder lack the knobs
         self.__dict__.setdefault("on_invalid", "ignore")
         self.__dict__.setdefault("debug_checks", False)
+        self.__dict__.setdefault("pad_batches", False)
         self.__dict__.setdefault("_faults_reported", 0)
         self.__dict__.setdefault("_last_update_unix", None)
-        self.__dict__["_state"] = jax.tree_util.tree_map(jnp.asarray, state["_state"])
-        self.__dict__["_defaults"] = jax.tree_util.tree_map(jnp.asarray, state["_defaults"])
+        self.__dict__["_state"] = _migrate_fault_vectors(
+            jax.tree_util.tree_map(jnp.asarray, state["_state"])
+        )
+        self.__dict__["_defaults"] = _migrate_fault_vectors(
+            jax.tree_util.tree_map(jnp.asarray, state["_defaults"])
+        )
         object.__setattr__(self, "_original_update", self._maybe_guard(type(self).update.__get__(self)))
         object.__setattr__(self, "_original_compute", type(self).compute.__get__(self))
         object.__setattr__(self, "update", self._wrap_update(self._original_update))

@@ -1,0 +1,68 @@
+"""Shared warn-once + memoized env-parse helpers for the ops-layer knobs.
+
+``ops/dispatch.py`` (``METRICS_TPU_KERNEL_BACKEND``) and ``ops/padding.py``
+(``METRICS_TPU_PAD_LADDER``) share one env-var contract: resolution at call
+time (trace time under jit), malformed values warn ONCE and fall back —
+a bad env var degrades performance or compile reuse, never correctness —
+and tests reset the warn-once memory plus the memoized parse between
+cases. This module is that contract's single implementation, so a fix to
+one knob (e.g. rank-zero gating of the warning) cannot drift from the
+other.
+
+Module import performs python work only (no jax calls, no device arrays —
+the hang-proof bootstrap contract, ``utilities/backend.py``).
+"""
+import os
+from typing import Any, Callable, Generic, Tuple, TypeVar
+
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+__all__ = ["WarnOnce", "EnvParse"]
+
+T = TypeVar("T")
+
+
+class WarnOnce:
+    """Keyed warn-once registry: the first call per key warns, the rest are
+    silent until :meth:`reset` (test isolation — the warning must be
+    observable per test, not per process)."""
+
+    def __init__(self) -> None:
+        self._seen: set = set()
+
+    def __call__(self, key: Tuple[Any, ...], msg: str) -> None:
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        rank_zero_warn(msg, UserWarning)
+
+    def reset(self) -> None:
+        self._seen.clear()
+
+
+class EnvParse(Generic[T]):
+    """Memoized parse of one env var: ``parse(raw)`` runs only when the raw
+    string CHANGES (these knobs sit on eager hot paths — re-tokenizing an
+    unchanged var per call buys nothing); unset/empty returns ``empty``
+    without parsing. The parse callable owns its own malformed-value
+    handling (warn once, return a fallback) — memoization means its
+    warning naturally fires once per raw value."""
+
+    def __init__(self, var: str, parse: Callable[[str], T], empty: T) -> None:
+        self.var = var
+        self._parse = parse
+        self._empty = empty
+        self._cache: Tuple[str, T] = ("", empty)
+
+    def __call__(self) -> T:
+        raw = os.environ.get(self.var, "").strip()
+        if not raw:
+            return self._empty
+        if raw == self._cache[0]:
+            return self._cache[1]
+        value = self._parse(raw)
+        self._cache = (raw, value)
+        return value
+
+    def reset(self) -> None:
+        self._cache = ("", self._empty)

@@ -56,10 +56,9 @@ binned_counters     xla | pallas |                binned precision/recall
 """
 import contextlib
 import importlib
-import os
-from typing import Any, Callable, Dict, Iterator, Optional, Set, Tuple
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
-from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 
 __all__ = [
     "KernelOp",
@@ -121,7 +120,7 @@ class KernelOp:
 
 _OPS: Dict[str, KernelOp] = {}
 _OVERRIDES: Dict[str, str] = {}
-_WARNED: Set[Tuple[Any, ...]] = set()
+_warn_once = WarnOnce()
 _IMPLS_ENSURED = False
 
 
@@ -153,29 +152,11 @@ def _ensure_impls() -> None:
         importlib.import_module(mod)
 
 
-def _warn_once(key: Tuple[Any, ...], msg: str) -> None:
-    if key in _WARNED:
-        return
-    _WARNED.add(key)
-    rank_zero_warn(msg, UserWarning)
-
-
-_ENV_CACHE: Tuple[str, Dict[str, str]] = ("", {})
-
-
-def _env_choices() -> Dict[str, str]:
+def _parse_env_choices(raw: str) -> Dict[str, str]:
     """Parse ``METRICS_TPU_KERNEL_BACKEND``: comma-separated tokens, bare
     token = global choice (key ``"*"``), ``op=choice`` = per-op. Malformed
     tokens warn once and are ignored (same stance as
-    ``METRICS_TPU_EAGER_WARN_ROWS``). The parse is memoized on the raw
-    string — dispatch runs on eager hot paths, and re-tokenizing an
-    unchanged var per call buys nothing."""
-    global _ENV_CACHE
-    raw = os.environ.get(_ENV_VAR, "").strip()
-    if not raw:
-        return {}
-    if raw == _ENV_CACHE[0]:
-        return _ENV_CACHE[1]
+    ``METRICS_TPU_EAGER_WARN_ROWS``)."""
     choices: Dict[str, str] = {}
     for tok in raw.split(","):
         tok = tok.strip()
@@ -206,8 +187,12 @@ def _env_choices() -> Dict[str, str]:
                 )
         else:
             choices["*"] = tok
-    _ENV_CACHE = (raw, choices)
     return choices
+
+
+# memoized on the raw string — dispatch runs on eager hot paths, and
+# re-tokenizing an unchanged var per call buys nothing
+_env_choices: "EnvParse[Dict[str, str]]" = EnvParse(_ENV_VAR, _parse_env_choices, {})
 
 
 def _requested(op_name: str) -> Tuple[str, str]:
@@ -328,7 +313,6 @@ def reset_dispatch_state() -> None:
     """Clear overrides, the warn-once memory, AND the memoized env parse
     (test isolation — the fallback warning must be observable per test,
     not per process, and a cached parse would skip its warn-once)."""
-    global _ENV_CACHE
     _OVERRIDES.clear()
-    _WARNED.clear()
-    _ENV_CACHE = ("", {})
+    _warn_once.reset()
+    _env_choices.reset()

@@ -1,0 +1,255 @@
+"""Padding-tier capacity ladder: ragged batches compile to a FIXED set of graphs.
+
+Production traffic sends batch shapes the compiler has never seen — and
+under jit every fresh leading dimension is a fresh trace, a fresh compile,
+and (for state-carrying paths) a fresh cache entry in every downstream
+consumer. This module bounds that: any incoming batch size pads **up** to
+one of a fixed ladder of capacities, so a sweep of arbitrary ragged sizes
+compiles at most ``len(ladder)`` graphs (the budget
+``analysis/registry.py`` pins via ``audit_recompilation``'s ladder sweep).
+
+Ladder resolution (the established ``METRICS_TPU_*`` env-var contract —
+same stance as ``ops/dispatch.py``):
+
+- ``METRICS_TPU_PAD_LADDER`` unset/empty → **pow-2 mode**: tier =
+  ``next_pow2(n)``. Unbounded sizes still hit only ``O(log max_n)`` tiers.
+- ``METRICS_TPU_PAD_LADDER="64,256,1024"`` → the explicit ascending ladder;
+  the smallest tier ``>= n`` wins. A batch larger than every tier warns
+  once and falls back to ``next_pow2(n)`` (degrades the graph-count budget,
+  never correctness).
+- A malformed value (non-integer token, non-positive tier) warns once and
+  falls back to pow-2 mode entirely — a bad env var degrades compile
+  reuse, never correctness.
+
+The parse is memoized on the raw string and resolution happens at **call
+time** (trace time under jit), like every other ``METRICS_TPU_*`` knob:
+changing the var does not invalidate already-cached jits.
+
+**Pad-row invisibility.** Padding alone would poison accumulators, so pad
+rows ride the framework's existing row-mask machinery: every padded call
+carries a ``valid`` mask (real rows True, pad rows False) that the update
+consumes — capacity-mode metrics mask the rows out of their ring states,
+stat-scores-family metrics (``_valid_mask_always``) zero the rows'
+tp/fp/tn/fn contributions before the reduce — and the pad count lands in
+the fault channel's ``padded_rows`` class (informational: it never trips
+``on_invalid='warn'/'error'`` and never flips ``health_report``'s
+``degraded`` flag). Pad VALUES are all-zeros — always clean under the
+traced validators (zero probabilities, label 0) — so the guard counts real
+faults only, and the ``valid`` mask alone decides visibility.
+
+Module import performs python work only (no jax calls, no device arrays —
+the hang-proof bootstrap contract, ``utilities/backend.py``).
+"""
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+
+__all__ = [
+    "pad_ladder",
+    "next_pow2",
+    "tier_for",
+    "pad_rows",
+    "pad_update_args",
+    "supports_row_mask",
+    "reset_padding_state",
+]
+
+_ENV_VAR = "METRICS_TPU_PAD_LADDER"
+
+_warn_once = WarnOnce()
+
+
+def _parse_ladder(raw: str) -> Optional[Tuple[int, ...]]:
+    try:
+        tiers = sorted({int(tok.strip()) for tok in raw.split(",") if tok.strip()})
+        if not tiers or any(t < 1 for t in tiers):
+            raise ValueError("tiers must be positive integers")
+        return tuple(tiers)
+    except ValueError:
+        _warn_once(
+            ("env-malformed", raw),
+            f"{_ENV_VAR}={raw!r} is malformed (expected comma-separated positive "
+            "integers, e.g. '64,256,1024'); falling back to the pow-2 ladder",
+        )
+        return None
+
+
+_ladder_env: "EnvParse[Optional[Tuple[int, ...]]]" = EnvParse(_ENV_VAR, _parse_ladder, None)
+
+
+def pad_ladder() -> Optional[Tuple[int, ...]]:
+    """The configured capacity ladder (ascending, deduplicated), or ``None``
+    for pow-2 mode. Malformed values warn once and fall back to ``None``."""
+    return _ladder_env()
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two ``>= n`` (``n >= 1``)."""
+    return 1 << max(0, int(n) - 1).bit_length() if n > 1 else 1
+
+
+def tier_for(n: int, ladder: Optional[Sequence[int]] = None) -> int:
+    """The padded capacity for an ``n``-row batch.
+
+    ``ladder=None`` reads :func:`pad_ladder` (the env var); pass an explicit
+    sequence to pin the ladder programmatically (tests, the multichip
+    dryrun). A batch above the top tier warns once and rounds up to the
+    next power of two instead — oversize traffic degrades the graph-count
+    budget, never drops data.
+    """
+    if n < 1:
+        raise ValueError(f"batch must have at least one row, got {n}")
+    lad = pad_ladder() if ladder is None else tuple(ladder)
+    if lad:
+        for t in lad:
+            if t >= n:
+                return t
+        _warn_once(
+            ("above-ladder", lad[-1]),
+            f"batch of {n} rows exceeds the top padding tier {lad[-1]} "
+            f"(ladder {lad}); padding to the next power of two instead — "
+            "each distinct oversize pow-2 tier compiles one extra graph",
+        )
+    return next_pow2(n)
+
+
+def _row_count(value: Any) -> Optional[int]:
+    """Concrete leading-axis length of an array-like, else None."""
+    shape = getattr(value, "shape", None)
+    if shape is None or len(shape) < 1:
+        return None
+    try:
+        return int(shape[0])
+    except TypeError:
+        return None  # polymorphic/dynamic dim — nothing to pad
+
+
+def _pad_host(a: Any, n: int, tier: int) -> Any:
+    """Zero-pad one array's leading axis to ``tier`` rows ON HOST.
+
+    numpy, deliberately: padding runs outside the jit boundary, and eager
+    on-device ops (``jnp.concatenate`` at every distinct ragged shape)
+    would compile one tiny XLA program per incoming batch size — the exact
+    unbounded-compile failure the ladder exists to prevent, relocated
+    instead of removed (measured 100x the whole update's latency under
+    mixed ragged traffic). Serving requests are host-born; a device-array
+    input pays one host round trip here and skips it thereafter.
+    """
+    arr = np.asarray(a)
+    out = np.zeros((tier,) + arr.shape[1:], arr.dtype)
+    out[:n] = arr
+    return out
+
+
+def _canon(v: Any) -> Any:
+    """Canonicalize one update argument to a jax array. Every padded call
+    must present the SAME argument types to the jit cache: jax keys numpy
+    and jax-array arguments differently, so a mix (padded numpy vs
+    exact-tier passthrough) would compile each tier twice and silently
+    double the ``len(ladder)`` graph budget."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(v)
+
+
+def pad_rows(
+    arrays: Sequence[Any],
+    valid: Optional[Any] = None,
+    ladder: Optional[Sequence[int]] = None,
+) -> Tuple[Tuple[Any, ...], Any]:
+    """Pad every array's leading axis up to the ladder tier with zero rows.
+
+    Returns ``(padded_arrays, valid_mask)`` where ``valid_mask`` is the
+    bool ``(tier,)`` row mask — ``valid`` (or all-True) for the real rows,
+    False for the pad rows. All arrays must share the leading length.
+    Padding is host-side numpy (see :func:`_pad_host`). The functional
+    building block behind :func:`pad_update_args`; use it directly with
+    ``functionalize``d metrics::
+
+        (p, t), mask = pad_rows((preds, target))
+        state = jitted_update(state, p, t, valid=mask)   # one graph per tier
+    """
+    ns = {_row_count(a) for a in arrays}
+    ns.discard(None)
+    if len(ns) != 1:
+        raise ValueError(f"pad_rows needs row-aligned arrays, got leading lengths {sorted(ns)}")
+    n = ns.pop()
+    tier = tier_for(n, ladder)
+    mask = np.zeros((tier,), bool)
+    mask[:n] = True if valid is None else np.asarray(valid, bool)
+    if tier == n:
+        return tuple(_canon(a) for a in arrays), _canon(mask)
+    return tuple(_canon(_pad_host(a, n, tier)) for a in arrays), _canon(mask)
+
+
+def supports_row_mask(metric: Any) -> bool:
+    """True when ``metric``'s update can provably hide pad rows: it accepts
+    a ``valid`` row mask it actually consumes (capacity-mode ring metrics,
+    or classes declaring ``_valid_mask_always`` — the stat-scores family),
+    or it is a kwargs-forwarding wrapper over such a metric (the streaming
+    wrappers). Delegates to the drop guard's capability predicate — one
+    definition of "consumes a row mask" for both subsystems."""
+    from metrics_tpu.utilities.guard import _consumes_valid_mask
+
+    return _consumes_valid_mask(metric)
+
+
+def pad_update_args(metric: Any, args: tuple, kwargs: dict) -> Tuple[tuple, dict, int]:
+    """Apply the padding ladder to one module-runtime update call.
+
+    Pads every row-aligned array argument up to the tier (host-side — see
+    :func:`_pad_host`), folds the pad mask into the ``valid`` kwarg (AND-ed
+    with any caller-provided mask), and returns ``(args, kwargs,
+    n_padded)``. Raises when the metric cannot consume a row mask — padding
+    without provable invisibility would be silent corruption, so an
+    unsupported configuration fails loudly at the first update instead.
+    """
+    from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+    n = None
+    for v in list(args) + [v for k, v in kwargs.items() if k != "valid"]:
+        n = _row_count(v)
+        if n is not None:
+            break
+    if n is None or n < 1:
+        return args, kwargs, 0  # scalar/row-less call: nothing to pad
+    prior = kwargs.get("valid")
+    # NOTE: an exact-tier batch still gets an (all-True) mask — otherwise
+    # tier-N traffic would compile a second, maskless variant of the same
+    # tier's graph and the "len(ladder) graphs" budget would double
+    if not supports_row_mask(metric):
+        raise MetricsTPUUserError(
+            f"{type(metric).__name__}(pad_batches=True): this metric's update cannot "
+            "consume a `valid` row mask, so padded rows could not be provably masked "
+            "out of its accumulators. Use a capacity-mode metric, a stat-scores-family "
+            "metric, or disable pad_batches."
+        )
+
+    # one pad_rows call over the row-aligned subset (scalars and static
+    # config pass through untouched) keeps this path and the functional
+    # pad_rows path a single implementation
+    row_args = [i for i, v in enumerate(args) if _row_count(v) == n]
+    row_kwargs = [k for k, v in kwargs.items() if k != "valid" and _row_count(v) == n]
+    padded, mask = pad_rows(
+        [args[i] for i in row_args] + [kwargs[k] for k in row_kwargs], valid=prior
+    )
+    new_args = list(args)
+    for i, v in zip(row_args, padded):
+        new_args[i] = v
+    new_kwargs: Dict[str, Any] = dict(kwargs)
+    for k, v in zip(row_kwargs, padded[len(row_args):]):
+        new_kwargs[k] = v
+    new_kwargs["valid"] = mask
+    # the pad count comes from the mask pad_rows ACTUALLY built — a separate
+    # tier_for(n) here could race a concurrent env-var/reset change in
+    # another serve worker and misstate padded_rows vs the applied mask
+    return tuple(new_args), new_kwargs, int(mask.shape[0]) - n
+
+
+def reset_padding_state() -> None:
+    """Clear the warn-once memory and the memoized env parse (test
+    isolation — same contract as ``dispatch.reset_dispatch_state``)."""
+    _warn_once.reset()
+    _ladder_env.reset()

@@ -30,6 +30,10 @@ from typing import Any, Dict, List, Optional
 #   forced_cpu             METRICS_TPU_FORCE_CPU / probe fallback re-pointed jax at CPU
 #   gather_degraded        multihost gather fell back to local-only state
 #   snapshot_fallback      a corrupt/incomplete snapshot was skipped for an older intact one
+#   overload_shed          a ServeLoop ingest queue was full and a request was shed
+#                          (metrics_tpu/serving — graceful overload degradation, counted
+#                          so accepted + shed always reconciles with offered)
+#   serve_update_error     a ServeLoop worker's update raised; the request was dropped
 _MAX_EVENTS = 256
 
 
@@ -94,9 +98,23 @@ def _metric_health(metric: Any) -> Dict[str, Any]:
     entry: Dict[str, Any] = {}
     faults = getattr(metric, "fault_counts", None)
     if faults:
-        nonzero = {k: v for k, v in faults.items() if v}
+        # function-level import: guard pulls in jax, and this module must
+        # stay importable with the jax stack wedged — but reaching here
+        # means the caller passed a constructed Metric, so jax is already up
+        from metrics_tpu.utilities.guard import INFORMATIONAL_FAULT_CLASSES
+
+        nonzero = {
+            k: v for k, v in faults.items() if v and k not in INFORMATIONAL_FAULT_CLASSES
+        }
         if nonzero:
             entry["faults"] = nonzero
+        # informational classes (padding is normal serving operation):
+        # reported — the pad volume is an interesting operational number —
+        # but never `degraded`
+        for name in INFORMATIONAL_FAULT_CLASSES:
+            count = faults.get(name)
+            if count:
+                entry[name] = count
     dropped = getattr(metric, "dropped_count", None)
     if dropped:
         entry["overflow_dropped"] = dropped

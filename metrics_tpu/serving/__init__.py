@@ -1,0 +1,10 @@
+"""Serving hardening: thread-safe serve loop with overload shedding.
+
+See :mod:`metrics_tpu.serving.loop` for the design (thread-confined replica
+accumulation, merged stale-view reads, shed-on-full ingest) and
+:mod:`metrics_tpu.ops.padding` for the padding-tier capacity ladder that
+keeps ragged request sizes from recompiling the serving graphs.
+"""
+from metrics_tpu.serving.loop import ServeLoop  # noqa: F401
+
+__all__ = ["ServeLoop"]

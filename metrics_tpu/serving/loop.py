@@ -1,0 +1,572 @@
+"""Thread-safe serving loop: lock-free accumulation, merged reads, shed-on-full.
+
+The module runtime (``metric.py``) is deliberately single-threaded: two
+request threads calling ``metric.update`` concurrently race on
+``Metric._state`` (the eager path swaps state per-key — a reader can see a
+torn update). This module is the serving answer, built from three rules:
+
+1. **Accumulation is thread-confined.** Each worker thread owns a full
+   replica (clone) of the served metric and is the only thread that ever
+   updates it — no locks on the request path. After every update the worker
+   *publishes* an immutable snapshot of its replica's state (jax arrays are
+   immutable; publication is one list-slot assignment, atomic under the
+   GIL), so readers never observe a half-applied update.
+2. **Reads merge, never block ingestion.** A background reducer folds the
+   published snapshots through the framework's existing merge rules —
+   ``Metric._reduce_states`` (weighted by each replica's update count for
+   'mean' states) and the sketches' own ``sketch_merge`` — into a fresh
+   reporter clone and computes it. ``report()`` serves the latest reduced
+   view with its ``staleness_s``; ``report(fresh=True, deadline_s=...)``
+   requests a reduce and waits at most the deadline, falling back to the
+   stale view — the serving path never blocks behind a merge/collective
+   (the T3 stance: stale-but-already-reduced beats fresh-but-blocking).
+3. **Overload sheds loudly.** Ingestion is a bounded queue; ``offer`` on a
+   full queue drops the request, counts it, and records an
+   ``overload_shed`` event in the process-wide :class:`HealthRegistry`, so
+   ``accepted + shed == offered`` always reconciles in ``health_report()``
+   — graceful degradation under spike load is counted, never silent.
+
+Pair with ``Metric(pad_batches=True)`` (``ops/padding.py``) so ragged
+request sizes compile at most ``len(ladder)`` graphs per replica, and with
+a :class:`~metrics_tpu.resilience.snapshot.SnapshotManager` for periodic
+crash-safe snapshots: each worker replica saves as one rank of a
+``world_size=workers`` group, so the standard elastic restore path merges
+them back at ANY new worker count (or into a single offline metric).
+"""
+import copy
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from metrics_tpu.resilience.health import health_report, record_degradation
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+__all__ = ["ServeLoop"]
+
+# snapshot form of one replica: {member_name: (state_dict, update_count, attrs)}
+# where attrs maps child-metric paths ("" = the member itself) to the
+# data-inferred `_snapshot_attrs` at that path (e.g. an input-mode enum
+# resolved at the first update — a wrapper's wrapped child carries its own).
+# Without them a fresh reporter clone could merge the state but not
+# compute() it.
+_Snapshot = Dict[str, Tuple[Dict[str, Any], int, Dict[str, Dict[str, Any]]]]
+
+
+def _inferred_attrs(m: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
+    """Data-inferred ``_snapshot_attrs`` of a metric and (recursively) its
+    child metrics, keyed by dotted child path."""
+    out: Dict[str, Dict[str, Any]] = {}
+    attrs = {a: getattr(m, a) for a in m._snapshot_attrs if getattr(m, a, None) is not None}
+    if attrs:
+        out[prefix] = attrs
+    for name, child in m._named_child_metrics():
+        out.update(_inferred_attrs(child, f"{prefix}.{name}" if prefix else name))
+    return out
+
+
+def _apply_inferred_attrs(m: Any, attrs_by_path: Dict[str, Dict[str, Any]]) -> None:
+    """First non-None wins, matching the update path's own
+    infer-once-then-keep behavior; unknown paths are skipped (a config
+    mismatch surfaces through the state merge, not here)."""
+    children = None
+    for path, attrs in attrs_by_path.items():
+        target = m
+        if path:
+            if children is None:
+                children = dict(m._named_child_metrics())
+            head = path.split(".", 1)
+            if head[0] not in children:
+                continue
+            _apply_inferred_attrs(children[head[0]], {head[1] if len(head) > 1 else "": attrs})
+            continue
+        for a, v in attrs.items():
+            if getattr(target, a, None) is None:
+                setattr(target, a, v)
+
+
+def _attr_cells(m: Any) -> List[Tuple[Any, str, Any]]:
+    """``(owner, attr, value)`` cells for every ``_snapshot_attrs`` slot of a
+    metric and (recursively) its child metrics — INCLUDING still-None slots,
+    so a rollback can un-set attrs a failed update inferred (e.g. Accuracy's
+    ``mode``, or its ``subset_accuracy`` flip) before failing."""
+    out: List[Tuple[Any, str, Any]] = [(m, a, getattr(m, a, None)) for a in m._snapshot_attrs]
+    for _, child in m._named_child_metrics():
+        out.extend(_attr_cells(child))
+    return out
+
+
+def _is_collection(obj: Any) -> bool:
+    return hasattr(obj, "_modules") and hasattr(obj, "items")
+
+
+def _clone(obj: Any) -> Any:
+    new = copy.deepcopy(obj)
+    new.reset()
+    return new
+
+
+def _members(obj: Any) -> List[Tuple[str, Any]]:
+    """(name, Metric) pairs — one ("", obj) pair for a plain metric.
+    ``copy_state=False``: read-only sweeps over a (possibly compute-group
+    aliased) collection, same stance as ``health_report``."""
+    if _is_collection(obj):
+        return list(obj.items(keep_base=True, copy_state=False))
+    return [("", obj)]
+
+
+def _snapshot_of(obj: Any) -> _Snapshot:
+    """A consistent, immutable state snapshot of one replica. Taken by the
+    thread that owns the replica (between updates), so it never tears."""
+    return {
+        name: (m._copy_state(), m._update_count, _inferred_attrs(m)) for name, m in _members(obj)
+    }
+
+
+def _fold_snapshot(target: Any, snap: _Snapshot) -> None:
+    """Merge one published snapshot into ``target`` through the framework's
+    merge rules: ``_reduce_states`` with the replica's update count as the
+    weight (exact for sum/cat/max/min/FaultCounters; count-weighted for
+    'mean' states; sketches union through ``sketch_merge``). Data-inferred
+    attrs (``_snapshot_attrs`` — e.g. Accuracy's input ``mode``) carry over
+    too: first non-None wins, matching the update path's own
+    infer-once-then-keep behavior."""
+    for name, m in _members(target):
+        state, count, attrs = snap[name]
+        if count == 0:
+            continue
+        _apply_inferred_attrs(m, attrs)
+        merged = m._reduce_states(m._copy_state(), state, m._update_count, batch_count=count)
+        object.__setattr__(m, "_state", merged)
+        m._update_count += count
+        m._update_called = True
+        m._computed = None
+
+
+class ServeLoop:
+    """Serve a metric (or ``MetricCollection``) under concurrent traffic.
+
+    Example::
+
+        loop = ServeLoop(Accuracy(num_classes=10, on_invalid="drop",
+                                  pad_batches=True), workers=4)
+        ok = loop.offer(preds, target)        # False = shed (queue full)
+        view = loop.report()                   # last reduced value + staleness_s
+        view = loop.report(fresh=True, deadline_s=0.2)  # bounded wait
+        loop.stop()
+
+    ``metric`` is used as the pristine prototype: every worker gets a fresh
+    clone, and reads merge the clones — the caller's instance is never
+    touched by the loop's threads.
+
+    **Windowed members.** A served :class:`~metrics_tpu.WindowedMetric`
+    keeps its time-bucket ring per replica, and replicas rotate buckets at
+    their own head positions — so the merged view is the SUM of per-worker
+    trailing windows, covering between ``window`` (all traffic on one
+    worker) and ``workers * window`` (even spread) rows of global traffic,
+    not a global trailing ``window``. Size ``window`` as a per-worker
+    budget (``global_budget / workers``) when a fixed global span matters.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        workers: int = 2,
+        queue_size: int = 256,
+        reduce_every_s: float = 0.25,
+        snapshot_manager: Optional[Any] = None,
+        snapshot_every_s: Optional[float] = None,
+    ) -> None:
+        if workers < 1:
+            raise ValueError(f"`workers` must be >= 1, got {workers}")
+        if queue_size < 1:
+            raise ValueError(f"`queue_size` must be >= 1, got {queue_size}")
+        if snapshot_every_s is not None and snapshot_manager is None:
+            raise ValueError("`snapshot_every_s` needs a `snapshot_manager`")
+        self.workers = workers
+        self.reduce_every_s = float(reduce_every_s)
+        self._proto = metric
+        self._replicas = [_clone(metric) for _ in range(workers)]
+        self._published: List[Optional[_Snapshot]] = [None] * workers
+        self._base_snap: Optional[_Snapshot] = None  # restored pre-crash state
+
+        self._queue: "queue.Queue[Tuple[tuple, dict]]" = queue.Queue(maxsize=queue_size)
+        self._stats_lock = threading.Lock()
+        self._offered = 0
+        self._accepted = 0
+        self._shed = 0
+        self._processed = 0
+        self._failed = 0
+
+        self._view: Optional[Dict[str, Any]] = None
+        self._publish_seq = 0  # bumped on every worker publish (stats lock)
+        self._reduced_seq = -1  # publish_seq covered by the current view
+        self._view_covered = -1  # publish_seq the CURRENT view is known to cover
+        self._stopping = False  # set under _stats_lock: offer/stop handshake
+        self._view_cv = threading.Condition()
+        self._last_reporter: Optional[Any] = None
+        self._reduce_request = threading.Event()
+        # two-phase shutdown: workers stop (after draining the backlog)
+        # BEFORE the reducer runs its final pass — one shared event let the
+        # reducer's "final" reduce race ahead of workers still mid-backlog,
+        # permanently orphaning their later publishes from report()
+        self._stop_workers = threading.Event()
+        self._stop_reducer = threading.Event()
+
+        self._snapshot_mgr = snapshot_manager
+        self._snapshot_every_s = snapshot_every_s
+        self._snapshot_step = itertools.count(1)
+        self._last_snapshot_unix = time.time()
+
+        self._threads = [
+            threading.Thread(target=self._worker, args=(i,), daemon=True, name=f"serve-worker-{i}")
+            for i in range(workers)
+        ]
+        self._threads.append(
+            threading.Thread(target=self._reducer, daemon=True, name="serve-reducer")
+        )
+        for t in self._threads:
+            t.start()
+
+    # -- ingestion ------------------------------------------------------
+
+    def offer(self, *args: Any, **kwargs: Any) -> bool:
+        """Enqueue one update batch; returns False when the batch was SHED
+        (queue full — counted, health-recorded, never silent)."""
+        # the count AND the enqueue happen under one lock hold: a request
+        # counted accepted is already queued, so stop()'s drain (which reads
+        # the same counters before _stop is ever set) can never let a racing
+        # offer slip a batch in after the workers have exited — and
+        # ``accepted + shed == offered`` holds at every instant. put_nowait
+        # never blocks, and nobody nests the queue's lock around
+        # ``_stats_lock``, so holding both here cannot deadlock.
+        shed = None
+        with self._stats_lock:
+            if self._stopping:
+                raise MetricsTPUUserError("ServeLoop.offer called after stop()")
+            self._offered += 1
+            try:
+                self._queue.put_nowait((args, kwargs))
+                self._accepted += 1
+            except queue.Full:
+                self._shed += 1
+                shed = self._shed
+        if shed is not None:
+            record_degradation(
+                "overload_shed",
+                f"serve queue full ({self._queue.maxsize}); request shed",
+                shed_total=shed,
+                metric=type(self._proto).__name__,
+            )
+            return False
+        return True
+
+    def _worker(self, i: int) -> None:
+        replica = self._replicas[i]
+        while True:
+            try:
+                args, kwargs = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._stop_workers.is_set():
+                    return
+                continue
+            # the module runtime increments update counters (and may flip
+            # jittable_update off in its TypeError fallback) BEFORE the body
+            # can fail, and the eager fallback mutates state per-key — roll
+            # all of it back so a poison request leaves the replica exactly
+            # as it was: counts weight the 'mean' merge, and a torn state
+            # would poison every subsequent reduce. (_copy_state is a
+            # shallow copy over immutable jax arrays — cheap.)
+            bookkeeping = [
+                (m, m._copy_state(), m._update_count, m.jittable_update, _attr_cells(m))
+                for _, m in _members(replica)
+            ]
+            try:
+                replica.update(*args, **kwargs)
+            except Exception as err:  # noqa: BLE001 - one bad request must not kill the worker
+                for m, state, count, jittable, attr_cells in bookkeeping:
+                    object.__setattr__(m, "_state", state)
+                    m._update_count = count
+                    object.__setattr__(m, "jittable_update", jittable)
+                    # data-inferred attrs too: a malformed first batch that
+                    # set Accuracy's `mode` before raising would otherwise
+                    # poison the replica's mode check for all later traffic
+                    for owner, attr, value in attr_cells:
+                        setattr(owner, attr, value)
+                with self._stats_lock:
+                    self._failed += 1
+                record_degradation(
+                    "serve_update_error",
+                    f"worker {i} update raised {type(err).__name__}: {err}",
+                    metric=type(self._proto).__name__,
+                )
+            else:
+                # publish AFTER the update completes: one atomic slot write
+                # of an immutable snapshot — readers never see a torn state
+                self._published[i] = _snapshot_of(replica)
+                with self._stats_lock:
+                    self._publish_seq += 1
+            finally:
+                with self._stats_lock:
+                    self._processed += 1
+                self._queue.task_done()
+
+    # -- reduction / reads ----------------------------------------------
+
+    def _reduce_once(self, covered_seq: int) -> bool:
+        """One full clone + fold + compute pass. ``covered_seq`` is the
+        publish sequence number read BEFORE this pass swept ``_published``
+        — a lower bound on what the resulting view covers, recorded so
+        ``report(fresh=True)`` can wait for a view that provably includes
+        the publishes that existed when the caller asked."""
+        snaps = [s for s in ([self._base_snap] + list(self._published)) if s is not None]
+        reporter = _clone(self._proto)
+        try:
+            for snap in snaps:
+                _fold_snapshot(reporter, snap)
+            value = reporter.compute() if snaps else None
+        except Exception as err:  # noqa: BLE001 - e.g. on_invalid='error' firing at compute
+            record_degradation(
+                "serve_reduce_error",
+                f"reduce/compute raised {type(err).__name__}: {err}",
+                metric=type(self._proto).__name__,
+            )
+            return False  # keep serving the previous view
+        # fault counters of the merged view, per member (None when unguarded);
+        # bind the property once — each read is a device-to-host transfer
+        faults = {}
+        for name, m in _members(reporter):
+            fc = getattr(m, "fault_counts", None)
+            if fc:
+                faults[name or type(m).__name__] = fc
+        view = {
+            "value": value,
+            "computed_unix": time.time(),
+            "updates": sum(m._update_count for _, m in _members(reporter)),
+            "faults": faults,
+        }
+        self._last_reporter = reporter
+        with self._view_cv:
+            self._view = view
+            self._view_covered = max(self._view_covered, covered_seq)
+            self._view_cv.notify_all()
+        return True
+
+    def _reducer(self) -> None:
+        while True:
+            # the wait must also wake for the snapshot cadence: with only
+            # reduce_every_s as the timeout, snapshot_every_s shorter than
+            # the reduce cadence would silently stretch to it on an idle loop
+            timeout = self.reduce_every_s
+            if self._snapshot_every_s is not None:
+                due_in = self._last_snapshot_unix + self._snapshot_every_s - time.time()
+                timeout = max(0.0, min(timeout, due_in))
+            triggered = self._reduce_request.wait(timeout=timeout)
+            if triggered:
+                self._reduce_request.clear()
+            with self._stats_lock:
+                seq = self._publish_seq
+            # an idle loop must not burn a clone+fold+compute cycle every
+            # cadence tick re-deriving a bit-identical view; explicit
+            # requests (fresh=True, restore_snapshot) always reduce
+            if triggered or seq != self._reduced_seq:
+                # advance only on success: after a transient reduce error the
+                # next cadence tick must retry even with no new publish, or
+                # report() would serve an ever-staler view until fresh traffic
+                if self._reduce_once(seq):
+                    self._reduced_seq = seq
+            if (
+                self._snapshot_every_s is not None
+                and time.time() - self._last_snapshot_unix >= self._snapshot_every_s
+            ):
+                try:
+                    self.save_snapshot()
+                except Exception as err:  # noqa: BLE001 - snapshots degrade, never kill serving
+                    # stamp the attempt: a persistently failing writer retries
+                    # on the cadence instead of busy-spinning the zero timeout
+                    self._last_snapshot_unix = time.time()
+                    record_degradation(
+                        "serve_snapshot_error",
+                        f"periodic snapshot raised {type(err).__name__}: {err}",
+                    )
+            if self._stop_reducer.is_set():
+                # final view covers every processed batch — stop() only sets
+                # this event after the workers have joined, so every publish
+                # exists by now. Skip the pass when the reduce just above
+                # already covered the last publish (stop() triggers the
+                # event, so a quiet shutdown would otherwise run two
+                # identical ~full reduces back to back).
+                with self._stats_lock:
+                    seq = self._publish_seq
+                if seq != self._reduced_seq:
+                    self._reduce_once(seq)
+                return
+
+    def report(self, fresh: bool = False, deadline_s: float = 0.5) -> Dict[str, Any]:
+        """The merged metric value as last reduced, never blocking ingestion.
+
+        Default: return the latest reduced view immediately with its age
+        (``staleness_s``). ``fresh=True``: request an immediate reduce and
+        wait for it at most ``deadline_s`` — on timeout the STALE view comes
+        back (``fresh`` False in the result), which is the designed
+        degradation: a deadline miss costs freshness, not availability.
+        """
+        got_fresh = False
+        if fresh:
+            # "fresh" means: a view covering every publish that existed when
+            # this call was made. Waiting for *any* view swap would let a
+            # reduce already in flight (whose snapshot sweep predates the
+            # latest publishes) satisfy the wait with stale data.
+            with self._stats_lock:
+                target = self._publish_seq
+            with self._view_cv:
+                covered = lambda: self._view is not None and self._view_covered >= target
+                if covered():
+                    got_fresh = True  # already covered: no forced reduce
+                elif self._stop_reducer.is_set():
+                    got_fresh = False  # reducer exited: no fresher view can arrive
+                else:
+                    self._reduce_request.set()
+                    got_fresh = self._view_cv.wait_for(covered, timeout=max(0.0, deadline_s))
+        view = self._view
+        # hand out copies of the view's mutable containers: the same view
+        # dict serves every reader until the next reduce, so a caller
+        # mutating its result must not corrupt other readers
+        value = view["value"] if view else None
+        if isinstance(value, dict):
+            value = dict(value)
+        out: Dict[str, Any] = {
+            "value": value,
+            "updates": view["updates"] if view else 0,
+            "faults": {k: dict(v) for k, v in view["faults"].items()} if view else {},
+            "staleness_s": (max(0.0, time.time() - view["computed_unix"]) if view else None),
+            "fresh": bool(got_fresh),
+            "stats": self.stats(),
+        }
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        """Request accounting. Invariant: ``accepted + shed == offered``."""
+        with self._stats_lock:
+            return {
+                "offered": self._offered,
+                "accepted": self._accepted,
+                "shed": self._shed,
+                "processed": self._processed,
+                "failed": self._failed,
+                "queue_depth": self._queue.qsize(),
+            }
+
+    def health(self) -> Dict[str, Any]:
+        """``health_report()`` over the merged view plus serving counters
+        (shed events are already first-class registry events, so a shedding
+        loop reads ``degraded`` without this extra key)."""
+        rep = (
+            health_report(self._last_reporter)
+            if self._last_reporter is not None
+            else health_report()
+        )
+        view = self._view
+        rep["serving"] = {
+            **self.stats(),
+            "workers": self.workers,
+            "queue_capacity": self._queue.maxsize,
+            "report_staleness_s": (
+                max(0.0, time.time() - view["computed_unix"]) if view else None
+            ),
+        }
+        return rep
+
+    # -- lifecycle ------------------------------------------------------
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Wait until every ACCEPTED request has been processed (test/
+        shutdown helper); False on timeout."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._stats_lock:
+                done = self._processed >= self._accepted
+            if done:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def stop(self, drain: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop workers (optionally draining accepted requests first) and
+        run a final reduce so ``report()`` covers everything processed.
+
+        Shutdown is two-phase: workers finish the queue backlog and JOIN
+        before the reducer is told to run its final pass — even when
+        ``drain=False`` or the drain timed out, every batch a worker
+        processed makes it into the final view (a worker outliving its
+        join timeout is the one bounded exception; it is a daemon thread
+        and its later publishes are lost with the process)."""
+        with self._stats_lock:
+            self._stopping = True  # offers now raise; accepted set is final
+        if drain:
+            self.drain(timeout_s)
+        self._stop_workers.set()
+        for t in self._threads[:-1]:
+            t.join(timeout=timeout_s)
+        self._stop_reducer.set()
+        self._reduce_request.set()
+        self._threads[-1].join(timeout=timeout_s)
+
+    def __enter__(self) -> "ServeLoop":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- snapshots ------------------------------------------------------
+
+    def save_snapshot(self, step: Optional[int] = None) -> int:
+        """One crash-safe snapshot group: worker ``i``'s published state is
+        rank ``i`` of a ``world_size=workers`` group (the restored base, if
+        any, folds into rank 0), all through ``SnapshotManager``'s atomic,
+        checksummed writer — so restore works at ANY new worker count via
+        the standard elastic merge."""
+        if self._snapshot_mgr is None:
+            raise MetricsTPUUserError("ServeLoop has no snapshot_manager configured")
+        if step is None:
+            step = next(self._snapshot_step)
+        published = list(self._published)  # one consistent sweep
+        for i in range(self.workers):
+            scratch = _clone(self._proto)
+            if i == 0 and self._base_snap is not None:
+                _fold_snapshot(scratch, self._base_snap)
+            if published[i] is not None:
+                _fold_snapshot(scratch, published[i])
+            self._snapshot_mgr.save(scratch, step=step, rank=i, world_size=self.workers)
+        self._last_snapshot_unix = time.time()
+        return step
+
+    def restore_snapshot(self) -> Dict[str, Any]:
+        """Load the newest intact snapshot group (any saved world size) as
+        the serve loop's base state: it joins every subsequent reduce and
+        the rank-0 slot of every subsequent snapshot.
+
+        Restore must happen BEFORE the loop serves traffic (the crash-
+        recovery startup path). On a loop whose workers have already
+        published, the restored base would contain the same updates the
+        replicas still hold and every later reduce would count them twice —
+        so that call refuses instead."""
+        if self._snapshot_mgr is None:
+            raise MetricsTPUUserError("ServeLoop has no snapshot_manager configured")
+        if any(s is not None for s in self._published):
+            raise MetricsTPUUserError(
+                "ServeLoop.restore_snapshot on a loop that has already served traffic: "
+                "the restored base would double-count the replicas' published updates. "
+                "Restore into a fresh ServeLoop before offering requests."
+            )
+        base = _clone(self._proto)
+        info = self._snapshot_mgr.restore(base, rank=0, world_size=1)
+        self._base_snap = _snapshot_of(base)
+        # the base joins the coverage accounting: bump the publish sequence so
+        # the cadence reducer picks it up and report(fresh=True) waits for a
+        # view that provably includes it
+        with self._stats_lock:
+            self._publish_seq += 1
+        self._reduce_request.set()
+        return info

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import _TRACE_ERRORS, Metric
+from metrics_tpu.utilities.checks import _is_concrete
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 from metrics_tpu.utilities.prints import rank_zero_warn
 
@@ -149,15 +150,36 @@ class _StreamingWrapper(Metric):
     def fault_counts(self) -> Optional[Dict[str, int]]:
         """The wrapped metric's fault counters under this wrapper's
         aggregation (windowed counters expire with their bucket; decayed
-        counters never decay). ``None`` when the child is unguarded or the
-        state is traced — same contract as ``Metric.fault_counts``."""
-        from metrics_tpu.utilities.guard import FAULT_CLASSES
+        counters never decay), plus the wrapper's OWN counters when it has
+        any (``pad_batches=True`` records ``padded_rows`` at the wrapper
+        level — pads never expire, they are bookkeeping, not stream
+        evidence). ``None`` when neither channel exists or the state is
+        traced — same contract as ``Metric.fault_counts``."""
+        from metrics_tpu.utilities.guard import FAULT_CLASSES, INFORMATIONAL_FAULT_CLASSES
 
         counts = self._aggregated_fault_counts()
-        if counts is None:
+        own = self._state.get("_faults")
+        if counts is None and own is None:
             return None
         try:
-            host = np.asarray(counts)
+            host = np.zeros(len(FAULT_CLASSES), np.int64)
+            if counts is not None:
+                host += np.asarray(counts).astype(np.int64)
+            if own is not None:
+                own_host = np.asarray(own.counts).astype(np.int64)
+                if counts is not None and self.on_invalid in ("warn", "error"):
+                    # a counting-only wrapper guard saw the same rows the
+                    # propagated child guard counted into the ring — adding
+                    # its validator classes would double-count every fault.
+                    # Only the wrapper-level pad bookkeeping is unique to
+                    # `own` here. (Under 'drop' the wrapper guard CONSUMES
+                    # the faulty rows — the child sees clean data, the ring
+                    # stays empty, and `own` is the authoritative channel.)
+                    keep = np.array(
+                        [name in INFORMATIONAL_FAULT_CLASSES for name in FAULT_CLASSES]
+                    )
+                    own_host = np.where(keep, own_host, 0)
+                host += own_host
         except _TRACE_ERRORS:
             return None
         return {name: int(host[i]) for i, name in enumerate(FAULT_CLASSES)}
@@ -175,9 +197,9 @@ class _StreamingWrapper(Metric):
             host = np.asarray(counts).astype(np.int64)
         except _TRACE_ERRORS:
             return
-        total = int(host.sum())
-        from metrics_tpu.utilities.guard import format_fault_report
+        from metrics_tpu.utilities.guard import actionable_fault_total, format_fault_report
 
+        total = actionable_fault_total(host)
         owner = f"{type(self).__name__}({type(self.wrapped).__name__})"
         if policy == "error":
             if total > 0:
@@ -191,6 +213,22 @@ class _StreamingWrapper(Metric):
     def reset(self) -> None:
         super().reset()
         self.wrapped.reset()
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        super().__setstate__(state)
+        # pickles from builds with fewer fault classes: Metric.__setstate__
+        # widens the raw ``win___faults``/``dec___faults`` state rings, but
+        # the windowed per-state identity rows live in a plain attribute and
+        # must widen with them or the first bucket rotation shape-mismatches
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES
+
+        idents = self.__dict__.get("_identities")
+        if idents:
+            for name, kind in self._specs.items():
+                v = idents.get(name)
+                if kind == "faults" and v is not None and v.shape[-1] < NUM_FAULT_CLASSES:
+                    pad = jnp.zeros((NUM_FAULT_CLASSES - v.shape[-1],), v.dtype)
+                    idents[name] = jnp.concatenate([v, pad])
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.wrapped!r})"
@@ -257,20 +295,39 @@ class WindowedMetric(_StreamingWrapper):
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         n = _leading_rows(args, kwargs)
-        if n > self.bucket_len and not self.__dict__.get("_batch_span_warned"):
-            # n is static (a shape), so this fires at trace/call time, once:
-            # oversized batches make the covered span buckets*batch instead
-            # of `window` — defined behavior, but never silent
-            object.__setattr__(self, "_batch_span_warned", True)
-            rank_zero_warn(
-                f"{type(self).__name__}({type(self.wrapped).__name__}): update batches of "
-                f"{n} rows exceed the {self.bucket_len}-row bucket quota (window={self.window}, "
-                f"buckets={self.buckets}); each batch fills a whole bucket, so the covered span "
-                f"grows toward {self.buckets * n} rows instead of {self.window}. Size `buckets` "
-                "so window/buckets is at least the batch size (check `window_rows` for the span "
-                "actually covered).",
-                UserWarning,
-            )
+        # the span warning judges REAL rows: under the padding ladder (or an
+        # explicit mask) a 70-row request padded to a 128-row tier consumes
+        # 70 rows of quota, and warning on 128 would be false. A traced mask
+        # has no concrete popcount — skip the warning rather than guess. The
+        # popcount is a blocking host read, so it only runs while the warning
+        # can still fire: n bounds n_real from above, and warn-once means
+        # a fired warning ends the check for the metric's lifetime.
+        if (
+            n is not None
+            and n > self.bucket_len
+            and not self.__dict__.get("_batch_span_warned")
+        ):
+            n_real: Optional[int] = n
+            valid_in = kwargs.get("valid")
+            if valid_in is not None:
+                if _is_concrete(valid_in):
+                    n_real = int(np.asarray(valid_in).astype(bool).sum())
+                else:
+                    n_real = None
+            if n_real is not None and n_real > self.bucket_len:
+                # n_real is concrete, so this fires at trace/call time, once:
+                # oversized batches make the covered span buckets*batch
+                # instead of `window` — defined behavior, but never silent
+                object.__setattr__(self, "_batch_span_warned", True)
+                rank_zero_warn(
+                    f"{type(self).__name__}({type(self.wrapped).__name__}): update batches of "
+                    f"{n_real} rows exceed the {self.bucket_len}-row bucket quota (window={self.window}, "
+                    f"buckets={self.buckets}); each batch fills a whole bucket, so the covered span "
+                    f"grows toward {self.buckets * n_real} rows instead of {self.window}. Size `buckets` "
+                    "so window/buckets is at least the batch size (check `window_rows` for the span "
+                    "actually covered).",
+                    UserWarning,
+                )
         delta = self._delta_state(args, kwargs)
         B = self.buckets
         head = self.win__head
@@ -297,13 +354,18 @@ class WindowedMetric(_StreamingWrapper):
             else:
                 add = lambda r, v: r.at[head].add(v)
             setattr(self, ring_name, roll(getattr(self, ring_name), self._identities[name], add, leaf))
+        # row accounting counts REAL rows: under the padding ladder (or an
+        # explicit `valid` mask) pad/masked rows contribute no delta, so
+        # they must not consume window quota either
+        valid = kwargs.get("valid")
+        rows = jnp.asarray(valid, bool).sum().astype(jnp.int32) if valid is not None else jnp.int32(n)
         self.win__n_updates = roll(
             self.win__n_updates, jnp.zeros((), jnp.int32), lambda r, v: r.at[head].add(v), jnp.int32(1)
         )
         self.win__rows = roll(
-            self.win__rows, jnp.zeros((), jnp.int32), lambda r, v: r.at[head].add(v), jnp.int32(n)
+            self.win__rows, jnp.zeros((), jnp.int32), lambda r, v: r.at[head].add(v), rows
         )
-        self.win__fill = jnp.where(rotate, 0, fill) + n
+        self.win__fill = jnp.where(rotate, 0, fill) + rows
         self.win__head = head
 
     def _window_child_state(self) -> Dict[str, Any]:
@@ -382,7 +444,16 @@ class DecayedMetric(_StreamingWrapper):
     def update(self, *args: Any, **kwargs: Any) -> None:
         n = _leading_rows(args, kwargs)
         delta = self._delta_state(args, kwargs)
-        factor = jnp.float32(2.0 ** (-n / self.halflife))  # n is static
+        # decay judges REAL rows: under the padding ladder (or an explicit
+        # `valid` mask) pad/masked rows contribute no delta, so they must
+        # not age the accumulated history either — a 5-row request padded
+        # to a 128-row tier decays by 5 rows, not 128
+        valid = kwargs.get("valid")
+        if valid is not None:
+            rows = jnp.asarray(valid, bool).sum().astype(jnp.float32)
+            factor = jnp.exp2(-rows / jnp.float32(self.halflife))
+        else:
+            factor = jnp.float32(2.0 ** (-n / self.halflife))  # n is static
         for name, kind in self._specs.items():
             dec_name = f"dec__{name}"
             if kind == "faults":

@@ -47,9 +47,26 @@ FAULT_CLASSES: Tuple[str, ...] = (
     "label_out_of_range",  # integer label < 0 or >= num_classes
     "nonfinite_state",  # NaN found in an accumulated state leaf (eager boundary)
     "dropped_rows",  # rows masked out of the accumulators by the drop policy
+    "padded_rows",  # ladder pad rows masked out by `valid` (ops/padding.py)
 )
 NUM_FAULT_CLASSES = len(FAULT_CLASSES)
 _IDX = {name: i for i, name in enumerate(FAULT_CLASSES)}
+
+# classes that record normal, intended operation rather than input damage:
+# they ride the counter vector (merge/sync/snapshot for free) but must not
+# trip on_invalid='warn'/'error' or flip health_report's `degraded` flag
+INFORMATIONAL_FAULT_CLASSES: Tuple[str, ...] = ("padded_rows",)
+
+
+def actionable_fault_total(counts: Any) -> int:
+    """Total fault count EXCLUDING the informational classes — the number
+    the warn/error policies act on (concrete counts only)."""
+    c = np.asarray(counts).astype(np.int64).reshape(-1)
+    total = int(c.sum())
+    for name in INFORMATIONAL_FAULT_CLASSES:
+        if _IDX[name] < c.shape[0]:
+            total -= int(c[_IDX[name]])
+    return total
 
 VALID_POLICIES = ("error", "warn", "drop", "ignore")
 
@@ -278,18 +295,44 @@ def _body_neutralizes(metric: Any) -> Tuple[bool, bool]:
     return masks, imputes
 
 
+def _consumes_valid_mask(metric: Any) -> bool:
+    """The update takes a ``valid`` row mask it actually consumes: capacity
+    mode (ring metrics accept ``valid`` only with a ring to mask), a class
+    declaring ``_valid_mask_always`` (the stat-scores family, whose update
+    zeroes masked rows' tp/fp/tn/fn contributions unconditionally), or a
+    kwargs-forwarding wrapper (the streaming wrappers) over such a metric —
+    the wrapper passes ``valid`` through to the child update AND counts its
+    own window quota from the mask. The ONE capability predicate shared by
+    the drop guard and the padding ladder (``ops/padding.py``), so the two
+    subsystems cannot drift."""
+    import inspect
+
+    sig = getattr(metric, "_update_signature", None)
+    if sig is None:
+        return False
+    params = sig.parameters
+    if "valid" in params:
+        return (
+            getattr(metric, "capacity", None) is not None
+            or getattr(metric, "_valid_mask_always", False)
+        )
+    wrapped = getattr(metric, "wrapped", None)
+    if wrapped is not None and any(
+        p.kind == inspect.Parameter.VAR_KEYWORD for p in params.values()
+    ):
+        return _consumes_valid_mask(wrapped)
+    return False
+
+
 def can_drop_traced(metric: Any) -> bool:
     """True when ``on_invalid='drop'`` stays inside the compiled graph:
-    the update takes capacity-mode ``valid`` row masks, or the metric's own
-    body neutralizes invalid values (aggregator masking/imputation).
-    Anything else needs concrete boolean indexing and degrades to the eager
-    path."""
+    the update consumes ``valid`` row masks (capacity mode or
+    ``_valid_mask_always``), or the metric's own body neutralizes invalid
+    values (aggregator masking/imputation). Anything else needs concrete
+    boolean indexing and degrades to the eager path."""
     if any(_body_neutralizes(metric)):
         return True
-    return (
-        "valid" in getattr(metric, "_update_signature").parameters
-        and getattr(metric, "capacity", None) is not None
-    )
+    return _consumes_valid_mask(metric)
 
 
 def _normalize_call(metric: Any, args: tuple, kwargs: dict) -> Optional[Dict[str, Any]]:
@@ -378,7 +421,7 @@ def guard_update_args(metric: Any, args: tuple, kwargs: dict) -> Tuple[tuple, di
 
     counters += FaultCounters.single(dropped_rows=bad.sum())
     good = ~bad
-    if "valid" in metric._update_signature.parameters and getattr(metric, "capacity", None) is not None:
+    if _consumes_valid_mask(metric):
         prior = norm.get("valid")
         norm = dict(norm)
         norm["valid"] = good if prior is None else (jnp.asarray(prior, bool) & good)

@@ -18,6 +18,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import metrics_tpu as mt
 from metrics_tpu import FaultCounters
+from metrics_tpu.utilities import guard
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 from metrics_tpu.utilities.guard import (
     FAULT_CLASSES,
@@ -146,14 +147,59 @@ class TestPolicies:
         np.testing.assert_allclose(got, float(ref.compute()), atol=1e-7)
         assert m.fault_counts["dropped_rows"] == 64 - clean_p.shape[0]
 
-    def test_drop_eager_fallback_without_row_machinery(self):
-        """Metrics without `valid`/aggregator masking degrade to the eager
-        boolean-indexing path (jit falls back, value stays correct)."""
+    def test_drop_stays_traced_for_stat_scores_family(self):
+        """The stat-scores family consumes `valid` row masks
+        (`_valid_mask_always`, PR 7), so on_invalid='drop' stays inside the
+        compiled update instead of degrading to the eager path."""
         p = np.asarray([[0.8, 0.1, 0.1], [np.nan] * 3, [0.1, 0.1, 0.8]], np.float32)
         m = mt.Accuracy(num_classes=3, on_invalid="drop")
         m.update(jnp.asarray(p), jnp.asarray([0, 1, 2]))
-        assert not m.jittable_update  # degraded, documented
+        assert m.jittable_update  # masking happened in-graph
         np.testing.assert_allclose(float(m.compute()), 1.0)
+        assert m.fault_counts["dropped_rows"] == 1
+
+    def test_drop_falls_back_eager_for_mask_refusing_configs(self):
+        """Stat-scores-family CONFIGS whose update rejects `valid` (per-sample
+        reductions, negative ignore_index, subset_accuracy) must not be
+        treated as mask-consuming: `_valid_mask_always` is config-aware, so
+        drop degrades to the eager boolean-indexing path instead of raising
+        on every update (regression: the class-level flag claimed mask
+        support the update then refused)."""
+        nan_row = [np.nan] * 3
+        cases = [
+            (
+                mt.StatScores(reduce="samples", on_invalid="drop"),
+                mt.StatScores(reduce="samples"),
+            ),
+            (
+                mt.Accuracy(num_classes=3, ignore_index=-1, on_invalid="drop"),
+                mt.Accuracy(num_classes=3, ignore_index=-1),
+            ),
+            (
+                mt.Accuracy(num_classes=3, subset_accuracy=True, on_invalid="drop"),
+                mt.Accuracy(num_classes=3, subset_accuracy=True),
+            ),
+        ]
+        p = np.asarray([[0.8, 0.1, 0.1], nan_row, [0.1, 0.1, 0.8]], np.float32)
+        t = np.asarray([0, 1, 2], np.int32)
+        for m, ref in cases:
+            assert not guard._consumes_valid_mask(m), type(m).__name__
+            m.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(jnp.asarray(p[[0, 2]]), jnp.asarray(t[[0, 2]]))
+            np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+            assert m.fault_counts["dropped_rows"] == 1
+
+    def test_drop_eager_fallback_without_row_machinery(self):
+        """Metrics without `valid`/aggregator masking degrade to the eager
+        boolean-indexing path (jit falls back, value stays correct)."""
+        p = np.asarray([1.0, np.nan, 3.0], np.float32)
+        t = np.asarray([1.5, 2.0, 2.0], np.float32)
+        m = mt.MeanSquaredError(on_invalid="drop")
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        assert not m.jittable_update  # degraded, documented
+        ref = mt.MeanSquaredError()
+        ref.update(jnp.asarray([1.0, 3.0]), jnp.asarray([1.5, 2.0]))
+        np.testing.assert_allclose(float(m.compute()), float(ref.compute()))
         assert m.fault_counts["dropped_rows"] == 1
 
     def test_nonfinite_state_leaf_detected_at_compute(self):
@@ -316,7 +362,18 @@ class TestFunctional:
 
     def test_drop_without_row_machinery_rejected_at_functionalize(self):
         with pytest.raises(ValueError, match="on_invalid='drop'"):
-            mt.functionalize(mt.Accuracy(num_classes=3, on_invalid="drop"))
+            mt.functionalize(mt.MeanSquaredError(on_invalid="drop"))
+
+    def test_drop_stat_scores_functionalizes_and_masks_in_graph(self):
+        """Since the family consumes `valid` masks (PR 7), a guarded
+        stat-scores metric functionalizes and drops NaN rows fully traced."""
+        mdef = mt.functionalize(mt.Accuracy(num_classes=3, on_invalid="drop"))
+        p = np.asarray([[0.8, 0.1, 0.1], [np.nan] * 3, [0.1, 0.1, 0.8]], np.float32)
+        state = jax.jit(mdef.update)(mdef.init(), jnp.asarray(p), jnp.asarray([0, 1, 2]))
+        np.testing.assert_allclose(float(jax.jit(mdef.compute)(state)), 1.0)
+        counts = _counts(jax.jit(mdef.faults)(state))
+        assert counts[_cls("dropped_rows")] == 1
+        assert counts[_cls("nonfinite_preds")] == 1
 
     def test_acceptance_drop_nan_preds_jitted_and_sharded(self):
         """THE acceptance criterion: NaN preds + on_invalid='drop' leave the

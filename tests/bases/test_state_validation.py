@@ -142,6 +142,62 @@ class TestFaultCountersRoundTrip:
         m2.update(jnp.asarray([np.nan]))
         assert m2.fault_counts["nonfinite_preds"] == 3
 
+    def test_short_counters_pickle_migrates(self):
+        """A pickle from a build with fewer fault classes carries a shorter
+        counts vector; ``__setstate__`` must zero-pad it (appends-only
+        contract) or the first guarded update broadcasts to an error and
+        ``as_dict`` misindexes."""
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES
+
+        m = _guarded_mean_with_faults()
+        state = m.__getstate__()
+        for key in ("_state", "_defaults"):
+            old = state[key]["_faults"]
+            state[key]["_faults"] = FaultCounters(counts=np.asarray(old.counts)[: NUM_FAULT_CLASSES - 1])
+        m2 = mt.MeanMetric.__new__(mt.MeanMetric)
+        m2.__setstate__(state)
+        assert m2._state["_faults"].counts.shape == (NUM_FAULT_CLASSES,)
+        assert m2._defaults["_faults"].counts.shape == (NUM_FAULT_CLASSES,)
+        assert m2.fault_counts == m.fault_counts  # old classes preserved, new zeroed
+        m2.update(jnp.asarray([np.nan]))  # the (old, broken) broadcast site
+        assert m2.fault_counts["nonfinite_preds"] == 3
+
+    def test_short_fault_ring_pickle_migrates(self):
+        """The streaming wrappers carry RAW class-trailing fault rings
+        (``win___faults`` shape (buckets, C), ``dec___faults`` shape (C,))
+        plus the windowed identity row — a pickle from a build with fewer
+        fault classes must widen all of them, or ``fault_counts`` and the
+        first bucket rotation shape-mismatch."""
+        from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES
+
+        old_c = NUM_FAULT_CLASSES - 1
+        for cls, kwargs, ring_key in (
+            (mt.WindowedMetric, {"window": 8, "buckets": 2}, "win___faults"),
+            (mt.DecayedMetric, {"halflife": 4.0}, "dec___faults"),
+        ):
+            m = cls(mt.MeanMetric(), on_invalid="drop", **kwargs)
+            m.update(jnp.asarray([1.0, np.nan, 3.0]))
+            state = m.__getstate__()
+            for key in ("_state", "_defaults"):
+                state[key][ring_key] = jnp.asarray(
+                    np.asarray(state[key][ring_key])[..., :old_c]
+                )
+            if "_identities" in state:
+                state["_identities"]["_faults"] = state["_identities"]["_faults"][:old_c]
+            m2 = cls.__new__(cls)
+            m2.__setstate__(state)
+            assert m2._state[ring_key].shape[-1] == NUM_FAULT_CLASSES
+            assert m2._defaults[ring_key].shape[-1] == NUM_FAULT_CLASSES
+            assert m2.fault_counts == m.fault_counts
+            # keeps counting (and, for windowed, rotating) through the guard,
+            # in lockstep with a reference that never went through a pickle
+            for _ in range(4):
+                m2.update(jnp.asarray([np.nan, 2.0, 2.0]))
+                m.update(jnp.asarray([np.nan, 2.0, 2.0]))
+            assert m2.fault_counts == m.fault_counts
+            assert m2.fault_counts["dropped_rows"] >= 1
+            assert float(m2.compute()) == float(m.compute())
+
     def test_pre_fault_channel_pickle_loads(self):
         """Pickles written before the fault channel lack its knobs; they
         must keep loading (defaulting to the unguarded policy)."""

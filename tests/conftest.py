@@ -52,3 +52,10 @@ def pytest_configure(config):
         "compile-heavy full-registry audit is additionally marked slow and "
         "runs in CI through `make lint`)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serving: the serving-hardening subsystem (metrics_tpu/serving/ "
+        "ServeLoop + the ops/padding.py capacity ladder) — multi-thread "
+        "request-driver stress, overload shedding, recompile budgets; "
+        "select with -m serving, or run the directory via `make test-serving`",
+    )

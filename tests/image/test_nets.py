@@ -115,6 +115,8 @@ def test_inception_loader_rejects_shape_mismatch():
         load_inception_torch_state_dict(ex.variables, sd)
 
 
+@pytest.mark.slow  # heavyweight twin construction (~21s: same full torch
+#                    InceptionV3 init as the shape-mismatch case above)
 def test_inception_loader_skips_auxlogits_and_counters():
     twin = TorchInceptionV3(variant="fid")
     sd = dict(twin.state_dict())

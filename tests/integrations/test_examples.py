@@ -8,8 +8,18 @@ import sys
 import pytest
 
 _EXAMPLES = sorted((pathlib.Path(__file__).resolve().parents[2] / "examples").glob("*.py"))
-# examples that pull real pretrained encoders run in the slow lane
-_HEAVY = {"fid_with_real_inception.py", "bertscore_with_real_bert.py"}
+# examples that pull real pretrained encoders, or whose subprocess replays
+# machinery tier-1 already covers in-process (serve_loop ~17s via
+# tests/serving, distributed_mesh ~7s via the dryrun lane + sharded-pattern
+# tests, train_with_metrics ~5s via tests/integrations/test_training_loop),
+# run in the slow lane
+_HEAVY = {
+    "fid_with_real_inception.py",
+    "bertscore_with_real_bert.py",
+    "serve_loop.py",
+    "distributed_mesh.py",
+    "train_with_metrics.py",
+}
 
 
 @pytest.mark.parametrize(

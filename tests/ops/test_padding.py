@@ -1,0 +1,322 @@
+"""Padding-tier capacity ladder (ISSUE 7): ladder resolution under the
+``METRICS_TPU_PAD_LADDER`` env contract, pad-row invisibility through the
+``valid``-mask machinery, the module runtime's ``pad_batches=True`` path,
+and the recompile-budget pin — a sweep of 50 ragged batch sizes compiles
+exactly ``len(ladder)`` graphs, and a ladder-bypassing path is caught.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.ops import padding
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+pytestmark = pytest.mark.ops
+
+
+@pytest.fixture(autouse=True)
+def _fresh_padding(monkeypatch):
+    """Each test sees pow-2 mode, a re-armed warn-once memory, and leaves
+    no env behind (same stance as tests/ops/test_dispatch.py)."""
+    monkeypatch.delenv("METRICS_TPU_PAD_LADDER", raising=False)
+    padding.reset_padding_state()
+    yield
+    padding.reset_padding_state()
+
+
+def _stream(seed, n, classes=4):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, classes)).astype(np.float32),
+        rng.integers(0, classes, n).astype(np.int32),
+    )
+
+
+# --------------------------------------------------------------------------
+# ladder resolution / env contract
+# --------------------------------------------------------------------------
+
+
+def test_pow2_mode_is_default():
+    assert padding.pad_ladder() is None
+    for n, tier in [(1, 1), (2, 2), (3, 4), (5, 8), (8, 8), (9, 16), (1000, 1024)]:
+        assert padding.tier_for(n) == tier
+
+
+def test_explicit_ladder_env(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", " 64, 16,256 ")
+    assert padding.pad_ladder() == (16, 64, 256)  # sorted, whitespace-tolerant
+    assert padding.tier_for(1) == 16
+    assert padding.tier_for(16) == 16
+    assert padding.tier_for(17) == 64
+    assert padding.tier_for(256) == 256
+
+
+def test_above_ladder_falls_back_to_pow2_with_one_warning(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "16,64")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert padding.tier_for(100) == 128  # next pow2, data never dropped
+        assert padding.tier_for(200) == 256
+    assert sum("exceeds the top padding tier" in str(x.message) for x in w) == 1
+
+
+@pytest.mark.parametrize("raw", ["64,abc", "0,64", "-8,16", ",,"])
+def test_malformed_env_warns_once_and_uses_pow2(monkeypatch, raw):
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", raw)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert padding.tier_for(5) == 8  # pow-2 fallback
+        assert padding.tier_for(9) == 16
+    assert sum("malformed" in str(x.message) for x in w) == 1
+
+
+def test_tier_for_programmatic_ladder_ignores_env(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "4")
+    assert padding.tier_for(5, ladder=(8, 32)) == 8
+
+
+# --------------------------------------------------------------------------
+# pad_rows (the functional building block)
+# --------------------------------------------------------------------------
+
+
+def test_pad_rows_masks_exactly_the_pad_rows():
+    p, t = _stream(0, 5)
+    (pp, tp), mask = padding.pad_rows((jnp.asarray(p), jnp.asarray(t)))
+    assert pp.shape == (8, 4) and tp.shape == (8,)
+    np.testing.assert_array_equal(np.asarray(mask), [True] * 5 + [False] * 3)
+    np.testing.assert_array_equal(np.asarray(pp[:5]), p)
+    assert not np.asarray(pp[5:]).any()  # zero fill
+
+
+def test_pad_rows_threads_a_caller_valid_mask():
+    p, t = _stream(1, 5)
+    prior = np.asarray([True, False, True, True, False])
+    (_, _), mask = padding.pad_rows((jnp.asarray(p), jnp.asarray(t)), valid=prior)
+    np.testing.assert_array_equal(np.asarray(mask), list(prior) + [False] * 3)
+
+
+def test_pad_rows_exact_tier_is_a_noop_with_mask():
+    p, t = _stream(2, 8)
+    (pp, tp), mask = padding.pad_rows((jnp.asarray(p), jnp.asarray(t)))
+    assert pp.shape[0] == 8
+    assert np.asarray(mask).all()
+
+
+def test_pad_rows_rejects_misaligned_leading_axes():
+    with pytest.raises(ValueError, match="row-aligned"):
+        padding.pad_rows((jnp.zeros((5, 2)), jnp.zeros((6,))))
+
+
+# --------------------------------------------------------------------------
+# pad-row invisibility through the module runtime (pad_batches=True)
+# --------------------------------------------------------------------------
+
+
+def test_padded_value_bit_equal_to_unpadded_reference():
+    """THE invisibility pin: a ragged padded stream computes the identical
+    value to the same stream unpadded, with every pad row accounted for in
+    the informational ``padded_rows`` class."""
+    sizes = [1, 3, 5, 8, 11, 17, 31, 32, 57]
+    m = mt.Accuracy(num_classes=4, pad_batches=True)
+    ref = mt.Accuracy(num_classes=4)
+    expect_padded = 0
+    for i, n in enumerate(sizes):
+        p, t = _stream(10 + i, n)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(jnp.asarray(p), jnp.asarray(t))
+        expect_padded += padding.next_pow2(n) - n
+    assert float(m.compute()) == float(ref.compute())
+    assert m.fault_counts["padded_rows"] == expect_padded
+    assert m.fault_counts["dropped_rows"] == 0
+
+
+def test_padding_composes_with_drop_guard():
+    """Pad mask AND-ed with the guard's good-row mask: NaN rows drop (and
+    count as dropped), pad rows count as padded, value equals the clean
+    stream — the two masks never double-count."""
+    from tests.helpers.fault_injection import corrupt_rows_nonfinite, pick_rows
+
+    rng = np.random.default_rng(3)
+    p, t = _stream(4, 11)
+    rows = pick_rows(rng, 11, 0.2)
+    bad_p = corrupt_rows_nonfinite(p, rows)
+    keep = np.ones(11, bool)
+    keep[rows] = False
+
+    m = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    m.update(jnp.asarray(bad_p), jnp.asarray(t))
+    ref = mt.Accuracy(num_classes=4)
+    ref.update(jnp.asarray(p[keep]), jnp.asarray(t[keep]))
+    assert float(m.compute()) == float(ref.compute())
+    assert m.fault_counts["dropped_rows"] == len(rows)
+    assert m.fault_counts["padded_rows"] == 16 - 11
+
+
+def test_padded_rows_are_informational_never_warn_or_degrade():
+    """`padded_rows` records normal operation: no on_invalid='warn' firing,
+    health_report reports the count but keeps `degraded` False."""
+    from metrics_tpu.resilience.health import registry
+
+    registry.clear()  # the process-wide registry carries other tests' events
+    m = mt.Accuracy(num_classes=4, on_invalid="warn", pad_batches=True)
+    p, t = _stream(5, 5)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m.compute()
+    assert not [x for x in w if "fault" in str(x.message).lower()]
+    rep = mt.health_report(m)
+    (entry,) = [v for k, v in rep["metrics"].items()]
+    assert entry.get("padded_rows") == 3
+    assert "faults" not in entry
+    assert rep["degraded"] is False
+
+
+def test_pad_batches_rejects_metrics_without_row_mask_machinery():
+    m = mt.MeanSquaredError(pad_batches=True)
+    with pytest.raises(MetricsTPUUserError, match="valid"):
+        m.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.0, 2.0, 3.0]))
+
+
+def test_scalar_update_is_left_alone():
+    """Row-less calls (scalar aggregator feeds) pass through unpadded."""
+    m = mt.Accuracy(num_classes=4, pad_batches=True)
+    p, t = _stream(6, 4)
+    m.update(jnp.asarray(p), jnp.asarray(t))  # smoke: tier == batch
+    assert m.fault_counts["padded_rows"] == 0
+
+
+# --------------------------------------------------------------------------
+# recompile budget: the acceptance pin + the seeded bypass regression
+# --------------------------------------------------------------------------
+
+
+def test_module_runtime_sweep_compiles_one_graph_per_tier(monkeypatch):
+    """50 ragged batch sizes through a ladder-enabled guarded metric keep
+    the module runtime's jit cache at exactly len(ladder) entries."""
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "16,64,128")
+    m = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    rng = np.random.default_rng(7)
+    sizes = sorted(rng.choice(np.arange(1, 129), size=50, replace=False).tolist())
+    for i, n in enumerate(sizes):
+        p, t = _stream(100 + i, int(n))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    assert m.jittable_update
+    assert m._update_jit._cache_size() == 3  # == len(ladder)
+
+
+def test_module_runtime_without_ladder_recompiles_per_shape():
+    """The seeded regression: the ladder-bypassing path (pad_batches left
+    False) compiles one graph per distinct ragged size — the failure mode
+    the ladder exists to prevent."""
+    m = mt.Accuracy(num_classes=4, on_invalid="drop")
+    for i, n in enumerate([5, 6, 7, 9, 10]):
+        p, t = _stream(200 + i, n)
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    assert m._update_jit._cache_size() == 5  # one per shape: unbounded
+
+
+def test_audit_recompilation_sweep_pins_ladder_graph_count():
+    """The functional-path pin via audit_recompilation: 50 ragged sizes
+    through a pad_rows-wrapped guarded update compile exactly len(ladder)
+    graphs (budget N passes, budget N-1 fails), and the ladder-bypassing
+    update is caught by the same budget."""
+    from metrics_tpu.analysis.graph_audit import audit_recompilation
+
+    ladder = (16, 64, 128)
+    mdef = mt.functionalize(mt.Accuracy(num_classes=4, on_invalid="drop"))
+
+    def update(p, t, valid):
+        return mdef.update(mdef.init(), p, t, valid=valid)
+
+    def padded_args(batch):
+        p, t = _stream(batch, batch)
+        (pp, tt), valid = padding.pad_rows(
+            (jnp.asarray(p), jnp.asarray(t)), ladder=ladder
+        )
+        return (pp, tt, valid)
+
+    rng = np.random.default_rng(8)
+    sweep = tuple(int(x) for x in rng.choice(np.arange(1, 129), size=50, replace=False))
+    sweep = sweep + (16, 64, 128)  # make sure every tier is covered
+
+    ok = audit_recompilation(update, padded_args, sweep_sizes=sweep, max_graphs=len(ladder))
+    assert ok == []
+    # exactness: one fewer graph must fail => the sweep compiled exactly 3
+    tight = audit_recompilation(
+        update, padded_args, sweep_sizes=sweep, max_graphs=len(ladder) - 1
+    )
+    assert len(tight) == 1 and "ragged" in tight[0].detail
+
+    def bypass_args(batch):  # the seeded regression: no padding
+        p, t = _stream(batch, batch)
+        return (jnp.asarray(p), jnp.asarray(t), jnp.ones((batch,), bool))
+
+    # small sweep: per-shape retrace blows the same budget immediately
+    caught = audit_recompilation(
+        update, bypass_args, sweep_sizes=(5, 6, 7, 9, 10, 11), max_graphs=len(ladder)
+    )
+    assert len(caught) == 1 and "recompile unboundedly" in caught[0].detail
+
+
+# --------------------------------------------------------------------------
+# padding through the streaming wrappers
+# --------------------------------------------------------------------------
+
+
+def test_wrapper_level_drop_guard_stays_traced():
+    """The unified capability predicate (guard._consumes_valid_mask ==
+    padding.supports_row_mask): a kwargs-forwarding wrapper over a
+    mask-consuming child folds the drop guard's mask into `valid` in-graph
+    instead of degrading to the eager boolean-indexing path."""
+    p = np.asarray(
+        [[0.8, 0.1, 0.1, 0.0], [np.nan] * 4, [0.1, 0.1, 0.8, 0.0]], np.float32
+    )
+    wm = mt.WindowedMetric(mt.Accuracy(num_classes=4), window=32, buckets=4, on_invalid="drop")
+    wm.update(jnp.asarray(p), jnp.asarray([0, 1, 1]))  # row 3 predicted 2: a miss
+    assert wm.jittable_update  # masking happened in-graph
+    np.testing.assert_allclose(float(wm.compute()), 0.5)
+    assert wm.fault_counts["dropped_rows"] == 1
+    # the dropped row consumed no window quota (mask popcount, not shape)
+    assert int(np.asarray(wm.win__rows).sum()) == 2
+
+
+def test_decayed_metric_decays_by_real_rows_only():
+    """A decayed metric under the ladder: the decay factor ages history by
+    REAL rows, not the padded tier — one 5-row request padded to a big tier
+    must not near-erase everything accumulated before it."""
+    dm = mt.DecayedMetric(mt.Accuracy(num_classes=4), halflife=16.0, pad_batches=True)
+    ref = mt.DecayedMetric(mt.Accuracy(num_classes=4), halflife=16.0)
+    for i, n in enumerate([5, 8, 3, 7, 8, 6]):
+        p, t = _stream(400 + i, n)
+        dm.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(dm.compute()), float(ref.compute()), rtol=1e-6)
+    assert dm.fault_counts["padded_rows"] == sum(
+        padding.next_pow2(n) - n for n in [5, 8, 3, 7, 8, 6]
+    )
+
+
+def test_windowed_metric_pads_and_counts_real_rows_only():
+    """A windowed metric under the ladder: pad rows are invisible to the
+    value AND to the window's row quota (a pad row consuming window space
+    would silently shrink the effective window)."""
+    W, B = 32, 4
+    wm = mt.WindowedMetric(mt.Accuracy(num_classes=4), window=W, buckets=B, pad_batches=True)
+    ref = mt.WindowedMetric(mt.Accuracy(num_classes=4), window=W, buckets=B)
+    for i, n in enumerate([5, 8, 3, 7, 8, 6]):
+        p, t = _stream(300 + i, n)
+        wm.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(jnp.asarray(p), jnp.asarray(t))
+    assert float(wm.compute()) == float(ref.compute())
+    assert int(np.asarray(wm.win__rows).sum()) == int(np.asarray(ref.win__rows).sum())
+    assert wm.fault_counts["padded_rows"] == sum(
+        padding.next_pow2(n) - n for n in [5, 8, 3, 7, 8, 6]
+    )

@@ -1,0 +1,398 @@
+"""ServeLoop contracts (ISSUE 7): thread-confined replica accumulation with
+merged reads, the bounded-deadline stale-view ``report()``, shed-on-full
+overload accounting riding ``health_report()``, snapshot round trips — and
+THE acceptance stress test: N request threads firing ragged, fault-injected
+batches at a guarded windowed collection, with the merged value bit-equal
+to the single-thread clean-stream reference and every injected/shed row
+accounted for.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.ops import padding
+from metrics_tpu.resilience.health import registry
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Clean health registry and a pinned one-tier ladder (everything in the
+    fast lane pads to 16 rows → one compiled graph per member)."""
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "16")
+    padding.reset_padding_state()
+    registry.clear()
+    yield
+    registry.clear()
+    padding.reset_padding_state()
+
+
+def _batch(rng, n, classes=4):
+    return (
+        rng.random((n, classes)).astype(np.float32),
+        rng.integers(0, classes, n).astype(np.int32),
+    )
+
+
+# --------------------------------------------------------------------------
+# basic loop behavior
+# --------------------------------------------------------------------------
+
+
+def test_offers_drain_and_report_reconciles():
+    rng = np.random.default_rng(0)
+    with mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=2) as loop:
+        ref = mt.Accuracy(num_classes=4)
+        for _ in range(12):
+            p, t = _batch(rng, int(rng.integers(1, 17)))
+            assert loop.offer(jnp.asarray(p), jnp.asarray(t))
+            ref.update(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(30)
+        loop.stop()
+        view = loop.report()
+    assert view["stats"]["offered"] == 12
+    assert view["stats"]["accepted"] + view["stats"]["shed"] == view["stats"]["offered"]
+    assert view["stats"]["processed"] == 12
+    assert view["updates"] == 12
+    assert float(view["value"]) == float(ref.compute())
+
+
+def test_report_never_blocks_and_serves_stale_view():
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=4, pad_batches=True), workers=1, reduce_every_s=600.0
+    ) as loop:
+        rng = np.random.default_rng(1)
+        p, t = _batch(rng, 8)
+        loop.offer(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(30)
+        # no periodic reduce has run (600 s cadence): the stale path answers
+        # immediately anyway
+        t0 = time.monotonic()
+        view = loop.report()
+        assert time.monotonic() - t0 < 1.0
+        assert not view["fresh"]
+        # fresh=True triggers a reduce and waits (bounded) for it
+        view = loop.report(fresh=True, deadline_s=30.0)
+        assert view["fresh"]
+        assert view["updates"] == 1
+        assert view["staleness_s"] is not None
+        loop.stop()
+
+
+def test_fresh_deadline_miss_degrades_to_stale_view():
+    """A deadline the reducer cannot meet returns the stale view with
+    fresh=False — availability over freshness, never an exception."""
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=4, pad_batches=True), workers=1, reduce_every_s=600.0
+    ) as loop:
+        view = loop.report(fresh=True, deadline_s=0.0)
+        assert not view["fresh"]
+        assert view["value"] is None  # nothing reduced yet — still answers
+        loop.stop()
+
+
+def test_offer_after_stop_raises():
+    loop = mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=1)
+    loop.stop()
+    with pytest.raises(MetricsTPUUserError, match="after stop"):
+        loop.offer(jnp.zeros((4, 4)), jnp.zeros((4,), jnp.int32))
+
+
+def test_worker_survives_poison_request():
+    """One malformed request is counted + health-recorded; the worker keeps
+    serving the requests behind it."""
+    rng = np.random.default_rng(2)
+    with mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=1) as loop:
+        p, t = _batch(rng, 8)
+        loop.offer(jnp.asarray(p), jnp.asarray(t))
+        loop.offer("not-an-array")  # raises inside the worker
+        loop.offer(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(30)
+        loop.stop()
+        view = loop.report()
+    assert view["stats"]["failed"] == 1
+    assert view["updates"] == 2
+    assert registry.counts().get("serve_update_error") == 1
+
+
+def test_poison_request_rolls_back_inferred_mode():
+    """A poison FIRST request that infers a data-dependent attr before
+    raising (Accuracy resolves mode='multi-label', then top_k rejects it)
+    must not poison the replica: the rollback restores `_snapshot_attrs`
+    too, so subsequent good multiclass traffic still lands."""
+    rng = np.random.default_rng(7)
+    with mt.ServeLoop(mt.Accuracy(num_classes=4, top_k=1, pad_batches=True), workers=1) as loop:
+        # multilabel-shaped batch: mode inference succeeds, top_k then raises
+        loop.offer(
+            jnp.asarray(rng.random((8, 4)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 2, (8, 4)).astype(np.int32)),
+        )
+        p, t = _batch(rng, 8)
+        loop.offer(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(30)
+        loop.stop()
+        view = loop.report()
+    assert view["stats"]["failed"] == 1, "only the poison request may fail"
+    ref = mt.Accuracy(num_classes=4, top_k=1)
+    ref.update(jnp.asarray(p), jnp.asarray(t))
+    assert view["updates"] == 1
+    assert float(view["value"]) == float(ref.compute())
+
+
+def test_overload_sheds_loudly_and_reconciles():
+    """Flood a 1-slot queue: shed requests are counted, recorded as
+    first-class health events, and accepted + shed == offered."""
+    rng = np.random.default_rng(3)
+    loop = mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=1, queue_size=1)
+    p, t = _batch(rng, 16)
+    for _ in range(200):
+        loop.offer(jnp.asarray(p), jnp.asarray(t))
+    loop.stop()
+    stats = loop.stats()
+    assert stats["shed"] > 0, "flooding a 1-slot queue must shed"
+    assert stats["accepted"] + stats["shed"] == stats["offered"] == 200
+    assert stats["processed"] == stats["accepted"]
+    assert registry.counts()["overload_shed"] == stats["shed"]
+    rep = loop.health()
+    assert rep["degraded"] is True  # shedding is a visible degradation
+    assert rep["serving"]["shed"] == stats["shed"]
+    # the merged value covers exactly the accepted requests
+    assert loop.report()["updates"] == stats["accepted"]
+
+
+class _SlowMean(mt.MeanMetric):
+    """MeanMetric whose update sleeps — builds a queue backlog that
+    reliably outlives a non-draining stop()."""
+
+    def update(self, value, weight=1.0):  # noqa: D102
+        time.sleep(0.02)
+        super().update(value, weight)
+
+
+def test_stop_without_drain_reduces_every_processed_batch():
+    """stop(drain=False) with a backlog: workers finish the queue and JOIN
+    before the reducer's final pass, so report() covers every processed
+    batch — the final reduce racing ahead of mid-backlog workers would
+    permanently orphan their later publishes."""
+    loop = mt.ServeLoop(_SlowMean(), workers=1, queue_size=64, reduce_every_s=600.0)
+    for v in range(20):
+        assert loop.offer(jnp.asarray([float(v)]))
+    loop.stop(drain=False, timeout_s=30.0)
+    stats = loop.stats()
+    assert stats["processed"] == stats["accepted"] == 20
+    view = loop.report()
+    assert view["updates"] == 20
+    ref = sum(range(20)) / 20.0
+    np.testing.assert_allclose(float(view["value"]), ref, rtol=1e-6)
+
+
+def test_fresh_report_after_stop_short_circuits():
+    """Once the reducer has exited no fresher view can arrive:
+    report(fresh=True) must answer immediately instead of burning its
+    whole deadline waiting on a condition nobody will ever signal."""
+    loop = mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=1)
+    loop.stop()
+    t0 = time.monotonic()
+    view = loop.report(fresh=True, deadline_s=5.0)
+    assert time.monotonic() - t0 < 1.0
+    assert view["value"] is None  # nothing was ever served — still answers
+
+
+# --------------------------------------------------------------------------
+# THE acceptance stress test
+# --------------------------------------------------------------------------
+
+
+def test_multithread_ragged_fault_stress_matches_single_thread_reference():
+    """N driver threads fire ragged batch sizes with NaN-corrupt pred rows
+    and out-of-range-label rows at a guarded windowed collection behind a
+    small queue. Accepted batches are recorded per driver; afterwards the
+    merged value must be bit-equal to a single-thread clean-stream
+    reference over exactly those batches, the fault counters must account
+    for every injected row that was accepted, and accepted + shed ==
+    offered."""
+    from tests.helpers.fault_injection import corrupt_labels_out_of_range, corrupt_rows_nonfinite
+
+    CLASSES, DRIVERS, BATCHES = 4, 3, 20
+    W, B = 4096, 2  # bucket quota 2048 rows >> total stream: no rotation,
+    #                 so windowed == full-stream and replica merge is exact
+
+    def make_collection():
+        return mt.MetricCollection(
+            {
+                "acc": mt.Accuracy(num_classes=CLASSES, on_invalid="drop", pad_batches=True),
+                "win": mt.WindowedMetric(
+                    mt.Accuracy(num_classes=CLASSES, on_invalid="drop"),
+                    window=W,
+                    buckets=B,
+                    pad_batches=True,
+                ),
+            }
+        )
+
+    loop = mt.ServeLoop(make_collection(), workers=3, queue_size=4)
+
+    # warm the tier graphs so the flood sheds on genuine queue pressure,
+    # not on first-compile stalls
+    rng = np.random.default_rng(99)
+    p, t = _batch(rng, 16, CLASSES)
+    loop.offer(jnp.asarray(p), jnp.asarray(t))
+    assert loop.drain(60)
+
+    accepted_lock = threading.Lock()
+    accepted = []  # (clean_preds, clean_target, keep_mask, n_nan, n_label)
+
+    def driver(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(BATCHES):
+            n = int(rng.integers(4, 17))
+            p, t = _batch(rng, n, CLASSES)
+            # disjoint corrupt rows: counter accounting stays exact
+            rows = rng.permutation(n)
+            nan_rows, label_rows = rows[:2], rows[2:3]
+            bad_p = corrupt_rows_nonfinite(p, nan_rows)
+            bad_t = corrupt_labels_out_of_range(t, label_rows, CLASSES)
+            if loop.offer(jnp.asarray(bad_p), jnp.asarray(bad_t)):
+                keep = np.ones(n, bool)
+                keep[nan_rows] = False
+                keep[label_rows] = False
+                with accepted_lock:
+                    accepted.append((p, t, keep, len(nan_rows), len(label_rows)))
+
+    threads = [threading.Thread(target=driver, args=(1000 + i,)) for i in range(DRIVERS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert loop.drain(120)
+    loop.stop()
+
+    stats = loop.stats()
+    assert stats["offered"] == DRIVERS * BATCHES + 1
+    assert stats["accepted"] + stats["shed"] == stats["offered"]
+    assert stats["processed"] == stats["accepted"]
+    assert stats["failed"] == 0
+
+    # single-thread clean-stream reference over exactly the accepted batches
+    ref = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=CLASSES),
+            "win": mt.WindowedMetric(mt.Accuracy(num_classes=CLASSES), window=W, buckets=B),
+        }
+    )
+    ref.update(jnp.asarray(p), jnp.asarray(t))  # the warmup batch (clean)
+    for cp, ct, keep, _, _ in accepted:
+        ref.update(jnp.asarray(cp[keep]), jnp.asarray(ct[keep]))
+    ref_vals = ref.compute()
+
+    view = loop.report()
+    assert view["updates"] == stats["accepted"] * len(ref.keys())
+    for key in ("acc", "win"):
+        assert float(view["value"][key]) == float(ref_vals[key]), key
+
+    # every injected row accounted for (among ACCEPTED batches)
+    n_nan = sum(a[3] for a in accepted)
+    n_label = sum(a[4] for a in accepted)
+    acc_faults = view["faults"]["acc"]
+    assert acc_faults["nonfinite_preds"] == n_nan
+    assert acc_faults["label_out_of_range"] == n_label
+    assert acc_faults["dropped_rows"] == n_nan + n_label
+    win_faults = view["faults"]["win"]
+    assert win_faults["dropped_rows"] == n_nan + n_label
+
+    # ...and in health_report(): shed events reconcile, faults visible
+    rep = loop.health()
+    assert rep["serving"]["accepted"] + rep["serving"]["shed"] == rep["serving"]["offered"]
+    if stats["shed"]:
+        assert rep["event_counts"]["overload_shed"] == stats["shed"]
+
+
+# --------------------------------------------------------------------------
+# snapshots
+# --------------------------------------------------------------------------
+
+
+def test_snapshot_roundtrip_restores_served_state(tmp_path):
+    rng = np.random.default_rng(5)
+    mgr = mt.SnapshotManager(tmp_path, keep=2)
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    ref = mt.Accuracy(num_classes=4)
+
+    with mt.ServeLoop(proto, workers=2, snapshot_manager=mgr) as loop:
+        for _ in range(12):
+            p, t = _batch(rng, int(rng.integers(1, 17)))
+            loop.offer(jnp.asarray(p), jnp.asarray(t))
+            ref.update(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(60)
+        loop.stop()
+        step = loop.save_snapshot()
+        pre_crash = loop.report()
+
+    # a fresh loop (different worker count — the elastic path) restores
+    # the group and serves the pre-crash value
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True),
+        workers=3,
+        snapshot_manager=mgr,
+    ) as loop2:
+        info = loop2.restore_snapshot()
+        assert info["step"] == step
+        view = loop2.report(fresh=True, deadline_s=60.0)
+        assert float(view["value"]) == float(pre_crash["value"]) == float(ref.compute())
+        assert view["updates"] == 12
+        loop2.stop()
+
+
+def test_snapshot_cadence_not_gated_on_reduce_cadence(tmp_path):
+    """`snapshot_every_s` shorter than `reduce_every_s` must still be
+    honored: the reducer's wait wakes for whichever cadence is due first
+    (a crash on an idle loop must not lose reduce_every_s worth of state)."""
+    rng = np.random.default_rng(7)
+    mgr = mt.SnapshotManager(tmp_path, keep=2)
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=4, pad_batches=True),
+        workers=1,
+        reduce_every_s=3600.0,
+        snapshot_manager=mgr,
+        snapshot_every_s=0.1,
+    ) as loop:
+        p, t = _batch(rng, 8)
+        assert loop.offer(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(30)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not any(tmp_path.iterdir()):
+            time.sleep(0.02)
+        assert any(tmp_path.iterdir()), "periodic snapshot never fired on an idle loop"
+        loop.stop()
+
+
+def test_restore_on_warm_loop_refuses(tmp_path):
+    """Restoring into a loop whose replicas already published would fold the
+    same updates twice (once via the base, once via the still-published
+    replica snapshots) — the call must refuse instead of double-counting."""
+    rng = np.random.default_rng(6)
+    mgr = mt.SnapshotManager(tmp_path, keep=2)
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=4, pad_batches=True), workers=1, snapshot_manager=mgr
+    ) as loop:
+        p, t = _batch(rng, 8)
+        assert loop.offer(jnp.asarray(p), jnp.asarray(t))
+        assert loop.drain(30)
+        loop.save_snapshot()
+        with pytest.raises(MetricsTPUUserError, match="already served traffic"):
+            loop.restore_snapshot()
+        loop.stop()
+
+
+def test_snapshot_requires_manager():
+    with mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=1) as loop:
+        with pytest.raises(MetricsTPUUserError, match="snapshot_manager"):
+            loop.save_snapshot()
+        loop.stop()

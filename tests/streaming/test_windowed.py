@@ -3,6 +3,8 @@ the trailing W rows (bit-exact for sum-reduced states, across bucket
 boundaries, window wrap-around, and reset()), decayed-mean closed-form
 parity, jitted-stream behavior, the windowed fault channel, and the
 refusal surface for states with no bucket/decay semantics."""
+import warnings
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -166,6 +168,23 @@ def test_windowed_fault_counters_expire_with_their_bucket():
         wm.update(good)
     assert wm.fault_counts["dropped_rows"] == 0
     assert np.isfinite(float(wm.compute()))
+
+
+@pytest.mark.faults
+@pytest.mark.parametrize("policy", ["warn", "drop"])
+def test_wrapper_guard_faults_counted_once(policy):
+    """One NaN row is ONE nonfinite_preds count regardless of policy: a
+    counting-only wrapper guard ('warn'/'error') sees the same rows the
+    propagated child guard counts into the windowed ring, so its own
+    validator counts are duplicates and must not be added on top — while
+    under 'drop' the wrapper guard consumes the rows (the ring stays
+    empty) and its own channel is authoritative."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        wm = mt.WindowedMetric(mt.MeanMetric(), window=8, buckets=2, on_invalid=policy)
+        wm.update(jnp.asarray([1.0, np.nan, 3.0]))
+        assert wm.fault_counts["nonfinite_preds"] == 1
+        assert float(wm.compute()) == 2.0
 
 
 @pytest.mark.faults

@@ -20,7 +20,19 @@ _MODULES = sorted(
 )
 
 
-@pytest.mark.parametrize("module_name", _MODULES)
+# modules whose doctests replay heavyweight examples (bootstrap replica
+# sweeps, ~8s) run in the slow lane for tier-1; `make doctest` (and its CI
+# step) runs this file WITHOUT the `not slow` filter, so they stay gated
+_HEAVY_DOCTESTS = {"metrics_tpu.wrappers.bootstrapping"}
+
+
+@pytest.mark.parametrize(
+    "module_name",
+    [
+        pytest.param(m, marks=[pytest.mark.slow] if m in _HEAVY_DOCTESTS else [])
+        for m in _MODULES
+    ],
+)
 def test_module_doctests(module_name):
     module = importlib.import_module(module_name)
     skips = set(getattr(module, "__doctest_skip__", ()))

@@ -100,6 +100,8 @@ def test_bert_parity_with_padding_mask():
     np.testing.assert_allclose(got[valid], want[valid], atol=1e-4)
 
 
+@pytest.mark.slow  # heavyweight twin construction (~19s: a full BERT twin
+#                    just to rewrite its key prefixes)
 def test_bert_loader_accepts_bert_prefix_and_skips_heads():
     """Checkpoints saved from task models carry a ``bert.`` prefix and
     pooler/cls heads; the loader normalizes and skips them."""
