@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -71,3 +71,10 @@ test-ops:
 # trips (the padding tests also ride the `ops` lane via their directory).
 test-serving:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/serving/ tests/ops/test_padding.py -q -m 'not slow' -p no:cacheprovider
+
+# Fast feedback on the overlapped async-sync layer (parallel/async_sync.py
+# scheduler + Metric(sync_mode='overlapped') + overlapped_functionalize):
+# blocking-vs-overlapped value parity, staleness bounds, degradation paths,
+# cycle/read collective budgets (same tests the `async_sync` marker selects).
+test-async:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/async_sync/ -q -m 'not slow' -p no:cacheprovider

@@ -92,7 +92,14 @@ from metrics_tpu.image import (  # noqa: E402
     StructuralSimilarityIndexMeasure,
     UniversalImageQualityIndex,
 )
-from metrics_tpu.pure import MetricDef, bootstrap_functionalize, functionalize  # noqa: E402
+from metrics_tpu.parallel.async_sync import AsyncSyncScheduler  # noqa: E402
+from metrics_tpu.pure import (  # noqa: E402
+    MetricDef,
+    OverlappedDef,
+    bootstrap_functionalize,
+    functionalize,
+    overlapped_functionalize,
+)
 from metrics_tpu.streaming import (  # noqa: E402
     CountMinSketch,
     CountMinState,
@@ -209,6 +216,8 @@ __all__ = [
     "Metric",
     "MetricCollection",
     "MetricDef",
+    "OverlappedDef",
+    "AsyncSyncScheduler",
     "MetricTracker",
     "MinMaxMetric",
     "MinMetric",
@@ -264,6 +273,7 @@ __all__ = [
     "bootstrap_functionalize",
     "ensure_backend",
     "functionalize",
+    "overlapped_functionalize",
     "health_report",
     "ServeLoop",
 ]

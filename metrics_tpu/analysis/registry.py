@@ -31,6 +31,20 @@ entry                           budget
 ``bucketed_rank_step``          the bucketed-rank kernel step (dispatched
                                 descending order + inverse ranks): **0**
                                 collectives, no f64/callbacks/dynamic shapes
+``overlapped_fused_step``       overlapped async sync (ISSUE 8 —
+                                ``pure.py::overlapped_functionalize``): one
+                                update + one sync ``cycle`` + one stale
+                                ``read`` of the guarded fused 4-metric
+                                collection: **≤ 2** all-reduces per cycle
+                                (int32 states bucket + uint32 fault bucket —
+                                the guarded-collection budget holds per
+                                overlapped cycle), AND recompile-stable
+                                (double-buffered state avals are batch-size
+                                independent, cache hit at equal avals)
+``overlapped_read_step``        the stale-read path alone (``read`` on a
+                                replicated reduced buffer over the mesh):
+                                **0** collectives — the zero-collective-
+                                latency read the ISSUE 8 acceptance names
 ``ladder_served_update``        ladder-padded guarded serving update (ISSUE 7
                                 — ``ops/padding.py``): **0** collectives, no
                                 f64/callbacks/dynamic shapes, AND a ragged
@@ -238,6 +252,93 @@ def _build_bucketed_rank_step(ndev: int):
     return jax.jit(step), (x,)
 
 
+def _overlapped_coll():
+    """The ISSUE 8 acceptance surface: the guarded fused 4-metric
+    collection (StatScores family sharing one compute-group state, fault
+    channel on), whose blocking sync budget is the guarded-collection ≤2."""
+    import metrics_tpu as mt
+
+    return mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=4, on_invalid="warn"),
+            "prec": mt.Precision(num_classes=4, average="macro", on_invalid="warn"),
+            "rec": mt.Recall(num_classes=4, average="macro", on_invalid="warn"),
+            "f1": mt.F1Score(num_classes=4, average="macro", on_invalid="warn"),
+        }
+    )
+
+
+def _overlapped_make_args(batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(batch)
+    return (
+        jnp.asarray(rng.random((batch, 4), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, 4, batch).astype(np.int32)),
+    )
+
+
+def _build_overlapped_raw_step():
+    import metrics_tpu as mt
+
+    # single-device form (axis_name=None): the cycle degrades to the
+    # identity snapshot but the double-buffered state LAYOUT — what the
+    # recompile audit checks — is identical to the mesh form
+    odef = mt.overlapped_functionalize(_overlapped_coll())
+
+    def step(p, t):
+        s = odef.cycle(odef.update(odef.init(), p, t))
+        return odef.read(s)
+
+    return step
+
+
+def _build_overlapped_fused_step(ndev: int):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    odef = mt.overlapped_functionalize(_overlapped_coll(), axis_name="data")
+
+    def step(p, t):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+        )
+        s = odef.update(s, p, t)  # live buffer only: no collectives
+        s = odef.cycle(s)  # THE sync cycle: one fused_sync over every leaf
+        return odef.read(s)  # stale-read rides along (already replicated)
+
+    p, t = _overlapped_make_args(8 * ndev)
+    fn = jax.jit(
+        jax.shard_map(step, mesh=_mesh(ndev), in_specs=(P("data"), P("data")), out_specs=P())
+    )
+    return fn, (p, t)
+
+
+def _build_overlapped_read_step(ndev: int):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    odef = mt.overlapped_functionalize(_overlapped_coll(), axis_name="data")
+
+    # the read path audited alone: a replicated (already-reduced) state in,
+    # the computed values out — the budget proves the stale read compiles
+    # with ZERO collectives on the mesh (its structure is state-content
+    # independent, so the init state is a sound stand-in for a cycled one)
+    def read(state):
+        return odef.read(state)
+
+    # one eager update so the members' data-inferred attrs (Accuracy's input
+    # mode) exist before compute lowers; the audited graph is read-only
+    state0 = odef.update(odef.init(), *_overlapped_make_args(8))
+    fn = jax.jit(jax.shard_map(read, mesh=_mesh(ndev), in_specs=(P(),), out_specs=P()))
+    return fn, (state0,)
+
+
 # the serving ladder under audit: pinned programmatically (not via the env
 # var) so the audit result cannot depend on ambient METRICS_TPU_PAD_LADDER
 _SERVE_LADDER = (8, 32, 128)
@@ -334,6 +435,23 @@ REGISTRY: Tuple[AuditEntry, ...] = (
             max_all_to_all=0,
         ),
         build=_build_bucketed_rank_step,
+    ),
+    AuditEntry(
+        name="overlapped_fused_step",
+        budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
+        build=_build_overlapped_fused_step,
+        build_recompile=lambda: (_build_overlapped_raw_step(), _overlapped_make_args),
+    ),
+    AuditEntry(
+        name="overlapped_read_step",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_overlapped_read_step,
     ),
     AuditEntry(
         name="ladder_served_update",

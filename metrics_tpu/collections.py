@@ -103,10 +103,13 @@ class MetricCollection:
                 self._compute_groups_create_state_ref()
                 self._groups_checked = True
 
-    def compute(self) -> Dict[str, Any]:
-        """Reference ``collections.py:269-273``."""
+    def compute(self, fresh: bool = False) -> Dict[str, Any]:
+        """Reference ``collections.py:269-273``. ``fresh=True`` is the
+        overlapped-sync escape hatch, forwarded to every member (a no-op
+        for blocking-mode members)."""
         self._compute_groups_create_state_ref()
-        res = {k: m.compute() for k, m in self._modules.items()}
+        kw = {"fresh": True} if fresh else {}
+        res = {k: m.compute(**kw) for k, m in self._modules.items()}
         res = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -271,7 +274,96 @@ class MetricCollection:
                         # ALREADY-declared states (collection infra, not a new leaf)
                         mi._state[state] = m0_state
                     mi._computed = None
+            self._ensure_overlap_scheduler()
         self._state_is_copy = copy
+
+    def _ensure_overlap_scheduler(self) -> None:
+        """ONE overlapped-sync scheduler for the whole collection.
+
+        Per-member (or even per-group) schedulers would mean several issuer
+        threads whose gather sequences order by host-local thread
+        scheduling — and process-level collectives pair across hosts by
+        issue order, so that ordering must be deterministic (the
+        `parallel/async_sync.py` contract). A single collection scheduler
+        is a single issuer: each cycle snapshots every overlapped group
+        head and gathers them in fixed group order inside ONE atomic
+        sequence (under `gather_sequence_lock`), so K overlapped metrics in
+        G groups cost one deterministic cycle, not K (or G) racing ones.
+        Members read their group head's entry of the shared view via
+        `_sync_view_key`. Stray per-member schedulers spawned before the
+        first group formation are stopped here — never leaked."""
+        heads = [
+            (cg[0], self._modules[cg[0]])
+            for cg in self._groups.values()
+            if getattr(self._modules[cg[0]], "sync_mode", "blocking") == "overlapped"
+        ]
+        if not heads:
+            return
+        sched = self.__dict__.get("_overlap_sched")
+        if sched is None or sched.stopped:
+            from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
+            from metrics_tpu.parallel.sync import gather_sequence_lock
+            from metrics_tpu.resilience.health import record_degradation
+
+            head_map = dict(heads)
+            coll_name = f"collection({'+'.join(type(m).__name__ for _, m in heads)})"
+
+            def snapshot():
+                # each head's state captured under its own swap lock; the
+                # entry keeps the head's step count for per-metric lag
+                return [(name, m._overlap_snapshot()) for name, m in heads], None
+
+            def reduce(payload):
+                # one atomic multi-head gather sequence, in fixed group
+                # order — identical on every host of an SPMD update stream
+                with gather_sequence_lock:
+                    return {
+                        name: (head_map[name]._overlap_reduce(state), steps)
+                        for name, (state, steps) in payload
+                    }
+
+            def on_error(err: BaseException) -> None:
+                record_degradation(
+                    "async_sync_error",
+                    f"overlapped sync cycle for {coll_name} raised "
+                    f"{type(err).__name__}: {err}",
+                    metric=coll_name,
+                )
+
+            # the collection cycle runs at the strictest cadence any member
+            # asked for (notify unit = head updates: one collection.update
+            # notifies once per overlapped group)
+            every_n = [m.sync_every_n for _, m in heads if m.sync_every_n is not None]
+            every_s = [m.sync_every_s for _, m in heads if m.sync_every_s is not None]
+            sched = AsyncSyncScheduler(
+                snapshot,
+                reduce,
+                sync_every_n=min(every_n) if every_n else None,
+                sync_every_s=min(every_s) if every_s else None,
+                on_error=on_error,
+                name=coll_name,
+            )
+            self.__dict__["_overlap_sched"] = sched
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            if getattr(m0, "sync_mode", "blocking") != "overlapped":
+                continue
+            head_lock = m0.__dict__.get("_overlap_lock")
+            for name in cg:
+                mi = self._modules[name]
+                if getattr(mi, "sync_mode", "blocking") != "overlapped":
+                    continue
+                old = mi.__dict__.get("_sync_scheduler")
+                if old is not None and old is not sched:
+                    # a stray private scheduler (spawned by an update before
+                    # group formation): stop its worker — an orphan thread
+                    # would keep snapshotting, and on a real pod keep
+                    # ISSUING gather sequences nobody consumes
+                    old.stop(final=False, timeout_s=5.0)
+                object.__setattr__(mi, "_sync_scheduler", sched)
+                object.__setattr__(mi, "_sync_view_key", cg[0])
+                if mi is not m0:
+                    object.__setattr__(mi, "_overlap_lock", head_lock)
 
     @property
     def compute_groups(self) -> Dict[int, List[str]]:

@@ -32,8 +32,10 @@ the reference, by subsystem:
   ``state_dict`` returns numpy copies; orbax/flax checkpointing works on the
   same pytree for free.
 """
+import contextlib
 import functools
 import inspect
+import threading
 import time
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
@@ -70,6 +72,11 @@ _TRACE_ERRORS = tuple(
 def jit_distributed_available() -> bool:
     """Reference ``metric.py:40-41``."""
     return distributed_available()
+
+
+# sentinel: "the overlapped scheduler has no completed cycle yet" — distinct
+# from any legal metric value (None is a legal compute result)
+_NO_SYNC_VIEW = object()
 
 
 def _migrate_fault_vectors(state: Dict[str, Any]) -> Dict[str, Any]:
@@ -141,6 +148,9 @@ class Metric:
         on_invalid: str = "ignore",
         debug_checks: bool = False,
         pad_batches: bool = False,
+        sync_mode: str = "blocking",
+        sync_every_n: Optional[int] = None,
+        sync_every_s: Optional[float] = None,
         **kwargs: Any,
     ) -> None:
         from metrics_tpu.utilities.guard import VALID_POLICIES, FaultCounters
@@ -167,6 +177,39 @@ class Metric:
         # pad rows are masked through the `valid` machinery and counted in
         # the fault channel's informational `padded_rows` class
         self.pad_batches = bool(pad_batches)
+        # overlapped async sync (parallel/async_sync.py): double-buffer the
+        # reduced state — collectives issue eagerly at update time against a
+        # snapshot while the live accumulator keeps absorbing updates, so
+        # compute() reads an already-reduced, at-most-one-cycle-stale view
+        # with ZERO collective latency; compute(fresh=True) escapes back to
+        # the blocking fused sync
+        if sync_mode not in ("blocking", "overlapped"):
+            raise ValueError(
+                f"`sync_mode` must be 'blocking' or 'overlapped', got {sync_mode!r}"
+            )
+        self.sync_mode = sync_mode
+        if sync_mode == "overlapped":
+            from metrics_tpu.parallel.async_sync import resolve_sync_cadence
+
+            self.sync_every_n, self.sync_every_s = resolve_sync_cadence(
+                sync_every_n, sync_every_s
+            )
+            # one lock guards every _state swap window (update commit,
+            # blocking-sync swap, overlapped-view read, snapshot_state) so
+            # the scheduler's background snapshot can never capture a torn
+            # mid-swap state — and crash snapshots stay consistent
+            object.__setattr__(self, "_overlap_lock", threading.RLock())
+        else:
+            if sync_every_n is not None or sync_every_s is not None:
+                raise ValueError(
+                    "`sync_every_n`/`sync_every_s` need sync_mode='overlapped'"
+                )
+            self.sync_every_n = None
+            self.sync_every_s = None
+        object.__setattr__(self, "_sync_scheduler", None)
+        # set by MetricCollection._ensure_overlap_scheduler: which head's
+        # entry of a collection-shared view this metric reads
+        object.__setattr__(self, "_sync_view_key", None)
         self._faults_reported = 0
         if on_invalid != "ignore" or self.pad_batches:
             # the in-graph fault channel: per-class uint32 counters carried
@@ -362,52 +405,69 @@ class Metric:
 
         return jax.jit(pure_compute)
 
+    def _state_swap_guard(self):
+        """The overlapped-sync swap lock (a no-op context for blocking
+        metrics): held around every window where ``_state`` is mutated or
+        temporarily swapped, so the async scheduler's background snapshot —
+        and a crash snapshot — can never observe a torn mid-swap state."""
+        lock = self.__dict__.get("_overlap_lock")
+        return lock if lock is not None else contextlib.nullcontext()
+
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
-            self._computed = None
-            self._update_count += 1
-            self._update_called = True
-            self._last_update_unix = time.time()
-            if self._is_synced:
-                raise MetricsTPUUserError(
-                    "The Metric shouldn't be synced when performing ``update``. "
-                    "HINT: Did you forget to call ``unsync``?"
-                )
-            n_padded = 0
-            if self.pad_batches:
-                # pad OUTSIDE the jit boundary: the compiled update only ever
-                # sees ladder-tier shapes, so ragged traffic reuses graphs
-                from metrics_tpu.ops.padding import pad_update_args
-
-                args, kwargs, n_padded = pad_update_args(self, args, kwargs)
-            if self._can_jit_update() and not self.compute_on_cpu:
-                if self._update_jit is None:
-                    self._update_jit = self._make_update_jit()
-                try:
-                    new_state = self._update_jit(dict(self._state), args, kwargs)
-                except (_TRACE_ERRORS + (TypeError,)):
-                    # update body needs concrete values, or takes non-array
-                    # args jit can't stage → fall back to eager (a genuine
-                    # bug will re-raise from the eager call below)
-                    object.__setattr__(self, "jittable_update", False)
-                    update(*args, **kwargs)
-                else:
-                    object.__setattr__(self, "_state", new_state)
-            else:
-                update(*args, **kwargs)
-            if n_padded:
-                # the pad count is static (a shape delta), so it accumulates
-                # with one tiny eager add instead of riding the jitted graph
-                from metrics_tpu.utilities.guard import FaultCounters
-
-                self._state["_faults"] = self._state["_faults"] + FaultCounters.single(
-                    padded_rows=n_padded
-                )
-            if self.compute_on_cpu:
-                self._move_list_states_to_host()
+            with self._state_swap_guard():
+                self._run_update(update, args, kwargs)
+            if self.sync_mode == "overlapped":
+                # eager issue: the scheduler snapshots the just-committed
+                # state and runs the collective on its worker thread while
+                # this thread moves on to the next batch (the T3 overlap)
+                self._ensure_sync_scheduler().notify(steps=self._update_count)
 
         return wrapped_func
+
+    def _run_update(self, update: Callable, args: tuple, kwargs: dict) -> None:
+        self._computed = None
+        self._update_count += 1
+        self._update_called = True
+        self._last_update_unix = time.time()
+        if self._is_synced:
+            raise MetricsTPUUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        n_padded = 0
+        if self.pad_batches:
+            # pad OUTSIDE the jit boundary: the compiled update only ever
+            # sees ladder-tier shapes, so ragged traffic reuses graphs
+            from metrics_tpu.ops.padding import pad_update_args
+
+            args, kwargs, n_padded = pad_update_args(self, args, kwargs)
+        if self._can_jit_update() and not self.compute_on_cpu:
+            if self._update_jit is None:
+                self._update_jit = self._make_update_jit()
+            try:
+                new_state = self._update_jit(dict(self._state), args, kwargs)
+            except (_TRACE_ERRORS + (TypeError,)):
+                # update body needs concrete values, or takes non-array
+                # args jit can't stage → fall back to eager (a genuine
+                # bug will re-raise from the eager call below)
+                object.__setattr__(self, "jittable_update", False)
+                update(*args, **kwargs)
+            else:
+                object.__setattr__(self, "_state", new_state)
+        else:
+            update(*args, **kwargs)
+        if n_padded:
+            # the pad count is static (a shape delta), so it accumulates
+            # with one tiny eager add instead of riding the jitted graph
+            from metrics_tpu.utilities.guard import FaultCounters
+
+            self._state["_faults"] = self._state["_faults"] + FaultCounters.single(
+                padded_rows=n_padded
+            )
+        if self.compute_on_cpu:
+            self._move_list_states_to_host()
 
     def _move_list_states_to_host(self) -> None:
         """Offload accumulated list ("cat") states to host memory.
@@ -425,29 +485,182 @@ class Metric:
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            # `fresh=True` is the overlapped-sync escape hatch: skip the
+            # double-buffered view and pay today's blocking fused sync for a
+            # value covering every local update (a no-op for blocking-mode
+            # metrics, which are always "fresh")
+            fresh = bool(kwargs.pop("fresh", False))
             if not self._update_called:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` "
                     "method which may lead to errors, as metric states have not yet been updated.",
                     UserWarning,
                 )
+            if (
+                self.sync_mode == "overlapped"
+                and not fresh
+                and self._to_sync
+                and self.sync_on_compute
+                and not self._is_synced
+                # forward-protocol internal computes (batch-local values on a
+                # freshly-reset state) must never serve the accumulated view
+                and self._should_unsync
+            ):
+                value = self._overlapped_read(*args, **kwargs)
+                if value is not _NO_SYNC_VIEW:
+                    return value
+                # no completed cycle yet: kick one so later reads are
+                # covered, and fall through to the blocking path below
+                self._ensure_sync_scheduler().request()
             if self._computed is not None:
                 return self._computed  # cache (reference ``metric.py:512``)
-            with self.sync_context(
-                dist_sync_fn=self.dist_sync_fn,
-                should_sync=self._to_sync and self.sync_on_compute,
-                should_unsync=self._should_unsync,
-            ):
-                value = self._compute_unsynced(*args, **kwargs)
-                # checked while synced: `dropped`/fault counters are then the
-                # global (summed) counts, so every rank takes the same
-                # warn/error branch
-                self._check_cat_overflow()
-                self._check_faults()
-            self._computed = _squeeze_if_scalar(value)
+            with self._state_swap_guard():
+                with self.sync_context(
+                    dist_sync_fn=self.dist_sync_fn,
+                    should_sync=self._to_sync and self.sync_on_compute,
+                    should_unsync=self._should_unsync,
+                ):
+                    value = self._compute_unsynced(*args, **kwargs)
+                    # checked while synced: `dropped`/fault counters are then
+                    # the global (summed) counts, so every rank takes the
+                    # same warn/error branch
+                    self._check_cat_overflow()
+                    self._check_faults()
+                self._computed = _squeeze_if_scalar(value)
             return self._computed
 
         return wrapped_func
+
+    # ------------------------------------------------------------------
+    # overlapped async sync (parallel/async_sync.py)
+    # ------------------------------------------------------------------
+
+    def _ensure_sync_scheduler(self):
+        """Lazily build this metric's :class:`AsyncSyncScheduler` (threads
+        must not outlive clones: deepcopy/pickle drop the scheduler and the
+        copy rebuilds its own on first use)."""
+        sched = self.__dict__.get("_sync_scheduler")
+        if sched is None:
+            from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
+            from metrics_tpu.resilience.health import record_degradation
+
+            name = type(self).__name__
+
+            def on_error(err: BaseException) -> None:
+                # a failed cycle keeps the previous view: loudly stale (the
+                # event is the loudness), never a hang; cadence retries
+                record_degradation(
+                    "async_sync_error",
+                    f"overlapped sync cycle for {name} raised "
+                    f"{type(err).__name__}: {err}",
+                    metric=name,
+                )
+
+            sched = AsyncSyncScheduler(
+                snapshot_fn=self._overlap_snapshot,
+                reduce_fn=self._overlap_reduce,
+                sync_every_n=self.sync_every_n,
+                sync_every_s=self.sync_every_s,
+                on_error=on_error,
+                name=name,
+            )
+            object.__setattr__(self, "_sync_scheduler", sched)
+        return sched
+
+    def _overlap_snapshot(self):
+        """Worker-side capture of the live state (the cycle's snapshot
+        buffer). The swap guard makes it impossible to catch a blocking
+        sync's temporary global state or a half-committed eager update."""
+        with self._state_swap_guard():
+            return self._copy_state(), self._update_count
+
+    def _overlap_reduce(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        """The cycle's collective: the SAME gather+reduce the blocking path
+        runs (so an overlapped read is bit-identical to a blocking read over
+        the batches its cycle covers), applied to the snapshot buffer on the
+        scheduler thread. Single-process worlds reduce to the identity —
+        the view is then just a consistent copy of the live state."""
+        if not distributed_available():
+            return state
+        return self._gathered_state(
+            state, self.dist_sync_fn or gather_all_arrays, self.process_group
+        )
+
+    def _overlapped_read(self, *args: Any, **kwargs: Any) -> Any:
+        """Zero-collective read path: compute on the scheduler's front
+        buffer (already reduced, at most one cycle stale). Returns the
+        ``_NO_SYNC_VIEW`` sentinel before the first completed cycle."""
+        sched = self.__dict__.get("_sync_scheduler")
+        view = sched.view() if sched is not None else None
+        if view is None:
+            return _NO_SYNC_VIEW
+        payload = view.payload
+        key = self.__dict__.get("_sync_view_key")
+        if key is not None:
+            # collection-shared scheduler: the payload maps each compute-
+            # group head's name to its (synced state, covered steps) entry
+            entry = payload.get(key)
+            if entry is None:
+                return _NO_SYNC_VIEW
+            payload = entry[0]
+        with self._state_swap_guard():
+            prev_state = self.__dict__["_state"]
+            prev_synced = self._is_synced
+            object.__setattr__(self, "_state", dict(payload))
+            self._is_synced = True  # the view IS the globally-reduced state
+            try:
+                value = self._compute_unsynced(*args, **kwargs)
+                # policy checks run against the view's (global) counters —
+                # same stance as the blocking path's checked-while-synced
+                self._check_cat_overflow()
+                self._check_faults()
+            finally:
+                object.__setattr__(self, "_state", prev_state)
+                self._is_synced = prev_synced
+        return _squeeze_if_scalar(value)
+
+    def request_sync(self, wait: bool = False, deadline_s: float = 30.0) -> bool:
+        """Ask the overlapped scheduler for a cycle now. ``wait=True``
+        blocks (bounded) until the front view covers every update made so
+        far; returns whether it does. Blocking-mode metrics return True
+        (every read is already fresh)."""
+        if self.sync_mode != "overlapped":
+            return True
+        sched = self._ensure_sync_scheduler()
+        target = sched.seq()
+        if not wait:
+            sched.request()
+            return sched.covered(target)
+        return sched.wait_covered(target, deadline_s)
+
+    @property
+    def sync_lag(self) -> Optional[Dict[str, Any]]:
+        """Staleness of the overlapped view vs the live accumulator
+        (``sync_lag_steps``/``sync_lag_s`` — surfaced per metric by
+        ``health_report()``). None for blocking-mode metrics."""
+        if self.sync_mode != "overlapped":
+            return None
+        sched = self.__dict__.get("_sync_scheduler")
+        if sched is None:
+            return {
+                "sync_lag_steps": self._update_count,
+                "sync_lag_s": None,
+                "synced_once": False,
+                "in_flight": False,
+            }
+        key = self.__dict__.get("_sync_view_key")
+        if key is not None:
+            # collection-shared scheduler: lag in THIS metric's update steps
+            # comes from its group head's entry, not the collection-wide
+            # notify watermark (whose unit is head-updates across groups)
+            base = sched.lag(live_steps=self._update_count)
+            view = sched.view()
+            entry = view.payload.get(key) if view is not None else None
+            if entry is None:
+                return {**base, "sync_lag_steps": self._update_count,
+                        "sync_lag_s": None, "synced_once": False}
+            return {**base, "sync_lag_steps": max(0, self._update_count - entry[1])}
+        return sched.lag(live_steps=self._update_count)
 
     @property
     def dropped_count(self) -> Optional[int]:
@@ -591,12 +804,19 @@ class Metric:
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate into global state AND return the batch-local value.
         The batch value is kept in ``_forward_cache`` (reference
-        ``metric.py:238``; Lightning reads it) until the next ``reset``."""
-        if self.full_state_update or self.dist_sync_on_step:
-            batch_val = self._forward_full_state_update(*args, **kwargs)
-        else:
-            batch_val = self._forward_reduce_state_update(*args, **kwargs)
-        self._forward_cache = batch_val
+        ``metric.py:238``; Lightning reads it) until the next ``reset``.
+
+        The whole save/reset/update/restore dance runs under the overlapped
+        swap guard (re-entrant: the inner update/compute re-acquire it), so
+        an async sync cycle can never snapshot one of the protocol's
+        transient states (a reset or batch-only accumulator) as if it were
+        the live stream."""
+        with self._state_swap_guard():
+            if self.full_state_update or self.dist_sync_on_step:
+                batch_val = self._forward_full_state_update(*args, **kwargs)
+            else:
+                batch_val = self._forward_reduce_state_update(*args, **kwargs)
+            self._forward_cache = batch_val
         return batch_val
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -778,11 +998,46 @@ class Metric:
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
         """Gather + reduce every state across processes (reference ``metric.py:348-374``)."""
+        object.__setattr__(
+            self, "_state", self._gathered_state(self._copy_state(), dist_sync_fn, process_group)
+        )
+
+    def _gathered_state(
+        self,
+        state: Dict[str, Any],
+        dist_sync_fn: Callable = gather_all_arrays,
+        process_group: Optional[Any] = None,
+    ) -> Dict[str, Any]:
+        """The gather+reduce core of :meth:`_sync_dist`, as an explicit
+        ``state -> synced state`` function. It reads only immutable config
+        (``_reductions``, ``process_group``) — never ``self._state`` — so the
+        overlapped sync scheduler (``parallel/async_sync.py``) can run it on
+        its worker thread against a snapshot buffer while the live
+        accumulator keeps absorbing updates.
+
+        The whole multi-leaf gather sequence holds the process-wide
+        ``gather_sequence_lock``: process-level collectives pair across
+        hosts by issue order, so a scheduler cycle and a concurrent
+        blocking sync on another thread must serialize, never interleave
+        their per-leaf gathers (ordering contract in
+        ``parallel/async_sync.py``)."""
+        from metrics_tpu.parallel.sync import gather_sequence_lock
+
+        with gather_sequence_lock:
+            return self._gathered_state_seq(state, dist_sync_fn, process_group)
+
+    def _gathered_state_seq(
+        self,
+        state: Dict[str, Any],
+        dist_sync_fn: Callable,
+        process_group: Optional[Any],
+    ) -> Dict[str, Any]:
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
         from metrics_tpu.utilities.guard import FaultCounters
 
-        input_dict = {attr: self._state[attr] for attr in self._reductions}
+        state = dict(state)
+        input_dict = {attr: state[attr] for attr in self._reductions}
         # CatBuffer states: gather data and mask; the union of valid rows is
         # the stacked buffers (masked rows stay masked)
         for attr, value in list(input_dict.items()):
@@ -801,13 +1056,13 @@ class Metric:
                 merged = ranks[0]
                 for other in ranks[1:]:
                     merged = merged.sketch_merge(other)
-                self._state[attr] = merged
+                state[attr] = merged
                 del input_dict[attr]
                 continue
             if isinstance(value, FaultCounters):
                 group = self.process_group if process_group is None else process_group
                 gathered = dist_sync_fn(value.counts, group)
-                self._state[attr] = FaultCounters(counts=sum(jnp.asarray(g) for g in gathered))
+                state[attr] = FaultCounters(counts=sum(jnp.asarray(g) for g in gathered))
                 del input_dict[attr]
                 continue
             if isinstance(value, CatBuffer):
@@ -816,10 +1071,10 @@ class Metric:
                 mask = jnp.concatenate(dist_sync_fn(value.mask, group), axis=0)
                 local_dropped = value.dropped if value.dropped is not None else jnp.zeros((), jnp.int32)
                 dropped = sum(dist_sync_fn(local_dropped, group))
-                self._state[attr] = CatBuffer(data=data, mask=mask, dropped=dropped)
+                state[attr] = CatBuffer(data=data, mask=mask, dropped=dropped)
                 del input_dict[attr]
         if not input_dict:
-            return
+            return state
         for attr in input_dict:
             # pre-concat list states to minimize gathers (reference ``metric.py:352-354``)
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
@@ -836,27 +1091,28 @@ class Metric:
             if attr not in output_dict:  # CatBuffer states handled above
                 continue
             out = output_dict[attr]
-            if isinstance(self._state[attr], list):
-                self._state[attr] = _flatten(out) if out else []
+            if isinstance(state[attr], list):
+                state[attr] = _flatten(out) if out else []
                 continue
             # out is a list of per-rank arrays
             stacked = jnp.stack(out, axis=0)
             if reduction_fn == "sum":
-                self._state[attr] = jnp.sum(stacked, axis=0)
+                state[attr] = jnp.sum(stacked, axis=0)
             elif reduction_fn == "mean":
-                self._state[attr] = jnp.mean(stacked, axis=0)
+                state[attr] = jnp.mean(stacked, axis=0)
             elif reduction_fn == "max":
-                self._state[attr] = jnp.max(stacked, axis=0)
+                state[attr] = jnp.max(stacked, axis=0)
             elif reduction_fn == "min":
-                self._state[attr] = jnp.min(stacked, axis=0)
+                state[attr] = jnp.min(stacked, axis=0)
             elif reduction_fn == "cat":
-                self._state[attr] = jnp.concatenate([jnp.atleast_1d(o) for o in out], axis=0)
+                state[attr] = jnp.concatenate([jnp.atleast_1d(o) for o in out], axis=0)
             elif callable(reduction_fn):
-                self._state[attr] = reduction_fn(stacked)
+                state[attr] = reduction_fn(stacked)
             elif reduction_fn is None:
-                self._state[attr] = stacked
+                state[attr] = stacked
             else:
                 raise MetricsTPUUserError(f"Unsupported reduction: {reduction_fn}")
+        return state
 
     def sync(
         self,
@@ -935,6 +1191,12 @@ class Metric:
 
     def reset(self) -> None:
         """Restore default state (reference ``metric.py:539``)."""
+        sched = self.__dict__.get("_sync_scheduler")
+        if sched is not None:
+            # the scheduler's view covers the pre-reset stream; stop it
+            # (no final cycle needed) and lazily rebuild on the next update
+            sched.stop(final=False, timeout_s=5.0)
+            object.__setattr__(self, "_sync_scheduler", None)
         self._update_count = 0
         self._update_called = False
         # staleness restarts with the epoch: a reset-but-unfed metric must
@@ -1015,27 +1277,36 @@ class Metric:
         different value) plus the update counter, recursively over child
         metrics (wrappers hold their state in children). Values serialize
         per :meth:`_serialize_state_value`; rebuilt by
-        :meth:`load_snapshot_state`."""
-        out: Dict[str, Any] = {
-            "states": {key: self._serialize_state_value(self._state[key]) for key in self._defaults},
-            "update_count": self._update_count,
-        }
-        if self._last_update_unix is not None:
-            # the staleness clock must survive crash recovery: a restored
-            # metric with 500 updates reporting "never updated" would tell
-            # operators the opposite of the truth (resilience/health.py)
-            out["last_update_unix"] = self._last_update_unix
-        attrs = {
-            name: getattr(self, name)
-            for name in self._snapshot_attrs
-            if getattr(self, name, None) is not None
-        }
-        if attrs:
-            out["attrs"] = attrs
-        children = {name: child.snapshot_state() for name, child in self._named_child_metrics()}
-        if children:
-            out["children"] = children
-        return out
+        :meth:`load_snapshot_state`.
+
+        Overlapped-sync metrics serialize under the swap guard, so the
+        captured buffer is always a consistent live state — never a torn
+        mid-swap pair from a concurrent scheduler cycle or blocking read."""
+        with self._state_swap_guard():
+            out: Dict[str, Any] = {
+                "states": {
+                    key: self._serialize_state_value(self._state[key]) for key in self._defaults
+                },
+                "update_count": self._update_count,
+            }
+            if self._last_update_unix is not None:
+                # the staleness clock must survive crash recovery: a restored
+                # metric with 500 updates reporting "never updated" would tell
+                # operators the opposite of the truth (resilience/health.py)
+                out["last_update_unix"] = self._last_update_unix
+            attrs = {
+                name: getattr(self, name)
+                for name in self._snapshot_attrs
+                if getattr(self, name, None) is not None
+            }
+            if attrs:
+                out["attrs"] = attrs
+            children = {
+                name: child.snapshot_state() for name, child in self._named_child_metrics()
+            }
+            if children:
+                out["children"] = children
+            return out
 
     def load_snapshot_state(self, payload: Dict[str, Any]) -> None:
         """Restore a :meth:`snapshot_state` payload. Every value is validated
@@ -1240,7 +1511,7 @@ class Metric:
 
     def __getstate__(self) -> Dict[str, Any]:
         """Pickle support: drop wrapped/bound/jitted fns (reference ``metric.py:560-569``)."""
-        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit", "_update_signature", "_bucket_kernels"}
+        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit", "_update_signature", "_bucket_kernels", "_sync_scheduler", "_overlap_lock"}
         state = {k: v for k, v in self.__dict__.items() if k not in skip}
         state["_state"] = jax.tree_util.tree_map(np.asarray, self.__dict__["_state"])
         state["_defaults"] = jax.tree_util.tree_map(np.asarray, self.__dict__["_defaults"])
@@ -1255,6 +1526,17 @@ class Metric:
         self.__dict__.setdefault("pad_batches", False)
         self.__dict__.setdefault("_faults_reported", 0)
         self.__dict__.setdefault("_last_update_unix", None)
+        # pickles never carry the scheduler thread or its lock — the copy
+        # rebuilds both on first use (pre-overlap pickles default to blocking)
+        self.__dict__.setdefault("sync_mode", "blocking")
+        self.__dict__.setdefault("sync_every_n", None)
+        self.__dict__.setdefault("sync_every_s", None)
+        self.__dict__["_sync_scheduler"] = None
+        # a standalone copy is no longer wired to a collection's shared
+        # scheduler; its own (plain-state) views carry no head keying
+        self.__dict__["_sync_view_key"] = None
+        if self.sync_mode == "overlapped":
+            self.__dict__["_overlap_lock"] = threading.RLock()
         self.__dict__["_state"] = _migrate_fault_vectors(
             jax.tree_util.tree_map(jnp.asarray, state["_state"])
         )
@@ -1273,7 +1555,7 @@ class Metric:
         cls = type(self)
         new = cls.__new__(cls)
         memo[id(self)] = new
-        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit"}
+        skip = {"update", "compute", "_original_update", "_original_compute", "_update_jit", "_compute_jit", "_sync_scheduler", "_overlap_lock"}
         for k, v in self.__dict__.items():
             if k in skip:
                 continue
@@ -1288,6 +1570,13 @@ class Metric:
         object.__setattr__(new, "compute", new._wrap_compute(new._original_compute))
         object.__setattr__(new, "_update_jit", None)
         object.__setattr__(new, "_compute_jit", None)
+        # scheduler threads and locks are per-instance: the clone starts
+        # with no in-flight cycles and builds its own scheduler lazily
+        # (and is no longer wired to any collection's shared scheduler)
+        object.__setattr__(new, "_sync_scheduler", None)
+        object.__setattr__(new, "_sync_view_key", None)
+        if getattr(new, "sync_mode", "blocking") == "overlapped":
+            object.__setattr__(new, "_overlap_lock", threading.RLock())
         return new
 
     # ------------------------------------------------------------------

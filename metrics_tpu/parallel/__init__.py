@@ -7,3 +7,9 @@ from metrics_tpu.parallel.sync import (  # noqa: F401
     sync_leaf,
     sync_state,
 )
+from metrics_tpu.parallel.async_sync import (  # noqa: F401
+    AsyncSyncScheduler,
+    SyncView,
+    reset_async_sync_state,
+    resolve_sync_cadence,
+)

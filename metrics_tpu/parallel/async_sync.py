@@ -1,0 +1,443 @@
+"""Overlapped (asynchronous) sync scheduler — double-buffered reduced views.
+
+Every cross-replica sync used to be a *blocking* collective issued inside
+``compute()`` (``metric.py::sync`` → ``gather_all_arrays``, or ``ServeLoop``'s
+forced reduce): the read path paid the full ICI/DCN round trip per read —
+PR 7 measured the gap directly (~79 ms forced reduce vs ~3 µs stale view).
+Per T3 ("Transparent Tracking & Triggering for Fine-grained Overlap of
+Compute & Collectives", PAPERS.md), the fix is to *overlap*: issue the
+collective eagerly against a **snapshot buffer** while the live accumulator
+keeps absorbing updates, and let the read path consume the already-reduced
+result with zero collective latency.
+
+:class:`AsyncSyncScheduler` is that mechanism, factored once and consumed by
+two layers:
+
+- ``Metric(sync_mode='overlapped')`` (``metric.py``): after each update the
+  metric ``notify()``-s the scheduler; on the configured cadence
+  (``sync_every_n`` updates and/or ``sync_every_s`` seconds) the scheduler
+  snapshots the live state and runs the gather+reduce on its worker thread,
+  publishing an immutable :class:`SyncView`. ``compute()`` then reads the
+  view — an at-most-one-cycle-stale, already-reduced state — in microseconds;
+  ``compute(fresh=True)`` is the escape hatch back to the blocking sync.
+- ``ServeLoop`` (``metrics_tpu/serving``): the background reducer *is* a
+  scheduler cycle (snapshot = sweep the workers' published states, reduce =
+  clone+fold+compute), so serving and metric sync share one double-buffer
+  implementation instead of two drifting ones.
+
+Degradation contract (the ``RetryingGather`` stance generalized to in-flight
+async collectives): a cycle whose reduce raises keeps the previous view and
+reports through ``on_error`` (health-registry event) — readers keep getting
+the old reduced view, loudly stale, never a hang; the next cadence retries.
+A cycle stuck past ``deadline_s`` records ``async_sync_stalled`` once per
+episode the moment a reader observes it. The transport-level hang itself is
+bounded by ``RetryingGather`` (timeout + breaker + loud local-only
+fallback), which the default metric reduce path already rides.
+
+Publication is torn-proof by construction: a :class:`SyncView` is an
+immutable tuple written to one slot under the condition lock — a reader sees
+the whole previous view or the whole next one, never a mid-swap pair.
+
+Multi-process ordering contract: host-level gathers
+(``multihost_utils.process_allgather``) pair calls across processes by
+*issue order*, so two gather sequences interleaving differently on
+different hosts would silently mis-pair tensors. Within a host, every
+multi-leaf gather sequence — a scheduler cycle's reduce or a blocking
+``compute(fresh=True)`` sync — is atomic under the process-wide
+``parallel.sync.gather_sequence_lock``, so sequences can only serialize,
+never interleave. Across hosts, sequence order must agree by deployment:
+overlapped metrics issue exclusively from their scheduler in notify order,
+which is identical on every host of an SPMD update stream (the intended
+deployment); mixing overlapped cycles with concurrent blocking syncs of
+*other* metrics on different threads is on the operator, exactly as
+concurrent blocking syncs already were. A mis-paired or wedged gather is
+still bounded by ``RetryingGather`` (timeout + breaker + loud local-only
+fallback) rather than hanging.
+
+Cadence defaults resolve from the environment (the established
+``METRICS_TPU_*`` contract — malformed values warn once and fall back, a bad
+env var can degrade freshness, never correctness):
+
+- ``METRICS_TPU_SYNC_EVERY_N`` — sync every N updates (default 1: eager,
+  issued at update time).
+- ``METRICS_TPU_SYNC_EVERY_S`` — and/or at least every S seconds (default
+  unset: purely update-driven).
+
+Module import performs python work only (stdlib + the shared env tools) —
+the hang-proof bootstrap contract (``utilities/backend.py``) holds.
+"""
+import threading
+import time
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+
+__all__ = [
+    "AsyncSyncScheduler",
+    "SyncView",
+    "resolve_sync_cadence",
+    "reset_async_sync_state",
+]
+
+_warn_once = WarnOnce()
+
+
+def _parse_every_n(raw: str) -> Optional[int]:
+    try:
+        n = int(raw)
+        if n < 1:
+            raise ValueError(raw)
+        return n
+    except ValueError:
+        _warn_once(
+            ("sync_every_n", raw),
+            f"METRICS_TPU_SYNC_EVERY_N={raw!r} is not a positive integer; "
+            "falling back to the default cadence (sync every update).",
+        )
+        return None
+
+
+def _parse_every_s(raw: str) -> Optional[float]:
+    try:
+        s = float(raw)
+        if s <= 0:
+            raise ValueError(raw)
+        return s
+    except ValueError:
+        _warn_once(
+            ("sync_every_s", raw),
+            f"METRICS_TPU_SYNC_EVERY_S={raw!r} is not a positive number; "
+            "ignoring the time cadence.",
+        )
+        return None
+
+
+_ENV_EVERY_N: EnvParse = EnvParse("METRICS_TPU_SYNC_EVERY_N", _parse_every_n, None)
+_ENV_EVERY_S: EnvParse = EnvParse("METRICS_TPU_SYNC_EVERY_S", _parse_every_s, None)
+
+
+def resolve_sync_cadence(
+    sync_every_n: Optional[int], sync_every_s: Optional[float]
+) -> Tuple[Optional[int], Optional[float]]:
+    """Programmatic args beat env vars beat defaults (the dispatch-layer
+    resolution rule). Returns ``(every_n, every_s)`` with ``every_n``
+    defaulting to 1 (eager, at update time) when neither source sets a
+    cadence at all — an overlapped metric with no cadence would never sync.
+    """
+    n = sync_every_n if sync_every_n is not None else _ENV_EVERY_N()
+    s = sync_every_s if sync_every_s is not None else _ENV_EVERY_S()
+    if n is not None and n < 1:
+        raise ValueError(f"`sync_every_n` must be >= 1, got {n}")
+    if s is not None and s <= 0:
+        raise ValueError(f"`sync_every_s` must be > 0, got {s}")
+    if n is None and s is None:
+        n = 1
+    return n, s
+
+
+def reset_async_sync_state() -> None:
+    """Test hook: forget memoized env parses and warn-once history (the
+    shared contract with ``ops.dispatch``/``ops.padding`` reset hooks)."""
+    _warn_once.reset()
+    _ENV_EVERY_N.reset()
+    _ENV_EVERY_S.reset()
+
+
+class SyncView(NamedTuple):
+    """One completed sync cycle: the reduced payload plus its coverage.
+
+    ``covered_seq`` is the notify-sequence watermark read *before* the
+    snapshot was taken — a lower bound on what the payload covers, so a
+    waiter can ask for "a view covering everything that existed when I
+    asked" (the ServeLoop fresh-report watermark, generalized).
+    ``covered_steps`` is the producer's own step counter at snapshot time
+    (update count for a metric) — the number ``sync_lag_steps`` is measured
+    against."""
+
+    payload: Any
+    covered_seq: int
+    covered_steps: int
+    snapshot_unix: float
+    completed_unix: float
+
+
+class AsyncSyncScheduler:
+    """Background double-buffered reducer: snapshot → reduce → publish.
+
+    ``snapshot_fn() -> (payload, steps)`` captures the live inputs (must be
+    safe to call from the worker thread — the callers hold their own swap
+    locks); ``reduce_fn(payload) -> reduced`` runs the collective/merge.
+    Exactly one cycle runs at a time; triggers arriving mid-cycle coalesce
+    into the next one. The last completed cycle is the *front* buffer
+    (:meth:`view`); the in-flight cycle is the back buffer — the double
+    buffering that lets readers never wait on a collective.
+    """
+
+    def __init__(
+        self,
+        snapshot_fn: Callable[[], Tuple[Any, Optional[int]]],
+        reduce_fn: Callable[[Any], Any],
+        *,
+        sync_every_n: Optional[int] = 1,
+        sync_every_s: Optional[float] = None,
+        deadline_s: float = 120.0,
+        tick_fn: Optional[Callable[[], Optional[float]]] = None,
+        on_error: Optional[Callable[[BaseException], None]] = None,
+        name: str = "metric",
+    ) -> None:
+        self.snapshot_fn = snapshot_fn
+        self.reduce_fn = reduce_fn
+        self.sync_every_n = sync_every_n
+        self.sync_every_s = sync_every_s
+        self.deadline_s = float(deadline_s)
+        self.tick_fn = tick_fn
+        self.on_error = on_error
+        self.name = name
+
+        self._lock = threading.Lock()
+        self._seq = 0  # bumped by notify(); the coverage watermark unit
+        self._steps = 0  # producer's own step counter (last notify)
+        self._cycle_seq = 0  # seq at the last cycle *attempt* (cadence base)
+        self._covered = -1  # seq covered by the front view (written ONLY by
+        #                     the worker, under _cv — single-writer, no races)
+        self._skip_final = False  # stop(final=False): shutdown pass skips
+        self._last_attempt_mono = time.monotonic()
+        self._in_flight_since: Optional[float] = None
+        self._stall_reported = False
+
+        self._cv = threading.Condition()
+        self._view: Optional[SyncView] = None
+        self._stopped = False
+
+        self._wake = threading.Event()
+        self._stop_evt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"metrics-tpu-async-sync-{name}"
+        )
+        self._thread.start()
+
+    # -- producer side --------------------------------------------------
+
+    def notify(self, steps: Optional[int] = None) -> None:
+        """One live mutation happened (an update landed / a replica
+        published). Wakes the worker when the update cadence is due."""
+        with self._lock:
+            self._seq += 1
+            self._steps = steps if steps is not None else self._seq
+            due = (
+                self.sync_every_n is not None
+                and (self._seq - self._cycle_seq) >= self.sync_every_n
+            )
+        if due:
+            self._wake.set()
+
+    def request(self) -> None:
+        """Ask for a cycle now (cadence-independent)."""
+        self._wake.set()
+
+    def seq(self) -> int:
+        """Current notify watermark (pair with :meth:`wait_covered`)."""
+        with self._lock:
+            return self._seq
+
+    # -- reader side ----------------------------------------------------
+
+    def view(self) -> Optional[SyncView]:
+        """The front buffer: the last completed cycle (None before the
+        first). Never blocks; an immutable tuple, never torn."""
+        self._check_stalled()
+        return self._view
+
+    def covered(self, target_seq: Optional[int] = None) -> bool:
+        with self._cv:
+            target = self._seq if target_seq is None else target_seq
+            return self._view is not None and self._covered >= target
+
+    def wait_covered(self, target_seq: int, deadline_s: float) -> bool:
+        """Block (bounded) until the front view covers ``target_seq``.
+        Returns False on deadline or when the scheduler has stopped with the
+        target uncovered — the caller degrades to the stale view."""
+        with self._cv:
+            def _cov() -> bool:
+                return self._view is not None and self._covered >= target_seq
+
+            def _done() -> bool:
+                # a stop() mid-wait must wake the waiter too: once the
+                # scheduler has stopped, no fresher view can ever arrive, so
+                # sleeping out the rest of the deadline buys nothing
+                return _cov() or self._stopped
+
+            if _cov():
+                return True
+            if self._stopped:
+                # no fresher view can ever arrive; answer immediately
+                # instead of burning the caller's whole deadline
+                return False
+            self._wake.set()
+            self._cv.wait_for(_done, timeout=max(0.0, deadline_s))
+            return _cov()
+
+    def lag(self, live_steps: Optional[int] = None) -> dict:
+        """Staleness of the front buffer relative to the live stream."""
+        self._check_stalled()
+        view = self._view
+        with self._lock:
+            steps = self._steps if live_steps is None else live_steps
+            in_flight = self._in_flight_since is not None
+        if view is None:
+            return {
+                "sync_lag_steps": steps,
+                "sync_lag_s": None,
+                "synced_once": False,
+                "in_flight": in_flight,
+            }
+        return {
+            "sync_lag_steps": max(0, steps - view.covered_steps),
+            "sync_lag_s": max(0.0, time.time() - view.snapshot_unix),
+            "synced_once": True,
+            "in_flight": in_flight,
+        }
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def _check_stalled(self) -> None:
+        """An in-flight cycle past its deadline is reported ONCE per episode
+        the moment a reader observes it — loud degradation, never a hang
+        (readers keep serving the previous view regardless)."""
+        with self._lock:
+            since = self._in_flight_since
+            if since is None or self._stall_reported:
+                return
+            overdue = time.monotonic() - since - self.deadline_s
+            if overdue <= 0:
+                return
+            self._stall_reported = True
+        from metrics_tpu.resilience.health import record_degradation
+
+        record_degradation(
+            "async_sync_stalled",
+            f"overlapped sync cycle for {self.name} in flight past its "
+            f"{self.deadline_s:.0f}s deadline; readers are serving the previous "
+            "reduced view (growing staleness)",
+            name=self.name,
+        )
+
+    # -- worker ---------------------------------------------------------
+
+    def _wait_timeout(self) -> Optional[float]:
+        waits = []
+        if self.sync_every_s is not None:
+            waits.append(
+                max(0.0, self._last_attempt_mono + self.sync_every_s - time.monotonic())
+            )
+        if self.tick_fn is not None and self._tick_due is not None:
+            waits.append(max(0.0, self._tick_due))
+        return min(waits) if waits else None
+
+    def _loop(self) -> None:
+        # learn the side-work cadence up front (a tick with nothing due just
+        # returns its due-in) — initializing to "due now" would force an
+        # immediate spurious wakeup and an empty first cycle
+        self._tick_due: Optional[float] = None
+        if self.tick_fn is not None:
+            try:
+                self._tick_due = self.tick_fn()
+            except Exception as err:  # noqa: BLE001 — side-work degrades, never kills the loop
+                if self.on_error is not None:
+                    self.on_error(err)
+        while True:
+            triggered = self._wake.wait(timeout=self._wait_timeout())
+            if triggered:
+                self._wake.clear()
+            if (
+                self.sync_every_s is not None
+                and time.monotonic() - self._last_attempt_mono >= self.sync_every_s
+            ):
+                # the cadence base advances on idle wakeups too — otherwise a
+                # quiet scheduler's wait timeout collapses to 0 and spins
+                self._last_attempt_mono = time.monotonic()
+            with self._lock:
+                seq = self._seq
+                skip = self._skip_final
+            # an idle scheduler must not burn reduce cycles re-deriving a
+            # bit-identical view: cycle only when there is uncovered work
+            if seq != self._covered and not skip:
+                self._cycle(seq)
+            if self.tick_fn is not None:
+                try:
+                    self._tick_due = self.tick_fn()
+                except Exception as err:  # noqa: BLE001 — side-work degrades, never kills the loop
+                    self._tick_due = None
+                    if self.on_error is not None:
+                        self.on_error(err)
+            if self._stop_evt.is_set():
+                # final pass so readers cover everything produced — unless
+                # the cycle just above already did (a quiet shutdown must
+                # not run two identical reduces back to back) or
+                # stop(final=False) waived it
+                with self._lock:
+                    seq = self._seq
+                    skip = self._skip_final
+                if seq != self._covered and not skip:
+                    self._cycle(seq)
+                with self._cv:
+                    self._stopped = True
+                    self._cv.notify_all()
+                return
+
+    def _cycle(self, seq: int) -> None:
+        """One snapshot → reduce → publish pass. ``seq`` was read BEFORE the
+        snapshot, so it is a sound lower bound on the view's coverage."""
+        with self._lock:
+            self._in_flight_since = time.monotonic()
+            self._stall_reported = False
+            self._cycle_seq = seq
+        self._last_attempt_mono = time.monotonic()
+        snapshot_unix = time.time()
+        try:
+            payload, steps = self.snapshot_fn()
+            if steps is None:
+                # snapshot hooks without their own step counter (ServeLoop's
+                # sweep) cover the notify watermark read before the sweep —
+                # using anything else (e.g. a snapshot count) would make
+                # lag()'s steps arithmetic compare incommensurable units
+                steps = seq
+            reduced = self.reduce_fn(payload)
+        except Exception as err:  # noqa: BLE001 — a failed cycle degrades to the stale view
+            if self.on_error is not None:
+                self.on_error(err)
+            return  # covered NOT advanced: the next trigger/cadence retries
+        finally:
+            with self._lock:
+                self._in_flight_since = None
+        view = SyncView(
+            payload=reduced,
+            covered_seq=seq,
+            covered_steps=steps,
+            snapshot_unix=snapshot_unix,
+            completed_unix=time.time(),
+        )
+        with self._cv:
+            self._view = view
+            self._covered = max(self._covered, seq)
+            self._cv.notify_all()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stop(self, final: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop the worker. ``final=True`` (default) lets it run one last
+        cycle so the front view covers every notify that happened."""
+        if not final:
+            # waive the shutdown reduce via a dedicated flag — writing
+            # _covered here would race the worker's own (under _cv) write
+            # and a lost update could resurrect the reduce being waived
+            with self._lock:
+                self._skip_final = True
+        self._stop_evt.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout_s)
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()

@@ -26,6 +26,7 @@ Three execution regimes, all supported:
    NCCL ``all_gather`` with the pad-gather-trim dance for ragged shapes
    (reference ``utilities/distributed.py:128-151``).
 """
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -34,6 +35,15 @@ import numpy as np
 
 Array = jax.Array
 Reduction = Union[str, Callable, None]
+
+# Process-wide serializer for host-issued gather SEQUENCES. Process-level
+# collectives pair calls across hosts by issue order, so two multi-leaf sync
+# sequences (a blocking `_sync_dist`, an overlapped scheduler cycle) running
+# on different threads of one host must never interleave their per-leaf
+# gathers — each sequence holds this lock end to end (re-entrant: a sequence
+# may nest helper gathers). Cross-host sequence ordering is a deployment
+# contract documented in `parallel/async_sync.py`.
+gather_sequence_lock = threading.RLock()
 
 
 def distributed_available() -> bool:

@@ -305,6 +305,187 @@ def bootstrap_functionalize(
     return MetricDef(init=init, update=update, compute=compute, merge=merge, dropped=dropped, faults=faults)
 
 
+class OverlappedDef(NamedTuple):
+    """Pure functions for overlapped (double-buffered) sync inside compiled
+    code — the T3 stance expressed as an explicit state layout:
+
+    ``state = {"live": <local accumulator>, "reduced": <last synced buffer>,
+    "steps": i32, "covered": i32}``
+
+    - ``update(state, *batch)`` folds a batch into the LIVE buffer only —
+      **zero collectives** (pinned by the ``overlapped_read_step`` registry
+      budget together with ``read``).
+    - ``cycle(state)`` issues the sync collectives against a snapshot of the
+      live buffer (ONE ``fused_sync`` over every leaf of the whole
+      metric/wrapper/collection tree → the guarded-collection ≤2-all-reduce
+      budget holds per cycle) and publishes it as the ``reduced`` buffer.
+      The collective has no data dependency on concurrently-dispatched
+      ``update`` calls on newer live states, so XLA/the async dispatch queue
+      overlaps it with ongoing update compute.
+    - ``read(state)`` computes from the ``reduced`` buffer with **no sync**:
+      an already-reduced, at-most-one-cycle-stale view, zero collective
+      latency on the read path.
+    - ``read_fresh(state)`` is the blocking escape hatch: sync the live
+      buffer, then compute — today's semantics, today's latency.
+    - ``lag(state)`` = ``steps - covered``, the staleness in update steps.
+
+    An overlapped ``read`` after ``cycle`` equals a blocking ``read_fresh``
+    over exactly the batches the cycle covered — bit-identical for exact
+    (sum/count) states, since both run the same fused collectives on the
+    same data.
+    """
+
+    init: Callable[[], Dict[str, Any]]
+    update: Callable[..., Dict[str, Any]]
+    cycle: Callable[[Dict[str, Any]], Dict[str, Any]]
+    read: Callable[[Dict[str, Any]], Any]
+    read_fresh: Callable[[Dict[str, Any]], Any]
+    lag: Callable[[Dict[str, Any]], Any]
+    # fault/overflow counters of the REDUCED buffer: after a cycle these are
+    # already the global sums, so reading them costs zero collectives (the
+    # MetricDef.faults/dropped contract moved onto the stale-read path)
+    faults: Callable[[Dict[str, Any]], Any] = None
+    dropped: Callable[[Dict[str, Any]], Any] = None
+
+
+def _fused_sync_tree(metric: "Metric", axis_name: str) -> Callable[[Any], Any]:
+    """Build ``state -> globally-synced state`` as ONE ``fused_sync`` over
+    every leaf row of a metric / trace-safe wrapper / collection — one
+    overlapped cycle per fused compute-group, preserving the collection's
+    per-cycle collective budget (the blocking compute path syncs wrapper
+    members separately; the cycle fuses them into the same buckets)."""
+    from metrics_tpu.collections import MetricCollection  # local import to avoid cycle
+    from metrics_tpu.parallel.sync import fused_sync
+
+    if isinstance(metric, MetricCollection):
+        members = list(metric.items(keep_base=True, copy_state=False))
+        wrapper_names = {name for name, m in members if _is_trace_safe_wrapper(m)}
+        row_meta = []  # (name, node_index_or_None, reductions, defaults)
+        for name, m in members:
+            if name in wrapper_names:
+                for j, node in enumerate(_collect_metrics(m)):
+                    row_meta.append((name, j, dict(node._reductions), node._sync_defaults()))
+            else:
+                row_meta.append((name, None, dict(m._reductions), m._sync_defaults()))
+
+        def sync_tree(state: Dict[str, Any]) -> Dict[str, Any]:
+            rows = [
+                dict(state[name] if j is None else state[name][j])
+                for name, j, _, _ in row_meta
+            ]
+            synced = fused_sync(
+                rows,
+                [r for _, _, r, _ in row_meta],
+                axis_name,
+                defaults=[d for _, _, _, d in row_meta],
+            )
+            out = {
+                name: (list(state[name]) if name in wrapper_names else state[name])
+                for name, _ in members
+            }
+            for (name, j, _, _), s in zip(row_meta, synced):
+                if j is None:
+                    out[name] = s
+                else:
+                    out[name][j] = s
+            return out
+
+        return sync_tree
+
+    if _is_trace_safe_wrapper(metric):
+        nodes = _collect_metrics(metric)
+        reds = [dict(n._reductions) for n in nodes]
+        defs = [n._sync_defaults() for n in nodes]
+
+        def sync_tree(states):
+            return fused_sync([dict(s) for s in states], reds, axis_name, defaults=defs)
+
+        return sync_tree
+
+    reds_one = dict(metric._reductions)
+    defs_one = metric._sync_defaults()
+
+    def sync_tree(state):
+        return fused_sync([dict(state)], [reds_one], axis_name, defaults=[defs_one])[0]
+
+    return sync_tree
+
+
+def overlapped_functionalize(metric: "Metric", axis_name: Optional[str] = None) -> OverlappedDef:
+    """Build the overlapped (double-buffered) pure API for a metric or
+    collection — see :class:`OverlappedDef` for the state layout and
+    semantics. ``axis_name=None`` degrades the cycle's collective to the
+    identity snapshot (single-device semantics: the reduced buffer is a
+    consistent copy of the live one), which keeps the state layout — and
+    its recompile stability — identical across regimes.
+
+    Example (single-device form)::
+
+        odef = overlapped_functionalize(Accuracy(num_classes=3))
+        s = odef.init()
+        s = jax.jit(odef.update)(s, preds, target)   # live only, 0 collectives
+        s = jax.jit(odef.cycle)(s)                   # snapshot -> sync -> publish
+        value = jax.jit(odef.read)(s)                # zero-collective read
+    """
+    import jax.numpy as jnp
+
+    mdef = functionalize(metric)  # NO axis: local update + local compute
+    sync_tree = (
+        _fused_sync_tree(metric, axis_name) if axis_name is not None else (lambda s: s)
+    )
+
+    def init() -> Dict[str, Any]:
+        # the reduced buffer starts as the identity state: a read before the
+        # first cycle covers exactly zero batches (covered == 0)
+        return {
+            "live": mdef.init(),
+            "reduced": mdef.init(),
+            "steps": jnp.zeros((), jnp.int32),
+            "covered": jnp.zeros((), jnp.int32),
+        }
+
+    def update(state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return {
+            **state,
+            "live": mdef.update(state["live"], *args, **kwargs),
+            "steps": state["steps"] + 1,
+        }
+
+    def cycle(state: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            **state,
+            "reduced": sync_tree(state["live"]),
+            "covered": state["steps"],
+        }
+
+    def read(state: Dict[str, Any]) -> Any:
+        return mdef.compute(state["reduced"])
+
+    def read_fresh(state: Dict[str, Any]) -> Any:
+        return mdef.compute(sync_tree(state["live"]))
+
+    def lag(state: Dict[str, Any]) -> Any:
+        return state["steps"] - state["covered"]
+
+    def faults(state: Dict[str, Any]) -> Any:
+        # the cycle already summed the counters globally — no psum here
+        return mdef.faults(state["reduced"])
+
+    def dropped(state: Dict[str, Any]) -> Any:
+        return mdef.dropped(state["reduced"])
+
+    return OverlappedDef(
+        init=init,
+        update=update,
+        cycle=cycle,
+        read=read,
+        read_fresh=read_fresh,
+        lag=lag,
+        faults=faults,
+        dropped=dropped,
+    )
+
+
 def _merge_by_reduction(reductions, state_a, state_b, count_a, count_b, owner_name):
     """Shared pure merge rule keyed by each state's reduction tag."""
     import jax.numpy as jnp

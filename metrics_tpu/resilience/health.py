@@ -34,6 +34,10 @@ from typing import Any, Dict, List, Optional
 #                          (metrics_tpu/serving — graceful overload degradation, counted
 #                          so accepted + shed always reconciles with offered)
 #   serve_update_error     a ServeLoop worker's update raised; the request was dropped
+#   async_sync_error       an overlapped sync cycle's gather/reduce raised; readers keep
+#                          the previous (staler) reduced view and the cadence retries
+#   async_sync_stalled     an overlapped sync cycle overran its deadline; readers keep
+#                          serving the previous view while staleness grows
 _MAX_EVENTS = 256
 
 
@@ -118,6 +122,19 @@ def _metric_health(metric: Any) -> Dict[str, Any]:
     dropped = getattr(metric, "dropped_count", None)
     if dropped:
         entry["overflow_dropped"] = dropped
+    if getattr(metric, "sync_mode", "blocking") == "overlapped":
+        # overlapped async sync (parallel/async_sync.py): how far the
+        # double-buffered reduced view trails the live accumulator, in
+        # update steps and wall-clock. Informational like staleness — an
+        # operator decides how much lag is too much; only a scheduler
+        # degradation event (async_sync_error/_stalled) flips `degraded`.
+        lag = getattr(metric, "sync_lag", None)
+        if lag is not None:
+            entry["sync_mode"] = "overlapped"
+            entry["sync_lag_steps"] = lag.get("sync_lag_steps")
+            entry["sync_lag_s"] = lag.get("sync_lag_s")
+            if lag.get("in_flight"):
+                entry["sync_in_flight"] = True
     last = getattr(metric, "_last_update_unix", None)
     if last is not None:
         entry["last_update_unix"] = last

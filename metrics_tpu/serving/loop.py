@@ -11,15 +11,20 @@ torn update). This module is the serving answer, built from three rules:
    *publishes* an immutable snapshot of its replica's state (jax arrays are
    immutable; publication is one list-slot assignment, atomic under the
    GIL), so readers never observe a half-applied update.
-2. **Reads merge, never block ingestion.** A background reducer folds the
-   published snapshots through the framework's existing merge rules —
-   ``Metric._reduce_states`` (weighted by each replica's update count for
-   'mean' states) and the sketches' own ``sketch_merge`` — into a fresh
-   reporter clone and computes it. ``report()`` serves the latest reduced
-   view with its ``staleness_s``; ``report(fresh=True, deadline_s=...)``
-   requests a reduce and waits at most the deadline, falling back to the
-   stale view — the serving path never blocks behind a merge/collective
-   (the T3 stance: stale-but-already-reduced beats fresh-but-blocking).
+2. **Reads merge, never block ingestion.** The background reducer is an
+   :class:`~metrics_tpu.parallel.async_sync.AsyncSyncScheduler` cycle — the
+   SAME double-buffered snapshot→reduce→publish mechanism that powers
+   ``Metric(sync_mode='overlapped')``, not a second reduction implementation.
+   Each cycle folds the published snapshots through the framework's existing
+   merge rules — ``Metric._reduce_states`` (weighted by each replica's
+   update count for 'mean' states) and the sketches' own ``sketch_merge`` —
+   into a fresh reporter clone and computes it. ``report()`` serves the
+   scheduler's front view with its ``staleness_s``; ``report(fresh=True,
+   deadline_s=...)`` waits (bounded, on the scheduler's coverage watermark)
+   for a view covering every publish that existed at call time, falling
+   back to the stale view — the serving path never blocks behind a
+   merge/collective (the T3 stance: stale-but-already-reduced beats
+   fresh-but-blocking).
 3. **Overload sheds loudly.** Ingestion is a bounded queue; ``offer`` on a
    full queue drops the request, counts it, and records an
    ``overload_shed`` event in the process-wide :class:`HealthRegistry`, so
@@ -40,6 +45,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
 from metrics_tpu.resilience.health import health_report, record_degradation
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 
@@ -199,33 +205,40 @@ class ServeLoop:
         self._processed = 0
         self._failed = 0
 
-        self._view: Optional[Dict[str, Any]] = None
-        self._publish_seq = 0  # bumped on every worker publish (stats lock)
-        self._reduced_seq = -1  # publish_seq covered by the current view
-        self._view_covered = -1  # publish_seq the CURRENT view is known to cover
         self._stopping = False  # set under _stats_lock: offer/stop handshake
-        self._view_cv = threading.Condition()
         self._last_reporter: Optional[Any] = None
-        self._reduce_request = threading.Event()
         # two-phase shutdown: workers stop (after draining the backlog)
-        # BEFORE the reducer runs its final pass — one shared event let the
-        # reducer's "final" reduce race ahead of workers still mid-backlog,
-        # permanently orphaning their later publishes from report()
+        # BEFORE the scheduler runs its final pass — a "final" reduce racing
+        # ahead of workers still mid-backlog would permanently orphan their
+        # later publishes from report()
         self._stop_workers = threading.Event()
-        self._stop_reducer = threading.Event()
 
         self._snapshot_mgr = snapshot_manager
         self._snapshot_every_s = snapshot_every_s
         self._snapshot_step = itertools.count(1)
         self._last_snapshot_unix = time.time()
 
+        # the background reducer IS an async-sync scheduler cycle: snapshot =
+        # sweep the workers' published states (+ any restored base), reduce =
+        # clone+fold+compute — the same double-buffer mechanism as
+        # Metric(sync_mode='overlapped'), so serving has no private second
+        # reduction machinery. Workers notify() per publish; the cadence is
+        # time-driven (reduce_every_s), with the snapshot side-cadence riding
+        # the scheduler's tick hook.
+        self._scheduler = AsyncSyncScheduler(
+            snapshot_fn=self._sweep_published,
+            reduce_fn=self._reduce_view,
+            sync_every_n=None,
+            sync_every_s=self.reduce_every_s,
+            tick_fn=self._snapshot_tick,
+            on_error=self._on_reduce_error,
+            name=f"serve-{type(metric).__name__}",
+        )
+
         self._threads = [
             threading.Thread(target=self._worker, args=(i,), daemon=True, name=f"serve-worker-{i}")
             for i in range(workers)
         ]
-        self._threads.append(
-            threading.Thread(target=self._reducer, daemon=True, name="serve-reducer")
-        )
         for t in self._threads:
             t.start()
 
@@ -303,10 +316,11 @@ class ServeLoop:
                 )
             else:
                 # publish AFTER the update completes: one atomic slot write
-                # of an immutable snapshot — readers never see a torn state
+                # of an immutable snapshot — readers never see a torn state.
+                # The notify lands after the slot write, so the scheduler's
+                # coverage watermark is always a sound lower bound.
                 self._published[i] = _snapshot_of(replica)
-                with self._stats_lock:
-                    self._publish_seq += 1
+                self._scheduler.notify()
             finally:
                 with self._stats_lock:
                     self._processed += 1
@@ -314,25 +328,25 @@ class ServeLoop:
 
     # -- reduction / reads ----------------------------------------------
 
-    def _reduce_once(self, covered_seq: int) -> bool:
-        """One full clone + fold + compute pass. ``covered_seq`` is the
-        publish sequence number read BEFORE this pass swept ``_published``
-        — a lower bound on what the resulting view covers, recorded so
-        ``report(fresh=True)`` can wait for a view that provably includes
-        the publishes that existed when the caller asked."""
+    def _sweep_published(self) -> Tuple[List[_Snapshot], Optional[int]]:
+        """Scheduler snapshot hook: one consistent sweep of the restored
+        base + every worker's published state (each slot an immutable
+        snapshot — the sweep can never tear). Steps is None: the scheduler
+        substitutes its notify (publish-sequence) watermark, so
+        ``health()["serving"]["sync"]["sync_lag_steps"]`` counts publishes
+        behind — a caught-up reducer reads 0, however much traffic flowed."""
         snaps = [s for s in ([self._base_snap] + list(self._published)) if s is not None]
+        return snaps, None
+
+    def _reduce_view(self, snaps: List[_Snapshot]) -> Dict[str, Any]:
+        """Scheduler reduce hook: one full clone + fold + compute pass over
+        the swept snapshots. Raises on failure — the scheduler then keeps
+        the previous view (loudly, via :meth:`_on_reduce_error`) and the
+        next cadence tick retries."""
         reporter = _clone(self._proto)
-        try:
-            for snap in snaps:
-                _fold_snapshot(reporter, snap)
-            value = reporter.compute() if snaps else None
-        except Exception as err:  # noqa: BLE001 - e.g. on_invalid='error' firing at compute
-            record_degradation(
-                "serve_reduce_error",
-                f"reduce/compute raised {type(err).__name__}: {err}",
-                metric=type(self._proto).__name__,
-            )
-            return False  # keep serving the previous view
+        for snap in snaps:
+            _fold_snapshot(reporter, snap)
+        value = reporter.compute() if snaps else None
         # fault counters of the merged view, per member (None when unguarded);
         # bind the property once — each read is a device-to-host transfer
         faults = {}
@@ -340,68 +354,43 @@ class ServeLoop:
             fc = getattr(m, "fault_counts", None)
             if fc:
                 faults[name or type(m).__name__] = fc
-        view = {
+        self._last_reporter = reporter
+        return {
             "value": value,
             "computed_unix": time.time(),
             "updates": sum(m._update_count for _, m in _members(reporter)),
             "faults": faults,
         }
-        self._last_reporter = reporter
-        with self._view_cv:
-            self._view = view
-            self._view_covered = max(self._view_covered, covered_seq)
-            self._view_cv.notify_all()
-        return True
 
-    def _reducer(self) -> None:
-        while True:
-            # the wait must also wake for the snapshot cadence: with only
-            # reduce_every_s as the timeout, snapshot_every_s shorter than
-            # the reduce cadence would silently stretch to it on an idle loop
-            timeout = self.reduce_every_s
-            if self._snapshot_every_s is not None:
-                due_in = self._last_snapshot_unix + self._snapshot_every_s - time.time()
-                timeout = max(0.0, min(timeout, due_in))
-            triggered = self._reduce_request.wait(timeout=timeout)
-            if triggered:
-                self._reduce_request.clear()
-            with self._stats_lock:
-                seq = self._publish_seq
-            # an idle loop must not burn a clone+fold+compute cycle every
-            # cadence tick re-deriving a bit-identical view; explicit
-            # requests (fresh=True, restore_snapshot) always reduce
-            if triggered or seq != self._reduced_seq:
-                # advance only on success: after a transient reduce error the
-                # next cadence tick must retry even with no new publish, or
-                # report() would serve an ever-staler view until fresh traffic
-                if self._reduce_once(seq):
-                    self._reduced_seq = seq
-            if (
-                self._snapshot_every_s is not None
-                and time.time() - self._last_snapshot_unix >= self._snapshot_every_s
-            ):
-                try:
-                    self.save_snapshot()
-                except Exception as err:  # noqa: BLE001 - snapshots degrade, never kill serving
-                    # stamp the attempt: a persistently failing writer retries
-                    # on the cadence instead of busy-spinning the zero timeout
-                    self._last_snapshot_unix = time.time()
-                    record_degradation(
-                        "serve_snapshot_error",
-                        f"periodic snapshot raised {type(err).__name__}: {err}",
-                    )
-            if self._stop_reducer.is_set():
-                # final view covers every processed batch — stop() only sets
-                # this event after the workers have joined, so every publish
-                # exists by now. Skip the pass when the reduce just above
-                # already covered the last publish (stop() triggers the
-                # event, so a quiet shutdown would otherwise run two
-                # identical ~full reduces back to back).
-                with self._stats_lock:
-                    seq = self._publish_seq
-                if seq != self._reduced_seq:
-                    self._reduce_once(seq)
-                return
+    def _on_reduce_error(self, err: BaseException) -> None:
+        record_degradation(
+            "serve_reduce_error",
+            f"reduce/compute raised {type(err).__name__}: {err}",
+            metric=type(self._proto).__name__,
+        )
+
+    def _snapshot_tick(self) -> Optional[float]:
+        """Scheduler tick hook: the periodic-snapshot side cadence. Returns
+        seconds until the next snapshot is due so the scheduler's wait wakes
+        for whichever of reduce/snapshot cadence fires first — a
+        ``snapshot_every_s`` shorter than ``reduce_every_s`` is honored even
+        on an idle loop."""
+        if self._snapshot_every_s is None:
+            return None
+        due_in = self._last_snapshot_unix + self._snapshot_every_s - time.time()
+        if due_in > 0:
+            return due_in
+        try:
+            self.save_snapshot()
+        except Exception as err:  # noqa: BLE001 - snapshots degrade, never kill serving
+            # stamp the attempt: a persistently failing writer retries on the
+            # cadence instead of busy-spinning a zero wait
+            self._last_snapshot_unix = time.time()
+            record_degradation(
+                "serve_snapshot_error",
+                f"periodic snapshot raised {type(err).__name__}: {err}",
+            )
+        return self._snapshot_every_s
 
     def report(self, fresh: bool = False, deadline_s: float = 0.5) -> Dict[str, Any]:
         """The merged metric value as last reduced, never blocking ingestion.
@@ -415,21 +404,16 @@ class ServeLoop:
         got_fresh = False
         if fresh:
             # "fresh" means: a view covering every publish that existed when
-            # this call was made. Waiting for *any* view swap would let a
-            # reduce already in flight (whose snapshot sweep predates the
-            # latest publishes) satisfy the wait with stale data.
-            with self._stats_lock:
-                target = self._publish_seq
-            with self._view_cv:
-                covered = lambda: self._view is not None and self._view_covered >= target
-                if covered():
-                    got_fresh = True  # already covered: no forced reduce
-                elif self._stop_reducer.is_set():
-                    got_fresh = False  # reducer exited: no fresher view can arrive
-                else:
-                    self._reduce_request.set()
-                    got_fresh = self._view_cv.wait_for(covered, timeout=max(0.0, deadline_s))
-        view = self._view
+            # this call was made — the scheduler's coverage watermark, not
+            # "any view swap" (a reduce already in flight when we asked may
+            # have swept snapshots predating the latest publishes). Already
+            # covered → no forced reduce; scheduler stopped → answer
+            # immediately instead of burning the deadline.
+            got_fresh = self._scheduler.wait_covered(
+                self._scheduler.seq(), deadline_s=max(0.0, deadline_s)
+            )
+        sync_view = self._scheduler.view()
+        view = sync_view.payload if sync_view is not None else None
         # hand out copies of the view's mutable containers: the same view
         # dict serves every reader until the next reduce, so a caller
         # mutating its result must not corrupt other readers
@@ -467,7 +451,8 @@ class ServeLoop:
             if self._last_reporter is not None
             else health_report()
         )
-        view = self._view
+        sync_view = self._scheduler.view()
+        view = sync_view.payload if sync_view is not None else None
         rep["serving"] = {
             **self.stats(),
             "workers": self.workers,
@@ -475,6 +460,10 @@ class ServeLoop:
             "report_staleness_s": (
                 max(0.0, time.time() - view["computed_unix"]) if view else None
             ),
+            # the scheduler's own lag view (publishes behind, seconds behind,
+            # cycle in flight) — same fields health_report grows per
+            # overlapped metric
+            "sync": self._scheduler.lag(),
         }
         return rep
 
@@ -497,7 +486,7 @@ class ServeLoop:
         run a final reduce so ``report()`` covers everything processed.
 
         Shutdown is two-phase: workers finish the queue backlog and JOIN
-        before the reducer is told to run its final pass — even when
+        before the scheduler is told to run its final pass — even when
         ``drain=False`` or the drain timed out, every batch a worker
         processed makes it into the final view (a worker outliving its
         join timeout is the one bounded exception; it is a daemon thread
@@ -507,11 +496,11 @@ class ServeLoop:
         if drain:
             self.drain(timeout_s)
         self._stop_workers.set()
-        for t in self._threads[:-1]:
+        for t in self._threads:
             t.join(timeout=timeout_s)
-        self._stop_reducer.set()
-        self._reduce_request.set()
-        self._threads[-1].join(timeout=timeout_s)
+        # final scheduler cycle (skipped when the cadence already covered the
+        # last publish — a quiet shutdown must not reduce twice back to back)
+        self._scheduler.stop(final=True, timeout_s=timeout_s)
 
     def __enter__(self) -> "ServeLoop":
         return self
@@ -563,10 +552,9 @@ class ServeLoop:
         base = _clone(self._proto)
         info = self._snapshot_mgr.restore(base, rank=0, world_size=1)
         self._base_snap = _snapshot_of(base)
-        # the base joins the coverage accounting: bump the publish sequence so
-        # the cadence reducer picks it up and report(fresh=True) waits for a
-        # view that provably includes it
-        with self._stats_lock:
-            self._publish_seq += 1
-        self._reduce_request.set()
+        # the base joins the coverage accounting: notify the scheduler so the
+        # cadence picks it up and report(fresh=True) waits for a view that
+        # provably includes it
+        self._scheduler.notify()
+        self._scheduler.request()
         return info

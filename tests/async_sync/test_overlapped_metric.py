@@ -1,0 +1,403 @@
+"""Module-runtime overlapped sync (ISSUE 8): ``Metric(sync_mode=
+'overlapped')`` reads an already-reduced double-buffered view with zero
+collective work on the read path — value parity with the blocking path is
+pinned BIT-IDENTICAL over the batches each cycle covers (sum/count
+states), staleness is bounded by one cycle, ``compute(fresh=True)``
+escapes to the blocking sync, and a dead transport degrades loudly to the
+previous view instead of hanging."""
+import copy
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu import metric as metric_mod
+from metrics_tpu.parallel.sync import _pad_gather_trim
+from metrics_tpu.resilience.health import registry
+
+pytestmark = pytest.mark.async_sync
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    registry.clear()
+    yield
+    registry.clear()
+
+
+def _two_rank_gather(x, group=None, transport=None):
+    """A simulated 2-rank pod: every rank contributes the same local state,
+    so synced sum states are exactly 2x the local ones — cheap, determinate,
+    and bit-exact for the parity pins."""
+    return _pad_gather_trim(x, lambda a: np.stack([np.asarray(a), np.asarray(a)]))
+
+
+@pytest.fixture()
+def _distributed(monkeypatch):
+    monkeypatch.setattr(metric_mod, "distributed_available", lambda: True)
+
+
+def _batch(rng, n, classes=4):
+    return (
+        jnp.asarray(rng.random((n, classes)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, classes, n).astype(np.int32)),
+    )
+
+
+def test_overlapped_read_bit_identical_to_blocking_over_covered_batches(_distributed):
+    rng = np.random.default_rng(0)
+    batches = [_batch(rng, 16) for _ in range(3)]
+    # a large sync_every_n pins cycle boundaries entirely to request_sync()
+    m = mt.Accuracy(
+        num_classes=4,
+        sync_mode="overlapped",
+        sync_every_n=10_000,
+        dist_sync_fn=_two_rank_gather,
+    )
+    ref = mt.Accuracy(num_classes=4, dist_sync_fn=_two_rank_gather)
+    for p, t in batches:
+        m.update(p, t)
+        ref.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    # value parity: the overlapped read equals the blocking read over
+    # exactly the batches the cycle covered
+    assert float(m.compute()) == float(ref.compute())
+    # state parity, bit-identical for the int sum states: the view's tp/fp/
+    # tn/fn equal the blocking gather+reduce of the same stream
+    view = m._sync_scheduler.view()
+    blocking_synced = ref._gathered_state(ref._copy_state(), _two_rank_gather)
+    for key in ("tp", "fp", "tn", "fn"):
+        np.testing.assert_array_equal(
+            np.asarray(view.payload[key]), np.asarray(blocking_synced[key]), err_msg=key
+        )
+
+
+def test_staleness_bounded_by_one_cycle_and_fresh_escape_hatch(_distributed):
+    rng = np.random.default_rng(1)
+    covered = [_batch(rng, 12) for _ in range(2)]
+    uncovered = [_batch(rng, 12) for _ in range(2)]
+    m = mt.Accuracy(
+        num_classes=4,
+        sync_mode="overlapped",
+        sync_every_n=10_000,
+        dist_sync_fn=_two_rank_gather,
+    )
+    at_cycle = mt.Accuracy(num_classes=4, dist_sync_fn=_two_rank_gather)
+    full = mt.Accuracy(num_classes=4, dist_sync_fn=_two_rank_gather)
+    for p, t in covered:
+        m.update(p, t)
+        at_cycle.update(p, t)
+        full.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    for p, t in uncovered:
+        m.update(p, t)
+        full.update(p, t)
+    # the stale read answers as of the cycle — not mid-way, not fresher
+    assert float(m.compute()) == float(at_cycle.compute())
+    lag = m.sync_lag
+    assert lag["sync_lag_steps"] == len(uncovered), lag
+    assert lag["synced_once"] and lag["sync_lag_s"] is not None
+    # the escape hatch pays the blocking sync and covers everything
+    assert float(m.compute(fresh=True)) == float(full.compute())
+
+
+def test_overlapped_fault_counters_are_global_at_cycle(_distributed):
+    rng = np.random.default_rng(2)
+    p, t = _batch(rng, 10)
+    p = p.at[0].set(jnp.nan)
+    m = mt.Accuracy(
+        num_classes=4,
+        sync_mode="overlapped",
+        sync_every_n=10_000,
+        on_invalid="drop",
+        dist_sync_fn=_two_rank_gather,
+    )
+    m.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    v = m.compute()
+    assert np.isfinite(float(v))
+    # the view's counters are the post-gather (2-rank) sums: 1 NaN row/rank
+    view = m._sync_scheduler.view()
+    counts = dict(zip(mt.FAULT_CLASSES, np.asarray(view.payload["_faults"].counts)))
+    assert counts["nonfinite_preds"] == 2
+    assert counts["dropped_rows"] == 2
+
+
+def test_single_process_overlapped_is_identity_reduce():
+    rng = np.random.default_rng(3)
+    p, t = _batch(rng, 8)
+    m = mt.Accuracy(num_classes=4, sync_mode="overlapped")
+    ref = mt.Accuracy(num_classes=4)
+    m.update(p, t)
+    ref.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    assert float(m.compute()) == float(ref.compute())
+
+
+def test_windowed_wrapper_rotation_survives_buffer_swap(_distributed):
+    """WindowedMetric under overlapped sync: bucket rotation happens on the
+    live rings; each cycle reduces a consistent snapshot of them, so the
+    overlapped read equals a blocking windowed clone fed the same stream —
+    across bucket boundaries and wrap-around."""
+    rng = np.random.default_rng(4)
+    stream = [_batch(rng, 8) for _ in range(7)]  # window 32 / 2 buckets of 16
+    m = mt.WindowedMetric(
+        mt.Accuracy(num_classes=4),
+        window=32,
+        buckets=2,
+        sync_mode="overlapped",
+        sync_every_n=10_000,
+        dist_sync_fn=_two_rank_gather,
+    )
+    ref = mt.WindowedMetric(
+        mt.Accuracy(num_classes=4), window=32, buckets=2, dist_sync_fn=_two_rank_gather
+    )
+    for p, t in stream:
+        m.update(p, t)
+        ref.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    assert float(m.compute()) == float(ref.compute())
+
+
+def test_decayed_wrapper_overlapped_parity(_distributed):
+    rng = np.random.default_rng(5)
+    m = mt.DecayedMetric(
+        mt.MeanMetric(),
+        halflife=64.0,
+        sync_mode="overlapped",
+        sync_every_n=10_000,
+        dist_sync_fn=_two_rank_gather,
+    )
+    ref = mt.DecayedMetric(mt.MeanMetric(), halflife=64.0, dist_sync_fn=_two_rank_gather)
+    for _ in range(5):
+        v = jnp.asarray(rng.random(16).astype(np.float32))
+        m.update(v)
+        ref.update(v)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(ref.compute()))
+
+
+def test_failed_cycle_degrades_loudly_to_previous_view(_distributed):
+    rng = np.random.default_rng(6)
+    p1, t1 = _batch(rng, 8)
+    p2, t2 = _batch(rng, 8)
+    transport_ok = {"ok": True}
+
+    def flaky_gather(x, group=None, transport=None):
+        if not transport_ok["ok"]:
+            raise RuntimeError("pod unreachable")
+        return _two_rank_gather(x)
+
+    m = mt.Accuracy(
+        num_classes=4, sync_mode="overlapped", sync_every_n=10_000, dist_sync_fn=flaky_gather
+    )
+    at_cycle = mt.Accuracy(num_classes=4, dist_sync_fn=_two_rank_gather)
+    m.update(p1, t1)
+    at_cycle.update(p1, t1)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    transport_ok["ok"] = False
+    m.update(p2, t2)
+    assert not m.request_sync(wait=True, deadline_s=1.0), "a dead transport cannot cover"
+    # loud: the failed cycle is a first-class health event …
+    assert registry.counts().get("async_sync_error", 0) >= 1
+    # … and available: the read serves the previous covered view, no hang
+    t0 = time.monotonic()
+    assert float(m.compute()) == float(at_cycle.compute())
+    assert time.monotonic() - t0 < 5.0
+    assert m.sync_lag["sync_lag_steps"] == 1
+
+
+def test_health_report_grows_sync_lag_fields(_distributed):
+    rng = np.random.default_rng(7)
+    p, t = _batch(rng, 8)
+    m = mt.Accuracy(
+        num_classes=4, sync_mode="overlapped", sync_every_n=10_000, dist_sync_fn=_two_rank_gather
+    )
+    m.update(p, t)
+    rep = mt.health_report(m)
+    entry = rep["metrics"]["Accuracy"]
+    assert entry["sync_mode"] == "overlapped"
+    assert entry["sync_lag_steps"] == 1  # nothing covered yet
+    assert entry["sync_lag_s"] is None
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    rep = mt.health_report(m)
+    entry = rep["metrics"]["Accuracy"]
+    assert entry["sync_lag_steps"] == 0
+    assert entry["sync_lag_s"] is not None
+    # lag is informational: a lagging-but-healthy metric is not `degraded`
+    assert rep["degraded"] is False
+    # blocking metrics grow no lag fields
+    b = mt.Accuracy(num_classes=4)
+    b.update(p, t)
+    assert "sync_lag_steps" not in mt.health_report(b)["metrics"]["Accuracy"]
+
+
+def test_collection_compute_group_shares_one_scheduler(_distributed):
+    rng = np.random.default_rng(8)
+    pre_threads = {
+        t.ident for t in threading.enumerate() if t.name.startswith("metrics-tpu-async-sync")
+    }
+    coll = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(
+                num_classes=4, sync_mode="overlapped", sync_every_n=10_000,
+                dist_sync_fn=_two_rank_gather,
+            ),
+            "prec": mt.Precision(
+                num_classes=4, average="macro", sync_mode="overlapped", sync_every_n=10_000,
+                dist_sync_fn=_two_rank_gather,
+            ),
+            "rec": mt.Recall(
+                num_classes=4, average="macro", sync_mode="overlapped", sync_every_n=10_000,
+                dist_sync_fn=_two_rank_gather,
+            ),
+            "f1": mt.F1Score(
+                num_classes=4, average="macro", sync_mode="overlapped", sync_every_n=10_000,
+                dist_sync_fn=_two_rank_gather,
+            ),
+        }
+    )
+    ref = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=4, dist_sync_fn=_two_rank_gather),
+            "prec": mt.Precision(num_classes=4, average="macro", dist_sync_fn=_two_rank_gather),
+            "rec": mt.Recall(num_classes=4, average="macro", dist_sync_fn=_two_rank_gather),
+            "f1": mt.F1Score(num_classes=4, average="macro", dist_sync_fn=_two_rank_gather),
+        }
+    )
+    for _ in range(2):
+        p, t = _batch(rng, 16)
+        coll.update(p, t)
+        ref.update(p, t)
+    # ONE scheduler for the WHOLE collection — a single issuer thread, so
+    # every cycle gathers all compute-group heads in one fixed-order atomic
+    # sequence (the cross-host issue-order contract); members read their
+    # group head's entry of the shared view via _sync_view_key. Stray
+    # per-member schedulers from the group-detection first update must have
+    # been stopped, not leaked.
+    members = dict(coll.items(keep_base=True, copy_state=False))
+    groups = coll.compute_groups
+    assert any(len(cg) > 1 for cg in groups.values()), "expected a fused group"
+    scheds = {id(m.__dict__["_sync_scheduler"]) for m in members.values()}
+    assert len(scheds) == 1 and None not in scheds
+    for cg in groups.values():
+        for name in cg:
+            assert members[name].__dict__["_sync_view_key"] == cg[0]
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        alive = [
+            t
+            for t in threading.enumerate()
+            if t.name.startswith("metrics-tpu-async-sync") and t.ident not in pre_threads
+        ]
+        if len(alive) <= 1:  # the stopped per-member strays must drain away
+            break
+        time.sleep(0.02)
+    assert len(alive) == 1, f"stray scheduler threads leaked: {[t.name for t in alive]}"
+    any_member = next(iter(members.values()))
+    assert any_member.request_sync(wait=True, deadline_s=30.0)
+    vals = coll.compute()
+    ref_vals = ref.compute()
+    for key in vals:
+        assert float(vals[key]) == float(ref_vals[key]), key
+    # per-member lag reads 0 in each member's own update units
+    assert all(m.sync_lag["sync_lag_steps"] == 0 for m in members.values())
+    # fresh=True forwards to every member
+    vals_fresh = coll.compute(fresh=True)
+    for key in vals_fresh:
+        assert float(vals_fresh[key]) == float(ref_vals[key]), key
+
+
+def test_clone_and_pickle_drop_scheduler_threads(_distributed):
+    rng = np.random.default_rng(9)
+    p, t = _batch(rng, 8)
+    m = mt.Accuracy(
+        num_classes=4, sync_mode="overlapped", sync_every_n=10_000, dist_sync_fn=_two_rank_gather
+    )
+    m.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    c = m.clone()
+    assert c.__dict__["_sync_scheduler"] is None, "a clone must not share the live scheduler"
+    assert c.sync_mode == "overlapped"
+    c.update(p, t)  # rebuilds its own scheduler lazily
+    assert c.request_sync(wait=True, deadline_s=30.0)
+    # pickle round trip (dist_sync_fn is a module-level function → picklable)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2.__dict__["_sync_scheduler"] is None
+    assert m2.sync_mode == "overlapped"
+    m2.update(p, t)
+    assert m2.request_sync(wait=True, deadline_s=30.0)
+
+
+def test_reset_discards_view_and_scheduler():
+    rng = np.random.default_rng(10)
+    p, t = _batch(rng, 8)
+    m = mt.Accuracy(num_classes=4, sync_mode="overlapped")
+    m.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    m.reset()
+    assert m.__dict__["_sync_scheduler"] is None
+    assert m.sync_lag["synced_once"] is False
+    m.update(p, t)
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    ref = mt.Accuracy(num_classes=4)
+    ref.update(p, t)
+    assert float(m.compute()) == float(ref.compute())
+
+
+def test_forward_protocol_returns_batch_values_not_the_view(_distributed):
+    """forward() computes batch-local values on a freshly-reset state; the
+    overlapped read path must never substitute the accumulated view there."""
+    rng = np.random.default_rng(11)
+    m = mt.Accuracy(
+        num_classes=4, sync_mode="overlapped", sync_every_n=10_000, dist_sync_fn=_two_rank_gather
+    )
+    b = mt.Accuracy(num_classes=4, dist_sync_fn=_two_rank_gather)
+    for _ in range(3):
+        p, t = _batch(rng, 8)
+        assert float(m(p, t)) == float(b(p, t))
+    assert m.request_sync(wait=True, deadline_s=30.0)
+    assert float(m.compute()) == float(b.compute())
+
+
+def test_snapshot_state_consistent_under_concurrent_cycles(_distributed):
+    """snapshot_state() under a hammering update/cycle thread: every
+    captured payload must restore cleanly and carry an internally-consistent
+    stat-scores state (tp+fn row-sums bit-equal across leaves' provenance —
+    a torn mid-swap capture would mix pre- and post-gather states, whose
+    leaves differ by exactly 2x)."""
+    rng = np.random.default_rng(12)
+    m = mt.Accuracy(
+        num_classes=2, sync_mode="overlapped", sync_every_n=1, dist_sync_fn=_two_rank_gather
+    )
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            p = jnp.asarray(rng.random((4, 2)).astype(np.float32))
+            t = jnp.asarray((rng.random(4) > 0.5).astype(np.int32))
+            m.update(p, t)
+            m.compute()
+
+    th = threading.Thread(target=hammer)
+    th.start()
+    try:
+        for _ in range(20):
+            payload = m.snapshot_state()
+            # rows-per-update invariant: tp+fp+tn+fn == 2 * rows_seen for
+            # binary stat scores; a half-swapped (live/gathered) mix breaks it
+            tp, fp, tn, fn = (np.asarray(payload["states"][k]) for k in ("tp", "fp", "tn", "fn"))
+            total = int(tp + fp + tn + fn) if tp.ndim == 0 else int((tp + fp + tn + fn).sum())
+            rows = 4 * payload["update_count"]
+            assert total == 2 * rows, (total, rows)
+            fresh = mt.Accuracy(num_classes=2)
+            fresh.load_snapshot_state(payload)  # validates every leaf
+            time.sleep(0.002)
+    finally:
+        stop.set()
+        th.join()

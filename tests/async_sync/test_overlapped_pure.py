@@ -1,0 +1,185 @@
+"""Compiled-layer overlapped sync (``pure.py::overlapped_functionalize``):
+the double-buffered update/cycle/read triple — value parity with the
+blocking functional path (bit-identical for exact states), staleness
+bounded by the cycle, zero collectives on the read graph, ≤2 all-reduces
+on the guarded-collection cycle, recompile stability of the state layout."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.analysis.graph_audit import collective_counts, hlo_of
+
+pytestmark = pytest.mark.async_sync
+
+NDEV = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+
+def _coll():
+    return mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=4, on_invalid="warn"),
+            "f1": mt.F1Score(num_classes=4, average="macro", on_invalid="warn"),
+        }
+    )
+
+
+def _batch(seed, rows):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random((rows, 4), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, 4, rows).astype(np.int32)),
+    )
+
+
+def test_single_device_update_cycle_read_parity():
+    odef = mt.overlapped_functionalize(mt.Accuracy(num_classes=4))
+    mdef = mt.functionalize(mt.Accuracy(num_classes=4))
+    s = odef.init()
+    ref = mdef.init()
+    for seed in range(3):
+        p, t = _batch(seed, 8)
+        s = jax.jit(odef.update)(s, p, t)
+        ref = mdef.update(ref, p, t)
+    s = jax.jit(odef.cycle)(s)
+    # the read covers exactly the cycled batches, bit-identically
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(odef.read)(s)), np.asarray(mdef.compute(ref))
+    )
+    assert int(odef.lag(s)) == 0
+    # an update AFTER the cycle must not leak into the stale read …
+    p, t = _batch(99, 8)
+    s = jax.jit(odef.update)(s, p, t)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(odef.read)(s)), np.asarray(mdef.compute(ref))
+    )
+    assert int(odef.lag(s)) == 1
+    # … but read_fresh (the blocking escape hatch) covers everything
+    ref = mdef.update(ref, p, t)
+    np.testing.assert_array_equal(
+        np.asarray(jax.jit(odef.read_fresh)(s)), np.asarray(mdef.compute(ref))
+    )
+
+
+def test_mesh_cycle_read_parity_and_fault_counters():
+    """Blocking fused compute vs overlapped cycle+read on a 4-device mesh:
+    bit-identical values (int sum states) and identical global fault
+    counters, read with zero additional collectives."""
+    bdef = mt.functionalize(_coll(), axis_name="data")
+    odef = mt.overlapped_functionalize(_coll(), axis_name="data")
+    p, t = _batch(0, 8 * NDEV)
+    p = p.at[:2].set(jnp.nan)  # 2 poison rows → counted by both members
+
+    def blocking(p_, t_):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), bdef.init()
+        )
+        s = bdef.update(s, p_, t_)
+        return bdef.compute(s), bdef.faults(s)
+
+    def overlapped(p_, t_):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+        )
+        s = odef.update(s, p_, t_)
+        s = odef.cycle(s)
+        return odef.read(s), odef.faults(s)
+
+    specs = (P("data"), P("data"))
+    bv, bf = jax.jit(
+        jax.shard_map(blocking, mesh=_mesh(), in_specs=specs, out_specs=(P(), P()))
+    )(p, t)
+    ov, of = jax.jit(
+        jax.shard_map(overlapped, mesh=_mesh(), in_specs=specs, out_specs=(P(), P()))
+    )(p, t)
+    for key in bv:
+        assert float(bv[key]) == float(ov[key]), key
+    np.testing.assert_array_equal(np.asarray(bf), np.asarray(of))
+    counts = dict(zip(mt.FAULT_CLASSES, np.asarray(of)))
+    assert counts["nonfinite_preds"] == 2 * 2  # 2 rows x 2 guarded members
+
+
+def test_cycle_budget_and_zero_collective_read():
+    """The ISSUE 8 structural acceptance, pinned via collective_counts: the
+    overlapped cycle of the guarded collection lowers ≤2 all-reduces (the
+    guarded-collection budget per cycle) and the stale-read graph lowers
+    ZERO collectives of any kind."""
+    odef = mt.overlapped_functionalize(_coll(), axis_name="data")
+    p, t = _batch(1, 4 * NDEV)
+
+    def update_and_cycle(p_, t_):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+        )
+        s = odef.update(s, p_, t_)
+        return odef.cycle(s)["reduced"]
+
+    cycle_fn = jax.jit(
+        jax.shard_map(
+            update_and_cycle, mesh=_mesh(), in_specs=(P("data"), P("data")), out_specs=P()
+        )
+    )
+    cycle_counts = collective_counts(hlo_of(cycle_fn, p, t))
+    assert 1 <= cycle_counts["all-reduce"] <= 2, cycle_counts
+    assert cycle_counts["all-gather"] == 0, cycle_counts
+
+    state0 = odef.update(odef.init(), *_batch(2, 8))  # infer member modes
+
+    def read(state):
+        return odef.read(state)
+
+    read_fn = jax.jit(jax.shard_map(read, mesh=_mesh(), in_specs=(P(),), out_specs=P()))
+    read_counts = collective_counts(hlo_of(read_fn, state0))
+    for op, n in read_counts.items():
+        assert n == 0, f"stale-read path lowered a {op} collective"
+
+
+def test_state_layout_is_batch_size_independent():
+    from metrics_tpu.analysis.graph_audit import audit_recompilation
+    from metrics_tpu.analysis.registry import _build_overlapped_raw_step, _overlapped_make_args
+
+    violations = audit_recompilation(
+        _build_overlapped_raw_step(), _overlapped_make_args, entry="overlapped_fused_step"
+    )
+    assert violations == [], violations
+
+
+def test_wrapper_cycle_fuses_window_rings():
+    """A windowed member's ring states ride the SAME overlapped cycle (one
+    fused_sync over every leaf row) with value parity vs the wrapper's own
+    blocking compute-path sync."""
+    def build():
+        return mt.MetricCollection(
+            {
+                "mean": mt.MeanMetric(),
+                "win": mt.WindowedMetric(mt.MeanMetric(), window=32, buckets=2),
+            }
+        )
+
+    bdef = mt.functionalize(build(), axis_name="data")
+    odef = mt.overlapped_functionalize(build(), axis_name="data")
+    vals = jnp.asarray(np.random.default_rng(3).random(8 * NDEV).astype(np.float32))
+
+    def blocking(v):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), bdef.init()
+        )
+        return bdef.compute(bdef.update(s, v))
+
+    def overlapped(v):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+        )
+        return odef.read(odef.cycle(odef.update(s, v)))
+
+    bv = jax.jit(jax.shard_map(blocking, mesh=_mesh(), in_specs=(P("data"),), out_specs=P()))(vals)
+    ov = jax.jit(jax.shard_map(overlapped, mesh=_mesh(), in_specs=(P("data"),), out_specs=P()))(vals)
+    for key in bv:
+        np.testing.assert_allclose(np.asarray(bv[key]), np.asarray(ov[key]), rtol=0, atol=0)

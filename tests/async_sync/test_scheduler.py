@@ -1,0 +1,306 @@
+"""AsyncSyncScheduler unit contracts (ISSUE 8): cadence, coverage
+watermarks, double-buffer publication, failure/retry degradation, stop
+semantics, and the env-var cadence resolution — all host-side (no jax)."""
+import threading
+import time
+
+import pytest
+
+from metrics_tpu.parallel.async_sync import (
+    AsyncSyncScheduler,
+    reset_async_sync_state,
+    resolve_sync_cadence,
+)
+
+pytestmark = pytest.mark.async_sync
+
+
+@pytest.fixture(autouse=True)
+def _fresh_env(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_SYNC_EVERY_N", raising=False)
+    monkeypatch.delenv("METRICS_TPU_SYNC_EVERY_S", raising=False)
+    reset_async_sync_state()
+    yield
+    reset_async_sync_state()
+
+
+class _Producer:
+    """A tiny live accumulator: snapshot copies it, reduce doubles it (a
+    stand-in for a 2-rank sum collective)."""
+
+    def __init__(self, fail_times: int = 0):
+        self.lock = threading.Lock()
+        self.total = 0
+        self.steps = 0
+        self.fail_times = fail_times
+        self.errors = []
+
+    def bump(self, v: int) -> None:
+        with self.lock:
+            self.total += v
+            self.steps += 1
+
+    def snapshot(self):
+        with self.lock:
+            return self.total, self.steps
+
+    def reduce(self, total):
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("transport down")
+        return 2 * total
+
+    def on_error(self, err):
+        self.errors.append(err)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def test_update_cadence_every_n():
+    prod = _Producer()
+    sched = AsyncSyncScheduler(prod.snapshot, prod.reduce, sync_every_n=2, name="t")
+    try:
+        prod.bump(5)
+        sched.notify(steps=prod.steps)
+        time.sleep(0.1)
+        assert sched.view() is None, "n=2: the first update must not cycle"
+        prod.bump(7)
+        sched.notify(steps=prod.steps)
+        assert _wait(lambda: sched.view() is not None)
+        view = sched.view()
+        assert view.payload == 2 * 12  # both updates covered, reduced once
+        assert view.covered_steps == 2
+        assert sched.lag(live_steps=2)["sync_lag_steps"] == 0
+    finally:
+        sched.stop()
+
+
+def test_time_cadence_fires_without_reaching_n():
+    prod = _Producer()
+    sched = AsyncSyncScheduler(
+        prod.snapshot, prod.reduce, sync_every_n=1000, sync_every_s=0.05, name="t"
+    )
+    try:
+        prod.bump(3)
+        sched.notify(steps=prod.steps)
+        assert _wait(lambda: sched.view() is not None), "time cadence never fired"
+        assert sched.view().payload == 6
+    finally:
+        sched.stop()
+
+
+def test_idle_scheduler_does_not_rereduce():
+    prod = _Producer()
+    calls = []
+
+    def counting_reduce(total):
+        calls.append(total)
+        return total
+
+    sched = AsyncSyncScheduler(
+        prod.snapshot, counting_reduce, sync_every_n=None, sync_every_s=0.02, name="t"
+    )
+    try:
+        prod.bump(1)
+        sched.notify(steps=1)
+        assert _wait(lambda: len(calls) == 1)
+        time.sleep(0.2)  # many cadence ticks, no new notifies
+        assert len(calls) == 1, "an idle cadence must not re-derive the same view"
+    finally:
+        sched.stop()
+
+
+def test_failed_cycle_keeps_old_view_and_retries():
+    prod = _Producer()
+    sched = AsyncSyncScheduler(
+        prod.snapshot,
+        prod.reduce,
+        sync_every_n=1,
+        sync_every_s=0.02,
+        on_error=prod.on_error,
+        name="t",
+    )
+    try:
+        prod.bump(4)
+        sched.notify(steps=prod.steps)
+        assert _wait(lambda: sched.view() is not None)
+        first = sched.view()
+        prod.fail_times = 1  # next cycle's reduce raises once
+        prod.bump(6)
+        sched.notify(steps=prod.steps)
+        assert _wait(lambda: len(prod.errors) == 1), "on_error never fired"
+        # old view still served (loudly stale, never a hang) …
+        assert sched.view() is first or sched.view().covered_steps == 1
+        # … and the cadence retries without a new notify
+        assert _wait(lambda: sched.view() is not None and sched.view().covered_steps == 2)
+        assert sched.view().payload == 2 * 10
+    finally:
+        sched.stop()
+
+
+def test_wait_covered_watermark_and_stop_short_circuit():
+    prod = _Producer()
+    sched = AsyncSyncScheduler(prod.snapshot, prod.reduce, sync_every_n=None, name="t")
+    try:
+        prod.bump(2)
+        sched.notify(steps=prod.steps)
+        target = sched.seq()
+        assert sched.wait_covered(target, deadline_s=10.0)
+        assert sched.covered(target)
+        # already covered: returns immediately without forcing a cycle
+        t0 = time.monotonic()
+        assert sched.wait_covered(target, deadline_s=10.0)
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        sched.stop()
+    # post-stop: an uncoverable target answers immediately, not at deadline
+    sched2 = AsyncSyncScheduler(prod.snapshot, prod.reduce, sync_every_n=None, name="t2")
+    sched2.stop()
+    prod.bump(1)
+    sched2.notify(steps=prod.steps)
+    t0 = time.monotonic()
+    assert not sched2.wait_covered(sched2.seq(), deadline_s=5.0)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_stop_mid_wait_wakes_the_waiter_immediately():
+    """A waiter blocked in wait_covered when stop(final=False) lands must
+    wake right away (no fresher view can ever arrive), not sleep out its
+    whole deadline."""
+    prod = _Producer(fail_times=1000)  # every cycle fails: nothing can cover
+    sched = AsyncSyncScheduler(prod.snapshot, prod.reduce, sync_every_n=1000, name="t")
+    prod.bump(1)
+    sched.notify(steps=prod.steps)
+    result = {}
+
+    def waiter():
+        t0 = time.monotonic()
+        result["covered"] = sched.wait_covered(sched.seq(), deadline_s=30.0)
+        result["elapsed"] = time.monotonic() - t0
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.1)  # let the waiter block
+    sched.stop(final=False)
+    th.join(timeout=10.0)
+    assert not th.is_alive(), "waiter never woke after stop()"
+    assert result["covered"] is False
+    assert result["elapsed"] < 5.0, f"waiter burned {result['elapsed']:.1f}s of its deadline"
+
+
+def test_snapshot_without_steps_covers_the_notify_watermark():
+    """A snapshot hook returning steps=None (ServeLoop's sweep) must cover
+    the notify watermark: after the cycle, lag reads 0 publishes behind —
+    not the count of swept payload items."""
+    prod = _Producer()
+    sched = AsyncSyncScheduler(
+        lambda: (prod.snapshot()[0], None), prod.reduce, sync_every_n=1, name="t"
+    )
+    try:
+        for v in range(7):
+            prod.bump(v)
+            sched.notify()  # no steps arg either: pure publish counting
+        assert _wait(lambda: sched.covered())
+        lag = sched.lag()
+        assert lag["sync_lag_steps"] == 0, lag
+        assert sched.view().covered_steps == 7
+    finally:
+        sched.stop()
+
+
+def test_stop_final_covers_pending_notifies():
+    prod = _Producer()
+    sched = AsyncSyncScheduler(prod.snapshot, prod.reduce, sync_every_n=1000, name="t")
+    prod.bump(9)
+    sched.notify(steps=prod.steps)  # far below n: no cycle yet
+    sched.stop(final=True)
+    view = sched.view()
+    assert view is not None and view.payload == 18, "final pass must cover the backlog"
+    # stop(final=False) on a fresh scheduler leaves no view behind
+    prod2 = _Producer()
+    sched2 = AsyncSyncScheduler(prod2.snapshot, prod2.reduce, sync_every_n=1000, name="t")
+    prod2.bump(1)
+    sched2.notify(steps=prod2.steps)
+    sched2.stop(final=False)
+    assert sched2.view() is None
+
+
+def test_view_is_atomic_under_concurrent_cycles():
+    """The front buffer swaps as one immutable tuple: a reader hammering
+    view() while cycles publish must never see payload/coverage from two
+    different cycles (payload is always exactly 2x covered-total)."""
+    prod = _Producer()
+    totals = {}
+
+    def snapshot():
+        with prod.lock:
+            totals[prod.steps] = prod.total
+            return (prod.total, prod.steps), prod.steps
+
+    def reduce(payload):
+        total, steps = payload
+        return (2 * total, steps)
+
+    sched = AsyncSyncScheduler(snapshot, reduce, sync_every_n=1, name="t")
+    try:
+        stop = threading.Event()
+        torn = []
+
+        def reader():
+            while not stop.is_set():
+                v = sched.view()
+                if v is None:
+                    continue
+                total2x, steps = v.payload
+                if total2x != 2 * totals[steps] or v.covered_steps != steps:
+                    torn.append(v)
+
+        th = threading.Thread(target=reader)
+        th.start()
+        for i in range(200):
+            prod.bump(i)
+            sched.notify(steps=prod.steps)
+        sched.stop(final=True)
+        stop.set()
+        th.join()
+        assert not torn, f"observed torn views: {torn[:3]}"
+        assert sched.view().payload == (2 * prod.total, prod.steps)
+    finally:
+        stop.set()
+
+
+def test_env_cadence_resolution(monkeypatch):
+    assert resolve_sync_cadence(None, None) == (1, None)
+    assert resolve_sync_cadence(4, None) == (4, None)
+    assert resolve_sync_cadence(None, 2.5) == (None, 2.5)
+    monkeypatch.setenv("METRICS_TPU_SYNC_EVERY_N", "8")
+    monkeypatch.setenv("METRICS_TPU_SYNC_EVERY_S", "0.5")
+    reset_async_sync_state()
+    assert resolve_sync_cadence(None, None) == (8, 0.5)
+    # programmatic beats env
+    assert resolve_sync_cadence(2, 1.0) == (2, 1.0)
+    with pytest.raises(ValueError, match="sync_every_n"):
+        resolve_sync_cadence(0, None)
+
+
+def test_malformed_env_cadence_warns_once_and_falls_back(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_EVERY_N", "not-a-number")
+    monkeypatch.setenv("METRICS_TPU_SYNC_EVERY_S", "-3")
+    reset_async_sync_state()
+    with pytest.warns(UserWarning) as rec:
+        n, s = resolve_sync_cadence(None, None)
+    assert (n, s) == (1, None), "malformed env must fall back to the default cadence"
+    msgs = "\n".join(str(w.message) for w in rec)
+    assert "METRICS_TPU_SYNC_EVERY_N" in msgs and "METRICS_TPU_SYNC_EVERY_S" in msgs
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the second parse must stay silent
+        assert resolve_sync_cadence(None, None) == (1, None)

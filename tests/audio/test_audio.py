@@ -122,6 +122,8 @@ class TestSDR(MetricTester):
             assert exp > 39, "fixture should sit in the high-SDR regime"
             np.testing.assert_allclose(got, exp, atol=1e-3)
 
+    @pytest.mark.slow  # 128-tap CG-vs-direct solve sweep: ~8 s of pure numerics,
+    # property-sweep class; the fast lane keeps the direct-solver parity tests
     def test_cg_close_to_direct(self):
         direct = np.asarray(signal_distortion_ratio(PREDS_C[0], TARGET[0], filter_length=128))
         cg = np.asarray(signal_distortion_ratio(PREDS_C[0], TARGET[0], filter_length=128, use_cg_iter=30))

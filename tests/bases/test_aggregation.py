@@ -81,6 +81,8 @@ def test_reset_and_forward():
     assert np.asarray(metric.compute()) == pytest.approx(5.0)
 
 
+@pytest.mark.slow  # broad randomized bincount sweep across both paths (~4 s),
+# repeat-sweep class; the targeted bincount unit checks stay fast
 def test_bincount_both_paths_match_numpy():
     """_bincount picks one-hot (tiny ranges) or scatter-add (large) — both
     must match numpy, including out-of-range drops and empty input."""

@@ -159,6 +159,8 @@ class TestMeanMergeDrift:
         exp = float(np.mean([np.float32(vals[i : i + 50].mean()) for i in range(0, 10_000, 50)], dtype=np.float64))
         np.testing.assert_allclose(got, exp, rtol=1e-5)
 
+    @pytest.mark.slow  # 10k-iteration forward drift sweep (~4 s), the repeat-
+    # sweep class the tier-1 budget slow-marks; the short drift checks remain
     def test_10k_singleton_forwards(self):
         """One sample per forward — the recurrence runs 10k times."""
 

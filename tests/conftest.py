@@ -59,3 +59,12 @@ def pytest_configure(config):
         "request-driver stress, overload shedding, recompile budgets; "
         "select with -m serving, or run the directory via `make test-serving`",
     )
+    config.addinivalue_line(
+        "markers",
+        "async_sync: the overlapped async sync layer (parallel/async_sync.py "
+        "scheduler, Metric(sync_mode='overlapped'), pure.py::"
+        "overlapped_functionalize) — double-buffered zero-collective-latency "
+        "reads, staleness/degradation contracts, blocking-vs-overlapped value "
+        "parity; select with -m async_sync, or run the directory via "
+        "`make test-async`",
+    )

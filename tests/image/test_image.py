@@ -441,6 +441,8 @@ class TestLPIPSBundledDefault:
             mt.LearnedPerceptualImagePatchSimilarity()
         assert any("NOT comparable" in str(x.message) for x in w)
 
+    @pytest.mark.slow  # bundled-LPIPS (AlexNet) construction + 3 forward passes:
+    # ~11 s, the net-construction heavyweight class the tier-1 budget slow-marks
     def test_distance_properties(self):
         import warnings
 

@@ -195,6 +195,9 @@ def test_lpips_net_as_metric_backend():
     assert val > 0.0
 
 
+@pytest.mark.slow  # full InceptionV3 construction + 96px forward passes: 41 s on
+# this box — the net-construction heavyweight class the tier-1 budget moves to
+# the slow lane (PR 1/4/7 precedent); the cheap extractor surface stays fast
 def test_inception_extractor_as_fid_backend():
     """InceptionV3Extractor drops into FrechetInceptionDistance as feature=
     and identical distributions give FID 0."""
@@ -209,6 +212,8 @@ def test_inception_extractor_as_fid_backend():
     assert float(fid.compute()) == pytest.approx(0.0, abs=1e-3)
 
 
+@pytest.mark.slow  # second InceptionV3 construction (+ pickle rebuild = a third):
+# ~14 s, same net-construction class as above
 def test_extractor_pickle_roundtrip():
     import pickle
 

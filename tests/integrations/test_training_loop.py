@@ -131,6 +131,8 @@ def test_checkpoint_roundtrip_mid_epoch():
     np.testing.assert_allclose(float(acc.compute(state2)), 1.0)
 
 
+@pytest.mark.slow  # real orbax save/restore round trip (~6 s of checkpoint IO);
+# the in-process state_dict/pickle round trips stay in the fast lane
 def test_real_orbax_checkpoint_roundtrip(tmp_path):
     """The SURVEY §5.4 claim, for real: functional metric state (including a
     CatBuffer ring state) is a plain pytree of arrays, so orbax saves and

@@ -117,6 +117,8 @@ def test_bert_loader_accepts_bert_prefix_and_skips_heads():
         load_bert_torch_state_dict(enc.variables, sd_bad)
 
 
+@pytest.mark.slow  # full BERT encoder construction + e2e BERTScore: ~7 s, the
+# net-construction heavyweight class the tier-1 budget slow-marks
 def test_bert_encoder_drives_bert_score(tmp_path):
     """End-to-end: a real transformers.BertTokenizer built from a LOCAL
     vocab file + the flax model satisfy bert_score's encoder contract —
