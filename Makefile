@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -78,3 +78,10 @@ test-serving:
 # cycle/read collective budgets (same tests the `async_sync` marker selects).
 test-async:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/async_sync/ -q -m 'not slow' -p no:cacheprovider
+
+# Fast feedback on the observability layer (metrics_tpu/obs/ — span tracer
+# ring + thread safety, sketch-histogram eps contracts, Prometheus/Perfetto
+# export round trips, instrumented-seam coverage, overhead budgets; same
+# tests the `obs` pytest marker selects).
+test-obs:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/obs/ -q -m 'not slow' -p no:cacheprovider

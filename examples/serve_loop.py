@@ -1,5 +1,6 @@
 """Serving hardening end to end: padded ragged traffic, concurrent request
-threads, overload shedding, stale-view reads, crash-safe snapshots.
+threads, overload shedding, stale-view reads, crash-safe snapshots — and a
+scrapeable telemetry endpoint watching it all.
 
 The serving story (ISSUE 7): request threads `offer()` ragged,
 occasionally-corrupt batches to a :class:`~metrics_tpu.ServeLoop` over a
@@ -9,15 +10,27 @@ one per batch size), NaN rows drop in-graph and are counted, a full queue
 sheds loudly into ``health_report()``, and ``report()`` serves the last
 reduced view without ever blocking the request path.
 
+The observability story (ISSUE 10): ``METRICS_TPU_TRACE=1`` turns on the
+span tracer at the hot seams, the self-telemetry histograms (the library's
+own ``QuantileSketch``) collect request-latency quantiles, and a
+:class:`~metrics_tpu.obs.TelemetryExporter` serves one Prometheus
+text-format scrape over HTTP — request rates, shed counters, fault
+classes, and p50/p99/p999 latencies, scraped MID-TRAFFIC.
+
 Run: ``python examples/serve_loop.py``
 """
 import os
 import tempfile
 import threading
+import urllib.request
 
 import numpy as np
 
+# tracing on BEFORE any traffic: the seams record from the first request
+os.environ["METRICS_TPU_TRACE"] = "1"
+
 import metrics_tpu as mt
+from metrics_tpu.obs import TelemetryExporter
 from metrics_tpu.ops.padding import reset_padding_state
 
 NUM_CLASSES, DRIVERS, REQUESTS = 10, 4, 40
@@ -57,6 +70,10 @@ def main():
                 preds[rng.integers(0, n)] = np.nan  # corrupt row: dropped in-graph
             loop.offer(preds, target)  # False = shed (queue full), counted
 
+    # the scrapeable exporter: GET /metrics = Prometheus text over
+    # loop.health() + the process self-telemetry (obs/runtime_metrics.py)
+    exporter = TelemetryExporter(health_fn=loop.health)
+
     threads = [threading.Thread(target=driver, args=(i,)) for i in range(DRIVERS)]
     for t in threads:
         t.start()
@@ -64,9 +81,28 @@ def main():
     view = loop.report()  # never blocks: last reduced view + its age
     print("mid-flight stale view:", {"staleness_s": view["staleness_s"], "stats": view["stats"]})
 
+    # scrape MID-TRAFFIC, over HTTP, like a production scraper would
+    with urllib.request.urlopen(exporter.url, timeout=30) as resp:
+        mid_scrape = resp.read().decode()
+    assert "metrics_tpu_serve_shed_total" in mid_scrape  # shed counter exported
+    assert "metrics_tpu_serve_offered_total" in mid_scrape
+
     for t in threads:
         t.join()
     loop.drain(120)
+
+    # final scrape: every request processed -> latency quantiles present
+    with urllib.request.urlopen(exporter.url, timeout=30) as resp:
+        scrape = resp.read().decode()
+    quantile_lines = [
+        ln for ln in scrape.splitlines() if ln.startswith("metrics_tpu_serve_update_ms{")
+    ]
+    assert quantile_lines, "request-latency quantiles missing from the scrape"
+    print("scraped request-latency quantiles:", *quantile_lines, sep="\n  ")
+    shed_line = next(ln for ln in scrape.splitlines() if ln.startswith("metrics_tpu_serve_shed_total"))
+    print("scraped shed counter:", shed_line)
+    exporter.close()
+
     loop.stop()
     loop.save_snapshot()  # crash-safe: one rank per worker, elastic restore
 

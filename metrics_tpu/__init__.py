@@ -25,6 +25,7 @@ from metrics_tpu.utilities.backend import apply_force_cpu_escape_hatch as _apply
 
 _apply_force_cpu()
 
+from metrics_tpu import obs  # noqa: E402  — span tracer / self-metrics / exporters
 from metrics_tpu.resilience import SnapshotManager, health_report  # noqa: E402
 from metrics_tpu.serving import ServeLoop  # noqa: E402
 from metrics_tpu.utilities.backend import ensure_backend  # noqa: E402
@@ -275,5 +276,6 @@ __all__ = [
     "functionalize",
     "overlapped_functionalize",
     "health_report",
+    "obs",
     "ServeLoop",
 ]

@@ -45,6 +45,16 @@ entry                           budget
                                 replicated reduced buffer over the mesh):
                                 **0** collectives — the zero-collective-
                                 latency read the ISSUE 8 acceptance names
+``instrumented_update_step``    the module runtime's jitted guarded update
+                                lowered with tracing FORCED ON (ISSUE 10 —
+                                ``obs/trace.py``): **0** collectives and **0
+                                host callbacks** — spans and trace-time
+                                retrace instants never become graph ops (the
+                                no-instrumentation-inside-jit contract)
+``instrumented_fused_step``     the guarded fused collection lowered with
+                                tracing on: the guarded-collection **≤ 2**
+                                all-reduce budget holds UNCHANGED under
+                                instrumentation
 ``ladder_served_update``        ladder-padded guarded serving update (ISSUE 7
                                 — ``ops/padding.py``): **0** collectives, no
                                 f64/callbacks/dynamic shapes, AND a ragged
@@ -339,6 +349,51 @@ def _build_overlapped_read_step(ndev: int):
     return fn, (state0,)
 
 
+class _TracedLower:
+    """``hlo_of``-compatible wrapper that lowers its jitted function with
+    tracing FORCED ON (``obs/trace.py``), so the audited trace runs the
+    instrumented configuration: the ``instrumented_*`` entries prove that
+    enabling ``METRICS_TPU_TRACE`` adds **0 collectives and 0 host
+    callbacks** to a compiled graph — spans and retrace instants are
+    host/trace-time work, never graph ops (the no-instrumentation-inside-
+    jit contract, DESIGN.md "Observability")."""
+
+    def __init__(self, fn: Callable) -> None:
+        self._fn = fn
+
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        from metrics_tpu.obs.trace import force_tracing
+
+        with force_tracing(True):
+            return self._fn.lower(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        from metrics_tpu.obs.trace import force_tracing
+
+        with force_tracing(True):
+            return self._fn(*args, **kwargs)
+
+
+def _build_instrumented_update_step(ndev: int):
+    import metrics_tpu as mt
+
+    # the MODULE runtime's own jitted update — the graph that carries the
+    # metric.jit_retrace trace-time instant — on a guarded (fault-channel)
+    # metric, lowered with tracing on
+    m = mt.Accuracy(num_classes=4, on_invalid="warn")
+    fn = m._make_update_jit()
+    args = (dict(m.metric_state), _overlapped_make_args(32), {})
+    return _TracedLower(fn), args
+
+
+def _build_instrumented_fused_step(ndev: int):
+    # the guarded fused collection step (same construction as the
+    # guarded_collection entry) lowered with tracing on: the ≤2-all-reduce
+    # budget must hold UNCHANGED under instrumentation
+    fn, args = _build_guarded_collection(ndev)
+    return _TracedLower(fn), args
+
+
 # the serving ladder under audit: pinned programmatically (not via the env
 # var) so the audit result cannot depend on ambient METRICS_TPU_PAD_LADDER
 _SERVE_LADDER = (8, 32, 128)
@@ -469,6 +524,22 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         # check-2 warmup at batch 4 pads to tier 8 — no extra graph)
         sweep_sizes=(1, 3, 7, 8, 9, 20, 31, 32, 33, 57, 100, 127, 128),
         max_graphs=3,  # == len(_SERVE_LADDER)
+    ),
+    AuditEntry(
+        name="instrumented_update_step",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_instrumented_update_step,
+    ),
+    AuditEntry(
+        name="instrumented_fused_step",
+        budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
+        build=_build_instrumented_fused_step,
     ),
 )
 

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.parallel.sync import distributed_available, gather_all_arrays, sync_state
 from metrics_tpu.utilities.data import _flatten, _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
@@ -369,6 +370,12 @@ class Metric:
 
     def _make_update_jit(self) -> Callable:
         def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
+            # trace-TIME instant, not a graph op: this body runs once per
+            # (re)trace, so the event count IS the retrace count
+            # (audit_recompilation's idiom as live telemetry); the
+            # instrumented_update_step registry entry proves the compiled
+            # graph stays free of host callbacks
+            _obs_trace.instant("metric.jit_retrace", metric=type(self).__name__, fn="update")
             prev = self.__dict__["_state"]
             object.__setattr__(self, "_state", dict(state))
             try:
@@ -396,6 +403,8 @@ class Metric:
 
     def _make_compute_jit(self) -> Callable:
         def pure_compute(state: Dict[str, Any]) -> Any:
+            # trace-time retrace instant (see _make_update_jit)
+            _obs_trace.instant("metric.jit_retrace", metric=type(self).__name__, fn="compute")
             prev = self.__dict__["_state"]
             object.__setattr__(self, "_state", dict(state))
             try:
@@ -416,8 +425,9 @@ class Metric:
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
-            with self._state_swap_guard():
-                self._run_update(update, args, kwargs)
+            with _obs_trace.span("metric.update", metric=type(self).__name__):
+                with self._state_swap_guard():
+                    self._run_update(update, args, kwargs)
             if self.sync_mode == "overlapped":
                 # eager issue: the scheduler snapshots the just-committed
                 # state and runs the collective on its worker thread while
@@ -529,7 +539,15 @@ class Metric:
                 self._computed = _squeeze_if_scalar(value)
             return self._computed
 
-        return wrapped_func
+        @functools.wraps(compute)
+        def traced_compute(*args: Any, **kwargs: Any) -> Any:
+            # one span over the whole read path — cache hit, overlapped view
+            # swap, or blocking sync+compute alike (the sync leg additionally
+            # carries its own metric.sync_dist span)
+            with _obs_trace.span("metric.compute", metric=type(self).__name__):
+                return wrapped_func(*args, **kwargs)
+
+        return traced_compute
 
     # ------------------------------------------------------------------
     # overlapped async sync (parallel/async_sync.py)
@@ -998,9 +1016,10 @@ class Metric:
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_arrays, process_group: Optional[Any] = None) -> None:
         """Gather + reduce every state across processes (reference ``metric.py:348-374``)."""
-        object.__setattr__(
-            self, "_state", self._gathered_state(self._copy_state(), dist_sync_fn, process_group)
-        )
+        with _obs_trace.span("metric.sync_dist", metric=type(self).__name__):
+            object.__setattr__(
+                self, "_state", self._gathered_state(self._copy_state(), dist_sync_fn, process_group)
+            )
 
     def _gathered_state(
         self,

@@ -1,0 +1,65 @@
+"""Runtime observability: span tracing, self-metrics, scrapeable exporters.
+
+Three layers over the framework's host-side runtime (none of them ever
+inside a compiled graph — the ``instrumented_*`` analysis-registry entries
+pin that an instrumented graph gains 0 collectives and 0 host callbacks):
+
+- ``obs/trace.py`` — bounded thread-safe span ring at the hot seams
+  (metric update/sync/compute, async-sync cycle phases, ServeLoop
+  offer/update/reduce, snapshot save/restore, dispatch decisions, jit
+  retraces), enabled via ``METRICS_TPU_TRACE``, exportable as
+  Chrome/Perfetto trace JSON.
+- ``obs/runtime_metrics.py`` — process-wide counters + latency histograms
+  backed by the library's own ``QuantileSketch`` (p50/p99/p999 with the
+  KLL eps contract, mergeable across workers), fed by the tracer sink.
+- ``obs/export.py`` — Prometheus text / JSON renders over health +
+  telemetry, plus a stdlib HTTP exporter; ``ServeLoop.scrape()`` is the
+  one-call in-process scrape.
+"""
+from metrics_tpu.obs.trace import (
+    TraceRecord,
+    add_trace_sink,
+    chrome_trace_events,
+    clear_trace,
+    export_chrome_trace,
+    force_tracing,
+    instant,
+    remove_trace_sink,
+    reset_trace_state,
+    span,
+    trace_records,
+    tracing_enabled,
+)
+from metrics_tpu.obs.runtime_metrics import (
+    HISTOGRAM_SEAMS,
+    Counter,
+    LatencyHistogram,
+    RuntimeMetrics,
+    merged,
+    registry,
+)
+from metrics_tpu.obs.export import TelemetryExporter, json_text, prometheus_text
+
+__all__ = [
+    "TraceRecord",
+    "span",
+    "instant",
+    "tracing_enabled",
+    "force_tracing",
+    "trace_records",
+    "clear_trace",
+    "chrome_trace_events",
+    "export_chrome_trace",
+    "add_trace_sink",
+    "remove_trace_sink",
+    "reset_trace_state",
+    "Counter",
+    "LatencyHistogram",
+    "RuntimeMetrics",
+    "registry",
+    "merged",
+    "HISTOGRAM_SEAMS",
+    "TelemetryExporter",
+    "prometheus_text",
+    "json_text",
+]

@@ -1,0 +1,237 @@
+"""Scrapeable exporters: Prometheus text format + JSON over the telemetry.
+
+One render joins the two observability surfaces — ``health_report()`` /
+``ServeLoop.health()`` (degradation events, serving counters, sync lag,
+fault counters) and the self-telemetry registry
+(``obs/runtime_metrics.py`` counters + sketch-backed latency histograms) —
+into the exposition formats production scrapers consume:
+
+- :func:`prometheus_text` — the Prometheus text format (counters as
+  ``*_total``, histograms as summaries with ``quantile`` labels plus
+  ``_count``/``_sum``, gauges for depths/lags/staleness, label escaping
+  per the spec). ``tests/obs/test_export.py`` round-trips it through a
+  minimal parser.
+- :func:`json_text` — the same content as one JSON document.
+- :class:`TelemetryExporter` — a stdlib HTTP endpoint (``/metrics`` text,
+  ``/metrics.json``) on a daemon thread, for the scrape-mid-traffic story
+  (``examples/serve_loop.py``); ``ServeLoop.scrape()`` is the in-process
+  form.
+
+Module import performs python work only (stdlib + sibling obs modules) —
+the hang-proof bootstrap contract holds, and a scrape never compiles:
+histogram quantiles read through the numpy level-weight path.
+"""
+import http.server
+import json
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from metrics_tpu.obs.runtime_metrics import DEFAULT_QUANTILES, RuntimeMetrics
+from metrics_tpu.obs.runtime_metrics import registry as _default_registry
+
+__all__ = ["prometheus_text", "json_text", "TelemetryExporter"]
+
+_PREFIX = "metrics_tpu"
+
+
+def _escape_label(value: Any) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(value: Any) -> str:
+    if value is None:
+        return "NaN"
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    return repr(float(value)) if isinstance(value, float) else str(value)
+
+
+def _line(name: str, value: Any, **labels: Any) -> str:
+    if labels:
+        body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{body}}} {_fmt(value)}"
+    return f"{name} {_fmt(value)}"
+
+
+def _runtime_lines(runtime: RuntimeMetrics, qs: Sequence[float]) -> List[str]:
+    lines: List[str] = []
+    for name, value in sorted(runtime.counters().items()):
+        metric = f"{_PREFIX}_{name}"
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(_line(metric, value))
+    for name, hist in sorted(runtime.histograms().items()):
+        if hist.count == 0:
+            continue
+        metric = f"{_PREFIX}_{name}"
+        lines.append(f"# HELP {metric} latency summary (QuantileSketch, rank error <= eps*n, eps={hist.eps:g})")
+        lines.append(f"# TYPE {metric} summary")
+        quantiles = hist.quantiles(qs)
+        for q in qs:
+            lines.append(_line(metric, quantiles[q], quantile=f"{q:g}"))
+        lines.append(_line(f"{metric}_count", hist.count))
+        lines.append(_line(f"{metric}_sum", hist.sum_ms))
+    return lines
+
+
+def _health_lines(health: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    lines.append(f"# TYPE {_PREFIX}_health_degraded gauge")
+    lines.append(_line(f"{_PREFIX}_health_degraded", bool(health.get("degraded"))))
+    counts = health.get("event_counts") or {}
+    if counts:
+        lines.append(f"# TYPE {_PREFIX}_health_events_total counter")
+        for kind, n in sorted(counts.items()):
+            lines.append(_line(f"{_PREFIX}_health_events_total", n, kind=kind))
+    serving = health.get("serving")
+    if serving:
+        for key in ("offered", "accepted", "shed", "processed", "failed"):
+            if key in serving:
+                metric = f"{_PREFIX}_serve_{key}_total"
+                lines.append(f"# TYPE {metric} counter")
+                lines.append(_line(metric, serving[key]))
+        for key, gauge in (
+            ("queue_depth", "serve_queue_depth"),
+            ("queue_capacity", "serve_queue_capacity"),
+            ("workers", "serve_workers"),
+            ("report_staleness_s", "serve_report_staleness_seconds"),
+        ):
+            if serving.get(key) is not None:
+                metric = f"{_PREFIX}_{gauge}"
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(_line(metric, serving[key]))
+        sync = serving.get("sync") or {}
+        for key, gauge in (
+            ("sync_lag_steps", "serve_sync_lag_steps"),
+            ("sync_lag_s", "serve_sync_lag_seconds"),
+        ):
+            if sync.get(key) is not None:
+                metric = f"{_PREFIX}_{gauge}"
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(_line(metric, sync[key]))
+    metrics = health.get("metrics") or {}
+    fault_lines: List[str] = []
+    lag_lines: List[str] = []
+    stale_lines: List[str] = []
+    for name, entry in sorted(metrics.items()):
+        for cls, n in sorted((entry.get("faults") or {}).items()):
+            fault_lines.append(
+                _line(f"{_PREFIX}_metric_faults_total", n, metric=name, fault_class=cls)
+            )
+        if entry.get("sync_lag_steps") is not None:
+            lag_lines.append(_line(f"{_PREFIX}_metric_sync_lag_steps", entry["sync_lag_steps"], metric=name))
+        if entry.get("staleness_s") is not None:
+            stale_lines.append(_line(f"{_PREFIX}_metric_staleness_seconds", entry["staleness_s"], metric=name))
+    if fault_lines:
+        lines.append(f"# TYPE {_PREFIX}_metric_faults_total counter")
+        lines.extend(fault_lines)
+    if lag_lines:
+        lines.append(f"# TYPE {_PREFIX}_metric_sync_lag_steps gauge")
+        lines.extend(lag_lines)
+    if stale_lines:
+        lines.append(f"# TYPE {_PREFIX}_metric_staleness_seconds gauge")
+        lines.extend(stale_lines)
+    return lines
+
+
+def prometheus_text(
+    health: Optional[Dict[str, Any]] = None,
+    runtime: Optional[RuntimeMetrics] = None,
+    qs: Sequence[float] = DEFAULT_QUANTILES,
+) -> str:
+    """One Prometheus text-format scrape over the given health report and
+    runtime registry (defaults: the process-wide registry; no health)."""
+    lines = _runtime_lines(runtime if runtime is not None else _default_registry, qs)
+    if health is not None:
+        lines.extend(_health_lines(health))
+    return "\n".join(lines) + "\n"
+
+
+def json_text(
+    health: Optional[Dict[str, Any]] = None,
+    runtime: Optional[RuntimeMetrics] = None,
+    qs: Sequence[float] = DEFAULT_QUANTILES,
+) -> str:
+    """The same scrape as one JSON document (``runtime`` + ``health``)."""
+    doc: Dict[str, Any] = {
+        "runtime": (runtime if runtime is not None else _default_registry).snapshot(qs)
+    }
+    if health is not None:
+        doc["health"] = health
+    return json.dumps(doc, default=str)
+
+
+class TelemetryExporter:
+    """Scrapeable HTTP endpoint over the process telemetry.
+
+    ``GET /metrics`` serves the Prometheus text format, ``GET
+    /metrics.json`` the JSON document; anything else is 404. ``health_fn``
+    (e.g. ``loop.health`` or ``metrics_tpu.health_report``) is called per
+    scrape so every response reflects live state. ``port=0`` binds an
+    ephemeral port (read :attr:`port` / :attr:`url`); the server runs on a
+    daemon thread and ``close()`` (or the context manager) shuts it down.
+    """
+
+    def __init__(
+        self,
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        runtime: Optional[RuntimeMetrics] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        qs: Sequence[float] = DEFAULT_QUANTILES,
+    ) -> None:
+        exporter = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                try:
+                    health = exporter.health_fn() if exporter.health_fn is not None else None
+                    if self.path.split("?")[0] == "/metrics":
+                        body = prometheus_text(health, exporter.runtime, exporter.qs).encode()
+                        ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    elif self.path.split("?")[0] == "/metrics.json":
+                        body = json_text(health, exporter.runtime, exporter.qs).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as err:  # noqa: BLE001 — a scrape must answer, not kill the server
+                    self.send_error(500, explain=f"{type(err).__name__}: {err}")
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:  # silence per-scrape stderr
+                pass
+
+        self.health_fn = health_fn
+        self.runtime = runtime
+        self.qs = qs
+        self._server = http.server.ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="metrics-tpu-exporter"
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._server.server_address[0]
+        return f"http://{host}:{self.port}/metrics"
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=timeout_s)
+
+    def __enter__(self) -> "TelemetryExporter":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -1,0 +1,350 @@
+"""Process-wide self-telemetry: counters + sketch-backed latency histograms.
+
+The tracer (``obs/trace.py``) answers "what happened, when" as a timeline;
+this module answers "how fast, how often" as aggregates a scraper can
+consume: named monotonic counters and latency histograms whose
+distribution state is the library's **own** :class:`QuantileSketch`
+(dogfooding — p50/p99/p999 of update/sync/compute/request latencies carry
+KLL's stated rank-error bound ``eps * n``, and two workers' histograms
+merge through ``sketch_merge`` exactly like any metric sketch state).
+
+Feeding is the tracer's sink hook: every completed span lands in the
+``<seam>_total`` occurrence counter, and spans at the pre-registered seams
+(the :data:`HISTOGRAM_SEAMS` table) additionally observe their duration
+into the matching ``*_ms`` histogram. Observation is an O(1) host-side
+append to a bounded pending buffer; the jax sketch fold runs only when the
+buffer fills or a query needs it — the same batch-amortized stance as the
+sketch's own binned precompaction. Quantile queries read the sketch's
+``(items, counts)`` level weights through numpy (no compilation, no device
+work on the scrape path), so a scrape stays cheap and possible even while
+the accelerator stack is busy.
+
+Module import performs python work only — jax (via
+``streaming/sketches.py``) loads lazily at the first sketch fold, never at
+import and never on the pure-counter path, so the hang-proof bootstrap
+contract (``utilities/backend.py``) holds.
+"""
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "LatencyHistogram",
+    "RuntimeMetrics",
+    "registry",
+    "merged",
+    "HISTOGRAM_SEAMS",
+    "DEFAULT_QUANTILES",
+]
+
+DEFAULT_QUANTILES: Tuple[float, ...] = (0.5, 0.99, 0.999)
+
+# histogram geometry: eps is the KLL rank-error fraction reported alongside
+# every quantile; 1<<20 observed rows before the top level can saturate
+_HIST_EPS = 0.01
+_HIST_MAX_ITEMS = 1 << 20
+
+# pending-buffer bound: the O(1) observe path folds into the sketch once
+# per this many samples (batch-amortized, like sketch precompaction)
+_PENDING_CAP = 8192
+
+# span name -> histogram name: the instrumented seams whose latency
+# distributions are pre-registered (span occurrence counters exist for
+# EVERY span; only these carry a full histogram)
+HISTOGRAM_SEAMS: Dict[str, str] = {
+    "metric.update": "metric_update_ms",
+    "metric.sync_dist": "metric_sync_ms",
+    "metric.compute": "metric_compute_ms",
+    "async_sync.cycle": "async_cycle_ms",
+    "async_sync.snapshot": "async_snapshot_ms",
+    "async_sync.reduce": "async_reduce_ms",
+    "serve.offer": "serve_offer_ms",
+    "serve.update": "serve_update_ms",
+    "serve.reduce": "serve_reduce_ms",
+    "serve.forced_reduce": "serve_forced_reduce_ms",
+    "snapshot.save": "snapshot_save_ms",
+    "snapshot.restore": "snapshot_restore_ms",
+}
+
+
+class Counter:
+    """Monotonic named counter (thread-safe; int, never wraps)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+def _np_weighted_quantiles(
+    values: Any, weights: Any, qs: Sequence[float]
+) -> List[float]:
+    """Host-side inverse-CDF quantiles over ``(value, weight)`` rows — the
+    numpy twin of ``ops/compactor.py::weighted_quantiles``, used on the
+    scrape path so a quantile query never compiles or touches a device."""
+    import numpy as np
+
+    v = np.asarray(values, np.float64).reshape(-1)
+    w = np.asarray(weights, np.float64).reshape(-1)
+    keep = w > 0
+    v, w = v[keep], w[keep]
+    if v.size == 0:
+        return [float("nan")] * len(qs)
+    order = np.argsort(v, kind="stable")
+    v, w = v[order], w[order]
+    cum = np.cumsum(w)
+    total = cum[-1]
+    out = []
+    for q in qs:
+        idx = int(np.searchsorted(cum, q * total, side="left"))
+        out.append(float(v[min(idx, v.size - 1)]))
+    return out
+
+
+class LatencyHistogram:
+    """One latency distribution (milliseconds) at fixed state size.
+
+    ``observe()`` appends to a bounded host-side buffer; the buffer folds
+    into a :class:`~metrics_tpu.streaming.sketches.QuantileSketchState` when
+    full (the only jax work this class ever does). Quantiles come with the
+    sketch's rank-error contract: off by at most ``eps * n`` ranks, where
+    ``eps`` is :attr:`eps` — pending (not yet folded) samples are exact.
+    """
+
+    def __init__(self, name: str, eps: float = _HIST_EPS, max_items: int = _HIST_MAX_ITEMS) -> None:
+        self.name = name
+        self.eps = float(eps)
+        self.max_items = int(max_items)
+        self._lock = threading.RLock()
+        self._pending: List[float] = []
+        self._sketch = None  # QuantileSketchState, built at the first fold
+        self._count = 0
+        self._sum = 0.0
+
+    # -- write path ----------------------------------------------------
+
+    def observe(self, value_ms: float) -> None:
+        with self._lock:
+            self._pending.append(float(value_ms))
+            self._count += 1
+            self._sum += float(value_ms)
+            if len(self._pending) >= _PENDING_CAP:
+                self._fold_locked()
+
+    def observe_ns(self, dur_ns: int) -> None:
+        self.observe(dur_ns / 1e6)
+
+    def _fold_locked(self) -> None:
+        if not self._pending:
+            return
+        import jax.numpy as jnp
+
+        from metrics_tpu.streaming.sketches import QuantileSketchState
+
+        if self._sketch is None:
+            self._sketch = QuantileSketchState.create(eps=self.eps, max_items=self.max_items)
+        self._sketch = self._sketch.insert(jnp.asarray(self._pending, jnp.float32))
+        self._pending = []
+
+    # -- read path (numpy only: no compilation at scrape time) ----------
+
+    def _levels(self) -> Tuple[List[float], List[float]]:
+        """(values, weights) rows of the folded sketch plus the exact
+        pending tail (weight 1 each)."""
+        import numpy as np
+
+        values: List[float] = []
+        weights: List[float] = []
+        if self._sketch is not None:
+            items = np.asarray(self._sketch.items)
+            counts = np.asarray(self._sketch.counts)
+            for lvl in range(items.shape[0]):
+                c = int(counts[lvl])
+                if c > 0:
+                    values.extend(items[lvl, :c].tolist())
+                    weights.extend([float(1 << lvl)] * c)
+        values.extend(self._pending)
+        weights.extend([1.0] * len(self._pending))
+        return values, weights
+
+    def quantiles(self, qs: Sequence[float] = DEFAULT_QUANTILES) -> Dict[float, float]:
+        with self._lock:
+            values, weights = self._levels()
+        return dict(zip(qs, _np_weighted_quantiles(values, weights, qs)))
+
+    # count/sum read WITHOUT the lock: python int/float loads are
+    # GIL-atomic, and the lock may be held across a jax sketch fold — the
+    # light snapshot path (what health_report embeds) must stay answerable
+    # even while a fold is wedged with the accelerator stack
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum_ms(self) -> float:
+        return self._sum
+
+    # -- merge (the cross-worker/exporter path) -------------------------
+
+    def merged(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """A new histogram covering both streams: counts/sums add, sketch
+        states union through ``sketch_merge`` — mergeable across workers
+        exactly like any metric sketch state."""
+        if self.eps != other.eps or self.max_items != other.max_items:
+            raise ValueError(
+                f"cannot merge histogram {self.name!r} (eps={self.eps}, "
+                f"max_items={self.max_items}) with {other.name!r} "
+                f"(eps={other.eps}, max_items={other.max_items})"
+            )
+        out = LatencyHistogram(self.name, eps=self.eps, max_items=self.max_items)
+        # canonical lock order (by id): two threads merging the same pair in
+        # opposite directions must not ABBA-deadlock
+        first, second = (self, other) if id(self) <= id(other) else (other, self)
+        with first._lock:
+            with second._lock:
+                self._fold_locked()
+                other._fold_locked()
+                sk_a, count_a, sum_a = self._sketch, self._count, self._sum
+                sk_b, count_b, sum_b = other._sketch, other._count, other._sum
+        if sk_a is not None and sk_b is not None:
+            out._sketch = sk_a.sketch_merge(sk_b)
+        else:
+            out._sketch = sk_a if sk_a is not None else sk_b
+        out._count = count_a + count_b
+        out._sum = sum_a + sum_b
+        return out
+
+    def snapshot(self, qs: Sequence[float] = DEFAULT_QUANTILES) -> Dict[str, Any]:
+        quantiles = self.quantiles(qs)
+        return {
+            "count": self.count,
+            "sum_ms": self.sum_ms,
+            "eps": self.eps,
+            "quantiles_ms": {f"{q:g}": quantiles[q] for q in qs},
+        }
+
+
+class RuntimeMetrics:
+    """One registry of named counters and histograms (get-or-create)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._hists: Dict[str, LatencyHistogram] = {}
+        for hist_name in HISTOGRAM_SEAMS.values():
+            self._hists[hist_name] = LatencyHistogram(hist_name)
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            counter = self._counters.get(name)
+            if counter is None:
+                counter = self._counters[name] = Counter(name)
+            return counter
+
+    def histogram(self, name: str, eps: float = _HIST_EPS) -> LatencyHistogram:
+        with self._lock:
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = LatencyHistogram(name, eps=eps)
+            return hist
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {name: c.value for name, c in self._counters.items()}
+
+    def histograms(self) -> Dict[str, LatencyHistogram]:
+        with self._lock:
+            return dict(self._hists)
+
+    def snapshot(
+        self, qs: Sequence[float] = DEFAULT_QUANTILES, quantiles: bool = True
+    ) -> Dict[str, Any]:
+        """Plain-data view for exporters. ``quantiles=False`` is the
+        light form (counts/sums only — pure python, no numpy/jax): what
+        ``health_report()`` embeds, honoring its works-while-wedged
+        contract."""
+        hists: Dict[str, Any] = {}
+        for name, hist in self.histograms().items():
+            if hist.count == 0:
+                continue
+            if quantiles:
+                hists[name] = hist.snapshot(qs)
+            else:
+                hists[name] = {"count": hist.count, "sum_ms": hist.sum_ms, "eps": hist.eps}
+        return {"counters": self.counters(), "histograms": hists}
+
+    def reset(self) -> None:
+        """Test hook: drop every counter/histogram, re-seed the seam table."""
+        with self._lock:
+            self._counters.clear()
+            self._hists.clear()
+            for hist_name in HISTOGRAM_SEAMS.values():
+                self._hists[hist_name] = LatencyHistogram(hist_name)
+        if self is registry:
+            # the sink's memoized lookups point at the dropped objects
+            _sink_counters.clear()
+            _sink_hists.clear()
+
+
+registry = RuntimeMetrics()
+
+
+def merged(*registries: RuntimeMetrics) -> RuntimeMetrics:
+    """One registry covering every input's streams (the exporter's
+    cross-worker merge): counters add, histograms ``sketch_merge``."""
+    out = RuntimeMetrics()
+    for reg in registries:
+        for name, value in reg.counters().items():
+            out.counter(name).inc(value)
+        for name, hist in reg.histograms().items():
+            if hist.count == 0:
+                continue
+            with out._lock:
+                mine = out._hists.get(name)
+                if mine is None or mine.count == 0:
+                    out._hists[name] = hist.merged(LatencyHistogram(name, eps=hist.eps, max_items=hist.max_items))
+                else:
+                    out._hists[name] = mine.merged(hist)
+    return out
+
+
+# memoized span-name -> Counter/LatencyHistogram lookups for the sink (it
+# runs on the instrumented thread per record — a dict hit, not a registry
+# lock round trip); registry.reset() clears both
+_sink_counters: Dict[str, Counter] = {}
+_sink_hists: Dict[str, Any] = {}  # name -> LatencyHistogram | None (non-seam)
+
+
+def _trace_sink(name: str, dur_ns: int, attrs: Optional[Dict[str, Any]]) -> None:
+    """The tracer sink: every record counts, seam spans also observe."""
+    counter = _sink_counters.get(name)
+    if counter is None:
+        counter = _sink_counters[name] = registry.counter(name.replace(".", "_") + "_total")
+    counter.inc()
+    if dur_ns:
+        hist = _sink_hists.get(name, False)
+        if hist is False:
+            seam = HISTOGRAM_SEAMS.get(name)
+            hist = registry.histogram(seam) if seam is not None else None
+            _sink_hists[name] = hist
+        if hist is not None:
+            hist.observe(dur_ns / 1e6)
+
+
+# importing this module wires the sink; obs/__init__.py imports it, and
+# importing ANY obs submodule initializes the package first, so the sink
+# exists before the tracer can complete a record
+from metrics_tpu.obs.trace import add_trace_sink  # noqa: E402
+
+add_trace_sink(_trace_sink)

@@ -1,0 +1,337 @@
+"""Host-side span tracer: where does the wall-clock go, outside the graphs.
+
+The framework's runtime has grown real machinery between the compiled
+graphs — update commits, blocking gathers, overlapped sync cycles, serve
+workers, snapshot writers, dispatch decisions — and none of it was
+observable beyond ``health_report()``'s event ring. This module is the
+timeline layer: a thread-safe, bounded ring of ``(name, tid, t_start_ns,
+dur_ns, attrs)`` span records fed by ``span()`` context managers at the
+hot seams, exportable as Chrome/Perfetto trace JSON for profiling and
+consumed by ``obs/runtime_metrics.py`` (the self-telemetry histograms)
+through the sink hook.
+
+Contract (the T3/GL20x stance, enforced by the ``instrumented_*`` analysis
+registry entries): **instrumentation lives strictly outside jit**. Spans
+wrap the *eager* seams — the host-side call that launches a compiled step,
+never ops inside it — so an instrumented compiled graph is bit-identical
+to an uninstrumented one (0 extra collectives, 0 host callbacks). The one
+sanctioned in-graph-adjacent probe is :func:`instant` at *trace time*
+(``metric.jit_retrace``): the python body of a jitted function runs once
+per trace, so an instant there is exactly ``audit_recompilation``'s
+counting idiom — a retrace counter, not a graph op.
+
+Enablement rides the shared ``METRICS_TPU_*`` env contract
+(``ops/_envtools.py``): ``METRICS_TPU_TRACE=1`` turns tracing on at call
+time (malformed values warn once and stay off — a bad env var costs
+observability, never correctness or latency), ``METRICS_TPU_TRACE_BUFFER``
+sizes the ring (default 65536 records; malformed → warn once + default).
+``force_tracing(True)`` is the programmatic override (programmatic > env >
+default, the dispatch-layer rule). When tracing is off, ``span()`` returns
+one module-level no-op singleton — no record, no attrs retention, no
+allocation beyond the caller's kwargs — so the disabled path prices at a
+dict-build plus one memoized env read (pinned ≤1% of the compiled guarded
+fused step by ``tests/obs/test_overhead.py`` and the ``obs`` bench phase).
+
+Module import performs python work only (stdlib + the shared env tools) —
+the hang-proof bootstrap contract (``utilities/backend.py``) holds, and
+the tracer stays usable precisely when the accelerator stack is wedged.
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+
+__all__ = [
+    "TraceRecord",
+    "span",
+    "instant",
+    "tracing_enabled",
+    "force_tracing",
+    "trace_records",
+    "clear_trace",
+    "chrome_trace_events",
+    "export_chrome_trace",
+    "add_trace_sink",
+    "remove_trace_sink",
+    "reset_trace_state",
+]
+
+_DEFAULT_BUFFER = 65536
+
+_warn_once = WarnOnce()
+
+
+def _parse_trace(raw: str) -> bool:
+    low = raw.lower()
+    if low in ("1", "true", "on", "yes"):
+        return True
+    if low in ("0", "false", "off", "no"):
+        return False
+    _warn_once(
+        ("trace", raw),
+        f"METRICS_TPU_TRACE={raw!r} is not a boolean token (1/0/true/false/"
+        "on/off/yes/no); tracing stays disabled.",
+    )
+    return False
+
+
+def _parse_buffer(raw: str) -> int:
+    try:
+        n = int(raw)
+        if n < 1:
+            raise ValueError(raw)
+        return n
+    except ValueError:
+        _warn_once(
+            ("trace_buffer", raw),
+            f"METRICS_TPU_TRACE_BUFFER={raw!r} is not a positive integer; "
+            f"using the default ring of {_DEFAULT_BUFFER} records.",
+        )
+        return _DEFAULT_BUFFER
+
+
+_ENV_TRACE: "EnvParse[bool]" = EnvParse("METRICS_TPU_TRACE", _parse_trace, False)
+_ENV_BUFFER: "EnvParse[int]" = EnvParse("METRICS_TPU_TRACE_BUFFER", _parse_buffer, _DEFAULT_BUFFER)
+
+# programmatic override: True/False force, None defers to the env var
+_FORCED: Optional[bool] = None
+
+# the disabled path must price well under 1% of a compiled step, and ONE
+# ``os.environ`` read costs ~0.6 µs — so the env resolution is amortized:
+# the cached answer serves ``_RECHECK_EVERY`` calls, then the var is
+# re-read (flips still land within a bounded, tiny record window; tests
+# flip instantly via reset_trace_state()/force_tracing)
+_RECHECK_EVERY = 256
+_env_enabled = False
+_env_countdown = 0
+
+
+def tracing_enabled() -> bool:
+    """Is the tracer recording right now? (programmatic > env > off; the
+    env answer is re-read at most every ``_RECHECK_EVERY`` calls)."""
+    global _env_enabled, _env_countdown
+    if _FORCED is not None:
+        return _FORCED
+    if _env_countdown > 0:
+        _env_countdown -= 1
+        return _env_enabled
+    _env_countdown = _RECHECK_EVERY
+    _env_enabled = _ENV_TRACE()
+    return _env_enabled
+
+
+@contextlib.contextmanager
+def force_tracing(enabled: bool) -> Iterator[None]:
+    """Scoped programmatic enable/disable — wins over the env var (the
+    test/bench/audit hook, mirroring ``ops.dispatch.kernel_override``)."""
+    global _FORCED
+    prev = _FORCED
+    _FORCED = bool(enabled)
+    try:
+        yield
+    finally:
+        _FORCED = prev
+
+
+class TraceRecord(NamedTuple):
+    """One completed span (``dur_ns == 0`` marks an instant event)."""
+
+    name: str
+    tid: int
+    t_start_ns: int
+    dur_ns: int
+    attrs: Optional[Dict[str, Any]]
+
+
+# the ring: deque.append is atomic under the GIL, so the record path never
+# takes the lock — the lock only guards reconfiguration (capacity change /
+# clear) and consistent snapshots
+_ring_lock = threading.Lock()
+_ring: "deque[TraceRecord]" = deque(maxlen=_DEFAULT_BUFFER)
+
+# populated at import: obs/__init__.py imports runtime_metrics, whose
+# module bottom registers the self-telemetry sink (and importing any obs
+# submodule initializes the package first, so the sink is always wired
+# before a record can exist)
+_SINKS: List[Callable[[str, int, Optional[Dict[str, Any]]], None]] = []
+
+
+# capacity resolves lazily: at the first record after import or
+# reset_trace_state() (not per record — that would be another environ read
+# on the hot path); a changed knob takes effect at the next reset
+_ring_dirty = True
+
+
+def _current_ring() -> "deque[TraceRecord]":
+    """The ring at the configured capacity; resized (newest records kept)
+    when the buffer knob changed since the last ``reset_trace_state``."""
+    global _ring, _ring_dirty
+    if _ring_dirty:
+        with _ring_lock:
+            _ring_dirty = False
+            cap = _ENV_BUFFER()
+            if _ring.maxlen != cap:
+                _ring = deque(_ring, maxlen=cap)
+    return _ring
+
+
+def _record(name: str, t_start_ns: int, dur_ns: int, attrs: Optional[Dict[str, Any]]) -> None:
+    _current_ring().append(TraceRecord(name, threading.get_ident(), t_start_ns, dur_ns, attrs))
+    for sink in _SINKS:
+        try:
+            sink(name, dur_ns, attrs)
+        except Exception as err:  # noqa: BLE001 — telemetry degrades, never breaks the seam
+            _warn_once(
+                ("sink", type(err).__name__),
+                f"trace sink {getattr(sink, '__name__', sink)!r} raised "
+                f"{type(err).__name__}: {err}; its records are dropped",
+            )
+
+
+class _LiveSpan:
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name: str, attrs: Optional[Dict[str, Any]]) -> None:
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self) -> "_LiveSpan":
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        t0 = self._t0
+        _record(self.name, t0, time.monotonic_ns() - t0, self.attrs)
+        return False
+
+
+class _NoopSpan:
+    """The disabled path: one shared instance, enter/exit do nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+def span(name: str, /, **attrs: Any):
+    """Context manager timing one host-side seam. Disabled → the shared
+    no-op singleton (zero record-path allocation). ``name`` is
+    positional-only so an attr may also be called ``name``."""
+    # the enabled check is inlined (one function call saved per span —
+    # these sit on every metric update)
+    global _env_enabled, _env_countdown
+    if _FORCED is None:
+        if _env_countdown > 0:
+            _env_countdown -= 1
+            enabled = _env_enabled
+        else:
+            _env_countdown = _RECHECK_EVERY
+            enabled = _env_enabled = _ENV_TRACE()
+    else:
+        enabled = _FORCED
+    if not enabled:
+        return _NOOP_SPAN
+    return _LiveSpan(name, attrs or None)
+
+
+def instant(name: str, /, **attrs: Any) -> None:
+    """Record a point event (``dur_ns == 0``) — occurrence counting:
+    retrace events, dispatch decisions, coalesced triggers."""
+    if not tracing_enabled():
+        return
+    _record(name, time.monotonic_ns(), 0, attrs or None)
+
+
+# -- readers / export ------------------------------------------------------
+
+
+def trace_records(name: Optional[str] = None) -> List[TraceRecord]:
+    """A consistent snapshot of the ring, oldest first."""
+    with _ring_lock:
+        records = list(_ring)
+    if name is not None:
+        records = [r for r in records if r.name == name]
+    return records
+
+
+def clear_trace() -> None:
+    with _ring_lock:
+        _ring.clear()
+
+
+def chrome_trace_events() -> List[Dict[str, Any]]:
+    """The ring as Chrome/Perfetto trace events (``ph='X'`` complete spans,
+    ``ph='i'`` instants; timestamps/durations in microseconds, per the
+    trace-event format)."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = []
+    for rec in trace_records():
+        event: Dict[str, Any] = {
+            "name": rec.name,
+            "pid": pid,
+            "tid": rec.tid,
+            "ts": rec.t_start_ns / 1e3,
+        }
+        if rec.dur_ns:
+            event["ph"] = "X"
+            event["dur"] = rec.dur_ns / 1e3
+        else:
+            event["ph"] = "i"
+            event["s"] = "t"  # thread-scoped instant
+        if rec.attrs:
+            event["args"] = dict(rec.attrs)
+        events.append(event)
+    return events
+
+
+def export_chrome_trace(path: Optional[str] = None) -> str:
+    """The ring as a Chrome/Perfetto-loadable JSON document; optionally
+    written to ``path`` (load via ``chrome://tracing`` or ui.perfetto.dev)."""
+    doc = json.dumps({"traceEvents": chrome_trace_events(), "displayTimeUnit": "ms"})
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(doc)
+    return doc
+
+
+# -- sinks -----------------------------------------------------------------
+
+
+def add_trace_sink(sink: Callable[[str, int, Optional[Dict[str, Any]]], None]) -> None:
+    """Register ``sink(name, dur_ns, attrs)``, called per completed record.
+    Sinks run on the instrumented thread — they must be cheap; a raising
+    sink warns once and its records are dropped, never the caller's work."""
+    if sink not in _SINKS:
+        _SINKS.append(sink)
+
+
+def remove_trace_sink(sink: Callable[[str, int, Optional[Dict[str, Any]]], None]) -> None:
+    if sink in _SINKS:
+        _SINKS.remove(sink)
+
+
+def reset_trace_state() -> None:
+    """Test hook: clear the ring, the forced mode, warn-once memory, and
+    the memoized env parses (the shared ``reset_*_state`` contract); the
+    next enablement check and record re-read the env."""
+    global _FORCED, _env_enabled, _env_countdown, _ring_dirty
+    _FORCED = None
+    _env_enabled = False
+    _env_countdown = 0
+    _ring_dirty = True
+    _warn_once.reset()
+    _ENV_TRACE.reset()
+    _ENV_BUFFER.reset()
+    clear_trace()

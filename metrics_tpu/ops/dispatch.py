@@ -214,7 +214,13 @@ def resolve(op_name: str, *args: Any, **kwargs: Any) -> Tuple[str, Callable]:
     unknown *op*."""
     op = _get_op(op_name)
     choice, source = _requested(op_name)
-    return _resolve_choice(op, choice, source, args, kwargs)
+    impl_name, fn = _resolve_choice(op, choice, source, args, kwargs)
+    # observability seam: which impl each call (= each trace, under jit)
+    # baked in — a host-side instant event, never a graph op
+    from metrics_tpu.obs import trace as _obs_trace
+
+    _obs_trace.instant("dispatch.resolve", op=op_name, impl=impl_name, source=source)
+    return impl_name, fn
 
 
 def _get_op(op_name: str) -> KernelOp:

@@ -70,6 +70,7 @@ import threading
 import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 
 __all__ = [
@@ -391,38 +392,45 @@ class AsyncSyncScheduler:
         """One snapshot → reduce → publish pass. ``seq`` was read BEFORE the
         snapshot, so it is a sound lower bound on the view's coverage."""
         with self._lock:
+            # notifies absorbed since the last cycle attempt: >1 means the
+            # cadence coalesced triggers into this single pass
+            coalesced = seq - self._cycle_seq
             self._in_flight_since = time.monotonic()
             self._stall_reported = False
             self._cycle_seq = seq
         self._last_attempt_mono = time.monotonic()
         snapshot_unix = time.time()
-        try:
-            payload, steps = self.snapshot_fn()
-            if steps is None:
-                # snapshot hooks without their own step counter (ServeLoop's
-                # sweep) cover the notify watermark read before the sweep —
-                # using anything else (e.g. a snapshot count) would make
-                # lag()'s steps arithmetic compare incommensurable units
-                steps = seq
-            reduced = self.reduce_fn(payload)
-        except Exception as err:  # noqa: BLE001 — a failed cycle degrades to the stale view
-            if self.on_error is not None:
-                self.on_error(err)
-            return  # covered NOT advanced: the next trigger/cadence retries
-        finally:
-            with self._lock:
-                self._in_flight_since = None
-        view = SyncView(
-            payload=reduced,
-            covered_seq=seq,
-            covered_steps=steps,
-            snapshot_unix=snapshot_unix,
-            completed_unix=time.time(),
-        )
-        with self._cv:
-            self._view = view
-            self._covered = max(self._covered, seq)
-            self._cv.notify_all()
+        with _obs_trace.span("async_sync.cycle", name=self.name, coalesced=coalesced):
+            try:
+                with _obs_trace.span("async_sync.snapshot", name=self.name):
+                    payload, steps = self.snapshot_fn()
+                if steps is None:
+                    # snapshot hooks without their own step counter (ServeLoop's
+                    # sweep) cover the notify watermark read before the sweep —
+                    # using anything else (e.g. a snapshot count) would make
+                    # lag()'s steps arithmetic compare incommensurable units
+                    steps = seq
+                with _obs_trace.span("async_sync.reduce", name=self.name):
+                    reduced = self.reduce_fn(payload)
+            except Exception as err:  # noqa: BLE001 — a failed cycle degrades to the stale view
+                if self.on_error is not None:
+                    self.on_error(err)
+                return  # covered NOT advanced: the next trigger/cadence retries
+            finally:
+                with self._lock:
+                    self._in_flight_since = None
+            view = SyncView(
+                payload=reduced,
+                covered_seq=seq,
+                covered_steps=steps,
+                snapshot_unix=snapshot_unix,
+                completed_unix=time.time(),
+            )
+            with _obs_trace.span("async_sync.publish", name=self.name):
+                with self._cv:
+                    self._view = view
+                    self._covered = max(self._covered, seq)
+                    self._cv.notify_all()
 
     # -- lifecycle ------------------------------------------------------
 

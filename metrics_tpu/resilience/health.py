@@ -42,20 +42,47 @@ _MAX_EVENTS = 256
 
 
 class HealthRegistry:
-    """Bounded, thread-safe event log of degradations in this process."""
+    """Bounded, thread-safe event log of degradations in this process.
+
+    Two stores with different retention: the bounded event RING (full
+    messages + details, newest ``max_events`` — a flood of one kind, e.g.
+    ``overload_shed`` under spike load, evicts older entries), and the
+    per-kind TABLE that never evicts — occurrence count plus first/last
+    wall-clock and last monotonic timestamps per kind — so a degradation
+    that happened stays countable and datable however noisy the ring got
+    since. Events carry both clocks: ``time_unix`` for correlation with
+    external logs, ``time_mono`` for in-process interval arithmetic that
+    must survive wall-clock steps (NTP slew, clock jumps)."""
 
     def __init__(self, max_events: int = _MAX_EVENTS) -> None:
         self._lock = threading.Lock()
         self._events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
-        self._counts: Dict[str, int] = {}
+        self._kinds: Dict[str, Dict[str, Any]] = {}
 
     def record(self, kind: str, message: str, **details: Any) -> Dict[str, Any]:
-        event: Dict[str, Any] = {"kind": kind, "message": message, "time_unix": time.time()}
+        now_unix, now_mono = time.time(), time.monotonic()
+        event: Dict[str, Any] = {
+            "kind": kind,
+            "message": message,
+            "time_unix": now_unix,
+            "time_mono": now_mono,
+        }
         if details:
             event["details"] = details
         with self._lock:
             self._events.append(event)
-            self._counts[kind] = self._counts.get(kind, 0) + 1
+            entry = self._kinds.get(kind)
+            if entry is None:
+                self._kinds[kind] = {
+                    "count": 1,
+                    "first_unix": now_unix,
+                    "last_unix": now_unix,
+                    "last_mono": now_mono,
+                }
+            else:
+                entry["count"] += 1
+                entry["last_unix"] = now_unix
+                entry["last_mono"] = now_mono
         return event
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -67,17 +94,22 @@ class HealthRegistry:
 
     def counts(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._counts)
+            return {kind: entry["count"] for kind, entry in self._kinds.items()}
+
+    def kinds(self) -> Dict[str, Dict[str, Any]]:
+        """The never-evicting per-kind table (count + first/last seen)."""
+        with self._lock:
+            return {kind: dict(entry) for kind, entry in self._kinds.items()}
 
     @property
     def degraded(self) -> bool:
         with self._lock:
-            return bool(self._counts)
+            return bool(self._kinds)
 
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
-            self._counts.clear()
+            self._kinds.clear()
 
 
 registry = HealthRegistry()
@@ -156,6 +188,9 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
         {"backend": {...bootstrap state...},
          "events": [...degradation events, oldest first...],
          "event_counts": {kind: n},
+         "event_kinds": {kind: {"count", "first_unix", "last_unix",
+                                "last_mono"}},   # never evicts (ring does)
+         "runtime": {"counters": {...}, "histograms": {...}},  # when any
          "metrics": {name: {"faults": {...}, "overflow_dropped": n,
                             "last_update_unix": t, "last_update_step": s,
                             "staleness_s": age}},
@@ -172,8 +207,18 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
         "backend": backend_status(),
         "events": registry.events(),
         "event_counts": registry.counts(),
+        "event_kinds": registry.kinds(),
         "metrics": {},
     }
+    # self-telemetry summary (obs/runtime_metrics.py), LIGHT form only:
+    # counters plus histogram counts/sums — pure python, honoring this
+    # module's works-while-wedged contract (quantiles are the exporters'
+    # job: ServeLoop.scrape() / obs.prometheus_text)
+    from metrics_tpu.obs.runtime_metrics import registry as _runtime_registry
+
+    runtime = _runtime_registry.snapshot(quantiles=False)
+    if runtime["counters"] or runtime["histograms"]:
+        report["runtime"] = runtime
     seen: Dict[str, int] = {}
     for obj in metrics:
         # copy_state=False: this is a read-only fault-counter sweep — the

@@ -361,40 +361,42 @@ class SnapshotManager:
         if reduced and world_size != 1:
             raise ValueError("reduced=True snapshots are global state — save them with world_size=1")
         from metrics_tpu import __version__
+        from metrics_tpu.obs import trace as _obs_trace
 
-        payload = obj.snapshot_state()
-        header = {
-            "step": int(step),
-            "rank": int(rank),
-            "world_size": int(world_size),
-            "reduced": bool(reduced),
-            "mesh_axes": dict(mesh_axes) if mesh_axes else None,
-            "created_unix": time.time(),
-            "library_version": __version__,
-            "extra": dict(extra) if extra else None,
-        }
-        blob = pickle.dumps(
-            {
-                "magic": MAGIC,
-                "schema_version": SCHEMA_VERSION,
-                "header": header,
-                "payload": payload,
-                # header is covered too: a bit-flipped `reduced`/`world_size`
-                # would silently change restore SEMANTICS, not just values
-                "checksums": _checksum_tree({"header": header, "payload": payload}),
-            },
-            protocol=4,
-        )
-        final = os.path.join(self.directory, self._filename(step, rank, world_size))
-        tmp = f"{final}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)  # atomic on POSIX: readers see old or new, never torn
-        self._fsync_dir()
-        self._prune(rank)
-        return final
+        with _obs_trace.span("snapshot.save", step=int(step), rank=int(rank)):
+            payload = obj.snapshot_state()
+            header = {
+                "step": int(step),
+                "rank": int(rank),
+                "world_size": int(world_size),
+                "reduced": bool(reduced),
+                "mesh_axes": dict(mesh_axes) if mesh_axes else None,
+                "created_unix": time.time(),
+                "library_version": __version__,
+                "extra": dict(extra) if extra else None,
+            }
+            blob = pickle.dumps(
+                {
+                    "magic": MAGIC,
+                    "schema_version": SCHEMA_VERSION,
+                    "header": header,
+                    "payload": payload,
+                    # header is covered too: a bit-flipped `reduced`/`world_size`
+                    # would silently change restore SEMANTICS, not just values
+                    "checksums": _checksum_tree({"header": header, "payload": payload}),
+                },
+                protocol=4,
+            )
+            final = os.path.join(self.directory, self._filename(step, rank, world_size))
+            tmp = f"{final}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)  # atomic on POSIX: readers see old or new, never torn
+            self._fsync_dir()
+            self._prune(rank)
+            return final
 
     def _fsync_dir(self) -> None:
         try:
@@ -521,6 +523,12 @@ class SnapshotManager:
         """
         if not (0 <= rank < world_size):
             raise ValueError(f"rank {rank} outside world of size {world_size}")
+        from metrics_tpu.obs import trace as _obs_trace
+
+        with _obs_trace.span("snapshot.restore", rank=int(rank)):
+            return self._restore_newest(obj, rank, world_size)
+
+    def _restore_newest(self, obj: Any, rank: int, world_size: int) -> Dict[str, Any]:
         first_err: Optional[SnapshotError] = None
         fallbacks = 0
         for (step, world), files in sorted(self._scan().items(), reverse=True):

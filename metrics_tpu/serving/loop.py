@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
 from metrics_tpu.resilience.health import health_report, record_degradation
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
@@ -255,16 +256,17 @@ class ServeLoop:
         # never blocks, and nobody nests the queue's lock around
         # ``_stats_lock``, so holding both here cannot deadlock.
         shed = None
-        with self._stats_lock:
-            if self._stopping:
-                raise MetricsTPUUserError("ServeLoop.offer called after stop()")
-            self._offered += 1
-            try:
-                self._queue.put_nowait((args, kwargs))
-                self._accepted += 1
-            except queue.Full:
-                self._shed += 1
-                shed = self._shed
+        with _obs_trace.span("serve.offer"):
+            with self._stats_lock:
+                if self._stopping:
+                    raise MetricsTPUUserError("ServeLoop.offer called after stop()")
+                self._offered += 1
+                try:
+                    self._queue.put_nowait((args, kwargs))
+                    self._accepted += 1
+                except queue.Full:
+                    self._shed += 1
+                    shed = self._shed
         if shed is not None:
             record_degradation(
                 "overload_shed",
@@ -296,7 +298,12 @@ class ServeLoop:
                 for _, m in _members(replica)
             ]
             try:
-                replica.update(*args, **kwargs)
+                # the request-latency seam (serve_update_ms): replica update
+                # plus the snapshot build — the full per-request cost on the
+                # worker (the slot write + notify below are trivial)
+                with _obs_trace.span("serve.update", worker=i):
+                    replica.update(*args, **kwargs)
+                    snapshot = _snapshot_of(replica)
             except Exception as err:  # noqa: BLE001 - one bad request must not kill the worker
                 for m, state, count, jittable, attr_cells in bookkeeping:
                     object.__setattr__(m, "_state", state)
@@ -319,7 +326,7 @@ class ServeLoop:
                 # of an immutable snapshot — readers never see a torn state.
                 # The notify lands after the slot write, so the scheduler's
                 # coverage watermark is always a sound lower bound.
-                self._published[i] = _snapshot_of(replica)
+                self._published[i] = snapshot
                 self._scheduler.notify()
             finally:
                 with self._stats_lock:
@@ -343,6 +350,10 @@ class ServeLoop:
         the swept snapshots. Raises on failure — the scheduler then keeps
         the previous view (loudly, via :meth:`_on_reduce_error`) and the
         next cadence tick retries."""
+        with _obs_trace.span("serve.reduce", snapshots=len(snaps)):
+            return self._reduce_view_inner(snaps)
+
+    def _reduce_view_inner(self, snaps: List[_Snapshot]) -> Dict[str, Any]:
         reporter = _clone(self._proto)
         for snap in snaps:
             _fold_snapshot(reporter, snap)
@@ -409,9 +420,10 @@ class ServeLoop:
             # have swept snapshots predating the latest publishes). Already
             # covered → no forced reduce; scheduler stopped → answer
             # immediately instead of burning the deadline.
-            got_fresh = self._scheduler.wait_covered(
-                self._scheduler.seq(), deadline_s=max(0.0, deadline_s)
-            )
+            with _obs_trace.span("serve.forced_reduce"):
+                got_fresh = self._scheduler.wait_covered(
+                    self._scheduler.seq(), deadline_s=max(0.0, deadline_s)
+                )
         sync_view = self._scheduler.view()
         view = sync_view.payload if sync_view is not None else None
         # hand out copies of the view's mutable containers: the same view
@@ -466,6 +478,22 @@ class ServeLoop:
             "sync": self._scheduler.lag(),
         }
         return rep
+
+    def scrape(self, fmt: str = "prometheus") -> str:
+        """One exporter scrape over this loop: :meth:`health` (request
+        accounting, shed/fault/degradation counters, sync lag) joined with
+        the process self-telemetry (``metrics_tpu.obs`` latency histograms
+        — populated when ``METRICS_TPU_TRACE`` is on). ``fmt`` is
+        ``"prometheus"`` (text exposition format) or ``"json"``; serve it
+        over HTTP with :class:`metrics_tpu.obs.TelemetryExporter`
+        (``TelemetryExporter(health_fn=loop.health)``)."""
+        from metrics_tpu.obs.export import json_text, prometheus_text
+
+        if fmt == "prometheus":
+            return prometheus_text(health=self.health())
+        if fmt == "json":
+            return json_text(health=self.health())
+        raise MetricsTPUUserError(f"`fmt` must be 'prometheus' or 'json', got {fmt!r}")
 
     # -- lifecycle ------------------------------------------------------
 

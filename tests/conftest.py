@@ -61,6 +61,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "obs: the observability layer (metrics_tpu/obs/ — span tracer, "
+        "sketch-backed self-telemetry histograms, Prometheus/JSON exporters) "
+        "plus the instrumented runtime seams and overhead budgets; select "
+        "with -m obs, or run the directory via `make test-obs`",
+    )
+    config.addinivalue_line(
+        "markers",
         "async_sync: the overlapped async sync layer (parallel/async_sync.py "
         "scheduler, Metric(sync_mode='overlapped'), pure.py::"
         "overlapped_functionalize) — double-buffered zero-collective-latency "

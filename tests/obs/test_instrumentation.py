@@ -1,0 +1,240 @@
+"""Seam coverage (ISSUE 10): every instrumented runtime seam emits its
+span/instant, retrace instants follow the jit cache (the
+``audit_recompilation`` counting idiom), the health-registry satellite
+(dual timestamps + never-evicting kind table), and the analysis-registry
+proof that instrumented compiled graphs stay collective/callback-free."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.obs import runtime_metrics as rm
+from metrics_tpu.obs import trace
+from metrics_tpu.resilience.health import HealthRegistry
+from metrics_tpu.resilience.health import registry as health_registry
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_TRACE", raising=False)
+    trace.reset_trace_state()
+    rm.registry.reset()
+    health_registry.clear()
+    yield
+    trace.reset_trace_state()
+    rm.registry.reset()
+    health_registry.clear()
+
+
+def _names():
+    return [r.name for r in trace.trace_records()]
+
+
+def _batch(rng, n=8, classes=4):
+    return (
+        jnp.asarray(rng.random((n, classes)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, classes, n).astype(np.int32)),
+    )
+
+
+# --------------------------------------------------------------------------
+# metric runtime seams
+# --------------------------------------------------------------------------
+
+
+def test_metric_update_compute_spans_and_retrace_instants():
+    rng = np.random.default_rng(0)
+    with trace.force_tracing(True):
+        m = mt.Accuracy(num_classes=4, on_invalid="warn")
+        m.update(*_batch(rng, 8))
+        m.update(*_batch(rng, 8))  # same shape: cache hit, NO new retrace
+        m.compute()
+    names = _names()
+    assert names.count("metric.update") == 2
+    assert names.count("metric.compute") == 1
+    retraces = [r for r in trace.trace_records("metric.jit_retrace")]
+    assert [r.attrs["fn"] for r in retraces] == ["update", "compute"]
+    assert all(r.attrs["metric"] == "Accuracy" for r in retraces)
+    # and the sink fed the pre-registered seam histograms + counters
+    assert rm.registry.counter("metric_update_total").value == 2
+    assert rm.registry.histogram("metric_update_ms").count == 2
+    assert rm.registry.histogram("metric_compute_ms").count == 1
+
+
+def test_retrace_instant_fires_per_new_shape():
+    rng = np.random.default_rng(1)
+    with trace.force_tracing(True):
+        m = mt.Accuracy(num_classes=4, on_invalid="warn")
+        m.update(*_batch(rng, 8))
+        m.update(*_batch(rng, 16))  # new shape: one more retrace
+        m.update(*_batch(rng, 8))  # cached again
+    update_retraces = [
+        r for r in trace.trace_records("metric.jit_retrace") if r.attrs["fn"] == "update"
+    ]
+    assert len(update_retraces) == 2
+
+
+def test_blocking_sync_dist_span(monkeypatch):
+    from metrics_tpu import metric as metric_mod
+    from metrics_tpu.parallel.sync import _pad_gather_trim
+
+    def fake_gather(x, group=None, transport=None):
+        return _pad_gather_trim(x, lambda a: np.stack([np.asarray(a), np.asarray(a)]))
+
+    monkeypatch.setattr(metric_mod, "distributed_available", lambda: True)
+    rng = np.random.default_rng(2)
+    with trace.force_tracing(True):
+        m = mt.Accuracy(num_classes=4, dist_sync_fn=fake_gather)
+        m.update(*_batch(rng, 8))
+        m.compute()
+    assert "metric.sync_dist" in _names()
+    assert rm.registry.histogram("metric_sync_ms").count == 1
+
+
+def test_async_scheduler_cycle_phase_spans():
+    from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
+
+    with trace.force_tracing(True):
+        sched = AsyncSyncScheduler(
+            snapshot_fn=lambda: ({"x": 1}, 3),
+            reduce_fn=lambda payload: payload,
+            sync_every_n=1,
+            name="test",
+        )
+        sched.notify(steps=1)
+        assert sched.wait_covered(sched.seq(), deadline_s=30.0)
+        sched.stop()
+    names = _names()
+    for seam in ("async_sync.cycle", "async_sync.snapshot", "async_sync.reduce", "async_sync.publish"):
+        assert seam in names, f"missing {seam} span"
+    cycle = trace.trace_records("async_sync.cycle")[0]
+    assert cycle.attrs["name"] == "test" and cycle.attrs["coalesced"] >= 1
+
+
+def test_coalesced_trigger_count_recorded():
+    import threading
+
+    from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
+
+    release = threading.Event()
+
+    def slow_snapshot():
+        release.wait(30.0)
+        return ({"x": 1}, None)
+
+    with trace.force_tracing(True):
+        sched = AsyncSyncScheduler(
+            snapshot_fn=slow_snapshot, reduce_fn=lambda p: p, sync_every_n=1, name="coal"
+        )
+        sched.notify()  # first cycle starts, blocks in slow_snapshot
+        for _ in range(5):
+            sched.notify()  # these coalesce into the NEXT cycle
+        release.set()
+        sched.stop()  # final pass covers the coalesced notifies
+    counts = [r.attrs["coalesced"] for r in trace.trace_records("async_sync.cycle")]
+    assert max(counts) >= 2  # at least one cycle absorbed multiple triggers
+
+
+def test_serve_loop_and_snapshot_spans(tmp_path, monkeypatch):
+    from metrics_tpu.ops import padding
+
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "16")
+    padding.reset_padding_state()
+    rng = np.random.default_rng(3)
+    with trace.force_tracing(True):
+        mgr = mt.SnapshotManager(str(tmp_path))
+        with mt.ServeLoop(
+            mt.Accuracy(num_classes=4, pad_batches=True), workers=2, snapshot_manager=mgr
+        ) as loop:
+            for _ in range(6):
+                p, t = _batch(rng, int(rng.integers(1, 17)))
+                loop.offer(p, t)
+            assert loop.drain(60)
+            loop.report(fresh=True, deadline_s=30.0)
+            loop.save_snapshot()
+            loop.stop()
+        # same config as served (pad_batches adds the _faults state leaf)
+        restored = mt.Accuracy(num_classes=4, pad_batches=True)
+        mgr.restore(restored)
+    names = _names()
+    for seam in (
+        "serve.offer",
+        "serve.update",
+        "serve.reduce",
+        "serve.forced_reduce",
+        "snapshot.save",
+        "snapshot.restore",
+    ):
+        assert seam in names, f"missing {seam} span"
+    assert names.count("serve.offer") == 6
+    assert rm.registry.histogram("serve_update_ms").count == 6
+    padding.reset_padding_state()
+
+
+def test_dispatch_resolve_instant():
+    from metrics_tpu.ops import dispatch
+
+    with trace.force_tracing(True):
+        dispatch.resolve("ascending_order", jnp.arange(8.0))
+    (rec,) = trace.trace_records("dispatch.resolve")
+    assert rec.attrs["op"] == "ascending_order"
+    assert rec.attrs["impl"] in ("radix", "argsort")
+    assert rm.registry.counter("dispatch_resolve_total").value == 1
+
+
+# --------------------------------------------------------------------------
+# health-registry satellite: dual clocks + never-evicting kind table
+# --------------------------------------------------------------------------
+
+
+def test_events_carry_wall_and_monotonic_timestamps():
+    reg = HealthRegistry(max_events=8)
+    event = reg.record("gather_degraded", "fell back")
+    assert event["time_unix"] > 0 and event["time_mono"] > 0
+    (stored,) = reg.events()
+    assert stored["time_mono"] == event["time_mono"]
+
+
+def test_kind_table_survives_ring_eviction():
+    reg = HealthRegistry(max_events=16)
+    reg.record("snapshot_fallback", "older snapshot used")  # the rare, distinct kind
+    for i in range(200):
+        reg.record("overload_shed", f"shed {i}")  # the flood
+    # the ring lost the distinct degradation...
+    assert all(e["kind"] == "overload_shed" for e in reg.events())
+    # ...but the table never evicts: count, first/last seen all retained
+    kinds = reg.kinds()
+    assert kinds["snapshot_fallback"]["count"] == 1
+    assert kinds["overload_shed"]["count"] == 200
+    assert kinds["overload_shed"]["last_unix"] >= kinds["overload_shed"]["first_unix"]
+    assert kinds["overload_shed"]["last_mono"] > 0
+    assert reg.counts() == {"snapshot_fallback": 1, "overload_shed": 200}
+
+
+def test_health_report_surfaces_kind_table_and_runtime():
+    health_registry.record("forced_cpu", "probe fallback")
+    rm.registry.counter("metric_update_total").inc(3)
+    report = mt.health_report()
+    assert report["event_kinds"]["forced_cpu"]["count"] == 1
+    assert "last_mono" in report["event_kinds"]["forced_cpu"]
+    # light runtime summary rides along (counters + counts only — the
+    # quantile render is the exporters' job)
+    assert report["runtime"]["counters"]["metric_update_total"] == 3
+
+
+# --------------------------------------------------------------------------
+# the no-instrumentation-inside-jit proof
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.analysis
+def test_instrumented_graphs_add_no_collectives_or_callbacks():
+    from metrics_tpu.analysis.registry import REGISTRY, run_graph_audit
+
+    entries = tuple(e for e in REGISTRY if e.name.startswith("instrumented"))
+    assert len(entries) == 2
+    assert run_graph_audit(entries) == []
+    assert not trace.tracing_enabled()  # the forced mode was scoped to lowering

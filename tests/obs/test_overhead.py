@@ -1,0 +1,158 @@
+"""The ISSUE 10 overhead acceptance, pinned: tracing disabled costs ≤1% of
+the compiled guarded fused update+compute step, enabled ≤5%, and the
+disabled ``span()`` call is identity-level (the shared no-op singleton).
+
+Methodology: wall-clock ratios of two runs of the same step race timer
+noise on shared CI boxes, so the pin multiplies the *measured per-call
+span cost* (min over many batched samples — the stable estimator) by the
+spans per step and compares against the *measured step time*. The bench
+``obs`` phase records the end-to-end A/B of the same budget."""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.obs import runtime_metrics as rm
+from metrics_tpu.obs import trace
+
+pytestmark = pytest.mark.obs
+
+# spans/instants the module runtime issues per guarded fused
+# update+compute step on a warm (already-traced) 4-member collection:
+# one metric.update + one metric.compute per member, plus slack for the
+# enabled-path sink work
+_SPANS_PER_STEP = 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_TRACE", raising=False)
+    trace.reset_trace_state()
+    rm.registry.reset()
+    yield
+    trace.reset_trace_state()
+    rm.registry.reset()
+
+
+def _span_cost_s(samples: int = 30, batch: int = 2000) -> float:
+    """Per-call cost of ``span(...).__enter__/__exit__`` with one attr —
+    min over batched samples (min is robust to scheduler noise)."""
+    best = float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        for _ in range(batch):
+            with trace.span("overhead.probe", metric="X"):
+                pass
+        best = min(best, time.perf_counter() - t0)
+    return best / batch
+
+
+def _step_cost_s(coll, preds, target, samples: int = 15, batch: int = 5) -> float:
+    best = float("inf")
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        for _ in range(batch):
+            coll.update(preds, target)
+            vals = coll.compute()
+        jax.block_until_ready(list(vals.values()))
+        best = min(best, time.perf_counter() - t0)
+    return best / batch
+
+
+def _guarded_fused_collection():
+    return mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=16, on_invalid="warn"),
+            "prec": mt.Precision(num_classes=16, average="macro", on_invalid="warn"),
+            "rec": mt.Recall(num_classes=16, average="macro", on_invalid="warn"),
+            "f1": mt.F1Score(num_classes=16, average="macro", on_invalid="warn"),
+        }
+    )
+
+
+def _bench_shaped_batch(seed):
+    # the bench `obs` phase's step shape (B=8192, C=16): the budget is a
+    # ratio, so the step it is measured against must be the SAME serving-
+    # scale step the bench prices — a toy batch makes the denominator
+    # artificially tiny and the pin meaningless-noisy
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random((8192, 16), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, 16, 8192).astype(np.int32)),
+    )
+
+
+def test_disabled_span_overhead_within_one_percent_of_fused_step():
+    preds, target = _bench_shaped_batch(0)
+    coll = _guarded_fused_collection()
+    coll.update(preds, target)
+    jax.block_until_ready(list(coll.compute().values()))  # warm every graph
+    step_s = _step_cost_s(coll, preds, target)
+
+    assert not trace.tracing_enabled()
+    disabled_s = _span_cost_s()
+    overhead = _SPANS_PER_STEP * disabled_s / step_s
+    assert overhead <= 0.01, (
+        f"disabled tracing costs {overhead * 100:.3f}% of the guarded fused step "
+        f"({disabled_s * 1e9:.0f} ns/span x {_SPANS_PER_STEP} vs {step_s * 1e3:.3f} ms/step); "
+        "budget is 1%"
+    )
+
+    with trace.force_tracing(True):
+        enabled_s = _span_cost_s()
+    overhead_enabled = _SPANS_PER_STEP * enabled_s / step_s
+    assert overhead_enabled <= 0.05, (
+        f"enabled tracing costs {overhead_enabled * 100:.3f}% of the guarded fused step "
+        f"({enabled_s * 1e9:.0f} ns/span x {_SPANS_PER_STEP} vs {step_s * 1e3:.3f} ms/step); "
+        "budget is 5%"
+    )
+
+
+def test_disabled_path_is_identity_level():
+    """No ring growth, no sink feeds, the one shared singleton — and the
+    per-call cost is within 50x of an empty context manager (identity
+    level: both are sub-microsecond python overhead, nothing hidden)."""
+    import contextlib
+
+    assert trace.span("a") is trace.span("b")
+    trace.instant("nothing")
+    assert trace.trace_records() == []
+    assert rm.registry.counters() == {}
+
+    null = contextlib.nullcontext()
+    best_null = float("inf")
+    for _ in range(20):
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            with null:
+                pass
+        best_null = min(best_null, time.perf_counter() - t0)
+    best_null /= 2000
+    disabled = _span_cost_s(samples=20)
+    assert disabled <= max(50 * best_null, 20e-6), (
+        f"disabled span costs {disabled * 1e9:.0f} ns/call vs nullcontext "
+        f"{best_null * 1e9:.0f} ns/call"
+    )
+
+
+@pytest.mark.slow
+def test_end_to_end_step_ratio_budget():
+    """The wall-clock A/B the bench phase also runs: the same warm fused
+    step timed with tracing disabled vs enabled — enabled must stay within
+    the 5% budget (plus measurement slack) of disabled."""
+    preds, target = _bench_shaped_batch(1)
+    coll = _guarded_fused_collection()
+    coll.update(preds, target)
+    jax.block_until_ready(list(coll.compute().values()))
+    disabled_s = _step_cost_s(coll, preds, target, samples=25)
+    with trace.force_tracing(True):
+        enabled_s = _step_cost_s(coll, preds, target, samples=25)
+    # 5% budget + 5% timer slack for min-of-N on a shared box
+    assert enabled_s <= disabled_s * 1.10, (
+        f"enabled step {enabled_s * 1e3:.3f} ms vs disabled {disabled_s * 1e3:.3f} ms "
+        f"({enabled_s / disabled_s:.3f}x; budget 1.05x + slack)"
+    )

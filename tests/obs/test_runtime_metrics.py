@@ -1,0 +1,139 @@
+"""Self-telemetry contracts (ISSUE 10): counters under contention, the
+dogfooded sketch histogram's eps-bounded quantiles against a recorded
+reference stream, the batch-amortized fold path, and the cross-worker
+merge (``sketch_merge`` semantics, like any metric sketch state)."""
+import threading
+
+import numpy as np
+import pytest
+
+from metrics_tpu.obs import runtime_metrics as rm
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    rm.registry.reset()
+    yield
+    rm.registry.reset()
+
+
+def _assert_rank_error(estimate: float, stream: np.ndarray, q: float, eps: float) -> None:
+    """The KLL contract: the estimate's rank in the true stream is within
+    ``eps * n`` of the target rank (value-domain checks are meaningless for
+    arbitrary distributions; rank is what the sketch bounds)."""
+    n = stream.size
+    rank = np.searchsorted(np.sort(stream), estimate, side="right")
+    assert abs(rank - q * n) <= eps * n + 1, (
+        f"q={q}: estimate {estimate} has rank {rank}, target {q * n:.0f} "
+        f"(allowed slack {eps * n:.0f})"
+    )
+
+
+def test_counter_threaded_increments_are_exact():
+    counter = rm.registry.counter("hits_total")
+
+    def work():
+        for _ in range(5000):
+            counter.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter.value == 40000
+
+
+def test_histogram_quantiles_within_eps_of_reference_stream():
+    rng = np.random.default_rng(42)
+    stream = rng.lognormal(mean=1.0, sigma=1.5, size=30000).astype(np.float64)
+    hist = rm.LatencyHistogram("ref_ms", eps=0.01)
+    for v in stream:
+        hist.observe(float(v))
+    assert hist.count == stream.size
+    assert hist.sum_ms == pytest.approx(float(stream.sum()), rel=1e-6)
+    quantiles = hist.quantiles((0.5, 0.99, 0.999))
+    for q, est in quantiles.items():
+        _assert_rank_error(est, stream, q, hist.eps)
+
+
+def test_histogram_folds_pending_into_sketch_and_stays_correct(monkeypatch):
+    # tiny pending cap: every few observes folds through the jax sketch, so
+    # the fold path (not just the exact pending tail) carries the answer
+    monkeypatch.setattr(rm, "_PENDING_CAP", 64)
+    rng = np.random.default_rng(7)
+    stream = rng.random(4000)
+    hist = rm.LatencyHistogram("fold_ms", eps=0.02)
+    for v in stream:
+        hist.observe(float(v))
+    assert hist._sketch is not None  # the fold actually ran
+    assert len(hist._pending) < 64
+    for q, est in hist.quantiles((0.5, 0.99)).items():
+        _assert_rank_error(est, stream, q, hist.eps)
+
+
+def test_histogram_merge_covers_both_streams():
+    rng = np.random.default_rng(3)
+    a_stream, b_stream = rng.normal(10, 2, 8000), rng.normal(30, 5, 12000)
+    a = rm.LatencyHistogram("m_ms", eps=0.01)
+    b = rm.LatencyHistogram("m_ms", eps=0.01)
+    for v in a_stream:
+        a.observe(float(v))
+    for v in b_stream:
+        b.observe(float(v))
+    both = a.merged(b)
+    combined = np.concatenate([a_stream, b_stream])
+    assert both.count == combined.size
+    assert both.sum_ms == pytest.approx(float(combined.sum()), rel=1e-6)
+    for q, est in both.quantiles((0.5, 0.99)).items():
+        # merge adds one more eps-term of rank error (sketch union)
+        _assert_rank_error(est, combined, q, 2 * both.eps)
+
+
+def test_merge_rejects_geometry_mismatch():
+    a = rm.LatencyHistogram("x", eps=0.01)
+    b = rm.LatencyHistogram("x", eps=0.05)
+    a.observe(1.0)
+    b.observe(2.0)
+    with pytest.raises(ValueError, match="eps"):
+        a.merged(b)
+
+
+def test_registry_merged_sums_counters_and_unions_histograms():
+    reg_a, reg_b = rm.RuntimeMetrics(), rm.RuntimeMetrics()
+    reg_a.counter("offers_total").inc(10)
+    reg_b.counter("offers_total").inc(5)
+    reg_b.counter("only_b_total").inc(1)
+    rng = np.random.default_rng(11)
+    stream_a, stream_b = rng.random(3000), rng.random(3000) + 1.0
+    for v in stream_a:
+        reg_a.histogram("lat_ms").observe(float(v))
+    for v in stream_b:
+        reg_b.histogram("lat_ms").observe(float(v))
+    merged = rm.merged(reg_a, reg_b)
+    assert merged.counters()["offers_total"] == 15
+    assert merged.counters()["only_b_total"] == 1
+    hist = merged.histogram("lat_ms")
+    combined = np.concatenate([stream_a, stream_b])
+    assert hist.count == combined.size
+    _assert_rank_error(hist.quantiles((0.5,))[0.5], combined, 0.5, 2 * hist.eps)
+
+
+def test_snapshot_light_form_is_pure_python():
+    reg = rm.RuntimeMetrics()
+    reg.counter("c_total").inc(2)
+    reg.histogram("h_ms").observe(1.5)
+    light = reg.snapshot(quantiles=False)
+    assert light["counters"] == {"c_total": 2}
+    assert light["histograms"]["h_ms"] == {"count": 1, "sum_ms": 1.5, "eps": 0.01}
+    full = reg.snapshot()
+    assert "quantiles_ms" in full["histograms"]["h_ms"]
+
+
+def test_seam_table_pre_registered():
+    snap = rm.RuntimeMetrics()
+    assert set(rm.HISTOGRAM_SEAMS.values()) <= set(snap.histograms())
+    # empty histograms stay out of snapshots (no all-NaN noise in scrapes)
+    assert snap.snapshot()["histograms"] == {}
