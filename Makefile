@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -78,6 +78,15 @@ test-serving:
 # cycle/read collective budgets (same tests the `async_sync` marker selects).
 test-async:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/async_sync/ -q -m 'not slow' -p no:cacheprovider
+
+# The fleet aggregation tier (metrics_tpu/fleet/ — wire format, multi-hop
+# aggregators, publisher retry/breaker degradation, HTTP transport) plus the
+# shared parallel/retry.py policy. Includes the slow multiprocess acceptance
+# (8 host processes + SIGKILL survival) under a hard timeout: every child
+# runs in its own process group and teardown SIGKILLs the group, so a
+# wedged child can never hang the lane.
+test-fleet:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/fleet/ tests/parallel/ -q -p no:cacheprovider
 
 # Fast feedback on the observability layer (metrics_tpu/obs/ — span tracer
 # ring + thread safety, sketch-histogram eps contracts, Prometheus/Perfetto

@@ -1,0 +1,175 @@
+"""Fleet aggregation end to end: 3 host processes, 1 aggregator, one
+SIGKILL — and the global scrape keeps serving with the victim loudly stale.
+
+The fleet story (ISSUE 11): each host process runs its own ServeLoop-style
+stream (here a guarded ``Accuracy`` fed fault-injected traffic) and a
+:class:`~metrics_tpu.fleet.FleetPublisher` pushing its cumulative view on
+a cadence to an :class:`~metrics_tpu.fleet.Aggregator` over HTTP
+(:class:`~metrics_tpu.fleet.FleetServer`). Views ride the checksummed wire
+format — a corrupt blob would be refused naming host and leaf — and the
+fold is idempotent last-write-wins per host, so re-deliveries can never
+double-count. Mid-stream, one host is SIGKILLed: the aggregator keeps
+serving its last view, marks the host stale within one publish cadence
+(``fleet_host_stale`` health event + per-host staleness gauges in the
+Prometheus scrape), and the surviving hosts' traffic keeps flowing.
+
+Run: ``python examples/fleet.py``
+"""
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import metrics_tpu as mt
+from metrics_tpu.fleet import Aggregator, FleetServer
+from metrics_tpu.resilience.health import registry
+
+NUM_CLASSES, HOSTS, STALE_AFTER_S = 4, 3, 1.0
+
+# one host process: the production stack — request traffic (with injected
+# NaN rows the fault channel drops and counts) offered to a ServeLoop, whose
+# immutable reduced view the publisher pushes every 0.2 s (ServeLoop is the
+# race-free publisher source; see FleetPublisher's thread contract)
+_HOST = """
+import sys, time
+import numpy as np
+import jax.numpy as jnp
+import metrics_tpu as mt
+from metrics_tpu.fleet import FleetPublisher, HttpViewChannel
+
+host, url = int(sys.argv[1]), sys.argv[2]
+rng = np.random.default_rng(100 + host)
+loop = mt.ServeLoop(mt.Accuracy(num_classes={nc}, on_invalid="drop"),
+                    workers=1, reduce_every_s=0.1)
+pub = FleetPublisher(
+    loop, HttpViewChannel(url, timeout_s=5.0), host_id=f"host-{{host}}",
+    publish_every_s=0.2, deadline_s=5.0, max_retries=1, backoff_s=0.1,
+)
+print("READY", flush=True)
+while True:
+    preds = rng.random((32, {nc})).astype(np.float32)
+    preds[0, :] = np.nan  # one poison row per batch: dropped + counted
+    loop.offer(jnp.asarray(preds), jnp.asarray(rng.integers(0, {nc}, 32)))
+    time.sleep(0.1)
+""".format(nc=NUM_CLASSES)
+
+
+def spawn_host(h: int, publish_url: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    return subprocess.Popen(
+        [sys.executable, "-c", textwrap.dedent(_HOST), str(h), publish_url],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        start_new_session=True,  # its own process group: SIGKILL-able as a unit
+    )
+
+
+def await_ready(h: int, proc: subprocess.Popen, timeout_s: float = 120.0) -> None:
+    """Deadline-bounded READY handshake (the kill-discipline rule: a wedged
+    child must fail this example loudly, never hang it — a hung example
+    would orphan the other already-spawned while-True hosts)."""
+    import queue
+    import threading
+
+    box: "queue.Queue[str]" = queue.Queue(maxsize=1)
+    threading.Thread(target=lambda: box.put(proc.stdout.readline()), daemon=True).start()
+    try:
+        line = box.get(timeout=timeout_s)
+    except queue.Empty:
+        raise AssertionError(f"host-{h} produced no output within {timeout_s}s")
+    assert line.strip() == "READY", f"host-{h} failed to start ({line!r})"
+
+
+def killpg(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, OSError):
+        pass
+
+
+def wait_for(predicate, deadline_s: float, what: str):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main():
+    aggregator = Aggregator(
+        mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop"),
+        node_id="global",
+        stale_after_s=STALE_AFTER_S,
+    )
+    hosts = []
+    with FleetServer(aggregator) as server:
+        try:
+            print(f"aggregator listening on {server.url} (ingest: /publish, scrape: /metrics)")
+            for h in range(HOSTS):
+                hosts.append(spawn_host(h, server.publish_url))  # in `hosts` BEFORE any wait: the finally always reaps it
+            for h, proc in enumerate(hosts):
+                await_ready(h, proc)
+            wait_for(
+                # a ServeLoop's very first published view can predate its
+                # first reduce (0 updates); wait for real traffic too
+                lambda: len(aggregator.report()["hosts"]) == HOSTS
+                and aggregator.report()["updates"] > 0,
+                30.0,
+                "every host's first published view with traffic",
+            )
+            rep = aggregator.report()
+            print(f"all {HOSTS} hosts publishing: value={rep['value']:.4f} updates={rep['updates']}")
+
+            victim = hosts[0]
+            print("SIGKILL host-0 mid-stream ...")
+            killpg(victim)
+            wait_for(
+                lambda: aggregator.report()["hosts"]["host-0"]["stale"],
+                STALE_AFTER_S + 10.0,
+                "the dead host to be marked stale",
+            )
+
+            rep = aggregator.report()
+            assert rep["value"] is not None, "global view stopped serving"
+            assert rep["hosts"]["host-0"]["stale"] is True
+            live = [h for h, e in rep["hosts"].items() if not e["stale"]]
+            print(
+                f"global still serving: value={rep['value']:.4f} updates={rep['updates']} "
+                f"stale=['host-0'] live={sorted(live)}"
+            )
+            assert sorted(live) == ["host-1", "host-2"]
+            assert any("host-0" in e["message"] for e in registry.events("fleet_host_stale"))
+
+            # the whole-fleet Prometheus surface, scraped over HTTP mid-outage
+            text = urllib.request.urlopen(server.url + "/metrics", timeout=10).read().decode()
+            for line in text.splitlines():
+                if "fleet_host_stale{" in line or "fleet_hosts" in line:
+                    print("scrape>", line)
+            assert 'metrics_tpu_fleet_host_stale{host="host-0",node="global"} 1' in text
+            assert 'metrics_tpu_health_events_total{kind="fleet_host_stale"}' in text
+
+            # the survivors keep flowing: updates still climb after the kill
+            before = rep["updates"]
+            wait_for(
+                lambda: aggregator.report()["updates"] > before,
+                15.0,
+                "surviving hosts' traffic to keep flowing",
+            )
+            print("survivors kept publishing; fleet degraded loudly, never wedged. OK")
+        finally:
+            for proc in hosts:
+                killpg(proc)
+
+
+if __name__ == "__main__":
+    main()

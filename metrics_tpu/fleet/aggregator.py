@@ -1,0 +1,432 @@
+"""Fault-tolerant aggregation node: fold published host views, stay serving.
+
+One :class:`Aggregator` is one node of the fleet's multi-hop reduction
+tree (host → pod aggregator → global — DynamiQ's multi-hop all-reduce
+shape, PAPERS.md, applied at the service level over DCN/HTTP instead of
+ICI). It ingests wire-format view blobs (``fleet/wire.py``), refuses
+anything that fails verification, and folds the accepted views through the
+framework's existing merge protocol — the same ``_reduce_states`` /
+``sketch_merge`` / FaultCounters-sum / count-weighted-mean fold
+``ServeLoop`` uses for its worker replicas — into one reported value.
+
+**Idempotent by construction.** Every view is a host's *cumulative* state
+named by ``(host_id, seq)``; the fold is last-write-wins per host, never
+an accumulation of deltas. Re-delivered, duplicated, or reordered blobs
+fold at most once (an older or equal ``seq`` is ignored), and a pod
+aggregator re-publishing its whole merged view upward each cadence is
+likewise replace-not-add at the global node — no hop can double-count.
+(The corollary contract: a host must publish to exactly one pod; moving a
+live host between pods without restarting its identity would fold its
+stream twice, once per pod that remembers it.)
+
+**Degradation model** (the ``RetryingGather`` stance, service-level): a
+dead or flapping host simply stops refreshing its view — the aggregator
+keeps serving the last accepted view, marks the host **loudly stale**
+(``fleet_host_stale`` health event once per episode, per-host
+``staleness_s`` in every report and scrape) and never blocks. A corrupt
+or config-mismatched view is refused with a ``fleet_payload_rejected``
+event naming the host and leaf; the previous intact view keeps serving.
+A recovered host's next accepted view clears its staleness episode.
+
+Everything here is host-side python over snapshot payloads — zero
+collectives in any compiled graph (the fleet tier adds nothing to the
+jit'd update/sync paths; ``make lint`` budgets stay untouched).
+"""
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from metrics_tpu.fleet.wire import WireError, decode_view, encode_view, next_seq
+from metrics_tpu.fleet._env import resolve_fleet_knob
+from metrics_tpu.resilience.health import health_report, record_degradation
+from metrics_tpu.serving.loop import _clone, _fold_snapshot, _members, _snapshot_of
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+__all__ = ["Aggregator"]
+
+
+class Aggregator:
+    """Fold wire-format host views into one served value.
+
+    Example (one pod node)::
+
+        agg = Aggregator(Accuracy(num_classes=10), node_id="pod-0")
+        status = agg.ingest(blob)        # "accepted" | "duplicate:<seq>"; raises WireError on corruption
+        rep = agg.report()               # value + per-host staleness
+        text = agg.scrape()              # Prometheus text for the whole subtree
+
+    ``metric`` is the pristine prototype (Metric or MetricCollection) every
+    published view must structurally match — a mismatched view is refused
+    at ingest, before it can poison the fold. Multi-hop composition:
+    :meth:`view_blob` encodes this node's merged view under its own
+    ``node_id``, ready to push to the next hop (``FleetPublisher(agg, ...)``
+    does exactly that on a cadence).
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        node_id: str = "global",
+        stale_after_s: Optional[float] = None,
+    ) -> None:
+        if not node_id:
+            raise MetricsTPUUserError("`node_id` must be a non-empty string")
+        self.node_id = node_id
+        self.stale_after_s = resolve_fleet_knob("stale_after_s", stale_after_s)
+        self._proto = metric
+        self._lock = threading.Lock()
+        # host_id -> {"seq", "snap", "updates", "published_unix",
+        #             "received_unix", "received_mono", "stale_reported"}
+        self._views: Dict[str, Dict[str, Any]] = {}
+        self._accepted = 0
+        self._duplicates = 0
+        self._rejected: Dict[str, int] = {}
+        self._downstream_reported: Dict[str, bool] = {}  # stale-episode state
+        self._fold_cache: Optional[Any] = None  # (accepted_count, reporter)
+        self._seq = 0  # this node's own publish sequence (multi-hop)
+        self._publish_lock = threading.Lock()  # (payload, seq) pairing order
+
+    # -- ingest ---------------------------------------------------------
+
+    def ingest(self, blob: bytes, source: Optional[str] = None) -> str:
+        """Decode-validate-or-refuse one published view blob.
+
+        Returns ``"accepted"`` (the host's view advanced) or
+        ``"duplicate:<held_seq>"`` (re-delivered/reordered blob with a
+        known or older ``seq`` — folded once by construction, so this is a
+        no-op, not an error; the held seq lets a publisher detect a
+        persistent seq regression and jump past it).
+        Raises :class:`~metrics_tpu.fleet.wire.WireError` when the
+        blob fails checksum/schema verification or does not match the
+        aggregator's metric configuration — recorded as a
+        ``fleet_payload_rejected`` health event naming the host (or
+        ``source``, e.g. the peer address, when the header itself is
+        unreadable) and the offending leaf.
+        """
+        try:
+            header, payload = decode_view(blob)
+        except WireError as err:
+            self._reject(source or "<unknown>", str(err))
+            raise
+        host = header["host_id"]
+        with self._lock:
+            current_seq = (self._views.get(host) or {}).get("seq")
+        if current_seq is not None and header["seq"] <= current_seq:
+            # cheap pre-check: an at-least-once transport re-delivers whole
+            # blobs (the publisher's designed retry_timeouts path), and a
+            # known-or-older seq will be discarded anyway — skip the
+            # deepcopy + transactional load. The store below re-checks under
+            # the lock, so a racing fresher ingest still wins. The answer
+            # carries the seq the fold currently holds: a publisher seeing
+            # "duplicate" repeatedly (a restarted host whose wall clock
+            # stepped BACKWARD, so next_seq floors below the pre-restart
+            # seq) reads it and jumps its sequence past the regression —
+            # without it the host would be silently dropped for the whole
+            # skew duration while both ends report healthy.
+            with self._lock:
+                self._duplicates += 1
+            return f"duplicate:{current_seq}"
+        # structural validation against the prototype: load_snapshot_state
+        # is transactional and refuses unknown states/children/shapes naming
+        # the offender — a checksum-intact view from a mis-configured host
+        # must be refused here, not crash the fold later
+        scratch = _clone(self._proto)
+        try:
+            scratch.load_snapshot_state(payload)
+        except Exception as err:  # noqa: BLE001 — refusal path, always loud
+            msg = f"view from host {host!r} does not match this aggregator's metric config: {err}"
+            self._reject(host, msg)
+            raise WireError(f"{self.node_id}: {msg}")
+        entry = {
+            "seq": header["seq"],
+            "snap": _snapshot_of(scratch),
+            "updates": header.get("updates"),
+            "published_unix": header.get("published_unix"),
+            "received_unix": time.time(),
+            "received_mono": time.monotonic(),
+            "stale_reported": False,
+            # staleness table the publishing node observed for ITS children
+            # (a pod forwarding its hosts): the federation channel that lets
+            # the global scrape name a dead leaf host, not just a dead pod
+            "downstream": (header.get("extra") or {}).get("hosts") or {},
+        }
+        with self._lock:
+            current = self._views.get(host)
+            if current is not None and header["seq"] <= current["seq"]:
+                self._duplicates += 1
+                return f"duplicate:{current['seq']}"
+            self._views[host] = entry
+            self._accepted += 1
+        return "accepted"
+
+    def _reject(self, host: str, message: str) -> None:
+        with self._lock:
+            self._rejected[host] = self._rejected.get(host, 0) + 1
+        record_degradation(
+            "fleet_payload_rejected",
+            f"aggregator {self.node_id}: {message}",
+            node_id=self.node_id,
+            host=host,
+        )
+
+    # -- staleness ------------------------------------------------------
+
+    def _sweep_staleness(self) -> Dict[str, Dict[str, Any]]:
+        """Per-host staleness snapshot; records ``fleet_host_stale`` once
+        per episode (a fresh accepted view resets the episode). Ages are
+        measured on this node's monotonic clock from receipt — publisher
+        clocks are display-only, so cross-process skew cannot mark a live
+        host stale."""
+        now_mono = time.monotonic()
+        stale_events = []
+        out: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for host, v in self._views.items():
+                age = max(0.0, now_mono - v["received_mono"])
+                stale = age > self.stale_after_s
+                if stale and not v["stale_reported"]:
+                    v["stale_reported"] = True
+                    stale_events.append((host, age, v["seq"]))
+                out[host] = {
+                    "seq": v["seq"],
+                    "updates": v["updates"],
+                    "published_unix": v["published_unix"],
+                    "received_unix": v["received_unix"],
+                    "staleness_s": age,
+                    "stale": stale,
+                }
+        for host, age, seq in stale_events:
+            record_degradation(
+                "fleet_host_stale",
+                f"aggregator {self.node_id}: host {host!r} has published nothing for "
+                f"{age:.1f}s (> {self.stale_after_s:g}s); its last view (seq {seq}) is "
+                "serving loudly stale",
+                node_id=self.node_id,
+                host=host,
+                staleness_s=age,
+            )
+        return out
+
+    def _downstream(self) -> Dict[str, Dict[str, Any]]:
+        """Hosts visible THROUGH this node's children (pod-forwarded
+        staleness tables), ages advanced by each child view's own age —
+        a killed pod's hosts keep aging here and cross the threshold even
+        though the pod can no longer report them. Stale transitions record
+        ``fleet_host_stale`` once per episode, in THIS process's registry:
+        in a multi-process tree the reporting pod's registry is elsewhere,
+        so the root must carry the event for its own scrape."""
+        now_mono = time.monotonic()
+        out: Dict[str, Dict[str, Any]] = {}
+        stale_events = []
+        with self._lock:
+            for via, v in self._views.items():
+                view_age = max(0.0, now_mono - v["received_mono"])
+                for name, d in (v.get("downstream") or {}).items():
+                    # staleness VERDICT: while the child view is fresh, the
+                    # child's own judgment stands (it watches the leaf
+                    # directly; re-thresholding the compounded leaf+transit
+                    # age here would spuriously flag healthy leaves whenever
+                    # cadences approach stale_after_s). Only once the child
+                    # ITSELF goes silent do its unobservable leaves go stale
+                    # locally. The reported age stays the honest compound.
+                    out[name] = {
+                        "staleness_s": float(d.get("staleness_s") or 0.0) + view_age,
+                        "stale": bool(d.get("stale")) or view_age > self.stale_after_s,
+                        "via": via,
+                    }
+            for name, e in out.items():
+                if e["stale"] and not self._downstream_reported.get(name):
+                    self._downstream_reported[name] = True
+                    stale_events.append((name, e["via"], e["staleness_s"]))
+                elif not e["stale"]:
+                    self._downstream_reported[name] = False  # episode over
+        for name, via, age in stale_events:
+            record_degradation(
+                "fleet_host_stale",
+                f"aggregator {self.node_id}: downstream host {name!r} (via {via!r}) is "
+                f"loudly stale ({age:.1f}s > {self.stale_after_s:g}s, or reported stale "
+                "by its aggregator)",
+                node_id=self.node_id,
+                host=name,
+                via=via,
+                staleness_s=age,
+            )
+        return out
+
+    # -- fold / report --------------------------------------------------
+
+    def _fold(self) -> Any:
+        """One clone+fold pass over the current views (the ServeLoop
+        reduce, across processes instead of worker threads), cached on the
+        accepted-view counter: scrape/report/publish cadences between
+        ingests re-read the same folded reporter instead of re-paying
+        deepcopy + N folds + compute per call, while any accepted view
+        invalidates the cache — scrape-only deployments still see live
+        fold state. (A reporter, once cached, is never mutated again —
+        concurrent readers at worst recompute the identical value.)"""
+        with self._lock:
+            key = self._accepted
+            cached = self._fold_cache
+            if cached is not None and cached[0] == key:
+                return cached[1]
+            snaps = [self._views[h]["snap"] for h in sorted(self._views)]
+        reporter = _clone(self._proto)
+        for snap in snaps:
+            _fold_snapshot(reporter, snap)
+        with self._lock:
+            # racing folds both computed from >= this key's views; keep the
+            # newer key (another ingest may have landed mid-fold, in which
+            # case the next reader re-folds)
+            if self._fold_cache is None or self._fold_cache[0] <= key:
+                self._fold_cache = (key, reporter)
+        return reporter
+
+    def report(self) -> Dict[str, Any]:
+        """The folded fleet value plus per-host staleness — never blocks on
+        a dead host (its last view serves, marked stale)."""
+        hosts = self._sweep_staleness()
+        downstream = self._downstream()
+        reporter = self._fold()
+        updates = sum(m._update_count for _, m in _members(reporter))
+        faults = {}
+        for name, m in _members(reporter):
+            fc = getattr(m, "fault_counts", None)
+            if fc:
+                faults[name or type(m).__name__] = fc
+        with self._lock:
+            rejected = dict(self._rejected)
+        return {
+            "value": reporter.compute() if updates else None,
+            "updates": updates,
+            "node_id": self.node_id,
+            "hosts": hosts,
+            "hosts_stale": sum(1 for h in hosts.values() if h["stale"]),
+            "downstream_stale": sum(1 for h in downstream.values() if h["stale"]),
+            "downstream": downstream,
+            # same shapes as health()["fleet"]: int total + per-host dict —
+            # a consumer alerting on one surface reads the other identically
+            "rejected": sum(rejected.values()),
+            "rejected_by_host": rejected,
+            "faults": faults,
+            "computed_unix": time.time(),
+        }
+
+    # -- multi-hop ------------------------------------------------------
+
+    def fleet_view(self) -> Optional[Dict[str, Any]]:
+        """This node's merged view as a ``snapshot_state`` payload (None
+        until the first host view lands) — the publisher-source hook, same
+        surface as ``ServeLoop.fleet_view``."""
+        with self._lock:
+            empty = not self._views
+        if empty:
+            return None
+        return self._fold().snapshot_state()
+
+    def fleet_extra(self) -> Optional[Dict[str, Any]]:
+        """Header extra for this node's upward publishes: the per-host
+        staleness table (direct children + anything they forwarded), so
+        staleness federates to the root along with the values.
+        ``FleetPublisher`` calls this per publish when the source defines
+        it — the staleness sweep therefore runs on the publish cadence,
+        which is exactly when a dead child must be noticed."""
+        table = {
+            name: {"staleness_s": e["staleness_s"], "stale": e["stale"]}
+            for name, e in self._sweep_staleness().items()
+        }
+        for name, e in self._downstream().items():
+            table.setdefault(name, {"staleness_s": e["staleness_s"], "stale": e["stale"]})
+        return {"hosts": table} if table else None
+
+    def view_blob(self) -> Optional[bytes]:
+        """Encode the merged view under this node's identity for the next
+        hop up the tree (the in-process form of what ``FleetPublisher``
+        does on a cadence). Seq increases per call (wall-clock floored so a
+        restarted node never re-publishes under an already-folded seq)."""
+        # fold-then-seq under ONE lock (the publish_now pairing rule): two
+        # concurrent view_blob calls folding and seq-assigning in opposite
+        # orders would hand the downstream fold an older payload under a
+        # newer seq, pinning stale state until the next publish. Payload and
+        # updates also come from ONE fold result, so a racing ingest cannot
+        # pair a fresh payload with a stale update count.
+        with self._publish_lock:
+            with self._lock:
+                if not self._views:
+                    return None
+            reporter = self._fold()
+            payload = reporter.snapshot_state()
+            updates = sum(m._update_count for _, m in _members(reporter))
+            extra = self.fleet_extra()
+            with self._lock:
+                self._seq = next_seq(self._seq)
+                seq = self._seq
+        return encode_view(
+            payload,
+            host_id=self.node_id,
+            seq=seq,
+            updates=updates,
+            extra=extra,
+        )
+
+    # -- observability --------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hosts": len(self._views),
+                "accepted": self._accepted,
+                "duplicates": self._duplicates,
+                "rejected": sum(self._rejected.values()),
+            }
+
+    def health(self) -> Dict[str, Any]:
+        """``health_report()`` over the folded view plus the fleet section
+        (per-host staleness, accept/duplicate/reject accounting) —
+        federated: one report covers every host below this node."""
+        # sweep BEFORE building the report: a host crossing the staleness
+        # threshold right now must show in THIS scrape's event counts
+        hosts = self._sweep_staleness()
+        downstream = self._downstream()
+        # fold NOW, not whenever report() last ran: a deployment whose only
+        # reader is the Prometheus scraper must still see live fold fault
+        # counters, never a stale (or absent) reporter
+        with self._lock:
+            has_views = bool(self._views)
+        rep = health_report(self._fold()) if has_views else health_report()
+        stats = self.stats()
+        with self._lock:
+            rejected = dict(self._rejected)
+        rep["fleet"] = {
+            "node_id": self.node_id,
+            "stale_after_s": self.stale_after_s,
+            "hosts": hosts,
+            "hosts_total": stats["hosts"],
+            "hosts_stale": sum(1 for h in hosts.values() if h["stale"]),
+            # summary gauge for the leaves too: a dead host behind a HEALTHY
+            # pod never flips hosts_stale (the pod is fresh), so an operator
+            # alerting on one aggregate number at the global must have this
+            "downstream_stale": sum(1 for h in downstream.values() if h["stale"]),
+            "downstream": downstream,
+            "accepted": stats["accepted"],
+            "duplicates": stats["duplicates"],
+            "rejected": stats["rejected"],
+            "rejected_by_host": rejected,
+        }
+        return rep
+
+    def scrape(self, fmt: str = "prometheus") -> str:
+        """One exporter scrape for the whole subtree under this node: the
+        federated :meth:`health` (per-host staleness gauges, event-kind
+        counts, fold fault counters) through the existing ``obs/export``
+        renderers. Serve it over HTTP with
+        :class:`~metrics_tpu.fleet.transport.FleetServer` (which exposes
+        ``/metrics`` + ``/metrics.json`` next to the ``/publish`` ingest
+        endpoint) or :class:`metrics_tpu.obs.TelemetryExporter`
+        (``TelemetryExporter(health_fn=agg.health)``)."""
+        from metrics_tpu.obs.export import json_text, prometheus_text
+
+        if fmt == "prometheus":
+            return prometheus_text(health=self.health())
+        if fmt == "json":
+            return json_text(health=self.health())
+        raise MetricsTPUUserError(f"`fmt` must be 'prometheus' or 'json', got {fmt!r}")

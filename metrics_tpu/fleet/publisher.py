@@ -1,0 +1,466 @@
+"""Cadenced host-side view publisher: push, retry, breaker, loudly-stale.
+
+``FleetPublisher`` is the host end of the fleet tree: on a cadence it
+snapshots its source's reduced view (a ``ServeLoop``, an ``Aggregator``
+re-publishing upward, or any ``Metric``/``MetricCollection``), encodes it
+with this host's identity + an increasing sequence (``fleet/wire.py``),
+and pushes the blob to every configured destination through a
+:class:`~metrics_tpu.parallel.retry.RetryPolicy` — the same
+timeout/backoff/breaker budget ``RetryingGather`` runs, with
+``retry_timeouts=True`` because a view push is idempotent (last-write-wins
+per host at the aggregator), so re-sending after a timeout can at worst
+deliver the same view twice, which folds once.
+
+Degradation contract (the breaker stance, publish-side): a dead or
+flapping aggregator NEVER blocks serving — the publisher runs on its own
+daemon thread, each attempt is deadline-bounded, and once a destination's
+budget is exhausted its breaker opens so subsequent cadences skip it
+cheaply. Failures surface as ``fleet_publish_error`` health events; when a
+destination has accepted nothing for ``stale_after_s`` the host records
+``fleet_host_stale`` once per episode — this host KNOWS the aggregator's
+view of it is now stale (the aggregator marks the same staleness from its
+side, so the gap is visible from both ends of the broken link). A
+successful push closes the breaker and ends the episode.
+
+Destinations are plain callables ``(blob: bytes) -> Any`` —
+``fleet.transport.HttpViewChannel`` in production, injectable fakes in
+tests (``tests/helpers/fault_injection.py`` network shapes).
+"""
+import threading
+import time
+from typing import Any, Callable, Dict, Mapping, Optional, Union
+
+from metrics_tpu.fleet.wire import encode_view, next_seq
+from metrics_tpu.fleet._env import resolve_fleet_knob
+from metrics_tpu.parallel.retry import CircuitOpenError, RetryBudgetExceededError, RetryPolicy
+from metrics_tpu.resilience.health import record_degradation
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+__all__ = ["FleetPublisher"]
+
+Channel = Callable[[bytes], Any]
+
+
+def _payload_updates(payload: Dict[str, Any]) -> int:
+    """Total update count of a snapshot payload (collection members sum;
+    child metrics are part of their parent's tree, not extra updates)."""
+    if "members" in payload and "states" not in payload:
+        return sum(_payload_updates(p) for p in payload["members"].values())
+    return int(payload.get("update_count", 0))
+
+
+class FleetPublisher:
+    """Publish a source's reduced view to aggregator destination(s).
+
+    Example::
+
+        loop = ServeLoop(metric, workers=4)
+        pub = FleetPublisher(
+            loop,
+            destinations={"pod-0": HttpViewChannel(url)},
+            host_id="host-17",
+            publish_every_s=0.5,
+        )
+        ...
+        pub.stop()
+
+    ``source`` must expose ``fleet_view() -> payload | None``
+    (``ServeLoop``, ``Aggregator``) or ``snapshot_state() -> payload``
+    (any Metric/MetricCollection). **Thread contract for bare metric
+    sources:** the cadence thread calls ``snapshot_state()``, which on a
+    blocking-mode metric is NOT synchronized against a concurrent
+    ``update()`` — a torn view could pair state N with count N+1. Either
+    update and publish from one thread (``start=False`` +
+    :meth:`publish_now`), construct the metric with
+    ``sync_mode='overlapped'`` (whose swap guard makes snapshots
+    consistent), or — the production pattern — serve it through a
+    ``ServeLoop``, whose ``fleet_view()`` reads an immutable reduced
+    reporter and is race-free by construction. ``destinations`` is one channel or a
+    ``{name: channel}`` mapping — each destination gets its OWN retry
+    policy and breaker, so one dead pod aggregator cannot starve pushes
+    to a healthy one. Knobs resolve programmatic > ``METRICS_TPU_FLEET_*``
+    env > default (``fleet/_env.py``). ``start=False`` defers the cadence
+    thread — call :meth:`start` later, or drive :meth:`publish_now`
+    manually (note: :meth:`request` only wakes a RUNNING cadence thread).
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        destinations: Union[Channel, Mapping[str, Channel]],
+        host_id: str,
+        publish_every_s: Optional[float] = None,
+        deadline_s: Optional[float] = None,
+        max_retries: int = 1,
+        backoff_s: float = 0.25,
+        breaker_cooldown_s: Optional[float] = None,
+        stale_after_s: Optional[float] = None,
+        start: bool = True,
+    ) -> None:
+        if not host_id:
+            raise MetricsTPUUserError("`host_id` must be a non-empty string")
+        if hasattr(source, "fleet_view"):
+            self._view_fn = source.fleet_view
+        elif hasattr(source, "snapshot_state"):
+            self._view_fn = source.snapshot_state
+        else:
+            raise MetricsTPUUserError(
+                f"`source` ({type(source).__name__}) exposes neither fleet_view() nor "
+                "snapshot_state(); pass a ServeLoop, Aggregator, Metric, or MetricCollection"
+            )
+        # optional source hook: header extra per publish (an Aggregator
+        # forwards its per-host staleness table up the tree through this)
+        self._extra_fn = getattr(source, "fleet_extra", None)
+        self.host_id = host_id
+        self.publish_every_s = resolve_fleet_knob("publish_every_s", publish_every_s)
+        self.stale_after_s = resolve_fleet_knob("stale_after_s", stale_after_s)
+        deadline = resolve_fleet_knob("deadline_s", deadline_s)
+        cooldown = resolve_fleet_knob("breaker_cooldown_s", breaker_cooldown_s)
+        if not isinstance(destinations, Mapping):
+            destinations = {"default": destinations}
+        if not destinations:
+            raise MetricsTPUUserError("`destinations` must name at least one channel")
+        self._channels: Dict[str, Channel] = dict(destinations)
+        # per-destination budget: one breaker each, so a dead pod opens ITS
+        # circuit only and healthy destinations keep receiving every cadence
+        self._policies: Dict[str, RetryPolicy] = {
+            name: RetryPolicy(
+                timeout_s=deadline,
+                max_retries=max_retries,
+                backoff_s=backoff_s,
+                cooldown_s=cooldown,
+                retry_timeouts=True,  # idempotent push: re-delivery folds once
+                name=f"fleet publish {host_id}->{name}",
+                thread_name=f"metrics-tpu-fleet-publish-{name}",
+            )
+            for name in self._channels
+        }
+        self._stats: Dict[str, Dict[str, int]] = {
+            name: {"published": 0, "failed": 0, "skipped_open": 0, "skipped_inflight": 0}
+            for name in self._channels
+        }
+        # at most ONE push runs per destination at any time (the policies
+        # are not thread-safe, and a second push behind a wedged one buys
+        # nothing — the next cadence carries a fresher view anyway)
+        self._inflight: Dict[str, Optional[threading.Thread]] = {
+            name: None for name in self._channels
+        }
+        self._last_ok_mono: Dict[str, Optional[float]] = {name: None for name in self._channels}
+        self._started_mono = time.monotonic()
+        self._stale_reported: Dict[str, bool] = {name: False for name in self._channels}
+        self._encode_error_reported = False  # snapshot/encode failure episode
+        self._dup_streak: Dict[str, int] = {name: 0 for name in self._channels}
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._snapshot_lock = threading.Lock()  # (payload, seq) pairing order
+        self._stop_evt = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"metrics-tpu-fleet-publisher-{host_id}"
+        )
+        if start:
+            self._thread.start()
+
+    def start(self) -> None:
+        """Start the cadence thread for a publisher constructed with
+        ``start=False`` (e.g. after warmup, or tests driving
+        :meth:`publish_now` manually first). Idempotent; raises after
+        :meth:`stop`."""
+        if self._stop_evt.is_set():
+            raise MetricsTPUUserError("FleetPublisher.start called after stop()")
+        if not self._thread.is_alive():
+            # re-stamp the staleness baseline: construction-to-start warmup
+            # is not a publish outage, so the first failure after a deferred
+            # start must not instantly fire a spurious stale episode
+            self._started_mono = time.monotonic()
+            try:
+                self._thread.start()
+            except RuntimeError:  # already started and exited between checks
+                pass
+
+    # -- publishing -----------------------------------------------------
+
+    def _next_seq(self) -> int:
+        # wall-clock floored (wire.next_seq): a restarted host (fresh
+        # publisher, same host_id) keeps seq monotonic, so the aggregator's
+        # last-write-wins fold never discards its post-restart views as "old"
+        with self._lock:
+            self._seq = next_seq(self._seq)
+            return self._seq
+
+    def publish_now(self, wait: bool = True) -> Dict[str, str]:
+        """One publish pass: snapshot the source, push to every destination.
+
+        Pushes run on one worker thread per destination — each destination
+        owns its policy/breaker, so a slow or blackholed endpoint burning
+        its full retry budget never delays delivery to healthy ones — and a
+        destination whose PREVIOUS push is still in flight is skipped
+        (``"skipped:inflight"``): the policies are not thread-safe, and the
+        next pass carries a fresher view anyway. With ``wait=True``
+        (default: tests, shutdown flush) the pass joins its spawned pushes,
+        bounded by the slowest per-destination budget; the cadence loop
+        passes ``wait=False`` and never blocks on any channel, so a dead
+        destination's breaker re-probe cannot stall healthy cadences.
+
+        Returns per-destination outcomes (``"ok"``, ``"skipped:empty"``,
+        ``"skipped:circuit_open"``, ``"skipped:inflight"``, ``"spawned"``
+        when ``wait=False``, or ``"failed:<error>"``). Never raises on
+        channel failure — failures degrade to events and staleness.
+        """
+        # classify destinations FIRST: when every destination is in flight
+        # or breaker-open (the common single-destination outage), the pass
+        # must be genuinely cheap — no snapshot, no pickle, no per-leaf
+        # sha256 walk of the whole state tree just to throw the blob away
+        outcomes: Dict[str, str] = {}
+        to_push = []
+        for name, channel in self._channels.items():
+            with self._lock:
+                prev = self._inflight[name]
+                if prev is not None and prev.is_alive():
+                    self._stats[name]["skipped_inflight"] += 1
+                    outcomes[name] = "skipped:inflight"
+                    continue
+            if self._policies[name].open:
+                with self._lock:
+                    self._stats[name]["skipped_open"] += 1
+                outcomes[name] = "skipped:circuit_open"
+                self._check_stale(name)
+                continue
+            to_push.append((name, channel))
+        if not to_push:
+            return outcomes
+        # snapshot and seq are taken under ONE lock: two concurrent passes
+        # snapshotting then seq-assigning in opposite orders would pair an
+        # OLDER payload with a NEWER seq, and the aggregator's last-write-
+        # wins fold would then pin the stale view until the next cadence
+        with self._snapshot_lock:
+            payload = self._view_fn()
+            if payload is None:
+                for name, _channel in to_push:
+                    outcomes[name] = "skipped:empty"
+                return outcomes
+            seq = self._next_seq()
+            extra = self._extra_fn() if self._extra_fn is not None else None
+        blob = encode_view(
+            payload,
+            host_id=self.host_id,
+            seq=seq,
+            updates=_payload_updates(payload),
+            extra=extra,
+        )
+        with self._lock:
+            self._encode_error_reported = False  # snapshot+encode healthy again
+        workers: Dict[str, threading.Thread] = {}
+        for name, channel in to_push:
+            with self._lock:
+                prev = self._inflight[name]
+                if prev is not None and prev.is_alive():
+                    # re-checked under the lock: a concurrent pass may have
+                    # spawned for this destination since classification
+                    self._stats[name]["skipped_inflight"] += 1
+                    outcomes[name] = "skipped:inflight"
+                    continue
+
+                def run(name: str = name, channel: Channel = channel) -> None:
+                    outcomes[name] = self._push(name, channel, blob)
+
+                t = threading.Thread(
+                    target=run, daemon=True, name=f"metrics-tpu-fleet-push-{name}"
+                )
+                self._inflight[name] = t
+                workers[name] = t
+                outcomes[name] = "spawned"
+                # started INSIDE the lock: a not-yet-started thread reads
+                # is_alive() False, so starting outside would let a racing
+                # publish_now slip a second push past the in-flight guard
+                # onto the same (not thread-safe) policy
+                t.start()
+        if wait:
+            for t in workers.values():
+                t.join()
+        return outcomes
+
+    def _note_duplicate(self, name: str, result: Any) -> None:
+        """Watch the aggregator's answers for a persistent seq regression.
+
+        A benign re-delivery (the idempotent retry path) answers
+        ``duplicate`` once and the next publish is accepted; a host
+        restarted after a BACKWARD wall-clock step answers ``duplicate``
+        on every publish — both ends look healthy while the fold silently
+        drops this host for the whole skew duration. After 3 consecutive
+        duplicates the publisher jumps its sequence past the seq the
+        aggregator reports holding and says so, loudly.
+        """
+        text = (
+            result.decode("utf-8", "replace")
+            if isinstance(result, (bytes, bytearray))
+            else result
+            if isinstance(result, str)
+            else None
+        )
+        if not (isinstance(text, str) and text.startswith("duplicate")):
+            with self._lock:
+                self._dup_streak[name] = 0
+            return
+        held = None
+        if ":" in text:
+            try:
+                held = int(text.split(":", 1)[1].strip())
+            except ValueError:
+                held = None
+        with self._lock:
+            self._dup_streak[name] += 1
+            streak = self._dup_streak[name]
+            # STRICT >: held == ours is the benign idempotent-retry case (a
+            # timed-out first attempt the server already folded — the retry
+            # answers duplicate with OUR seq); only a held seq ahead of ours
+            # is a genuine regression worth jumping and alerting on
+            jump = streak >= 3 and held is not None and held > self._seq
+            if jump:
+                self._seq = held  # the next publish issues next_seq(held) > held
+                self._dup_streak[name] = 0
+        if jump:
+            record_degradation(
+                "fleet_seq_regression",
+                f"host {self.host_id}: {streak} consecutive publishes answered "
+                f"'duplicate' by {name!r} holding seq {held} > ours — jumping the "
+                "sequence past it (host restarted after a backward clock step?)",
+                host=self.host_id,
+                destination=name,
+                held_seq=held,
+            )
+
+    def _push(self, name: str, channel: Channel, blob: bytes) -> str:
+        policy = self._policies[name]
+        try:
+            result = policy.call(lambda: channel(blob))
+        except CircuitOpenError:
+            # the breaker-opening pass already recorded the event; skipping
+            # is the cheap degraded path, not a new degradation
+            with self._lock:
+                self._stats[name]["skipped_open"] += 1
+            self._check_stale(name)
+            return "skipped:circuit_open"
+        except RetryBudgetExceededError as err:
+            with self._lock:
+                self._stats[name]["failed"] += 1
+            record_degradation(
+                "fleet_publish_error",
+                f"host {self.host_id}: publish to {name!r} failed after "
+                f"{err.attempts} attempt(s): {err.cause}",
+                host=self.host_id,
+                destination=name,
+                attempts=err.attempts,
+            )
+            self._check_stale(name)
+            return f"failed:{type(err.cause).__name__}"
+        self._note_duplicate(name, result)
+        with self._lock:
+            self._stats[name]["published"] += 1
+            self._last_ok_mono[name] = time.monotonic()
+            was_stale = self._stale_reported[name]
+            self._stale_reported[name] = False
+        if was_stale:
+            record_degradation(
+                "fleet_publish_recovered",
+                f"host {self.host_id}: publish to {name!r} succeeded again after a "
+                "stale episode; the aggregator's view of this host is fresh",
+                host=self.host_id,
+                destination=name,
+            )
+        return "ok"
+
+    def _record_encode_error(self, err: BaseException, during: str = "view snapshot/encode") -> None:
+        """Episode-gated like every other failure path: a persistently
+        failing snapshot on a fast cadence must not wheel the bounded
+        health-event ring and evict every other degradation — one event per
+        episode; the next successful encode re-arms it."""
+        with self._lock:
+            due = not self._encode_error_reported
+            self._encode_error_reported = True
+        if due:
+            record_degradation(
+                "fleet_publish_error",
+                f"host {self.host_id}: {during} raised {type(err).__name__}: {err} "
+                "(reported once per episode; the cadence keeps retrying)",
+                host=self.host_id,
+            )
+
+    def _check_stale(self, name: str) -> None:
+        with self._lock:
+            last_ok = self._last_ok_mono[name]
+            base = last_ok if last_ok is not None else self._started_mono
+            age = time.monotonic() - base
+            due = age > self.stale_after_s and not self._stale_reported[name]
+            if due:
+                self._stale_reported[name] = True
+        if due:
+            record_degradation(
+                "fleet_host_stale",
+                f"host {self.host_id}: no successful publish to {name!r} for {age:.1f}s "
+                f"(> {self.stale_after_s:g}s); this host is loudly stale in that "
+                "aggregator's view",
+                host=self.host_id,
+                destination=name,
+                staleness_s=age,
+            )
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            woke = self._wake.wait(timeout=self.publish_every_s)
+            if woke:
+                self._wake.clear()
+            if self._stop_evt.is_set():
+                return
+            try:
+                # wait=False: the cadence thread never blocks on a channel —
+                # a dead destination's budget runs on ITS worker while every
+                # healthy destination keeps receiving on every tick
+                self.publish_now(wait=False)
+            except Exception as err:  # noqa: BLE001 — a bad snapshot degrades, never kills the cadence
+                self._record_encode_error(err)
+
+    # -- observability / lifecycle --------------------------------------
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-destination accounting: published / failed / skipped_open,
+        plus seconds since the last successful push (None before the
+        first)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for name, counters in self._stats.items():
+                last_ok = self._last_ok_mono[name]
+                out[name] = {
+                    **counters,
+                    "since_last_ok_s": None if last_ok is None else max(0.0, now - last_ok),
+                    "circuit_open": self._policies[name].open,
+                }
+            return out
+
+    def request(self) -> None:
+        """Ask for an immediate publish pass (cadence-independent)."""
+        self._wake.set()
+
+    def stop(self, flush: bool = True, timeout_s: float = 10.0) -> None:
+        """Stop the cadence thread; ``flush=True`` runs one final publish
+        so the aggregators hold this host's last view — bounded by the
+        per-destination budgets, and destinations whose cadence push is
+        still in flight are skipped rather than raced (their in-flight
+        push already carries a current view), so a dead aggregator cannot
+        hang shutdown."""
+        self._stop_evt.set()
+        self._wake.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout_s)
+        if flush:
+            try:
+                self.publish_now()
+            except Exception as err:  # noqa: BLE001 — shutdown flush degrades, never raises
+                self._record_encode_error(err, during="shutdown flush")
+
+    def __enter__(self) -> "FleetPublisher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

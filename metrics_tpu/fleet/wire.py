@@ -1,0 +1,204 @@
+"""Versioned, per-leaf-checksummed wire format for published host views.
+
+The fleet tier moves metric state between *processes* (host → pod
+aggregator → global) over DCN/HTTP, so every payload crosses a boundary
+where truncation, bit rot, or a half-written proxy buffer can silently
+corrupt state that will be folded into the global view of the whole fleet.
+The disk snapshot layer (``resilience/snapshot.py``) already solved this
+for files: magic + schema version + one sha256 digest per state leaf
+(header fields digested too), verified before anything loads, failing
+loudly and naming the offender. This module is the same discipline applied
+to an in-memory publish instead of a file:
+
+- :func:`encode_view` wraps any :meth:`Metric.snapshot_state` /
+  ``MetricCollection.snapshot_state`` payload with a header carrying the
+  publishing node's identity (``host_id``) and a monotonically increasing
+  ``seq`` — the two fields the aggregator's idempotent last-write-wins
+  fold keys on — and the full per-leaf checksum tree (reusing the snapshot
+  layer's ``_checksum_tree`` walk verbatim, so the two formats cannot
+  drift).
+- :func:`decode_view` verifies magic, schema version, and every checksum
+  before returning; a torn or bit-flipped blob raises
+  :class:`WireCorruptionError` naming the publishing host (when the header
+  survived) and the first bad leaf — the aggregator refuses it and the
+  payload never touches the fold.
+
+Blobs are Python pickles of numpy trees, the same **trusted** transport
+model as the snapshot files (your own hosts, your own aggregators — the
+checksums defend against corruption, not adversaries). The format is
+deliberately payload-opaque and versioned so a later compressed transport
+(EQuARX-style quantized payloads, PAPERS.md) slots in as a new
+``encoding`` token without touching the fold protocol.
+
+Module import performs python work only (stdlib + numpy via the snapshot
+helpers — the hang-proof bootstrap contract, ``utilities/backend.py``).
+"""
+import pickle
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from metrics_tpu.resilience.snapshot import _checksum_tree
+
+__all__ = [
+    "MAGIC",
+    "SCHEMA_VERSION",
+    "WireError",
+    "WireCorruptionError",
+    "WireSchemaError",
+    "encode_view",
+    "decode_view",
+    "next_seq",
+]
+
+MAGIC = "metrics-tpu-fleet-view"
+SCHEMA_VERSION = 1
+# the one payload encoding this schema version ships; a compressed
+# transport registers a new token and older aggregators refuse it loudly
+# via the schema/encoding check instead of mis-decoding bytes
+ENCODING = "pickle-v1"
+
+
+def next_seq(prev: int) -> int:
+    """The publish-sequence generator both publishing sides share: strictly
+    increasing within a process AND floored to wall-clock microseconds, so a
+    restarted publisher (fresh counter, same ``host_id``) never re-publishes
+    under a seq the aggregator's last-write-wins fold has already passed.
+    One definition, because this is the invariant the idempotent fold keys
+    on — it must not drift between the publisher and the aggregator's
+    multi-hop re-publish."""
+    return max(int(prev) + 1, int(time.time() * 1_000_000))
+
+
+class WireError(RuntimeError):
+    """Base class for fleet wire encode/decode failures."""
+
+
+class WireCorruptionError(WireError):
+    """A published view failed integrity verification (truncation, bit
+    flip, torn proxy buffer) — refused, never folded."""
+
+
+class WireSchemaError(WireError):
+    """A published view was written by a newer schema/encoding than this
+    build understands."""
+
+
+def encode_view(
+    payload: Dict[str, Any],
+    host_id: str,
+    seq: int,
+    updates: Optional[int] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> bytes:
+    """Encode one ``snapshot_state`` payload as a self-verifying blob.
+
+    ``host_id`` names the publishing node (host or pod aggregator) and
+    must be stable for its lifetime — the aggregator's last-write-wins
+    fold is keyed on it. ``seq`` must increase per publish from that node
+    (re-deliveries and reorderings of old blobs are then folded at most
+    once). ``updates`` (optional) records the view's total update count
+    for observability; ``extra`` is recorded verbatim in the header.
+    """
+    if not host_id:
+        raise WireError("`host_id` must be a non-empty string")
+    header = {
+        "host_id": str(host_id),
+        "seq": int(seq),
+        "encoding": ENCODING,
+        "published_unix": time.time(),
+        "updates": None if updates is None else int(updates),
+        "extra": dict(extra) if extra else None,
+    }
+    return pickle.dumps(
+        {
+            "magic": MAGIC,
+            "schema_version": SCHEMA_VERSION,
+            "header": header,
+            "payload": payload,
+            # header covered too: a flipped host_id/seq would re-route the
+            # fold (double-count one host, orphan another), not just values
+            "checksums": _checksum_tree({"header": header, "payload": payload}),
+        },
+        protocol=4,
+    )
+
+
+def _header_hint(record: Any) -> str:
+    """Best-effort ``host=<id> seq=<n>`` naming for error messages — the
+    header may itself be the corrupt part, so this never trusts it beyond
+    display."""
+    try:
+        header = record.get("header") or {}
+        return f"host={header.get('host_id')!r} seq={header.get('seq')!r}"
+    except Exception:  # noqa: BLE001 — the record can be arbitrarily mangled
+        return "host=<unreadable>"
+
+
+def decode_view(blob: bytes) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Decode + verify one published view → ``(header, payload)``.
+
+    Raises :class:`WireCorruptionError` (unpicklable, bad magic, checksum
+    mismatch — naming the publishing host when readable and the first bad
+    leaf) or :class:`WireSchemaError` (newer schema or unknown payload
+    encoding). A blob this function returns from has every leaf verified.
+    """
+    try:
+        record = pickle.loads(blob)
+    except Exception as err:
+        raise WireCorruptionError(
+            f"fleet view blob is unreadable ({type(err).__name__}: {err}) — "
+            "truncated or corrupt payload refused"
+        )
+    if not isinstance(record, dict) or record.get("magic") != MAGIC:
+        raise WireCorruptionError(f"fleet view blob has no {MAGIC!r} magic header; refused")
+    version = record.get("schema_version")
+    if not isinstance(version, int) or version > SCHEMA_VERSION:
+        raise WireSchemaError(
+            f"fleet view ({_header_hint(record)}) has schema version {version!r}; this build "
+            f"understands <= {SCHEMA_VERSION} — upgrade the aggregator to fold it"
+        )
+    stored = record.get("checksums")
+    if not isinstance(stored, dict):
+        # an arbitrarily mangled blob can unpickle with ANY type here; the
+        # refusal path must stay typed (WireError) for it, never TypeError
+        raise WireCorruptionError(
+            f"fleet view ({_header_hint(record)}) carries no checksum manifest — refused"
+        )
+    try:
+        computed = _checksum_tree(
+            {"header": record.get("header"), "payload": record.get("payload")}
+        )
+    except Exception as err:  # noqa: BLE001 — a mangled tree must refuse TYPED
+        # an arbitrarily corrupt payload can defeat the walk itself (e.g.
+        # mixed-type dict keys break its sorted() traversal) — that is still
+        # corruption, and it must surface as WireError, never a raw TypeError
+        # escaping the aggregator's refusal handling as an HTTP 500
+        raise WireCorruptionError(
+            f"fleet view ({_header_hint(record)}) has an unwalkable state tree "
+            f"({type(err).__name__}: {err}) — corrupt view refused"
+        )
+    if stored != computed:
+        try:
+            bad = sorted(
+                set(stored).symmetric_difference(computed)
+                | {k for k in stored if k in computed and stored[k] != computed[k]},
+                key=str,
+            )
+        except Exception:  # noqa: BLE001 — naming the leaf is best-effort
+            bad = []
+        raise WireCorruptionError(
+            f"fleet view ({_header_hint(record)}) failed checksum verification at leaf "
+            f"{bad[0] if bad else '<manifest>'} — corrupt view refused"
+        )
+    header = record["header"]
+    if header.get("encoding") != ENCODING:
+        raise WireSchemaError(
+            f"fleet view ({_header_hint(record)}) uses payload encoding "
+            f"{header.get('encoding')!r}; this build decodes {ENCODING!r} only"
+        )
+    if not header.get("host_id") or not isinstance(header.get("seq"), int):
+        raise WireCorruptionError(
+            f"fleet view ({_header_hint(record)}) carries no usable host_id/seq — refused "
+            "(the idempotent fold cannot key it)"
+        )
+    return header, record["payload"]

@@ -93,6 +93,7 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
             ("queue_depth", "serve_queue_depth"),
             ("queue_capacity", "serve_queue_capacity"),
             ("workers", "serve_workers"),
+            ("dead_workers", "serve_dead_workers"),
             ("report_staleness_s", "serve_report_staleness_seconds"),
         ):
             if serving.get(key) is not None:
@@ -108,6 +109,80 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
                 metric = f"{_PREFIX}_{gauge}"
                 lines.append(f"# TYPE {metric} gauge")
                 lines.append(_line(metric, sync[key]))
+    fleet = health.get("fleet")
+    if fleet:
+        # the federated surface: one scrape at the global aggregator shows
+        # every host below it — per-host staleness is the "loudly stale"
+        # contract made scrapeable
+        node = fleet.get("node_id", "global")
+        for key, gauge in (
+            ("hosts_total", "fleet_hosts"),
+            ("hosts_stale", "fleet_hosts_stale"),
+            ("downstream_stale", "fleet_downstream_stale"),
+            ("stale_after_s", "fleet_stale_after_seconds"),
+        ):
+            if fleet.get(key) is not None:
+                metric = f"{_PREFIX}_{gauge}"
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(_line(metric, fleet[key], node=node))
+        for key in ("accepted", "duplicates", "rejected"):
+            if fleet.get(key) is not None:
+                metric = f"{_PREFIX}_fleet_views_{key}_total"
+                lines.append(f"# TYPE {metric} counter")
+                lines.append(_line(metric, fleet[key], node=node))
+        hosts = fleet.get("hosts")
+        downstream = fleet.get("downstream")
+        stale_host_lines: List[str] = []
+        flag_lines: List[str] = []
+        update_lines: List[str] = []
+        if isinstance(hosts, dict):
+            for host, entry in sorted(hosts.items()):
+                if entry.get("staleness_s") is not None:
+                    stale_host_lines.append(
+                        _line(f"{_PREFIX}_fleet_host_staleness_seconds", entry["staleness_s"], host=host, node=node)
+                    )
+                flag_lines.append(
+                    _line(f"{_PREFIX}_fleet_host_stale", bool(entry.get("stale")), host=host, node=node)
+                )
+                if entry.get("updates") is not None:
+                    update_lines.append(
+                        _line(f"{_PREFIX}_fleet_host_updates", entry["updates"], host=host, node=node)
+                    )
+        if isinstance(downstream, dict):
+            # hosts observed through a child node (pod-forwarded staleness):
+            # the `via` label names the reporting child, so one global scrape
+            # names a dead LEAF host, not just its dead pod
+            for host, entry in sorted(downstream.items()):
+                if host in (hosts or {}):
+                    continue
+                if entry.get("staleness_s") is not None:
+                    stale_host_lines.append(
+                        _line(
+                            f"{_PREFIX}_fleet_host_staleness_seconds",
+                            entry["staleness_s"],
+                            host=host,
+                            node=node,
+                            via=entry.get("via", ""),
+                        )
+                    )
+                flag_lines.append(
+                    _line(
+                        f"{_PREFIX}_fleet_host_stale",
+                        bool(entry.get("stale")),
+                        host=host,
+                        node=node,
+                        via=entry.get("via", ""),
+                    )
+                )
+        if stale_host_lines:
+            lines.append(f"# TYPE {_PREFIX}_fleet_host_staleness_seconds gauge")
+            lines.extend(stale_host_lines)
+        if flag_lines:
+            lines.append(f"# TYPE {_PREFIX}_fleet_host_stale gauge")
+            lines.extend(flag_lines)
+        if update_lines:
+            lines.append(f"# TYPE {_PREFIX}_fleet_host_updates gauge")
+            lines.extend(update_lines)
     metrics = health.get("metrics") or {}
     fault_lines: List[str] = []
     lag_lines: List[str] = []

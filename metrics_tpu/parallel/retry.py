@@ -1,0 +1,191 @@
+"""Reusable timeout + retry + exponential-backoff + circuit-breaker policy.
+
+Extracted from ``parallel/sync.py::RetryingGather`` the moment it grew a
+second consumer: the fleet publisher (``metrics_tpu/fleet``) pushes host
+views to aggregators over DCN/HTTP and needs the exact same failure budget
+— bound every attempt with a deadline, retry transient faults with
+exponential backoff, and once a call exhausts its budget open a breaker so
+subsequent calls degrade immediately instead of re-paying the whole budget.
+One implementation here, two wrappers (``RetryingGather`` keeps its
+collective-pairing timeout semantics and local-only fallback; the fleet
+publisher keeps its loudly-stale degradation), so a fix to the breaker
+cannot drift between the transports.
+
+Semantics, matching the gather's proven behavior:
+
+- Every attempt runs on an explicit **daemon** thread bounded by
+  ``timeout_s`` — a wedged callable costs bounded time and the abandoned
+  thread cannot block interpreter exit.
+- Exceptions retry up to ``max_retries`` times with ``backoff_s * 2**k``
+  sleeps between attempts.
+- Timeouts do NOT retry by default (``retry_timeouts=False``): a timed-out
+  *collective* may still complete on slow peers, so re-issuing it would
+  pair with the peers' next collective and desynchronize the sequence.
+  Idempotent transports (the fleet publisher's last-write-wins HTTP push)
+  opt in with ``retry_timeouts=True``.
+- After a call exhausts every permitted attempt the breaker opens for
+  ``cooldown_s``: :meth:`RetryPolicy.call` then raises
+  :class:`CircuitOpenError` immediately. A success closes the breaker.
+
+The policy is deliberately not thread-safe per call site: each consumer
+owns one policy per destination (the gather owns one per transport, the
+publisher one per aggregator endpoint), mirroring how ``RetryingGather``
+was always used.
+
+Module import performs python work only (stdlib — the hang-proof
+bootstrap contract, ``utilities/backend.py``).
+"""
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional, Type
+
+__all__ = [
+    "CallTimeoutError",
+    "CircuitOpenError",
+    "RetryBudgetExceededError",
+    "RetryPolicy",
+]
+
+
+class CallTimeoutError(RuntimeError):
+    """A deadline-bounded call did not complete within its timeout."""
+
+
+class CircuitOpenError(RuntimeError):
+    """The breaker is open: a recent call already paid the full failure
+    budget; this call was refused without touching the callable."""
+
+    def __init__(self, message: str, retry_in_s: float) -> None:
+        super().__init__(message)
+        self.retry_in_s = retry_in_s
+
+
+class RetryBudgetExceededError(RuntimeError):
+    """Every permitted attempt failed; the breaker is now open.
+
+    ``cause`` is the last attempt's exception, ``attempts`` the number of
+    attempts that actually ran (a non-retried timeout counts 1 however
+    large ``max_retries`` is).
+    """
+
+    def __init__(self, message: str, cause: BaseException, attempts: int) -> None:
+        super().__init__(message)
+        self.cause = cause
+        self.attempts = attempts
+
+
+class RetryPolicy:
+    """One destination's failure budget: deadline, retries, backoff, breaker.
+
+    ``timeout_error`` is the exception class raised on a deadline miss
+    (consumers keep their domain-specific types — the gather raises
+    ``GatherTimeoutError``); it must be constructible from one message
+    string. ``name`` labels timeout/breaker messages.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 120.0,
+        max_retries: int = 2,
+        backoff_s: float = 1.0,
+        cooldown_s: float = 60.0,
+        retry_timeouts: bool = False,
+        timeout_error: Type[BaseException] = CallTimeoutError,
+        name: str = "call",
+        thread_name: Optional[str] = None,
+    ) -> None:
+        if timeout_s <= 0:
+            raise ValueError(f"`timeout_s` must be > 0, got {timeout_s}")
+        if max_retries < 0:
+            raise ValueError(f"`max_retries` must be >= 0, got {max_retries}")
+        self.timeout_s = timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.cooldown_s = cooldown_s
+        self.retry_timeouts = retry_timeouts
+        self.timeout_error = timeout_error
+        self.name = name
+        self.thread_name = thread_name or f"metrics-tpu-retry-{name}"
+        self._open_until = 0.0
+
+    # -- breaker --------------------------------------------------------
+
+    @property
+    def open(self) -> bool:
+        return time.monotonic() < self._open_until
+
+    def open_for_s(self) -> float:
+        """Seconds until the breaker lets the next attempt through."""
+        return max(0.0, self._open_until - time.monotonic())
+
+    def trip(self) -> None:
+        self._open_until = time.monotonic() + self.cooldown_s
+
+    def close(self) -> None:
+        self._open_until = 0.0
+
+    # -- calls ----------------------------------------------------------
+
+    def attempt(self, fn: Callable[[], Any]) -> Any:
+        """One deadline-bounded attempt, no retries, breaker untouched.
+
+        The callable runs on a daemon thread and is abandoned on timeout —
+        it cannot be cancelled, and a non-daemon worker would re-create the
+        interpreter-exit hang this bound exists to close (concurrent.futures'
+        atexit hook joins its threads).
+        """
+        box: "queue.Queue" = queue.Queue(maxsize=1)
+
+        def run() -> None:
+            try:
+                box.put(("ok", fn()))
+            except BaseException as err:  # noqa: BLE001 — relayed to the caller
+                box.put(("err", err))
+
+        worker = threading.Thread(target=run, daemon=True, name=self.thread_name)
+        worker.start()
+        try:
+            kind, payload = box.get(timeout=self.timeout_s)
+        except queue.Empty:
+            raise self.timeout_error(
+                f"{self.name} exceeded {self.timeout_s}s (peer process down or wedged?)"
+            )
+        if kind == "err":
+            raise payload
+        return payload
+
+    def call(self, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` under the full budget; returns its result or raises
+        :class:`CircuitOpenError` (breaker open, nothing attempted) /
+        :class:`RetryBudgetExceededError` (budget exhausted, breaker now
+        open — ``cause`` holds the last attempt's exception)."""
+        if self.open:
+            raise CircuitOpenError(
+                f"{self.name} circuit open for {self.open_for_s():.0f}s more after repeated failures",
+                self.open_for_s(),
+            )
+        last_err: Optional[BaseException] = None
+        attempts = 0
+        for attempt in range(self.max_retries + 1):
+            attempts += 1
+            try:
+                out = self.attempt(fn)
+                self.close()  # healthy again
+                return out
+            except self.timeout_error as err:
+                last_err = err
+                if not self.retry_timeouts:
+                    break
+                if attempt < self.max_retries:
+                    time.sleep(self.backoff_s * (2**attempt))
+            except Exception as err:  # noqa: BLE001 — faults of any shape retry
+                last_err = err
+                if attempt < self.max_retries:
+                    time.sleep(self.backoff_s * (2**attempt))
+        self.trip()
+        raise RetryBudgetExceededError(
+            f"{self.name} failed after {attempts} attempt(s): {last_err}",
+            cause=last_err,
+            attempts=attempts,
+        )

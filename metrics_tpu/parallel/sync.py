@@ -385,6 +385,15 @@ class RetryingGather:
     loops this transport over every state leaf of every metric, so without
     the breaker one dead peer would cost minutes *per leaf*. A successful
     call (after the cooldown lets one through) closes the breaker.
+
+    The timeout/retry/backoff/breaker budget itself is
+    :class:`~metrics_tpu.parallel.retry.RetryPolicy` (``parallel/retry.py``)
+    — shared with the fleet publisher's DCN/HTTP channel — with the
+    collective-pairing specifics kept here: timeouts are never re-issued (a
+    timed-out collective may still complete on slow peers, so a retry would
+    pair with the peers' NEXT collective and desynchronize the sequence;
+    ``retry_timeouts=False``), and exhaustion degrades to the local-only
+    world-size-1 result instead of raising.
     """
 
     def __init__(
@@ -396,91 +405,102 @@ class RetryingGather:
         fallback_local: bool = True,
         cooldown_s: float = 60.0,
     ) -> None:
+        from metrics_tpu.parallel.retry import RetryPolicy
+
         self.allgather = allgather
-        self.timeout_s = timeout_s
-        self.max_retries = max_retries
-        self.backoff_s = backoff_s
         self.fallback_local = fallback_local
-        self.cooldown_s = cooldown_s
-        self._open_until = 0.0
+        self._policy = RetryPolicy(
+            timeout_s=timeout_s,
+            max_retries=max_retries,
+            backoff_s=backoff_s,
+            cooldown_s=cooldown_s,
+            retry_timeouts=False,  # the collective-pairing rule (class docstring)
+            timeout_error=GatherTimeoutError,
+            name="multihost allgather",
+            thread_name="metrics-tpu-gather",
+        )
 
-    def _attempt(self, array: Any) -> Any:
-        import queue
-        import threading
+    # budget knobs and breaker state live on the shared policy; these views
+    # keep the original attribute surface (tests and operators poke them)
+    @property
+    def timeout_s(self) -> float:
+        return self._policy.timeout_s
 
-        box: "queue.Queue" = queue.Queue(maxsize=1)
+    @timeout_s.setter
+    def timeout_s(self, value: float) -> None:
+        self._policy.timeout_s = value
 
-        def run() -> None:
-            try:
-                box.put(("ok", self.allgather(array)))
-            except BaseException as err:  # noqa: BLE001 — relayed to the caller
-                box.put(("err", err))
+    @property
+    def max_retries(self) -> int:
+        return self._policy.max_retries
 
-        worker = threading.Thread(target=run, daemon=True, name="metrics-tpu-gather")
-        worker.start()
-        try:
-            kind, payload = box.get(timeout=self.timeout_s)
-        except queue.Empty:
-            raise GatherTimeoutError(
-                f"multihost allgather exceeded {self.timeout_s}s (peer process down or wedged?)"
-            )
-        if kind == "err":
-            raise payload
-        return payload
+    @max_retries.setter
+    def max_retries(self, value: int) -> None:
+        self._policy.max_retries = value
+
+    @property
+    def backoff_s(self) -> float:
+        return self._policy.backoff_s
+
+    @backoff_s.setter
+    def backoff_s(self, value: float) -> None:
+        self._policy.backoff_s = value
+
+    @property
+    def cooldown_s(self) -> float:
+        return self._policy.cooldown_s
+
+    @cooldown_s.setter
+    def cooldown_s(self, value: float) -> None:
+        self._policy.cooldown_s = value
+
+    # the breaker-state proxy exists because the pre-extraction test surface
+    # (tests/integrations/test_gather_transport.py pokes `g._open_until`)
+    # must keep passing UNCHANGED — it is the extraction's compatibility
+    # contract, not an invitation to reach into the policy from new code
+    @property
+    def _open_until(self) -> float:
+        return self._policy._open_until
+
+    @_open_until.setter
+    def _open_until(self, value: float) -> None:
+        self._policy._open_until = value
 
     def __call__(self, array: Any) -> Any:
-        import time as _time
         import warnings
 
-        if _time.monotonic() < self._open_until:
+        from metrics_tpu.parallel.retry import CircuitOpenError, RetryBudgetExceededError
+
+        try:
+            return self._policy.call(lambda: self.allgather(array))
+        except CircuitOpenError as err:
             # circuit open: a recent call already paid the full failure
             # budget; degrade immediately instead of re-blocking per leaf
             # (no per-leaf health event either — the breaker-opening call
             # already recorded one; a sync loops this over every leaf)
             if not self.fallback_local:
                 raise GatherTimeoutError(
-                    f"multihost gather circuit open for {self._open_until - _time.monotonic():.0f}s "
+                    f"multihost gather circuit open for {err.retry_in_s:.0f}s "
                     "more after repeated failures"
                 )
             return np.asarray(array)[None]
-
-        last_err: Optional[BaseException] = None
-        attempts = 0
-        for attempt in range(self.max_retries + 1):
-            attempts += 1
-            try:
-                out = self._attempt(array)
-                self._open_until = 0.0  # healthy again: close the breaker
-                return out
-            except GatherTimeoutError as err:
-                # a timed-out collective must NOT be re-issued: the abandoned
-                # attempt may still complete on slow-but-alive peers, and a
-                # retry would then pair with the peers' NEXT collective,
-                # desynchronizing the whole sequence. Timeouts go straight to
-                # the fallback (or raise); only failures that erred on every
-                # participant are safe to retry.
-                last_err = err
-                break
-            except Exception as err:  # noqa: BLE001 — transport faults of any shape
-                last_err = err
-                if attempt < self.max_retries:
-                    _time.sleep(self.backoff_s * (2**attempt))
-        self._open_until = _time.monotonic() + self.cooldown_s
+        except RetryBudgetExceededError as err:
+            exhausted = err
         from metrics_tpu.resilience.health import record_degradation
 
         record_degradation(
             "gather_degraded",
             # `attempts` counts what actually ran: a timeout aborts after ONE
             # attempt by design (never re-issued), exceptions retry
-            f"multihost gather failed after {attempts} attempt(s): {last_err}",
+            f"multihost gather failed after {exhausted.attempts} attempt(s): {exhausted.cause}",
             timeout_s=self.timeout_s,
             cooldown_s=self.cooldown_s,
             fallback_local=self.fallback_local,
         )
         if not self.fallback_local:
-            raise last_err
+            raise exhausted.cause
         warnings.warn(
-            f"multihost gather FAILED after {attempts} attempt(s) ({last_err}); "
+            f"multihost gather FAILED after {exhausted.attempts} attempt(s) ({exhausted.cause}); "
             "degrading to LOCAL-ONLY state — synced values on this process cover this "
             "process's stream only, NOT the global one. Investigate the pod before trusting "
             "aggregate metrics.",

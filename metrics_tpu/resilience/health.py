@@ -38,6 +38,24 @@ from typing import Any, Dict, List, Optional
 #                          the previous (staler) reduced view and the cadence retries
 #   async_sync_stalled     an overlapped sync cycle overran its deadline; readers keep
 #                          serving the previous view while staleness grows
+#   serve_worker_died      a ServeLoop worker thread exited outside the stop handshake;
+#                          its published state keeps serving but its queue share no
+#                          longer drains (metrics_tpu/serving)
+#   fleet_payload_rejected an aggregator refused a published view (checksum/schema
+#                          failure or metric-config mismatch), naming host and leaf
+#                          (metrics_tpu/fleet)
+#   fleet_publish_error    a host's view push to an aggregator exhausted its
+#                          retry/timeout budget; the host keeps serving, the
+#                          destination's breaker opens (metrics_tpu/fleet)
+#   fleet_host_stale       a host view aged past the staleness threshold — recorded on
+#                          the aggregator (nothing received) and/or the publisher
+#                          (nothing delivered); cleared by the next accepted view
+#   fleet_publish_recovered a previously-stale publish channel delivered again (the
+#                          recovery edge, so stale episodes are bounded in the log)
+#   fleet_seq_regression   an aggregator answered 'duplicate' repeatedly while holding a
+#                          seq strictly ABOVE the publisher's (host restarted after a
+#                          backward clock step); the publisher jumped its sequence past
+#                          it (held == ours is the benign idempotent-retry case: no jump)
 _MAX_EVENTS = 256
 
 

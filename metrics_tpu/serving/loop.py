@@ -205,6 +205,7 @@ class ServeLoop:
         self._shed = 0
         self._processed = 0
         self._failed = 0
+        self._dead_workers = 0
 
         self._stopping = False  # set under _stats_lock: offer/stop handshake
         self._last_reporter: Optional[Any] = None
@@ -278,6 +279,28 @@ class ServeLoop:
         return True
 
     def _worker(self, i: int) -> None:
+        # a worker that dies for ANY reason other than the stop handshake —
+        # a BaseException escaping the per-request guard (the guard absorbs
+        # Exception; SystemExit/KeyboardInterrupt/thread kills pass through)
+        # — must be loud: its published snapshots keep serving (reads merge
+        # whatever was published), but its share of the backlog silently
+        # stops draining, which is exactly the degradation health() exists
+        # to surface
+        try:
+            self._worker_loop(i)
+        finally:
+            if not self._stop_workers.is_set():
+                with self._stats_lock:
+                    self._dead_workers += 1
+                record_degradation(
+                    "serve_worker_died",
+                    f"worker {i} exited outside the stop handshake; its queue share "
+                    "no longer drains (published state keeps serving)",
+                    worker=i,
+                    metric=type(self._proto).__name__,
+                )
+
+    def _worker_loop(self, i: int) -> None:
         replica = self._replicas[i]
         while True:
             try:
@@ -451,6 +474,7 @@ class ServeLoop:
                 "shed": self._shed,
                 "processed": self._processed,
                 "failed": self._failed,
+                "dead_workers": self._dead_workers,
                 "queue_depth": self._queue.qsize(),
             }
 
@@ -478,6 +502,15 @@ class ServeLoop:
             "sync": self._scheduler.lag(),
         }
         return rep
+
+    def fleet_view(self) -> Optional[Dict[str, Any]]:
+        """This loop's merged view as a ``snapshot_state`` payload — the
+        :class:`~metrics_tpu.fleet.FleetPublisher` source hook (None until
+        the first background reduce completes). The reporter behind the
+        front view is immutable once published (each reduce builds a fresh
+        clone), so snapshotting it here never races the scheduler."""
+        reporter = self._last_reporter
+        return None if reporter is None else reporter.snapshot_state()
 
     def scrape(self, fmt: str = "prometheus") -> str:
         """One exporter scrape over this loop: :meth:`health` (request

@@ -68,6 +68,17 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fleet: the fleet aggregation tier (metrics_tpu/fleet/ — checksummed "
+        "view wire format, multi-hop host→pod→global aggregators, the "
+        "cadenced publisher with retry/breaker degradation, HTTP transport) "
+        "plus the shared parallel/retry.py policy; select with -m fleet, or "
+        "run the lane via `make test-fleet` (the heavyweight multiprocess "
+        "acceptance tests — 8-host parity, SIGKILL-mid-run — are "
+        "additionally marked slow and run in CI through that target; a mini "
+        "2-host tree keeps the subprocess+HTTP plumbing in the fast lane)",
+    )
+    config.addinivalue_line(
+        "markers",
         "async_sync: the overlapped async sync layer (parallel/async_sync.py "
         "scheduler, Metric(sync_mode='overlapped'), pure.py::"
         "overlapped_functionalize) — double-buffered zero-collective-latency "

@@ -1,0 +1,39 @@
+"""Deterministic per-host traffic shared by the multiprocess fleet tests'
+parent (reference replay) and child processes (live streams) — one
+definition, so the two sides can never drift.
+"""
+import numpy as np
+
+NUM_CLASSES = 4
+FAULT_ROWS_PER_BATCH = 2
+
+
+def host_stream(host: int, batches: int = 4, n: int = 32):
+    """(preds, target) batches for one host: disjoint by seed, with
+    ``FAULT_ROWS_PER_BATCH`` injected non-finite preds rows per batch."""
+    rng = np.random.default_rng(5000 + host)
+    out = []
+    for _ in range(batches):
+        preds = rng.random((n, NUM_CLASSES)).astype(np.float32)
+        target = rng.integers(0, NUM_CLASSES, n)
+        preds[:FAULT_ROWS_PER_BATCH, :] = np.nan
+        out.append((preds, target))
+    return out
+
+
+def build_metric():
+    import metrics_tpu as mt
+
+    return mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop")
+
+
+def reference_over_hosts(num_hosts: int, batches: int = 4):
+    """One metric fed every host's stream in sequence — the single-stream
+    oracle the tree's global value must match bit-for-bit."""
+    import jax.numpy as jnp
+
+    ref = build_metric()
+    for host in range(num_hosts):
+        for preds, target in host_stream(host, batches):
+            ref.update(jnp.asarray(preds), jnp.asarray(target))
+    return ref

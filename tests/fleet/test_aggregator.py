@@ -1,0 +1,282 @@
+"""Aggregator fold semantics (``metrics_tpu/fleet/aggregator.py``): value
+parity with a single-stream reference, idempotent last-write-wins folds
+under duplicate/reordered delivery, corrupt-view refusal, per-host
+staleness with recovery — using the network-level fault shapes from
+``tests/helpers/fault_injection.py``.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.fleet import Aggregator, WireError, encode_view
+from metrics_tpu.fleet.wire import WireCorruptionError
+from metrics_tpu.resilience.health import registry
+from tests.helpers.fault_injection import (
+    CorruptingChannel,
+    DuplicatingChannel,
+    ReorderingChannel,
+    bitflip_blob,
+    corrupt_rows_nonfinite,
+    truncate_blob,
+)
+
+pytestmark = [pytest.mark.fleet, pytest.mark.faults]
+
+NUM_CLASSES = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry.clear()
+    yield
+    registry.clear()
+
+
+def _host_stream(host: int, batches: int = 3, n: int = 24):
+    """Deterministic disjoint per-host traffic: (preds, target) batches,
+    with one injected non-finite row per batch (the fault channel)."""
+    rng = np.random.default_rng(1000 + host)
+    out = []
+    for _ in range(batches):
+        preds = rng.random((n, NUM_CLASSES)).astype(np.float32)
+        target = rng.integers(0, NUM_CLASSES, n)
+        preds = corrupt_rows_nonfinite(preds, np.asarray([0]), "nan")
+        out.append((preds, target))
+    return out
+
+
+def _proto():
+    return mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop")
+
+
+def _host_blob(host: int, seq: int = 1, batches: int = 3):
+    m = _proto()
+    for preds, target in _host_stream(host, batches):
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+    return encode_view(m.snapshot_state(), host_id=f"host-{host}", seq=seq, updates=m.update_count)
+
+
+class TestFoldParity:
+    def test_eight_hosts_bit_equal_to_single_stream(self):
+        """Disjoint fault-injected streams on 8 simulated hosts: the folded
+        value is bit-equal to one metric fed all batches in sequence, and
+        the folded FaultCounters equal the sum of injected faults."""
+        agg = Aggregator(_proto(), node_id="global")
+        ref = _proto()
+        for host in range(8):
+            for preds, target in _host_stream(host):
+                ref.update(jnp.asarray(preds), jnp.asarray(target))
+            assert agg.ingest(_host_blob(host)) == "accepted"
+        rep = agg.report()
+        assert rep["value"] == float(ref.compute())  # bit-equal, not approx
+        assert rep["updates"] == ref.update_count == 24
+        name = next(iter(rep["faults"]))
+        # one nan row injected per batch, 3 batches per host, 8 hosts
+        assert rep["faults"][name]["nonfinite_preds"] == 24
+        assert rep["faults"][name] == ref.fault_counts
+
+    def test_sketch_states_within_eps(self):
+        """Approximate states: the tree-merged quantile sketch answers
+        within its eps*n rank contract of the true stream quantiles."""
+        eps, per_host = 0.05, 512
+        agg = Aggregator(mt.QuantileSketch(eps=eps, quantiles=(0.5,)), node_id="global")
+        everything = []
+        for host in range(8):
+            rng = np.random.default_rng(2000 + host)
+            values = rng.normal(loc=host, scale=3.0, size=per_host).astype(np.float32)
+            everything.append(values)
+            m = mt.QuantileSketch(eps=eps, quantiles=(0.5,))
+            m.update(jnp.asarray(values))
+            agg.ingest(encode_view(m.snapshot_state(), host_id=f"host-{host}", seq=1))
+        rep = agg.report()
+        stream = np.sort(np.concatenate(everything))
+        n = stream.shape[0]
+        rank = np.searchsorted(stream, float(rep["value"]))
+        assert abs(rank - 0.5 * n) <= 2 * eps * n + 1  # merge eps contract
+
+    def test_multi_hop_host_pod_global_parity(self):
+        """host → pod → global: two pods fold four hosts each, the global
+        folds the pods' re-published views, and the tree value equals the
+        flat single-stream value."""
+        pods = [Aggregator(_proto(), node_id=f"pod-{p}") for p in range(2)]
+        glob = Aggregator(_proto(), node_id="global")
+        ref = _proto()
+        for host in range(8):
+            for preds, target in _host_stream(host):
+                ref.update(jnp.asarray(preds), jnp.asarray(target))
+            pods[host % 2].ingest(_host_blob(host))
+        for pod in pods:
+            assert glob.ingest(pod.view_blob()) == "accepted"
+        # a pod re-publishing its whole view again is replace-not-add
+        for pod in pods:
+            glob.ingest(pod.view_blob())
+        rep = glob.report()
+        assert rep["value"] == float(ref.compute())
+        assert rep["updates"] == ref.update_count
+
+
+class TestIdempotentFold:
+    def test_duplicate_delivery_folds_once(self):
+        agg = Aggregator(_proto(), node_id="global")
+        channel = DuplicatingChannel(agg.ingest, times=3)
+        channel(_host_blob(0))
+        assert agg.stats()["accepted"] == 1 and agg.stats()["duplicates"] == 2
+        once = Aggregator(_proto(), node_id="once")
+        once.ingest(_host_blob(0))
+        assert agg.report()["value"] == once.report()["value"]
+
+    def test_reordered_delivery_is_last_write_wins(self):
+        """An old view arriving after a newer one must not resurrect stale
+        state: the fold keeps the newest seq per host."""
+        agg = Aggregator(_proto(), node_id="global")
+        channel = ReorderingChannel(agg.ingest, group=2)
+        old = _host_blob(0, seq=1, batches=1)
+        new = _host_blob(0, seq=2, batches=3)
+        channel(old)
+        channel(new)  # delivers reversed: new first, then old
+        assert agg.stats() == {"hosts": 1, "accepted": 1, "duplicates": 1, "rejected": 0}
+        want = Aggregator(_proto(), node_id="want")
+        want.ingest(new)
+        assert agg.report()["value"] == want.report()["value"]
+        assert agg.report()["updates"] == 3
+
+    def test_same_seq_redelivery_is_duplicate(self):
+        agg = Aggregator(_proto(), node_id="global")
+        blob = _host_blob(0)
+        assert agg.ingest(blob) == "accepted"
+        status = agg.ingest(blob)
+        # the duplicate answer names the seq the fold holds, so a publisher
+        # can detect (and jump past) a persistent seq regression
+        assert status == "duplicate:1"
+        assert agg.report()["updates"] == 3
+
+
+class TestRefusals:
+    def test_corrupt_view_refused_with_event_and_prior_view_serving(self):
+        agg = Aggregator(_proto(), node_id="global")
+        agg.ingest(_host_blob(0, seq=1))
+        before = agg.report()["value"]
+        channel = CorruptingChannel(agg.ingest, lambda b: bitflip_blob(b, position=len(b) - 8))
+        with pytest.raises(WireCorruptionError):
+            channel(_host_blob(0, seq=2))
+        events = registry.events("fleet_payload_rejected")
+        assert len(events) == 1 and "host-0" in events[0]["message"]
+        assert agg.stats()["rejected"] == 1
+        # the previous intact view keeps serving, untouched
+        assert agg.report()["value"] == before and agg.report()["updates"] == 3
+
+    def test_truncated_view_refused(self):
+        agg = Aggregator(_proto(), node_id="global")
+        with pytest.raises(WireCorruptionError):
+            agg.ingest(truncate_blob(_host_blob(0)), source="10.0.0.7")
+        events = registry.events("fleet_payload_rejected")
+        assert len(events) == 1 and events[0]["details"]["host"] == "10.0.0.7"
+
+    def test_config_mismatch_refused_naming_host(self):
+        """A checksum-intact view whose states do not match this
+        aggregator's metric config is refused at ingest (the transactional
+        load), never half-folded."""
+        agg = Aggregator(mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop"), node_id="g")
+        other = mt.QuantileSketch(eps=0.1)
+        other.update(jnp.arange(8.0))
+        blob = encode_view(other.snapshot_state(), host_id="host-9", seq=1)
+        with pytest.raises(WireError, match="host-9"):
+            agg.ingest(blob)
+        assert registry.counts().get("fleet_payload_rejected") == 1
+        assert agg.stats() == {"hosts": 0, "accepted": 0, "duplicates": 0, "rejected": 1}
+
+
+class TestStaleness:
+    def test_dead_host_marked_loudly_stale_once_per_episode(self):
+        agg = Aggregator(_proto(), node_id="global", stale_after_s=0.05)
+        agg.ingest(_host_blob(0))
+        time.sleep(0.12)
+        rep = agg.report()
+        assert rep["hosts"]["host-0"]["stale"] is True
+        assert rep["hosts_stale"] == 1
+        assert rep["value"] is not None  # the last view keeps serving
+        events = registry.events("fleet_host_stale")
+        assert len(events) == 1 and "host-0" in events[0]["message"]
+        agg.report()  # still stale: same episode, no second event
+        assert len(registry.events("fleet_host_stale")) == 1
+
+    def test_recovery_clears_staleness_and_rearms_the_episode(self):
+        agg = Aggregator(_proto(), node_id="global", stale_after_s=0.05)
+        agg.ingest(_host_blob(0, seq=1))
+        time.sleep(0.12)
+        assert agg.report()["hosts"]["host-0"]["stale"] is True
+        agg.ingest(_host_blob(0, seq=2))  # the host came back
+        rep = agg.report()
+        assert rep["hosts"]["host-0"]["stale"] is False
+        assert rep["hosts"]["host-0"]["staleness_s"] < 0.05
+        time.sleep(0.12)  # a NEW outage is a NEW episode: one more event
+        agg.report()
+        assert len(registry.events("fleet_host_stale")) == 2
+
+
+    def test_dead_leaf_behind_healthy_pod_counts_in_downstream_stale(self):
+        """The aggregate alerting surface: a dead leaf behind a healthy pod
+        never flips hosts_stale at the global (the pod is fresh), so the
+        summary gauge for the leaves is downstream_stale."""
+        pod = Aggregator(_proto(), node_id="pod-0", stale_after_s=0.05)
+        root = Aggregator(_proto(), node_id="root", stale_after_s=10.0)
+        pod.ingest(_host_blob(0))
+        time.sleep(0.12)  # the leaf dies at the pod
+        root.ingest(pod.view_blob())  # the pod itself keeps publishing
+        rep = root.report()
+        assert rep["hosts_stale"] == 0  # pod is fresh
+        assert rep["downstream_stale"] == 1  # the leaf is not
+        assert rep["downstream"]["host-0"]["stale"] is True
+        assert 'metrics_tpu_fleet_downstream_stale{node="root"} 1' in root.scrape()
+
+    def test_fold_cache_reuses_between_ingests_and_invalidates_on_accept(self):
+        agg = Aggregator(_proto(), node_id="global")
+        agg.ingest(_host_blob(0))
+        assert agg._fold() is agg._fold()  # no re-fold between ingests
+        assert agg.report()["updates"] == 3
+        agg.ingest(_host_blob(1))
+        assert agg.report()["updates"] == 6  # an accepted view re-folds
+
+
+class TestObservability:
+    def test_scrape_exposes_per_host_staleness_and_event_counts(self):
+        agg = Aggregator(_proto(), node_id="global", stale_after_s=0.05)
+        agg.ingest(_host_blob(0))
+        agg.ingest(_host_blob(1))
+        with pytest.raises(WireCorruptionError):
+            agg.ingest(truncate_blob(_host_blob(2)))
+        time.sleep(0.12)
+        text = agg.scrape()
+        assert 'metrics_tpu_fleet_hosts{node="global"} 2' in text
+        assert 'metrics_tpu_fleet_host_staleness_seconds{host="host-0",node="global"}' in text
+        assert 'metrics_tpu_fleet_host_stale{host="host-0",node="global"} 1' in text
+        assert 'metrics_tpu_fleet_views_rejected_total{node="global"} 1' in text
+        assert 'metrics_tpu_health_events_total{kind="fleet_payload_rejected"} 1' in text
+        assert 'metrics_tpu_health_events_total{kind="fleet_host_stale"}' in text
+        import json
+
+        doc = json.loads(agg.scrape("json"))
+        assert doc["health"]["fleet"]["hosts_total"] == 2
+        assert doc["health"]["fleet"]["hosts"]["host-1"]["stale"] is True
+
+    def test_scrape_only_deployment_sees_live_fold_faults(self):
+        """A deployment whose ONLY reader is the Prometheus scraper (nobody
+        ever calls report()) must still see the folded fault counters, and
+        they must track newly ingested views."""
+        agg = Aggregator(_proto(), node_id="global")
+        agg.ingest(_host_blob(0))
+        text = agg.scrape()
+        line = 'metrics_tpu_metric_faults_total{fault_class="nonfinite_preds",metric="Accuracy"}'
+        assert f"{line} 3" in text  # 1/batch × 3 batches
+        agg.ingest(_host_blob(1))
+        assert f"{line} 6" in agg.scrape()  # not frozen
+
+    def test_empty_aggregator_reports_and_scrapes(self):
+        agg = Aggregator(_proto(), node_id="global")
+        rep = agg.report()
+        assert rep["value"] is None and rep["updates"] == 0 and rep["hosts"] == {}
+        assert agg.fleet_view() is None and agg.view_blob() is None
+        assert "metrics_tpu_fleet_hosts" in agg.scrape()

@@ -1,0 +1,319 @@
+"""Multiprocess fleet acceptance: real host processes, real HTTP hops.
+
+Three scenarios over the host → pod → global tree:
+
+- **mini parity** (tier-1): 2 host processes + 1 pod process + the global
+  in-parent — subprocess + HTTP plumbing stays honest in the fast lane.
+- **full parity** (slow, `make test-fleet` / CI fleet lane): 8 host
+  processes with disjoint fault-injected streams through 2 pods; the
+  global value is bit-equal to the single-stream reference and the global
+  FaultCounters equal the sum of injected faults.
+- **kill** : SIGKILL one host AND one pod aggregator mid-run; the global
+  view keeps serving and marks each victim loudly stale within one
+  publish cadence.
+
+Deadline discipline (the ``resilience`` bootstrap-test stance): every
+child starts in its own session/process group, every wait is bounded, and
+teardown SIGKILLs each child's whole group — a wedged child can never
+hang the lane.
+"""
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.fleet import Aggregator, FleetServer
+from metrics_tpu.resilience.health import registry
+from tests.fleet._stream import NUM_CLASSES, FAULT_ROWS_PER_BATCH, reference_over_hosts
+
+pytestmark = [pytest.mark.fleet, pytest.mark.faults]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CHILD_DEADLINE_S = 180.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    registry.clear()
+    yield
+    registry.clear()
+
+
+def _child_env():
+    env = {k: v for k, v in os.environ.items() if not k.startswith("METRICS_TPU_FLEET_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO
+    env["PYTHONUNBUFFERED"] = "1"
+    return env
+
+
+def _spawn(code: str, *argv: str) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-c", code, *argv],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=_child_env(),
+        cwd=REPO,
+        start_new_session=True,  # its own process group: killable as a unit
+    )
+
+
+def _killpg(proc: subprocess.Popen) -> None:
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        pass
+
+
+def _read_line(proc: subprocess.Popen, timeout_s: float, tag: str) -> str:
+    """One stdout line from a child, deadline-bounded via a reader thread
+    (a wedged child yields a loud failure, never a hung lane)."""
+    box: "queue.Queue[str]" = queue.Queue(maxsize=1)
+
+    def read() -> None:
+        box.put(proc.stdout.readline())
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    try:
+        line = box.get(timeout=timeout_s)
+    except queue.Empty:
+        _killpg(proc)
+        raise AssertionError(f"{tag}: child produced no output within {timeout_s}s")
+    if not line:
+        _killpg(proc)
+        err = proc.stderr.read() if proc.stderr else ""
+        raise AssertionError(f"{tag}: child stdout closed early:\n{err[-2000:]}")
+    return line.strip()
+
+
+def _wait_done(proc: subprocess.Popen, timeout_s: float, tag: str) -> None:
+    try:
+        rc = proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        _killpg(proc)
+        raise AssertionError(f"{tag}: child still running after {timeout_s}s")
+    if rc != 0:
+        err = proc.stderr.read() if proc.stderr else ""
+        raise AssertionError(f"{tag}: child failed rc={rc}:\n{err[-2000:]}")
+
+
+def _poll(predicate, deadline_s: float, what: str, interval_s: float = 0.1):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out after {deadline_s}s waiting for {what}")
+
+
+# one-shot host: stream every batch, publish the final view, exit
+_HOST_FINITE = """
+import sys
+sys.path.insert(0, sys.argv[4])
+import jax.numpy as jnp
+from tests.fleet._stream import build_metric, host_stream
+from metrics_tpu.fleet import FleetPublisher, HttpViewChannel
+
+host, url = int(sys.argv[1]), sys.argv[2]
+batches = int(sys.argv[3])
+m = build_metric()
+for preds, target in host_stream(host, batches):
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+pub = FleetPublisher(
+    m, HttpViewChannel(url, timeout_s=10.0), host_id=f"host-{host}",
+    publish_every_s=60.0, deadline_s=10.0, max_retries=2, backoff_s=0.2, start=False,
+)
+out = pub.publish_now()
+assert out == {"default": "ok"}, out
+print("DONE")
+"""
+
+# long-running host: keep streaming + publishing until killed. Update and
+# publish run on ONE thread (start=False + publish_now) — the documented
+# contract for bare-metric sources: snapshot_state on a blocking-mode
+# metric is not synchronized against a concurrent update()
+_HOST_LOOP = """
+import sys, time
+sys.path.insert(0, sys.argv[3])
+import jax.numpy as jnp
+from tests.fleet._stream import build_metric, host_stream
+from metrics_tpu.fleet import FleetPublisher, HttpViewChannel
+
+host, url = int(sys.argv[1]), sys.argv[2]
+m = build_metric()
+batches = host_stream(host, 4)
+m.update(jnp.asarray(batches[0][0]), jnp.asarray(batches[0][1]))
+pub = FleetPublisher(
+    m, HttpViewChannel(url, timeout_s=5.0), host_id=f"host-{host}",
+    publish_every_s=0.2, deadline_s=5.0, max_retries=1, backoff_s=0.1,
+    breaker_cooldown_s=1.0, stale_after_s=2.0, start=False,
+)
+pub.publish_now()
+print("READY")
+i = 1
+while True:
+    time.sleep(0.2)
+    preds, target = batches[i % len(batches)]
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    pub.publish_now(wait=False)
+    i += 1
+"""
+
+# pod aggregator: ingest from hosts over HTTP, re-publish upward on a cadence
+_POD = """
+import sys, time
+sys.path.insert(0, sys.argv[3])
+from tests.fleet._stream import build_metric
+from metrics_tpu.fleet import Aggregator, FleetPublisher, FleetServer, HttpViewChannel
+
+node_id, upstream = sys.argv[1], sys.argv[2]
+agg = Aggregator(build_metric(), node_id=node_id, stale_after_s=1.0)
+server = FleetServer(agg)
+pub = FleetPublisher(
+    agg, HttpViewChannel(upstream, timeout_s=5.0), host_id=node_id,
+    publish_every_s=0.2, deadline_s=5.0, max_retries=1, backoff_s=0.1,
+    breaker_cooldown_s=1.0, stale_after_s=2.0,
+)
+print(f"PORT {server.port}")
+while True:
+    time.sleep(0.5)
+"""
+
+
+def _start_pod(node_id: str, upstream: str) -> "tuple[subprocess.Popen, str]":
+    proc = _spawn(_POD, node_id, upstream, REPO)
+    line = _read_line(proc, CHILD_DEADLINE_S, node_id)
+    assert line.startswith("PORT "), f"{node_id}: unexpected first line {line!r}"
+    return proc, f"http://127.0.0.1:{int(line.split()[1])}/publish"
+
+
+def _parity_scenario(num_hosts: int, num_pods: int, batches: int = 4) -> None:
+    glob = Aggregator(mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop"), node_id="global")
+    children: "list[subprocess.Popen]" = []
+    with FleetServer(glob) as server:
+        try:
+            pods = [_start_pod(f"pod-{p}", server.publish_url) for p in range(num_pods)]
+            children += [proc for proc, _url in pods]
+            hosts = [
+                _spawn(_HOST_FINITE, str(h), pods[h % num_pods][1], str(batches), REPO)
+                for h in range(num_hosts)
+            ]
+            children += hosts
+            for h, proc in enumerate(hosts):
+                _wait_done(proc, CHILD_DEADLINE_S, f"host-{h}")
+            # every pod must have relayed every host view upward
+            _poll(
+                lambda: glob.report()["updates"] == num_hosts * batches,
+                30.0,
+                "the global view to cover every host's stream",
+            )
+        finally:
+            for proc in children:
+                _killpg(proc)
+    rep = glob.report()
+    ref = reference_over_hosts(num_hosts, batches)
+    assert rep["value"] == float(ref.compute())  # bit-equal, not approx
+    assert rep["updates"] == ref.update_count == num_hosts * batches
+    faults = rep["faults"][next(iter(rep["faults"]))]
+    assert faults["nonfinite_preds"] == num_hosts * batches * FAULT_ROWS_PER_BATCH
+    assert faults == ref.fault_counts
+    assert sorted(rep["hosts"]) == [f"pod-{p}" for p in range(num_pods)]
+    text = glob.scrape()
+    assert 'metrics_tpu_fleet_hosts{node="global"}' in text
+
+
+class TestMultiprocessParity:
+    def test_mini_tree_two_hosts_one_pod(self):
+        """Tier-1 lane: the smallest real tree (2 host processes → 1 pod
+        process → global) — subprocess + HTTP plumbing, bit-equal fold."""
+        _parity_scenario(num_hosts=2, num_pods=1)
+
+    @pytest.mark.slow
+    def test_acceptance_eight_hosts_two_pods(self):
+        """THE acceptance scenario: 8 host processes, disjoint
+        fault-injected streams, global tree value bit-equal to the
+        single-stream reference with FaultCounters equal to the injected
+        fault total."""
+        _parity_scenario(num_hosts=8, num_pods=2)
+
+
+class TestKillMidRun:
+    @pytest.mark.slow
+    def test_sigkill_host_and_pod_leave_global_serving_and_stale_marked(self):
+        """SIGKILL one host, then one pod aggregator, mid-run: the global
+        keeps serving within one publish cadence and each victim is marked
+        loudly stale (health events at the global + per-host staleness in
+        the global scrape)."""
+        glob = Aggregator(
+            mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop"),
+            node_id="global",
+            stale_after_s=1.0,
+        )
+        children: "list[subprocess.Popen]" = []
+        with FleetServer(glob) as server:
+            try:
+                pods = [_start_pod(f"pod-{p}", server.publish_url) for p in range(2)]
+                children += [proc for proc, _url in pods]
+                # host-0, host-1 -> pod-0; host-2 -> pod-1
+                hosts = [
+                    _spawn(_HOST_LOOP, str(h), pods[0 if h < 2 else 1][1], REPO)
+                    for h in range(3)
+                ]
+                children += hosts
+                for h, proc in enumerate(hosts):
+                    assert _read_line(proc, CHILD_DEADLINE_S, f"host-{h}") == "READY"
+                _poll(
+                    lambda: sorted(glob.report()["hosts"]) == ["pod-0", "pod-1"]
+                    and sorted(glob.report().get("downstream", {}))
+                    == ["host-0", "host-1", "host-2"],
+                    60.0,
+                    "all hosts visible through both pods at the global",
+                )
+
+                # ---- kill one host ----
+                _killpg(hosts[0])
+                _poll(
+                    lambda: glob.report()["downstream"]["host-0"]["stale"] is True,
+                    20.0,
+                    "the killed host to be marked stale at the global",
+                )
+                rep = glob.report()
+                assert rep["value"] is not None and rep["updates"] > 0  # still serving
+                assert rep["downstream"]["host-1"]["stale"] is False
+                assert rep["downstream"]["host-2"]["stale"] is False
+                events = registry.events("fleet_host_stale")
+                assert any("host-0" in e["message"] for e in events)
+                text = glob.scrape()
+                assert 'metrics_tpu_fleet_host_stale{host="host-0"' in text
+
+                # ---- kill one pod aggregator ----
+                _killpg(pods[1][0])
+                _poll(
+                    lambda: glob.report()["hosts"]["pod-1"]["stale"] is True,
+                    20.0,
+                    "the killed pod to be marked stale at the global",
+                )
+                rep = glob.report()
+                assert rep["value"] is not None and rep["updates"] > 0  # still serving
+                assert rep["hosts"]["pod-0"]["stale"] is False  # the live pod is fresh
+                assert any(
+                    "pod-1" in e["message"] for e in registry.events("fleet_host_stale")
+                )
+                text = glob.scrape()
+                assert 'metrics_tpu_fleet_host_stale{host="pod-1",node="global"} 1' in text
+                # the global's HTTP surface answers mid-outage too
+                body = urllib.request.urlopen(server.url + "/report", timeout=10).read()
+                assert json.loads(body)["hosts"]["pod-1"]["stale"] is True
+            finally:
+                for proc in children:
+                    _killpg(proc)

@@ -1,0 +1,358 @@
+"""FleetPublisher degradation contract (``metrics_tpu/fleet/publisher.py``):
+cadenced pushes, per-destination retry/breaker budgets, loudly-stale
+episodes with recovery, env-knob resolution — channel faults injected via
+``tests/helpers/fault_injection.py``.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.fleet import Aggregator, FleetPublisher, reset_fleet_env_state
+from metrics_tpu.fleet._env import resolve_fleet_knob
+from metrics_tpu.resilience.health import registry
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+from tests.helpers.fault_injection import (
+    DeadChannel,
+    DelayedChannel,
+    FlappingChannel,
+    RecordingChannel,
+)
+
+pytestmark = [pytest.mark.fleet, pytest.mark.faults]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    registry.clear()
+    reset_fleet_env_state()
+    yield
+    registry.clear()
+    reset_fleet_env_state()
+
+
+def _metric(seed: int = 0, n: int = 32):
+    rng = np.random.default_rng(seed)
+    m = mt.Accuracy(num_classes=4)
+    m.update(jnp.asarray(rng.integers(0, 4, n)), jnp.asarray(rng.integers(0, 4, n)))
+    return m
+
+
+class TestPublishing:
+    def test_metric_source_publishes_on_cadence(self):
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        channel = RecordingChannel(agg.ingest)
+        m = _metric()
+        pub = FleetPublisher(
+            m, channel, host_id="host-0", publish_every_s=0.05, deadline_s=2.0
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while channel.calls < 2 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            pub.stop()
+        assert channel.calls >= 2
+        # cumulative view, last-write-wins: N deliveries fold to ONE host
+        assert agg.stats()["hosts"] == 1
+        assert agg.report()["value"] == float(m.compute())
+        assert agg.report()["updates"] == 1
+
+    def test_serve_loop_source_via_fleet_view(self):
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        rng = np.random.default_rng(3)
+        with mt.ServeLoop(mt.Accuracy(num_classes=4), workers=2, reduce_every_s=0.02) as loop:
+            for _ in range(6):
+                loop.offer(jnp.asarray(rng.integers(0, 4, 8)), jnp.asarray(rng.integers(0, 4, 8)))
+            loop.drain(5.0)
+            loop.report(fresh=True, deadline_s=2.0)
+            pub = FleetPublisher(
+                loop, RecordingChannel(agg.ingest), host_id="host-0",
+                publish_every_s=0.05, deadline_s=2.0,
+            )
+            pub.stop()  # stop flushes one final publish
+            served = loop.report()
+        rep = agg.report()
+        assert rep["updates"] == 6 and rep["value"] == served["value"]
+
+    def test_empty_source_skips_until_first_view(self):
+        loop = mt.ServeLoop(mt.Accuracy(num_classes=4), workers=1)
+        try:
+            pub = FleetPublisher(
+                loop, RecordingChannel(), host_id="h", publish_every_s=5.0, start=False
+            )
+            assert pub.publish_now() == {"default": "skipped:empty"}
+        finally:
+            loop.stop()
+
+    def test_deferred_start_publishes_once_started(self):
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        channel = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(
+            _metric(), channel, host_id="host-0", publish_every_s=0.05,
+            deadline_s=2.0, start=False,
+        )
+        try:
+            time.sleep(0.15)
+            assert channel.calls == 0  # deferred: nothing flows yet
+            pub.start()
+            pub.start()  # idempotent
+            deadline = time.monotonic() + 5.0
+            # wait on the aggregator, not channel.calls: the call counter
+            # increments before the sink's ingest completes
+            while agg.stats()["hosts"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert channel.calls >= 1 and agg.stats()["hosts"] == 1
+        finally:
+            pub.stop()
+        with pytest.raises(MetricsTPUUserError, match="after stop"):
+            pub.start()
+
+    def test_deferred_start_warmup_is_not_a_stale_episode(self):
+        """The construction-to-start() warmup must not count toward the
+        staleness baseline: one transient failure right after a deferred
+        start is not a stale episode."""
+        pub = FleetPublisher(
+            _metric(), DeadChannel(), host_id="host-0", publish_every_s=60.0,
+            deadline_s=1.0, max_retries=0, backoff_s=0.01, stale_after_s=0.2,
+            start=False,
+        )
+        time.sleep(0.3)  # warmup longer than stale_after_s
+        pub.start()
+        pub.request()  # one immediate pass (the 60s cadence won't fire in-test)
+        try:
+            deadline = time.monotonic() + 5.0
+            while pub.stats()["default"]["failed"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            pub.stop(flush=False)
+        assert pub.stats()["default"]["failed"] >= 1
+        assert not registry.events("fleet_host_stale")
+
+    def test_rejects_sourceless_objects_and_empty_destinations(self):
+        with pytest.raises(MetricsTPUUserError, match="fleet_view"):
+            FleetPublisher(object(), RecordingChannel(), host_id="h")
+        with pytest.raises(MetricsTPUUserError, match="destinations"):
+            FleetPublisher(_metric(), {}, host_id="h")
+        with pytest.raises(MetricsTPUUserError, match="host_id"):
+            FleetPublisher(_metric(), RecordingChannel(), host_id="")
+
+
+class TestDegradation:
+    def test_dead_destination_degrades_never_blocks(self):
+        channel = DeadChannel()
+        pub = FleetPublisher(
+            _metric(), channel, host_id="host-0",
+            publish_every_s=60.0, deadline_s=1.0, max_retries=1, backoff_s=0.01,
+            breaker_cooldown_s=30.0, start=False,
+        )
+        t0 = time.perf_counter()
+        out = pub.publish_now()
+        assert time.perf_counter() - t0 < 2.0
+        assert out["default"].startswith("failed:")
+        events = registry.events("fleet_publish_error")
+        assert len(events) == 1 and "2 attempt" in events[0]["message"]
+        # breaker open: the next cadence skips the dead endpoint cheaply
+        t0 = time.perf_counter()
+        assert pub.publish_now()["default"] == "skipped:circuit_open"
+        assert time.perf_counter() - t0 < 0.1
+        assert channel.calls == 2  # both from the first pass's budget
+        assert pub.stats()["default"]["skipped_open"] == 1
+        assert pub.stats()["default"]["circuit_open"] is True
+        assert len(registry.events("fleet_publish_error")) == 1  # no event spam
+
+    def test_flapping_endpoint_stale_episode_then_recovery(self):
+        """The fail-N-then-recover endpoint: failures open the breaker and
+        mark the host loudly stale; the first post-recovery success closes
+        the breaker, clears the episode, and records the recovery edge."""
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        channel = FlappingChannel(fail_times=2, sink=agg.ingest)
+        pub = FleetPublisher(
+            _metric(), channel, host_id="host-0",
+            publish_every_s=60.0, deadline_s=1.0, max_retries=0, backoff_s=0.01,
+            breaker_cooldown_s=30.0, stale_after_s=0.05, start=False,
+        )
+        assert pub.publish_now()["default"].startswith("failed:")
+        time.sleep(0.1)
+        assert pub.publish_now()["default"] == "skipped:circuit_open"
+        stale = registry.events("fleet_host_stale")
+        assert len(stale) == 1 and stale[0]["details"]["destination"] == "default"
+        # same episode: a further failing pass records no second stale event
+        pub.publish_now()
+        assert len(registry.events("fleet_host_stale")) == 1
+        # the endpoint recovers; cooldown elapses (forced, like the gather test)
+        pub._policies["default"].close()
+        channel.fail_times = 0
+        assert pub.publish_now()["default"] == "ok"
+        assert pub.stats()["default"]["circuit_open"] is False
+        assert pub.stats()["default"]["since_last_ok_s"] < 1.0
+        assert len(registry.events("fleet_publish_recovered")) == 1
+        # the aggregator holds the view; its side shows the host fresh
+        assert agg.report()["hosts"]["host-0"]["stale"] is False
+        # a NEW outage starts a NEW episode
+        channel.fail_times = 10**9
+        channel.calls = 0
+        time.sleep(0.1)
+        pub.publish_now()
+        time.sleep(0.1)
+        pub.publish_now()
+        assert len(registry.events("fleet_host_stale")) == 2
+
+    def test_per_destination_breakers_are_independent(self):
+        """One dead pod must not starve pushes to a healthy one."""
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        healthy = RecordingChannel(agg.ingest)
+        dead = DeadChannel()
+        pub = FleetPublisher(
+            _metric(), {"pod-0": dead, "pod-1": healthy}, host_id="host-0",
+            publish_every_s=60.0, deadline_s=1.0, max_retries=0, backoff_s=0.01,
+            start=False,
+        )
+        out = pub.publish_now()
+        assert out["pod-0"].startswith("failed:") and out["pod-1"] == "ok"
+        out = pub.publish_now()
+        assert out["pod-0"] == "skipped:circuit_open" and out["pod-1"] == "ok"
+        assert healthy.calls == 2 and agg.stats()["hosts"] == 1
+
+    def test_slow_destination_does_not_delay_healthy_ones(self):
+        """Per-destination isolation under load, not just under refusal: a
+        destination burning its whole deadline must not delay the healthy
+        destination's delivery on the same cadence pass."""
+        delivered_at = []
+        healthy = RecordingChannel(lambda blob: delivered_at.append(time.monotonic()))
+        slow = DelayedChannel(RecordingChannel(), delay_s=1.5)
+        pub = FleetPublisher(
+            _metric(), {"slow": slow, "fast": healthy}, host_id="host-0",
+            publish_every_s=60.0, deadline_s=1.0, max_retries=0, backoff_s=0.01,
+            start=False,
+        )
+        t0 = time.monotonic()
+        out = pub.publish_now()
+        assert out["fast"] == "ok" and out["slow"].startswith("failed:")
+        assert delivered_at and delivered_at[0] - t0 < 0.5  # not behind the slow budget
+
+    def test_cadence_keeps_serving_healthy_destination_across_ticks(self):
+        """The NEXT-tick guarantee, not just same-pass: while a wedged
+        destination is still burning its budget in flight, later cadence
+        ticks keep delivering to the healthy destination (the wedged one is
+        skipped in-flight, never re-entered concurrently)."""
+        healthy = RecordingChannel()
+        wedged = DelayedChannel(RecordingChannel(), delay_s=3.0)
+        pub = FleetPublisher(
+            _metric(), {"wedged": wedged, "fast": healthy}, host_id="host-0",
+            publish_every_s=0.05, deadline_s=5.0, max_retries=0, backoff_s=0.01,
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while healthy.calls < 4 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            pub.stop(flush=False)
+        assert healthy.calls >= 4  # kept flowing while `wedged` was in flight
+        assert wedged.calls == 1  # never re-entered concurrently
+        assert pub.stats()["wedged"]["skipped_inflight"] >= 2
+
+    def test_slow_destination_bounded_by_deadline(self):
+        slow = DelayedChannel(RecordingChannel(), delay_s=5.0)
+        pub = FleetPublisher(
+            _metric(), slow, host_id="host-0",
+            publish_every_s=60.0, deadline_s=0.1, max_retries=0, backoff_s=0.01,
+            start=False,
+        )
+        t0 = time.perf_counter()
+        assert pub.publish_now()["default"].startswith("failed:")
+        assert time.perf_counter() - t0 < 2.0
+
+
+class TestSeqRegression:
+    def test_backward_clock_restart_recovers_within_three_cadences(self):
+        """A host restarted after a backward wall-clock step publishes seqs
+        BELOW what the aggregator holds; every view answers 'duplicate' and
+        the fold silently freezes. The publisher must notice the streak,
+        jump its sequence past the held one (loudly), and the very next
+        publish must be accepted."""
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        m = _metric()
+        # pre-restart: a publish from "the future" (clock was ahead)
+        from metrics_tpu.fleet import encode_view
+
+        future_seq = int((time.time() + 3600) * 1_000_000)
+        agg.ingest(encode_view(m.snapshot_state(), host_id="host-0", seq=future_seq))
+        # post-restart publisher: fresh counter, wall clock now "stepped back"
+        pub = FleetPublisher(
+            m, RecordingChannel(agg.ingest), host_id="host-0",
+            publish_every_s=60.0, deadline_s=2.0, start=False,
+        )
+        outcomes = [pub.publish_now()["default"] for _ in range(3)]
+        assert all(o == "ok" for o in outcomes)  # delivered, but silently dropped...
+        assert agg.stats()["duplicates"] == 3
+        events = registry.events("fleet_seq_regression")
+        assert len(events) == 1 and events[0]["details"]["held_seq"] == future_seq
+        # ...and the jump makes the very next publish stick
+        assert pub.publish_now()["default"] == "ok"
+        assert agg.stats()["duplicates"] == 3  # no new duplicate
+        assert agg.report()["hosts"]["host-0"]["seq"] > future_seq
+
+    def test_single_benign_duplicate_does_not_jump(self):
+        """The idempotent retry path re-delivers one blob; that must not
+        trigger the regression jump (streak resets on the next accept)."""
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+        pub = FleetPublisher(
+            _metric(), RecordingChannel(agg.ingest), host_id="host-0",
+            publish_every_s=60.0, deadline_s=2.0, start=False,
+        )
+        pub.publish_now()
+        # one at-least-once re-delivery answers duplicate once...
+        pub._note_duplicate("default", f"duplicate:{pub._seq}")
+        # ...then the next publish is accepted and resets the streak
+        assert pub.publish_now()["default"] == "ok"
+        assert not registry.events("fleet_seq_regression")
+        # and even a SUSTAINED streak of equal-seq duplicates (the server
+        # folded each first attempt; the retry answers with OUR seq) is the
+        # benign timeout-retry shape, never a misdiagnosed clock regression
+        for _ in range(5):
+            pub._note_duplicate("default", f"duplicate:{pub._seq}")
+        assert not registry.events("fleet_seq_regression")
+
+
+class TestEnvKnobs:
+    def test_programmatic_beats_env_beats_default(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_FLEET_PUBLISH_EVERY_S", "7.5")
+        assert resolve_fleet_knob("publish_every_s", None) == 7.5
+        assert resolve_fleet_knob("publish_every_s", 0.25) == 0.25
+        monkeypatch.delenv("METRICS_TPU_FLEET_PUBLISH_EVERY_S")
+        reset_fleet_env_state()
+        assert resolve_fleet_knob("publish_every_s", None) == 1.0
+
+    def test_malformed_env_warns_once_and_falls_back(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_FLEET_STALE_AFTER_S", "-3")
+        with pytest.warns(UserWarning, match="METRICS_TPU_FLEET_STALE_AFTER_S"):
+            assert resolve_fleet_knob("stale_after_s", None) == 10.0
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # the second parse must stay silent
+            assert resolve_fleet_knob("stale_after_s", None) == 10.0
+
+    def test_publisher_reads_env_cadence(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_FLEET_PUBLISH_EVERY_S", "42.0")
+        pub = FleetPublisher(_metric(), RecordingChannel(), host_id="h", start=False)
+        assert pub.publish_every_s == 42.0
+
+    def test_nonsense_programmatic_knob_rejected(self):
+        with pytest.raises(ValueError, match="publish_every_s"):
+            FleetPublisher(
+                _metric(), RecordingChannel(), host_id="h", publish_every_s=-1.0, start=False
+            )
+
+    def test_nan_knobs_rejected_everywhere(self, monkeypatch):
+        """NaN slips every <= comparison — a NaN staleness threshold would
+        silently never mark anything stale, so both resolution paths must
+        refuse it (env: warn once + default; programmatic: ValueError)."""
+        monkeypatch.setenv("METRICS_TPU_FLEET_STALE_AFTER_S", "nan")
+        with pytest.warns(UserWarning, match="METRICS_TPU_FLEET_STALE_AFTER_S"):
+            assert resolve_fleet_knob("stale_after_s", None) == 10.0
+        with pytest.raises(ValueError, match="finite"):
+            resolve_fleet_knob("stale_after_s", float("nan"))

@@ -1,0 +1,142 @@
+"""The fleet view wire format (``metrics_tpu/fleet/wire.py``): round trips,
+refusals naming host and leaf, schema/encoding gates — using the
+network-level corruptors from ``tests/helpers/fault_injection.py``.
+"""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.fleet.wire import (
+    MAGIC,
+    SCHEMA_VERSION,
+    WireCorruptionError,
+    WireError,
+    WireSchemaError,
+    decode_view,
+    encode_view,
+)
+from tests.helpers.fault_injection import bitflip_blob, truncate_blob
+
+pytestmark = pytest.mark.fleet
+
+
+def _payload(seed: int = 0, n: int = 32):
+    rng = np.random.default_rng(seed)
+    m = mt.Accuracy(num_classes=4)
+    m.update(jnp.asarray(rng.integers(0, 4, n)), jnp.asarray(rng.integers(0, 4, n)))
+    return m, m.snapshot_state()
+
+
+class TestRoundTrip:
+    def test_header_and_payload_survive(self):
+        m, payload = _payload()
+        blob = encode_view(payload, host_id="host-3", seq=17, updates=1, extra={"pod": "p0"})
+        header, decoded = decode_view(blob)
+        assert header["host_id"] == "host-3" and header["seq"] == 17
+        assert header["updates"] == 1 and header["extra"] == {"pod": "p0"}
+        fresh = mt.Accuracy(num_classes=4)
+        fresh.load_snapshot_state(decoded)
+        assert float(fresh.compute()) == float(m.compute())
+
+    def test_collection_payload_round_trips(self):
+        rng = np.random.default_rng(1)
+        coll = mt.MetricCollection({"acc": mt.Accuracy(num_classes=4)})
+        coll.update(jnp.asarray(rng.integers(0, 4, 16)), jnp.asarray(rng.integers(0, 4, 16)))
+        blob = encode_view(coll.snapshot_state(), host_id="h", seq=1)
+        _header, decoded = decode_view(blob)
+        fresh = mt.MetricCollection({"acc": mt.Accuracy(num_classes=4)})
+        fresh.load_snapshot_state(decoded)
+        assert float(fresh.compute()["acc"]) == float(coll.compute()["acc"])
+
+    def test_empty_host_id_refused_at_encode(self):
+        with pytest.raises(WireError, match="host_id"):
+            encode_view({}, host_id="", seq=1)
+
+
+class TestRefusals:
+    def test_truncated_blob_refused(self):
+        _m, payload = _payload()
+        blob = encode_view(payload, host_id="host-0", seq=1)
+        with pytest.raises(WireCorruptionError, match="truncated or corrupt"):
+            decode_view(truncate_blob(blob, keep_frac=0.5))
+
+    def test_bitflipped_blob_refused_naming_host_and_leaf(self):
+        """A single flipped payload bit fails a leaf checksum; the refusal
+        names the publishing host and the offending leaf."""
+        _m, payload = _payload()
+        blob = encode_view(payload, host_id="host-7", seq=3)
+        refused = 0
+        # sweep several positions: wherever the flip lands (payload bytes,
+        # checksum bytes, header) the decode must refuse — never return a
+        # silently-different view
+        for pos in range(len(blob) // 4, len(blob), len(blob) // 4):
+            flipped = bitflip_blob(blob, position=pos)
+            try:
+                header, decoded = decode_view(flipped)
+            except WireError:
+                refused += 1
+                continue
+            # an unlucky flip may hit pickle framing padding and decode
+            # identically; identical bytes are the only acceptable escape
+            assert (header, repr(decoded)) == (decode_view(blob)[0], repr(decode_view(blob)[1]))
+        assert refused >= 1
+        with pytest.raises(WireCorruptionError, match=r"host='host-7'.*leaf"):
+            # a flip placed squarely in the payload region names the leaf
+            decode_view(bitflip_blob(blob, position=len(blob) - 8))
+
+    def test_mangled_checksum_manifest_refused_typed(self):
+        """A blob whose checksum field unpickles as a non-dict must still
+        refuse through the typed WireError path (never a TypeError escaping
+        the aggregator's refusal handling)."""
+        _m, payload = _payload()
+        record = pickle.loads(encode_view(payload, host_id="h", seq=1))
+        record["checksums"] = 17
+        with pytest.raises(WireCorruptionError, match="checksum manifest"):
+            decode_view(pickle.dumps(record))
+
+    def test_unwalkable_state_tree_refused_typed(self):
+        """A blob whose payload defeats the checksum walk itself (mixed-type
+        dict keys break the deterministic sorted() traversal) is still a
+        typed WireError refusal — never a raw TypeError reaching the
+        aggregator (which would answer HTTP 500 instead of 400)."""
+        record = pickle.loads(encode_view({"states": {}}, host_id="h", seq=1))
+        record["payload"] = {1: "x", "a": "y"}  # unsortable key mix
+        with pytest.raises(WireCorruptionError):
+            decode_view(pickle.dumps(record))
+        record["checksums"] = {2: "x", "b": "y"}  # and in the manifest itself
+        with pytest.raises(WireCorruptionError):
+            decode_view(pickle.dumps(record))
+
+    def test_not_a_pickle_refused(self):
+        with pytest.raises(WireCorruptionError, match="unreadable"):
+            decode_view(b"\x00\x01\x02 definitely not a view")
+
+    def test_wrong_magic_refused(self):
+        blob = pickle.dumps({"magic": "something-else", "schema_version": 1})
+        with pytest.raises(WireCorruptionError, match=MAGIC):
+            decode_view(blob)
+
+    def test_future_schema_refused(self):
+        _m, payload = _payload()
+        record = pickle.loads(encode_view(payload, host_id="h", seq=1))
+        record["schema_version"] = SCHEMA_VERSION + 1
+        with pytest.raises(WireSchemaError, match="upgrade"):
+            decode_view(pickle.dumps(record))
+
+    def test_unknown_encoding_refused(self):
+        """The compressed-transport forward-compatibility gate: an encoding
+        token this build does not implement is refused loudly, never
+        mis-decoded."""
+        _m, payload = _payload()
+        record = pickle.loads(encode_view(payload, host_id="h", seq=1))
+        record["header"]["encoding"] = "equarx-int8-v1"
+        from metrics_tpu.resilience.snapshot import _checksum_tree
+
+        record["checksums"] = _checksum_tree(
+            {"header": record["header"], "payload": record["payload"]}
+        )
+        with pytest.raises(WireSchemaError, match="encoding"):
+            decode_view(pickle.dumps(record))

@@ -1,14 +1,18 @@
 """Fault-injection harness for the in-graph fault channel
-(``metrics_tpu/utilities/guard.py``) and the retrying multihost transport
-(``metrics_tpu/parallel/sync.py``).
+(``metrics_tpu/utilities/guard.py``), the retrying multihost transport
+(``metrics_tpu/parallel/sync.py``), and the fleet view channel
+(``metrics_tpu/fleet``).
 
 Corruptors produce the fault classes the channel tracks — non-finite
 preds/target rows, out-of-range probabilities and labels, corrupted state
 leaves — with deterministic row selection so tests can assert exact
 counter values. Transport fakes simulate the pod-level failure modes
-(flaky, hanging, dead peers) without a real multi-host runtime.
+(flaky, hanging, dead peers) without a real multi-host runtime. The
+network-level shapes (blob corruptors + channel wrappers) simulate what a
+DCN/HTTP hop does to a published view — truncation, bit flips, delay,
+duplication, reordering, flapping endpoints — without a real network.
 """
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -137,3 +141,150 @@ class HangingGather(CountingGather):
         time.sleep(self.hang_s)
         local = np.asarray(array)
         return np.stack([local] * self.nproc)
+
+
+# --------------------------------------------------------------------------
+# network-level fault shapes (fleet view channel, metrics_tpu/fleet)
+# --------------------------------------------------------------------------
+
+
+def truncate_blob(blob: bytes, keep_frac: float = 0.5) -> bytes:
+    """A torn delivery: keep only the leading ``keep_frac`` of the bytes."""
+    keep = max(1, int(len(blob) * keep_frac))
+    return blob[:keep]
+
+
+def bitflip_blob(blob: bytes, position: Optional[int] = None, bit: int = 0) -> bytes:
+    """One flipped bit (default: middle byte) — the wire-checksum test case."""
+    pos = len(blob) // 2 if position is None else position
+    out = bytearray(blob)
+    out[pos] ^= 1 << bit
+    return bytes(out)
+
+
+class RecordingChannel:
+    """Well-behaved channel endpoint: counts calls and keeps every blob.
+
+    ``sink`` (optional) is the real receiver — e.g. ``aggregator.ingest`` —
+    whose return value is relayed; without one, delivery is just recorded.
+    """
+
+    def __init__(self, sink: Optional[Callable[[bytes], Any]] = None):
+        self.sink = sink
+        self.calls = 0
+        self.blobs: List[bytes] = []
+
+    def deliver(self, blob: bytes) -> Any:
+        self.blobs.append(blob)
+        return self.sink(blob) if self.sink is not None else None
+
+    def __call__(self, blob: bytes) -> Any:
+        self.calls += 1
+        return self.deliver(blob)
+
+
+class DeadChannel(RecordingChannel):
+    """Always raises — the dead-aggregator case that must degrade, not hang."""
+
+    def __call__(self, blob: bytes) -> Any:
+        self.calls += 1
+        raise ConnectionError("injected dead fleet endpoint")
+
+
+class FlappingChannel(RecordingChannel):
+    """Fails the first ``fail_times`` deliveries, then recovers — the
+    fail-N-then-recover endpoint: the breaker must open during the outage
+    and the first post-recovery success must close it and clear staleness."""
+
+    def __init__(self, fail_times: int, sink: Optional[Callable[[bytes], Any]] = None):
+        super().__init__(sink)
+        self.fail_times = fail_times
+
+    def __call__(self, blob: bytes) -> Any:
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise ConnectionError(f"injected flapping fleet endpoint failure #{self.calls}")
+        return self.deliver(blob)
+
+
+class CorruptingChannel(RecordingChannel):
+    """Applies a blob corruptor (:func:`truncate_blob` / :func:`bitflip_blob`
+    / any ``bytes -> bytes``) to every ``every``-th delivery — the
+    bit-rot-in-transit case the per-leaf checksums must refuse."""
+
+    def __init__(
+        self,
+        sink: Callable[[bytes], Any],
+        corruptor: Callable[[bytes], bytes],
+        every: int = 1,
+    ):
+        super().__init__(sink)
+        self.corruptor = corruptor
+        self.every = every
+
+    def __call__(self, blob: bytes) -> Any:
+        self.calls += 1
+        if self.calls % self.every == 0:
+            blob = self.corruptor(blob)
+        return self.deliver(blob)
+
+
+class DelayedChannel(RecordingChannel):
+    """Sleeps ``delay_s`` before delivering — the slow-hop case the
+    publish deadline must bound."""
+
+    def __init__(self, sink: Callable[[bytes], Any], delay_s: float):
+        super().__init__(sink)
+        self.delay_s = delay_s
+
+    def __call__(self, blob: bytes) -> Any:
+        import time
+
+        self.calls += 1
+        time.sleep(self.delay_s)
+        return self.deliver(blob)
+
+
+class DuplicatingChannel(RecordingChannel):
+    """Delivers every blob ``times`` times — the at-least-once transport
+    whose re-deliveries the idempotent (last-write-wins) fold must count
+    exactly once."""
+
+    def __init__(self, sink: Callable[[bytes], Any], times: int = 2):
+        super().__init__(sink)
+        self.times = times
+
+    def __call__(self, blob: bytes) -> Any:
+        self.calls += 1
+        out = None
+        for _ in range(self.times):
+            out = self.deliver(blob)
+        return out
+
+
+class ReorderingChannel(RecordingChannel):
+    """Buffers ``group`` deliveries and releases them in REVERSE order —
+    the out-of-order hop: an old view arriving after a newer one must be
+    folded as a duplicate, never resurrect stale state. Call
+    :meth:`flush` (also reversed) to drain a partial group."""
+
+    def __init__(self, sink: Callable[[bytes], Any], group: int = 2):
+        super().__init__(sink)
+        self.group = group
+        self._held: List[bytes] = []
+
+    def __call__(self, blob: bytes) -> Any:
+        self.calls += 1
+        self._held.append(blob)
+        if len(self._held) >= self.group:
+            return self.flush()
+        return None
+
+    def flush(self) -> Any:
+        held, self._held = self._held[::-1], []
+        out = None
+        for b in held:
+            out = self.deliver(b)
+        return out
+
+

@@ -26,6 +26,10 @@ _HEAVY = {
     "bootstrap_confidence.py",
     "stoi_as_loss.py",
     "retrieval_in_train_step.py",
+    # multiprocess fleet demo (~20 s: 3 jax child interpreters + kill/stale
+    # cadences) — the same machinery tier-1 covers in-process via
+    # tests/fleet/ and the mini multiprocess parity test
+    "fleet.py",
 }
 
 

@@ -1,0 +1,129 @@
+"""Unit tests for the shared retry/timeout/backoff/breaker policy
+(``metrics_tpu/parallel/retry.py``) — extracted from ``RetryingGather`` for
+its second consumer (the fleet publisher). The gather-level behavior stays
+pinned by ``tests/integrations/test_gather_transport.py`` unchanged; these
+tests pin the policy's own contract.
+"""
+import threading
+import time
+
+import pytest
+
+from metrics_tpu.parallel.retry import (
+    CallTimeoutError,
+    CircuitOpenError,
+    RetryBudgetExceededError,
+    RetryPolicy,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+class Flaky:
+    def __init__(self, fail_times: int, exc: Exception = None):
+        self.fail_times = fail_times
+        self.calls = 0
+        self.exc = exc or ConnectionError("injected failure")
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc
+        return "ok"
+
+
+class TestRetryPolicy:
+    def test_success_passes_through(self):
+        policy = RetryPolicy(timeout_s=5.0, backoff_s=0.01)
+        fn = Flaky(0)
+        assert policy.call(fn) == "ok" and fn.calls == 1
+        assert not policy.open
+
+    def test_exceptions_retry_with_backoff_then_succeed(self):
+        policy = RetryPolicy(timeout_s=5.0, max_retries=2, backoff_s=0.01)
+        fn = Flaky(2)
+        assert policy.call(fn) == "ok"
+        assert fn.calls == 3  # 2 failures + 1 success
+        assert not policy.open
+
+    def test_budget_exhausted_raises_with_cause_and_attempts(self):
+        policy = RetryPolicy(timeout_s=5.0, max_retries=2, backoff_s=0.01, cooldown_s=30.0)
+        fn = Flaky(10)
+        with pytest.raises(RetryBudgetExceededError) as info:
+            policy.call(fn)
+        assert info.value.attempts == 3 and fn.calls == 3
+        assert isinstance(info.value.cause, ConnectionError)
+        assert policy.open  # the breaker opened
+
+    def test_circuit_open_skips_the_callable_entirely(self):
+        policy = RetryPolicy(timeout_s=5.0, max_retries=0, backoff_s=0.01, cooldown_s=30.0)
+        fn = Flaky(10)
+        with pytest.raises(RetryBudgetExceededError):
+            policy.call(fn)
+        t0 = time.perf_counter()
+        with pytest.raises(CircuitOpenError) as info:
+            policy.call(fn)
+        assert time.perf_counter() - t0 < 0.05
+        assert fn.calls == 1  # nothing attempted while open
+        assert info.value.retry_in_s > 0
+
+    def test_success_after_cooldown_closes_the_breaker(self):
+        policy = RetryPolicy(timeout_s=5.0, max_retries=0, backoff_s=0.01, cooldown_s=30.0)
+        with pytest.raises(RetryBudgetExceededError):
+            policy.call(Flaky(10))
+        assert policy.open
+        policy.close()  # simulate the cooldown elapsing
+        assert policy.call(Flaky(0)) == "ok"
+        assert not policy.open
+
+    def test_timeout_not_retried_by_default(self):
+        """The collective-pairing rule the gather relies on: a deadline miss
+        runs ONE attempt however large max_retries is."""
+        calls = []
+
+        def hang():
+            calls.append(1)
+            time.sleep(5.0)
+
+        policy = RetryPolicy(timeout_s=0.1, max_retries=3, backoff_s=0.01)
+        with pytest.raises(RetryBudgetExceededError) as info:
+            policy.call(hang)
+        assert info.value.attempts == 1 and len(calls) == 1
+        assert isinstance(info.value.cause, CallTimeoutError)
+
+    def test_retry_timeouts_opt_in(self):
+        """Idempotent transports (the fleet publisher) retry deadline
+        misses too."""
+        calls = []
+
+        def slow_then_fast():
+            calls.append(1)
+            if len(calls) == 1:
+                time.sleep(5.0)
+            return "ok"
+
+        policy = RetryPolicy(timeout_s=0.2, max_retries=1, backoff_s=0.01, retry_timeouts=True)
+        assert policy.call(slow_then_fast) == "ok"
+        assert len(calls) == 2
+
+    def test_custom_timeout_error_class(self):
+        class MyTimeout(RuntimeError):
+            pass
+
+        policy = RetryPolicy(timeout_s=0.1, max_retries=0, timeout_error=MyTimeout)
+        with pytest.raises(RetryBudgetExceededError) as info:
+            policy.call(lambda: time.sleep(5.0))
+        assert isinstance(info.value.cause, MyTimeout)
+
+    def test_abandoned_attempt_thread_is_daemon(self):
+        policy = RetryPolicy(timeout_s=0.1, max_retries=0, thread_name="retry-test-worker")
+        with pytest.raises(RetryBudgetExceededError):
+            policy.call(lambda: time.sleep(3.0))
+        workers = [t for t in threading.enumerate() if t.name == "retry-test-worker"]
+        assert workers and all(t.daemon for t in workers)
+
+    def test_rejects_nonsense_budgets(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(timeout_s=0.0)
+        with pytest.raises(ValueError):
+            RetryPolicy(max_retries=-1)
