@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -94,3 +94,11 @@ test-fleet:
 # tests the `obs` pytest marker selects).
 test-obs:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/obs/ -q -m 'not slow' -p no:cacheprovider
+
+# The quantized sync transport layer (ops/quantize.py wire codecs + the
+# fused_sync quantized wire + overlapped-cycle compressed gathers + the
+# int8 fleet encoding): the error-bound property suite across adversarial
+# distributions, exact-mode bit-identity pins, budget/wire-dtype HLO pins,
+# and the fleet round trips — everything the `transport` marker selects.
+test-transport:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'transport and not slow' -p no:cacheprovider

@@ -1454,6 +1454,145 @@ def _phase_obs(jax, platform) -> None:
         print(f"bench: obs overhead failed: {err}", file=sys.stderr)
 
 
+def _phase_transport(jax, platform) -> None:
+    """Quantized sync transport (ISSUE 12): payload bytes + end-to-end cycle
+    latency for exact vs fp16 vs int8 on a simulated 2-rank pod whose
+    gather is DCN-shaped (fixed RTT + bytes/bandwidth — so payload bytes
+    ARE latency), plus the fleet view blob bytes exact vs int8.
+
+    The workload is the stated customer: an overlapped QuantileSketch
+    metric (double-buffered cycles ship the full sketch state per cycle).
+    Arms run interleaved (same thermal/jitter per rep), min over the reps
+    per arm; the exact arm carries a bit-exactness assert against a
+    blocking twin fed the identical stream.
+    """
+    _stamp("transport start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu import metric as metric_mod
+    from metrics_tpu.obs.runtime_metrics import registry as obs_registry
+    from metrics_tpu.parallel.sync import _pad_gather_trim
+
+    # DCN shape: 0.5 ms fixed RTT per collective + 25 MB/s effective
+    # per-flow bandwidth (congested cross-region DCN) — the regime the
+    # ROADMAP names, where the ~250 KB f32 sketch payload costs ~10 ms of
+    # pure byte time per gather and transport width prices directly into
+    # cycle latency
+    BASE_RTT_S = 0.0005
+    BYTES_PER_S = 25e6
+
+    def dcn_transport(a):
+        arr = np.asarray(a)
+        time.sleep(BASE_RTT_S + arr.nbytes / BYTES_PER_S)
+        return np.stack([arr, arr])
+
+    def dcn_gather(x, group=None, transport=None):
+        return _pad_gather_trim(x, dcn_transport)
+
+    metric_mod.distributed_available = lambda: True  # child process: isolated
+
+    # wide-and-flat geometry: ~256 KB of items at only 4 compactor levels,
+    # so the host-side merge floor stays small relative to the wire time
+    # this phase exists to price (error contract unchanged: eps is stated)
+    QS = dict(eps=0.01, k=16384, levels=4, quantiles=(0.5, 0.99))
+
+    def make(transport):
+        return mt.QuantileSketch(
+            **QS,
+            sync_mode="overlapped",
+            sync_every_n=1,
+            sync_transport=transport,
+            dist_sync_fn=dcn_gather,
+        )
+
+    rng = np.random.default_rng(31)
+    stream = [jnp.asarray(rng.lognormal(0, 2, 4096).astype(np.float32)) for _ in range(8)]
+
+    try:
+        arms = ("exact", "fp16", "int8")
+        metrics = {arm: make(arm) for arm in arms}
+        for arm in arms:  # warm: one covered cycle each (compile + trace)
+            metrics[arm].update(stream[0])
+            assert metrics[arm].request_sync(wait=True, deadline_s=60.0)
+        lat = {arm: [] for arm in arms}
+        cycle_bytes = {arm: [] for arm in arms}
+        for rep, batch in enumerate(stream[1:]):
+            for arm in arms:  # interleaved: same thermal/jitter per rep
+                m = metrics[arm]
+                b0 = obs_registry.counter("sync_payload_bytes").value
+                m.update(batch)
+                t0 = time.perf_counter()
+                ok = m.request_sync(wait=True, deadline_s=60.0)
+                lat[arm].append(time.perf_counter() - t0)
+                cycle_bytes[arm].append(obs_registry.counter("sync_payload_bytes").value - b0)
+                if not ok:
+                    print(f"bench: transport arm {arm} cycle uncovered", file=sys.stderr)
+
+        # exactness assert on the exact arm: bit-equal to a blocking twin
+        twin = mt.QuantileSketch(**QS, dist_sync_fn=dcn_gather)
+        for batch in stream:
+            twin.update(batch)
+        exact_vals = np.asarray(metrics["exact"].compute())
+        twin_vals = np.asarray(twin.compute())
+        if not np.array_equal(exact_vals, twin_vals):
+            print(
+                f"bench: PARITY-MISMATCH transport exact arm {exact_vals} != "
+                f"blocking twin {twin_vals}",
+                file=sys.stderr,
+            )
+        for m in metrics.values():
+            m._ensure_sync_scheduler().stop()
+
+        by = {arm: float(np.median(cycle_bytes[arm])) for arm in arms}
+        best = {arm: float(np.min(lat[arm])) * 1e3 for arm in arms}  # min over reps
+        for arm in arms:
+            _emit(
+                f"transport_cycle_{arm}_ms",
+                round(best[arm], 3),
+                f"ms/overlapped cycle end-to-end ({QS['eps']}-eps sketch state, "
+                f"simulated 2-rank pod, {BASE_RTT_S * 1e3:.1f} ms RTT + "
+                f"{BYTES_PER_S / 1e6:.0f} MB/s DCN-shaped gather, "
+                f"min-of-{len(stream) - 1}, {by[arm] / 1024:.0f} KiB/cycle, {platform})",
+            )
+        _emit(
+            "transport_sync_bytes_ratio_int8",
+            round(by["exact"] / by["int8"], 2),
+            f"x fewer gathered payload bytes per cycle vs exact f32 "
+            f"({by['exact'] / 1024:.0f} -> {by['int8'] / 1024:.0f} KiB; acceptance >= 3x, "
+            f"fp16 {by['exact'] / by['fp16']:.2f}x, {platform})",
+        )
+        if by["exact"] / by["int8"] < 3.0:
+            print(
+                f"bench: PARITY-MISMATCH transport acceptance: int8 byte ratio "
+                f"{by['exact'] / by['int8']:.2f} < 3x",
+                file=sys.stderr,
+            )
+
+        # fleet blob bytes: the same sketch state as a published host view
+        from metrics_tpu.fleet.wire import encode_view
+
+        payload = twin.snapshot_state()
+        blob_exact = encode_view(payload, host_id="bench", seq=1)
+        blob_int8 = encode_view(payload, host_id="bench", seq=2, encoding="int8")
+        _emit(
+            "transport_fleet_blob_ratio_int8",
+            round(len(blob_exact) / len(blob_int8), 2),
+            f"x smaller fleet view blob under int8-zlib-v1 "
+            f"({len(blob_exact) / 1024:.0f} -> {len(blob_int8) / 1024:.1f} KiB; "
+            f"acceptance >= 3x, {platform})",
+        )
+        if len(blob_exact) / len(blob_int8) < 3.0:
+            print(
+                f"bench: PARITY-MISMATCH transport acceptance: fleet blob ratio "
+                f"{len(blob_exact) / len(blob_int8):.2f} < 3x",
+                file=sys.stderr,
+            )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: transport failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -1470,6 +1609,7 @@ _PHASES = {
     "serving": (_phase_serving, 300),
     "async_sync": (_phase_async_sync, 300),
     "obs": (_phase_obs, 300),
+    "transport": (_phase_transport, 300),
 }
 
 _HEADLINE_METRIC = "fused_collection_step_ms"

@@ -69,6 +69,12 @@ class GraphBudget:
     allow_f64: bool = False
     allow_host_callback: bool = False
     allow_dynamic_shapes: bool = False
+    # structural regex pins on the HLO text, e.g. the quantized-transport
+    # entry requires an `s8[...] all-reduce` (the wire dtype actually
+    # lowered) and forbids any `f32[...] all-reduce` (no full-width float
+    # payload slipped back onto the wire)
+    require_patterns: Tuple[str, ...] = ()
+    forbid_patterns: Tuple[str, ...] = ()
 
     def collective_ceilings(self) -> Dict[str, Optional[int]]:
         return {
@@ -166,6 +172,25 @@ def audit_hlo(hlo: str, budget: GraphBudget, entry: str = "<fn>") -> List[GraphV
                 "shapes block fusion and force padding on TPU",
             )
         )
+    for pattern in budget.require_patterns:
+        if not re.search(pattern, hlo):
+            violations.append(
+                GraphViolation(
+                    entry,
+                    "missing-pattern",
+                    f"required HLO pattern {pattern!r} not found in the compiled graph",
+                )
+            )
+    for pattern in budget.forbid_patterns:
+        match = re.search(pattern, hlo)
+        if match:
+            violations.append(
+                GraphViolation(
+                    entry,
+                    "forbidden-pattern",
+                    f"forbidden HLO pattern {pattern!r} matched ({match.group(0)[:60]!r})",
+                )
+            )
     return violations
 
 

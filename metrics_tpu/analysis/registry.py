@@ -17,6 +17,16 @@ entry                           budget
 ``sketch_guarded_collection``   guarded collection WITH sketch states: **≤ 2**
                                 (quantile gather-merge joins the f32 sum
                                 bucket — the ISSUE 4/5 acceptance budget)
+``quantized_fused_step``        the SAME collection step lowered with
+                                ``sync_transport=int8`` (ISSUE 12 —
+                                ``ops/quantize.py``): the ≤ 2 all-reduce
+                                budget holds UNCHANGED, the wire lowers an
+                                ``s8`` all-reduce (dtype pinned via HLO
+                                pattern), and NO f32 all-reduce remains;
+                                with transport ``exact`` (default) output
+                                is bit-identical to
+                                ``sketch_guarded_collection`` (pinned in
+                                ``tests/parallel/test_quantized_sync.py``)
 ``auroc_capacity_step``         single-device jitted update+compute: **0**
                                 collectives, no f64/callbacks/dynamic shapes
 ``mean_update_stability``       recompilation detector on a guarded update:
@@ -195,6 +205,37 @@ def _build_sketch_guarded_collection(ndev: int):
     vals = jnp.asarray(np.random.default_rng(2).random(64 * ndev).astype(np.float32))
     fn = jax.jit(jax.shard_map(step, mesh=_mesh(ndev), in_specs=(P("data"),), out_specs=P()))
     return fn, (vals,)
+
+
+class _TransportLower:
+    """``hlo_of``-compatible wrapper that lowers (and runs) its jitted
+    function under a pinned ``sync_transport`` kernel override — transport
+    resolution happens at trace time, so the override must wrap ``lower``
+    itself (the ``_TracedLower`` stance applied to the quantized wire)."""
+
+    def __init__(self, fn: Callable, transport: str) -> None:
+        self._fn = fn
+        self._transport = transport
+
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        from metrics_tpu.ops.dispatch import kernel_override
+
+        with kernel_override(sync_transport=self._transport):
+            return self._fn.lower(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        from metrics_tpu.ops.dispatch import kernel_override
+
+        with kernel_override(sync_transport=self._transport):
+            return self._fn(*args, **kwargs)
+
+
+def _build_quantized_fused_step(ndev: int):
+    # the SAME construction as sketch_guarded_collection, lowered with the
+    # int8 transport forced — one build, so the exact and quantized audits
+    # measure the identical graph shape and only the wire dtype may differ
+    fn, args = _build_sketch_guarded_collection(ndev)
+    return _TransportLower(fn, "int8"), args
 
 
 def _build_auroc_capacity_step(ndev: int):
@@ -450,6 +491,23 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         name="sketch_guarded_collection",
         budget=GraphBudget(max_all_reduce=2),
         build=_build_sketch_guarded_collection,
+    ),
+    AuditEntry(
+        name="quantized_fused_step",
+        budget=GraphBudget(
+            max_all_reduce=2,
+            # the wire dtype is pinned structurally: the int8 transport must
+            # actually lower an s8 all-reduce, and no full-width f32 payload
+            # may remain on the wire (counter buckets stay integer-exact).
+            # The dtype token is matched anywhere in the line PREFIX before
+            # the all-reduce instruction token: optimized HLO may combine
+            # compatible all-reduces into ONE tuple-shaped op
+            # (`(f32[..], f32[..]) all-reduce(...)`), and a shape-adjacent
+            # regex would let a combined f32 pair evade the forbid pin
+            require_patterns=(r"(?m)^[^\n]*?s8\[[^\n]*?\ball-reduce(-start)?\(",),
+            forbid_patterns=(r"(?m)^[^\n]*?f32\[[^\n]*?\ball-reduce(-start)?\(",),
+        ),
+        build=_build_quantized_fused_step,
     ),
     AuditEntry(
         name="auroc_capacity_step",

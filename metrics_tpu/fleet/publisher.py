@@ -96,9 +96,19 @@ class FleetPublisher:
         breaker_cooldown_s: Optional[float] = None,
         stale_after_s: Optional[float] = None,
         start: bool = True,
+        encoding: Optional[str] = None,
     ) -> None:
         if not host_id:
             raise MetricsTPUUserError("`host_id` must be a non-empty string")
+        # quantized fleet payloads (fleet/wire.py): `encoding=` opts this
+        # publisher into blockwise-int8 + zlib view blobs ("int8"); None
+        # resolves METRICS_TPU_FLEET_ENCODING > pickle-v1 per publish. A
+        # programmatic typo raises here (code, not deployment config).
+        if encoding is not None:
+            from metrics_tpu.fleet.wire import resolve_fleet_encoding
+
+            resolve_fleet_encoding(encoding)  # validate eagerly
+        self._encoding = encoding
         if hasattr(source, "fleet_view"):
             self._view_fn = source.fleet_view
         elif hasattr(source, "snapshot_state"):
@@ -247,6 +257,7 @@ class FleetPublisher:
             seq=seq,
             updates=_payload_updates(payload),
             extra=extra,
+            encoding=self._encoding,
         )
         with self._lock:
             self._encode_error_reported = False  # snapshot+encode healthy again
@@ -331,9 +342,20 @@ class FleetPublisher:
             )
 
     def _push(self, name: str, channel: Channel, blob: bytes) -> str:
+        from metrics_tpu.obs.runtime_metrics import registry as _obs_registry
+
+        def send() -> Any:
+            # per-transport byte accounting (obs): counted per CHANNEL
+            # ATTEMPT, inside the policy, so retries count each re-send, a
+            # 3-destination publisher reports 3x len(blob) per pass, and a
+            # breaker-open skip counts nothing — the fleet twin of
+            # `sync_payload_bytes`, which also counts actual on-wire bytes
+            _obs_registry.counter("fleet_blob_bytes").inc(len(blob))
+            return channel(blob)
+
         policy = self._policies[name]
         try:
-            result = policy.call(lambda: channel(blob))
+            result = policy.call(send)
         except CircuitOpenError:
             # the breaker-opening pass already recorded the event; skipping
             # is the cheap degraded path, not a new degradation

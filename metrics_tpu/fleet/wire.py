@@ -26,36 +26,124 @@ to an in-memory publish instead of a file:
 Blobs are Python pickles of numpy trees, the same **trusted** transport
 model as the snapshot files (your own hosts, your own aggregators — the
 checksums defend against corruption, not adversaries). The format is
-deliberately payload-opaque and versioned so a later compressed transport
-(EQuARX-style quantized payloads, PAPERS.md) slots in as a new
-``encoding`` token without touching the fold protocol.
+deliberately payload-opaque and versioned: the reserved ``encoding`` token
+now carries two implementations —
+
+- ``pickle-v1`` (the default): raw numpy leaves, bit-exact.
+- ``int8-zlib-v1``: the EQuARX-style compressed transport (PAPERS.md).
+  Floating leaves of at least :data:`QUANTIZE_MIN_SIZE` lanes are encoded
+  blockwise-int8 (``ops/quantize.py``: per-block f32 dequantization scales
+  carried in the leaf header, NaN/±inf passthrough codes, worst-case error
+  ``absmax_block / 252`` per lane) with the code bytes zlib-compressed;
+  integer leaves — counters, CountMin counts, HLL registers, sketch level
+  counts, ``n_seen`` — and small floating leaves ship raw, so every
+  lossless path stays lossless and a sketch's rank contract extends to
+  ``eps_total = eps_sketch + eps_transport`` exactly as in the in-graph
+  wire. Per-leaf checksums are computed over the **encoded** payload, so a
+  corrupt blob is refused (naming host + leaf) before any dequantization
+  runs, and a build that doesn't know the token refuses it loudly —
+  listing the encodings it does support — instead of mis-decoding bytes.
+
+Which encoding a publisher ships resolves programmatic ``encoding=`` >
+``METRICS_TPU_FLEET_ENCODING`` (``exact``/``pickle`` | ``int8``) >
+``pickle-v1``; a malformed env value warns once and falls back — a bad env
+var degrades bytes, never correctness. Decoding is token-driven per blob,
+so a mixed-version / mixed-encoding fleet (one int8 host among exact
+hosts) folds correctly as long as the aggregator build knows each token.
 
 Module import performs python work only (stdlib + numpy via the snapshot
-helpers — the hang-proof bootstrap contract, ``utilities/backend.py``).
+helpers — the hang-proof bootstrap contract, ``utilities/backend.py``;
+the quantizer imports lazily at the first int8 encode/decode).
 """
 import pickle
 import time
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
+import numpy as np
+
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 from metrics_tpu.resilience.snapshot import _checksum_tree
 
 __all__ = [
     "MAGIC",
     "SCHEMA_VERSION",
+    "ENCODING",
+    "ENCODING_INT8",
+    "SUPPORTED_ENCODINGS",
+    "QUANTIZE_MIN_SIZE",
     "WireError",
     "WireCorruptionError",
     "WireSchemaError",
     "encode_view",
     "decode_view",
     "next_seq",
+    "resolve_fleet_encoding",
+    "reset_wire_env_state",
 ]
 
 MAGIC = "metrics-tpu-fleet-view"
 SCHEMA_VERSION = 1
-# the one payload encoding this schema version ships; a compressed
-# transport registers a new token and older aggregators refuse it loudly
-# via the schema/encoding check instead of mis-decoding bytes
+# the payload encodings this schema version ships; an unknown token is
+# refused loudly (listing these) instead of mis-decoding bytes
 ENCODING = "pickle-v1"
+ENCODING_INT8 = "int8-zlib-v1"
+SUPPORTED_ENCODINGS = (ENCODING, ENCODING_INT8)
+# floating leaves smaller than this ship raw even under int8: no byte win,
+# and scalar aggregates (a MeanMetric value) keep full width
+QUANTIZE_MIN_SIZE = 16
+# the sentinel key marking an encoded leaf inside the payload tree; state
+# names are python identifiers, so it can never collide with real state
+_QKEY = "__quantized__"
+
+_ENCODING_ALIASES = {
+    "exact": ENCODING,
+    "pickle": ENCODING,
+    ENCODING: ENCODING,
+    "int8": ENCODING_INT8,
+    ENCODING_INT8: ENCODING_INT8,
+}
+
+_warn_once = WarnOnce()
+
+
+def _parse_encoding(raw: str) -> Optional[str]:
+    token = _ENCODING_ALIASES.get(raw.strip().lower())
+    if token is None:
+        _warn_once(
+            ("fleet-encoding", raw),
+            f"METRICS_TPU_FLEET_ENCODING={raw!r} is not a known encoding "
+            f"(have {sorted(set(_ENCODING_ALIASES))}); publishing {ENCODING!r} "
+            "— a bad env var degrades bytes, never correctness.",
+        )
+    return token
+
+
+_ENV_ENCODING: "EnvParse[Optional[str]]" = EnvParse(
+    "METRICS_TPU_FLEET_ENCODING", _parse_encoding, None
+)
+
+
+def resolve_fleet_encoding(programmatic: Optional[str] = None) -> str:
+    """Programmatic arg > ``METRICS_TPU_FLEET_ENCODING`` > ``pickle-v1``
+    (the dispatch-layer resolution rule). Programmatic typos raise — they
+    are code, not deployment config."""
+    if programmatic is not None:
+        token = _ENCODING_ALIASES.get(str(programmatic).strip().lower())
+        if token is None:
+            raise WireError(
+                f"unknown fleet encoding {programmatic!r}; "
+                f"choose from {sorted(set(_ENCODING_ALIASES))}"
+            )
+        return token
+    token = _ENV_ENCODING()
+    return token if token is not None else ENCODING
+
+
+def reset_wire_env_state() -> None:
+    """Test hook: forget the memoized env parse and warn-once history."""
+    _warn_once.reset()
+    _ENV_ENCODING.reset()
 
 
 def next_seq(prev: int) -> int:
@@ -83,12 +171,71 @@ class WireSchemaError(WireError):
     build understands."""
 
 
+# --------------------------------------------------------------------------
+# int8-zlib-v1 payload coding: a structure-preserving walk that replaces
+# large floating leaves with blockwise-int8 records (scales in the leaf
+# header) and leaves every lossless leaf untouched
+# --------------------------------------------------------------------------
+
+
+def _encode_leaf_int8(arr: np.ndarray) -> Dict[str, Any]:
+    from metrics_tpu.ops.quantize import DEFAULT_BLOCK, blockwise_int8_encode_np
+
+    flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+    codes, scales = blockwise_int8_encode_np(flat, DEFAULT_BLOCK)
+    return {
+        _QKEY: "int8-block",
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "n": int(flat.shape[0]),
+        "block": DEFAULT_BLOCK,
+        # the dequantization scales ride the leaf header, bit-exact
+        "scales": scales,
+        "codes": zlib.compress(codes.tobytes(), 6),
+    }
+
+
+def _decode_leaf_int8(rec: Dict[str, Any]) -> np.ndarray:
+    from metrics_tpu.ops.quantize import blockwise_int8_decode_np
+
+    codes = np.frombuffer(zlib.decompress(rec["codes"]), np.int8)
+    vals = blockwise_int8_decode_np(codes, rec["scales"], rec["n"], rec["block"])
+    return vals.reshape(tuple(rec["shape"])).astype(np.dtype(rec["dtype"]))
+
+
+def _encode_payload_int8(node: Any) -> Any:
+    if isinstance(node, dict):
+        return {k: _encode_payload_int8(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return type(node)(_encode_payload_int8(v) for v in node)
+    if (
+        isinstance(node, np.ndarray)
+        # f32/f16 only: the codes are f32-based, so an f64 leaf would lose
+        # range/precision beyond the documented envelope — it ships raw
+        and node.dtype in (np.float32, np.float16)
+        and node.size >= QUANTIZE_MIN_SIZE
+    ):
+        return _encode_leaf_int8(node)
+    return node
+
+
+def _decode_payload_int8(node: Any) -> Any:
+    if isinstance(node, dict):
+        if node.get(_QKEY) == "int8-block":
+            return _decode_leaf_int8(node)
+        return {k: _decode_payload_int8(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return type(node)(_decode_payload_int8(v) for v in node)
+    return node
+
+
 def encode_view(
     payload: Dict[str, Any],
     host_id: str,
     seq: int,
     updates: Optional[int] = None,
     extra: Optional[Dict[str, Any]] = None,
+    encoding: Optional[str] = None,
 ) -> bytes:
     """Encode one ``snapshot_state`` payload as a self-verifying blob.
 
@@ -98,13 +245,19 @@ def encode_view(
     (re-deliveries and reorderings of old blobs are then folded at most
     once). ``updates`` (optional) records the view's total update count
     for observability; ``extra`` is recorded verbatim in the header.
+    ``encoding`` picks the payload encoding (module docstring): a token or
+    alias (``"exact"``/``"int8"``), ``None`` resolving
+    ``METRICS_TPU_FLEET_ENCODING`` > ``pickle-v1``. Checksums always cover
+    the payload AS ENCODED, so verification runs before any decode work.
     """
     if not host_id:
         raise WireError("`host_id` must be a non-empty string")
+    token = resolve_fleet_encoding(encoding)
+    wire_payload = _encode_payload_int8(payload) if token == ENCODING_INT8 else payload
     header = {
         "host_id": str(host_id),
         "seq": int(seq),
-        "encoding": ENCODING,
+        "encoding": token,
         "published_unix": time.time(),
         "updates": None if updates is None else int(updates),
         "extra": dict(extra) if extra else None,
@@ -114,10 +267,10 @@ def encode_view(
             "magic": MAGIC,
             "schema_version": SCHEMA_VERSION,
             "header": header,
-            "payload": payload,
+            "payload": wire_payload,
             # header covered too: a flipped host_id/seq would re-route the
             # fold (double-count one host, orphan another), not just values
-            "checksums": _checksum_tree({"header": header, "payload": payload}),
+            "checksums": _checksum_tree({"header": header, "payload": wire_payload}),
         },
         protocol=4,
     )
@@ -191,14 +344,30 @@ def decode_view(blob: bytes) -> Tuple[Dict[str, Any], Dict[str, Any]]:
             f"{bad[0] if bad else '<manifest>'} — corrupt view refused"
         )
     header = record["header"]
-    if header.get("encoding") != ENCODING:
+    encoding = header.get("encoding")
+    if encoding not in SUPPORTED_ENCODINGS:
+        # a mixed-version fleet rollout hits this: the message names every
+        # encoding THIS build can fold so the operator knows which side to
+        # upgrade (or which METRICS_TPU_FLEET_ENCODING to roll back)
         raise WireSchemaError(
             f"fleet view ({_header_hint(record)}) uses payload encoding "
-            f"{header.get('encoding')!r}; this build decodes {ENCODING!r} only"
+            f"{encoding!r}; this build decodes {list(SUPPORTED_ENCODINGS)}"
         )
     if not header.get("host_id") or not isinstance(header.get("seq"), int):
         raise WireCorruptionError(
             f"fleet view ({_header_hint(record)}) carries no usable host_id/seq — refused "
             "(the idempotent fold cannot key it)"
         )
-    return header, record["payload"]
+    payload = record["payload"]
+    if encoding == ENCODING_INT8:
+        try:
+            payload = _decode_payload_int8(payload)
+        except Exception as err:  # noqa: BLE001 — refusals stay typed (WireError)
+            # every leaf already passed its checksum, so reaching here means
+            # a malformed encode — still refused typed, never a raw
+            # zlib.error/KeyError escaping the aggregator as an HTTP 500
+            raise WireCorruptionError(
+                f"fleet view ({_header_hint(record)}) failed {ENCODING_INT8} payload "
+                f"decode ({type(err).__name__}: {err}) — refused"
+            )
+    return header, payload

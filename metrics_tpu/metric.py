@@ -152,6 +152,7 @@ class Metric:
         sync_mode: str = "blocking",
         sync_every_n: Optional[int] = None,
         sync_every_s: Optional[float] = None,
+        sync_transport: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         from metrics_tpu.utilities.guard import VALID_POLICIES, FaultCounters
@@ -207,6 +208,22 @@ class Metric:
                 )
             self.sync_every_n = None
             self.sync_every_s = None
+        # quantized sync transport (ops/quantize.py): the wire codec the
+        # OVERLAPPED cycle ships float state through — readers consume an
+        # at-most-one-cycle-stale view anyway, so compressed cycles trade
+        # precision nobody reads at full width for DCN bandwidth, within
+        # the codec's documented per-block error envelope. Blocking syncs
+        # (and compute(fresh=True)) are ALWAYS exact; None resolves
+        # METRICS_TPU_SYNC_TRANSPORT > 'exact' per cycle.
+        from metrics_tpu.ops.quantize import validate_transport
+
+        validate_transport(sync_transport)
+        if sync_transport not in (None, "exact") and sync_mode != "overlapped":
+            raise ValueError(
+                "`sync_transport` needs sync_mode='overlapped' (the blocking "
+                "sync path is always exact)"
+            )
+        self.sync_transport = sync_transport
         object.__setattr__(self, "_sync_scheduler", None)
         # set by MetricCollection._ensure_overlap_scheduler: which head's
         # entry of a collection-shared view this metric reads
@@ -597,12 +614,22 @@ class Metric:
         runs (so an overlapped read is bit-identical to a blocking read over
         the batches its cycle covers), applied to the snapshot buffer on the
         scheduler thread. Single-process worlds reduce to the identity —
-        the view is then just a consistent copy of the live state."""
+        the view is then just a consistent copy of the live state.
+
+        With a non-``exact`` ``sync_transport`` (ctor arg >
+        ``METRICS_TPU_SYNC_TRANSPORT`` > exact, resolved per cycle) the
+        per-leaf gathers ship blockwise-quantized wire instead of raw f32
+        — integer/counter leaves and small scalars always bypass
+        (``ops/quantize.py::wrap_gather_transport``), and the overlapped
+        read then bit-equals the blocking read only up to the codec's
+        documented error envelope (``compute(fresh=True)`` stays exact)."""
         if not distributed_available():
             return state
-        return self._gathered_state(
-            state, self.dist_sync_fn or gather_all_arrays, self.process_group
-        )
+        gather = self.dist_sync_fn or gather_all_arrays
+        from metrics_tpu.ops.quantize import resolve_codec, wrap_gather_transport
+
+        gather = wrap_gather_transport(gather, resolve_codec(self.sync_transport))
+        return self._gathered_state(state, gather, self.process_group)
 
     def _overlapped_read(self, *args: Any, **kwargs: Any) -> Any:
         """Zero-collective read path: compute on the scheduler's front

@@ -52,7 +52,16 @@ compactor_fold      xla | pallas |                sketch level folds
 sketch_precompact   binned | sort                 ``QuantileSketch.update``
 binned_counters     xla | pallas |                binned precision/recall
                     pallas-interpret              metrics
+sync_transport      exact | fp16 | int8           ``fused_sync``'s quantized
+                                                  wire, overlapped metric
+                                                  cycles, ``ServeLoop``
+                                                  reduces (own env var:
+                                                  ``METRICS_TPU_SYNC_TRANSPORT``)
 ==================  ============================  ==========================
+
+Ops may carry their OWN env var (``register_op(..., env_var=...)``) —
+consulted between the programmatic override and the shared
+``METRICS_TPU_KERNEL_BACKEND`` tokens, same warn-once fallback.
 """
 import contextlib
 import importlib
@@ -83,16 +92,25 @@ _IMPL_MODULES = (
     "metrics_tpu.ops.binning",
     "metrics_tpu.ops.pallas_kernels",
     "metrics_tpu.ops.binned_counters",
+    "metrics_tpu.ops.quantize",
 )
 
 
 class KernelOp:
     """One dispatched op: named impls, optional per-impl guards, an optional
-    ``auto`` chooser, and the default (always-runnable) implementation."""
+    ``auto`` chooser, and the default (always-runnable) implementation.
 
-    def __init__(self, name: str, default: str) -> None:
+    ``env_var`` (optional) gives the op its OWN environment variable —
+    consulted after the programmatic override and before the shared
+    ``METRICS_TPU_KERNEL_BACKEND`` tokens (the ``sync_transport`` op's
+    ``METRICS_TPU_SYNC_TRANSPORT`` is the first user). Values are plain
+    impl names; unknown ones warn once and fall back to the default, same
+    as any env-forced choice."""
+
+    def __init__(self, name: str, default: str, env_var: Optional[str] = None) -> None:
         self.name = name
         self.default = default
+        self.env_var = env_var
         self.impls: Dict[str, Callable] = {}
         self.guards: Dict[str, Callable[..., Optional[str]]] = {}
         self.chooser: Optional[Callable[..., str]] = None
@@ -119,17 +137,23 @@ class KernelOp:
 
 
 _OPS: Dict[str, KernelOp] = {}
+_OP_ENV: Dict[str, "EnvParse[Optional[str]]"] = {}  # ops with their own env var
 _OVERRIDES: Dict[str, str] = {}
 _warn_once = WarnOnce()
 _IMPLS_ENSURED = False
 
 
-def register_op(name: str, default: str) -> KernelOp:
+def register_op(name: str, default: str, env_var: Optional[str] = None) -> KernelOp:
     """Get-or-create an op. The first registration pins the default impl
     name (later calls with a different default are a programming error)."""
     op = _OPS.get(name)
     if op is None:
-        op = _OPS[name] = KernelOp(name, default)
+        op = _OPS[name] = KernelOp(name, default, env_var)
+        if env_var is not None:
+            # the per-op env var is a single bare impl token (memoized like
+            # the shared var; whitespace-trimmed; validation — warn-once +
+            # fallback — happens in _resolve_choice like any env choice)
+            _OP_ENV[name] = EnvParse(env_var, lambda raw: raw.strip(), None)
     elif op.default != default:
         raise ValueError(
             f"kernel op {name!r} already registered with default {op.default!r}, "
@@ -200,6 +224,11 @@ def _requested(op_name: str) -> Tuple[str, str]:
     'auto'} — the source decides how loudly a non-applicable choice fails."""
     if op_name in _OVERRIDES:
         return _OVERRIDES[op_name], "override"
+    own = _OP_ENV.get(op_name)
+    if own is not None:
+        choice = own()
+        if choice:
+            return choice, "env"
     env = _env_choices()
     if op_name in env:
         return env[op_name], "env"
@@ -322,3 +351,5 @@ def reset_dispatch_state() -> None:
     _OVERRIDES.clear()
     _warn_once.reset()
     _env_choices.reset()
+    for env in _OP_ENV.values():
+        env.reset()

@@ -1,0 +1,513 @@
+"""Blockwise quantized sync transport: error-bounded low-bit wire codecs.
+
+At multihost/DCN scale the sync wall is payload *bytes*: fused_sync already
+packs a guarded collection into ≤2 all-reduces (``parallel/sync.py``), every
+``AsyncSyncScheduler`` cycle re-ships the full state, and every fleet view
+blob pickles raw fp32 — so the remaining lever is the width of each lane on
+the wire. Per EQuARX (quantized all-reduce inside XLA) and DynamiQ
+(compressed multi-hop all-reduce, PAPERS.md), this module provides opt-in
+blockwise low-bit transport with *stated* worst-case error, registered as a
+dispatched op so one resolution rule covers every customer::
+
+    choice := programmatic argument   (fused_sync(transport=...),
+                                       Metric(sync_transport=...),
+                                       kernel_override(sync_transport=...))
+            | METRICS_TPU_SYNC_TRANSPORT   ("exact" | "fp16" | "int8")
+            | "exact"                       (the default)
+
+An unknown choice warns ONCE and falls back to ``exact`` — a bad env var
+degrades bytes, never correctness (the ``ops/dispatch.py`` contract).
+
+**Block scheme.** A flat f32 vector is split into blocks of
+``DEFAULT_BLOCK`` lanes; each block carries one f32 scale =
+``max(|finite x|)`` over the block (floored at the smallest normal f32).
+
+- ``int8``: finite lanes encode as ``round(x / scale * 126)`` clipped to
+  ``[-126, 126]``; the three spare codes are NaN/±inf passthrough lanes
+  (``-128`` → NaN, ``127`` → +inf, ``-127`` → −inf), reconstructed exactly.
+  Worst-case absolute error per lane is ``scale / 252`` — i.e. relative to
+  the block's absmax, at most ``1/252 ≈ 0.40%``. DENORMAL COLLAPSE: lanes
+  below the smallest normal f32 (``2**-126``) may decode to exactly zero —
+  XLA's flush-to-zero semantics can zero them before the scale is even
+  computed — so the envelope for denormal lanes is "absolute error below
+  ``2**-126``", far beneath any metric's meaningful precision (this is
+  also the one regime where the jax and numpy twins may differ: both stay
+  inside the envelope, numpy without FTZ quantizing, jax flushing).
+  Single-lane blocks (scalar sum states) decode to within 2 ulp of their
+  input — the lane is its own block absmax, so only the two f32 scale
+  roundings remain. Wire cost: 1 byte/lane + 4 bytes/block scale (1.125
+  B/lane at block 32, ~3.6× fewer bytes than f32).
+- ``fp16``: lanes are normalized by the block scale and stored as float16
+  (NaN/±inf are natively representable — ``x/scale`` of a non-finite lane
+  stays non-finite). Per-lane relative error ≤ ``2**-10`` for lanes at
+  least ``2**-14`` of the block absmax; smaller lanes have absolute error
+  ≤ ``absmax * 2**-24`` (fp16 subnormal granularity). The WIRE dtype is
+  int16: scale/tail lanes are bit patterns, and a float psum would quiet
+  any lane that happens to form a signaling-NaN pattern — integer adds
+  preserve every lane exactly. Wire cost: 2 bytes/lane + 4 bytes/block
+  (~2× fewer bytes than f32).
+- ``exact``: the identity codec — f32 in, f32 out, bit-identical. Every
+  customer's behavior with transport ``exact`` is *the same code path* as
+  before this layer existed (pinned in tests and the
+  ``quantized_fused_step`` registry entry).
+
+**Exact tails.** Lossless lanes (sketch level ``counts``/``n_seen``, any
+counter riding a packed payload) NEVER quantize: ``encode(x, exact_tail=t)``
+ships the last ``t`` lanes bit-exact (f32 bit patterns carried in wire-dtype
+lanes), so transport quantization can only ever touch value lanes whose
+error budget is already stated.
+
+**Error composition.** A sketch's documented rank-error eps extends under
+quantized transport to ``eps_total = eps_sketch + eps_transport``, where
+``eps_transport`` is the rank mass a per-lane value perturbation of
+``absmax/252`` (int8) / ``2**-10`` relative (fp16) can move — bounded by
+the CDF's local density and pinned empirically by the property suite in
+``tests/ops/test_quantize.py`` across adversarial distributions.
+
+Both jax (in-graph, trace-safe, static shapes) and numpy (host-side: the
+overlapped gather path and the fleet wire) implementations are provided and
+kept bit-identical — the property suite asserts encode parity lane by lane.
+
+Module import performs python work only beyond importing jax/numpy — no
+jax calls, no device arrays (the hang-proof bootstrap contract,
+``utilities/backend.py``).
+"""
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ops import dispatch
+
+__all__ = [
+    "DEFAULT_BLOCK",
+    "MAX_CODE",
+    "CODE_NAN",
+    "CODE_POS_INF",
+    "CODE_NEG_INF",
+    "TINY_NORMAL",
+    "INT8_REL_ERROR_BOUND",
+    "FP16_REL_ERROR_BOUND",
+    "MIN_HOST_QUANTIZE_SIZE",
+    "TRANSPORTS",
+    "WireCodec",
+    "validate_transport",
+    "resolve_codec",
+    "blockwise_int8_encode_np",
+    "blockwise_int8_decode_np",
+    "host_encode",
+    "host_decode",
+    "wrap_gather_transport",
+]
+
+# 32-lane blocks: small enough that a block of a SORTED payload (each
+# quantile-sketch level is a sorted run — the dominant quantized bytes)
+# spans a narrow value range, so the absmax-relative error stays small
+# relative to every lane in the block even on 50-decade-skewed streams;
+# scale overhead is 4/32 = 12.5% (int8 ships 1.125 B/lane vs f32's 4)
+DEFAULT_BLOCK = 32
+MAX_CODE = 126  # finite int8 codes live in [-126, 126]
+CODE_NAN = -128  # the three spare codes are the NaN/±inf passthrough lanes
+CODE_POS_INF = 127
+CODE_NEG_INF = -127
+TINY_NORMAL = float(np.float32(2.0 ** -126))  # scale floor (denormal collapse)
+# worst-case per-lane error bounds (module docstring derivations)
+INT8_REL_ERROR_BOUND = 1.0 / (2 * MAX_CODE)  # |err| <= absmax_block / 252
+FP16_REL_ERROR_BOUND = 2.0 ** -10  # |err| <= max(|x| * 2**-10, absmax * 2**-24)
+# host-side gather leaves smaller than this ship exact: there is no byte win
+# on tiny leaves and scalar aggregates (a MeanMetric value) keep full width
+MIN_HOST_QUANTIZE_SIZE = 64
+
+TRANSPORTS = ("exact", "fp16", "int8")
+
+
+def _num_blocks(n: int, block: int) -> int:
+    return -(-int(n) // int(block)) if n > 0 else 0
+
+
+# --------------------------------------------------------------------------
+# jax core (in-graph, static shapes — safe under jit / shard_map)
+# --------------------------------------------------------------------------
+
+
+def _split(x: Any, exact_tail: int):
+    x = jnp.asarray(x, jnp.float32).reshape(-1)
+    t = int(exact_tail)
+    if not 0 <= t <= x.shape[0]:
+        raise ValueError(f"exact_tail={t} out of range for a {x.shape[0]}-lane payload")
+    return x[: x.shape[0] - t], x[x.shape[0] - t :]
+
+
+def _block_scales(x2: Any):
+    """Per-block f32 scale: max finite magnitude, floored at the smallest
+    normal f32 (all-zero / all-special / denormal blocks get the floor)."""
+    finite = jnp.isfinite(x2)
+    absmax = jnp.max(jnp.where(finite, jnp.abs(x2), jnp.float32(0)), axis=1)
+    return jnp.maximum(absmax, jnp.float32(TINY_NORMAL)), finite
+
+
+def _blocked(head: Any, block: int):
+    nb = _num_blocks(head.shape[0], block)
+    return jnp.pad(head, (0, nb * block - head.shape[0])).reshape(nb, block), nb
+
+
+def _int8_encode(x: Any, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> Any:
+    head, tail = _split(x, exact_tail)
+    x2, _nb = _blocked(head, block)
+    scales, finite = _block_scales(x2)
+    # specials are zeroed BEFORE the cast (int8-of-NaN is undefined), then
+    # overwritten with their passthrough codes
+    q = jnp.clip(
+        jnp.round(jnp.where(finite, x2, jnp.float32(0)) / scales[:, None] * jnp.float32(MAX_CODE)),
+        -MAX_CODE,
+        MAX_CODE,
+    ).astype(jnp.int8)
+    q = jnp.where(jnp.isnan(x2), jnp.int8(CODE_NAN), q)
+    q = jnp.where(x2 == jnp.inf, jnp.int8(CODE_POS_INF), q)
+    q = jnp.where(x2 == -jnp.inf, jnp.int8(CODE_NEG_INF), q)
+    return jnp.concatenate(
+        [
+            q.reshape(-1),
+            jax.lax.bitcast_convert_type(scales, jnp.int8).reshape(-1),
+            jax.lax.bitcast_convert_type(tail, jnp.int8).reshape(-1),
+        ]
+    )
+
+
+def _int8_decode(wire: Any, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> Any:
+    wire = jnp.asarray(wire, jnp.int8).reshape(-1)
+    t = int(exact_tail)
+    h = int(n) - t
+    nb = _num_blocks(h, block)
+    q = wire[: nb * block].reshape(nb, block)
+    scales = jax.lax.bitcast_convert_type(
+        wire[nb * block : nb * block + 4 * nb].reshape(nb, 4), jnp.float32
+    )
+    tail = jax.lax.bitcast_convert_type(
+        wire[nb * block + 4 * nb : nb * block + 4 * nb + 4 * t].reshape(t, 4), jnp.float32
+    )
+    vals = q.astype(jnp.float32) * (scales[:, None] / jnp.float32(MAX_CODE))
+    vals = jnp.where(q == CODE_NAN, jnp.float32(jnp.nan), vals)
+    vals = jnp.where(q == CODE_POS_INF, jnp.float32(jnp.inf), vals)
+    vals = jnp.where(q == CODE_NEG_INF, jnp.float32(-jnp.inf), vals)
+    return jnp.concatenate([vals.reshape(-1)[:h], tail])
+
+
+def _fp16_encode(x: Any, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> Any:
+    # the WIRE dtype is int16, not float16: wire lanes carry f32 scale and
+    # exact-tail BIT PATTERNS, and an fp16 psum would quiet any lane whose
+    # half happens to be a signaling-NaN pattern (IEEE x+0.0 flips the
+    # quiet bit), silently corrupting "bit-exact" scales/counters. Integer
+    # adds are exact, so bitcasting the whole wire to s16 preserves every
+    # lane through the scatter-psum (the int8 wire is integer already).
+    head, tail = _split(x, exact_tail)
+    x2, _nb = _blocked(head, block)
+    scales, _finite = _block_scales(x2)
+    h16 = (x2 / scales[:, None]).astype(jnp.float16)  # NaN/±inf pass natively
+    return jax.lax.bitcast_convert_type(
+        jnp.concatenate(
+            [
+                h16.reshape(-1),
+                jax.lax.bitcast_convert_type(scales, jnp.float16).reshape(-1),
+                jax.lax.bitcast_convert_type(tail, jnp.float16).reshape(-1),
+            ]
+        ),
+        jnp.int16,
+    )
+
+
+def _fp16_decode(wire: Any, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> Any:
+    wire = jax.lax.bitcast_convert_type(jnp.asarray(wire, jnp.int16).reshape(-1), jnp.float16)
+    t = int(exact_tail)
+    h = int(n) - t
+    nb = _num_blocks(h, block)
+    h16 = wire[: nb * block].reshape(nb, block)
+    scales = jax.lax.bitcast_convert_type(
+        wire[nb * block : nb * block + 2 * nb].reshape(nb, 2), jnp.float32
+    )
+    tail = jax.lax.bitcast_convert_type(
+        wire[nb * block + 2 * nb : nb * block + 2 * nb + 2 * t].reshape(t, 2), jnp.float32
+    )
+    vals = h16.astype(jnp.float32) * scales[:, None]
+    return jnp.concatenate([vals.reshape(-1)[:h], tail])
+
+
+def _exact_encode(x: Any, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> Any:
+    return jnp.asarray(x, jnp.float32).reshape(-1)
+
+
+def _exact_decode(wire: Any, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> Any:
+    return jnp.asarray(wire, jnp.float32).reshape(-1)
+
+
+# --------------------------------------------------------------------------
+# numpy twins (host side: overlapped gathers, fleet wire) — bit-identical
+# to the jax core (pinned lane-by-lane in tests/ops/test_quantize.py)
+# --------------------------------------------------------------------------
+
+
+def _blocked_np(head: np.ndarray, block: int):
+    """numpy twin of :func:`_blocked` (zero-pad to a block multiple)."""
+    nb = _num_blocks(head.shape[0], block)
+    x2 = np.zeros((nb, block), np.float32)
+    x2.reshape(-1)[: head.shape[0]] = head
+    return x2, nb
+
+
+def _block_scales_np(x2: np.ndarray, nb: int):
+    """numpy twin of :func:`_block_scales` — ONE definition of the scale
+    rule per implementation, because the lane-by-lane jax/numpy parity pin
+    would silently break if a floor or padding tweak missed a copy."""
+    finite = np.isfinite(x2)
+    absmax = (
+        np.max(np.where(finite, np.abs(x2), np.float32(0)), axis=1)
+        if nb
+        else np.zeros((0,), np.float32)
+    )
+    return np.maximum(absmax, np.float32(TINY_NORMAL)).astype(np.float32), finite
+
+
+def blockwise_int8_encode_np(x: Any, block: int = DEFAULT_BLOCK):
+    """``(codes int8 (nb*block,), scales f32 (nb,))`` for a flat f32 vector
+    — the piece the fleet wire stores per leaf (scales in the leaf header)."""
+    x = np.asarray(x, np.float32).reshape(-1)
+    x2, nb = _blocked_np(x, block)
+    scales, finite = _block_scales_np(x2, nb)
+    q = np.clip(
+        np.round(np.where(finite, x2, np.float32(0)) / scales[:, None] * np.float32(MAX_CODE)),
+        -MAX_CODE,
+        MAX_CODE,
+    ).astype(np.int8)
+    q = np.where(np.isnan(x2), np.int8(CODE_NAN), q)
+    q = np.where(x2 == np.inf, np.int8(CODE_POS_INF), q)
+    q = np.where(x2 == -np.inf, np.int8(CODE_NEG_INF), q)
+    return q.reshape(-1), scales
+
+
+def blockwise_int8_decode_np(codes: Any, scales: Any, n: int, block: int = DEFAULT_BLOCK):
+    codes = np.asarray(codes, np.int8).reshape(-1)
+    scales = np.asarray(scales, np.float32).reshape(-1)
+    nb = _num_blocks(n, block)
+    q = codes[: nb * block].reshape(nb, block)
+    vals = q.astype(np.float32) * (scales[:, None] / np.float32(MAX_CODE))
+    vals = np.where(q == CODE_NAN, np.float32(np.nan), vals)
+    vals = np.where(q == CODE_POS_INF, np.float32(np.inf), vals)
+    vals = np.where(q == CODE_NEG_INF, np.float32(-np.inf), vals)
+    return vals.reshape(-1)[: int(n)]
+
+
+def _int8_encode_np(x: Any, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    x = np.asarray(x, np.float32).reshape(-1)
+    t = int(exact_tail)
+    head, tail = x[: x.shape[0] - t], x[x.shape[0] - t :]
+    codes, scales = blockwise_int8_encode_np(head, block)
+    return np.concatenate([codes, scales.view(np.int8), tail.view(np.int8)])
+
+
+def _int8_decode_np(wire: Any, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    wire = np.asarray(wire, np.int8).reshape(-1)
+    t = int(exact_tail)
+    h = int(n) - t
+    nb = _num_blocks(h, block)
+    scales = wire[nb * block : nb * block + 4 * nb].view(np.float32)
+    tail = wire[nb * block + 4 * nb : nb * block + 4 * nb + 4 * t].view(np.float32)
+    head = blockwise_int8_decode_np(wire[: nb * block], scales, h, block)
+    return np.concatenate([head, tail])
+
+
+def _fp16_encode_np(x: Any, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    x = np.asarray(x, np.float32).reshape(-1)
+    t = int(exact_tail)
+    head, tail = x[: x.shape[0] - t], x[x.shape[0] - t :]
+    x2, nb = _blocked_np(head, block)
+    scales, _finite = _block_scales_np(x2, nb)
+    h16 = (x2 / scales[:, None]).astype(np.float16)
+    # int16 wire: bit patterns, not fp16 arithmetic lanes (see _fp16_encode)
+    return np.concatenate(
+        [h16.reshape(-1), scales.view(np.float16), tail.view(np.float16)]
+    ).view(np.int16)
+
+
+def _fp16_decode_np(wire: Any, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    wire = np.asarray(wire, np.int16).reshape(-1).view(np.float16)
+    t = int(exact_tail)
+    h = int(n) - t
+    nb = _num_blocks(h, block)
+    h16 = wire[: nb * block].reshape(nb, block)
+    scales = wire[nb * block : nb * block + 2 * nb].view(np.float32)
+    tail = wire[nb * block + 2 * nb : nb * block + 2 * nb + 2 * t].view(np.float32)
+    vals = h16.astype(np.float32) * scales.reshape(-1, 1)
+    return np.concatenate([vals.reshape(-1)[:h], tail])
+
+
+def _exact_encode_np(x: Any, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    return np.asarray(x, np.float32).reshape(-1)
+
+
+def _exact_decode_np(wire: Any, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    return np.asarray(wire, np.float32).reshape(-1)
+
+
+# --------------------------------------------------------------------------
+# the codec objects + dispatch registration
+# --------------------------------------------------------------------------
+
+
+class WireCodec(NamedTuple):
+    """One named wire transport: paired jax / numpy encode+decode over a
+    flat f32 payload with an optional bit-exact tail. Shapes are static
+    functions of ``(n, exact_tail, block)`` so the jax pair is safe inside
+    jit / shard_map."""
+
+    name: str
+    wire_dtype: Any  # jnp dtype of the in-graph wire (psum operand dtype)
+    np_wire_dtype: Any
+    lanes_per_scale: int  # wire lanes carrying one f32 block scale
+    lanes_per_exact: int  # wire lanes carrying one bit-exact f32 tail lane
+    encode: Callable  # (x, exact_tail=0, block=...) -> wire   (jax)
+    decode: Callable  # (wire, n, exact_tail=0, block=...) -> f32 (jax)
+    encode_np: Callable
+    decode_np: Callable
+
+    def wire_size(self, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> int:
+        if self.name == "exact":
+            return int(n)
+        nb = _num_blocks(int(n) - int(exact_tail), block)
+        return nb * block + self.lanes_per_scale * nb + self.lanes_per_exact * int(exact_tail)
+
+    def wire_bytes(self, n: int, exact_tail: int = 0, block: int = DEFAULT_BLOCK) -> int:
+        return self.wire_size(n, exact_tail, block) * np.dtype(self.np_wire_dtype).itemsize
+
+
+EXACT_CODEC = WireCodec(
+    name="exact",
+    wire_dtype=jnp.float32,
+    np_wire_dtype=np.float32,
+    lanes_per_scale=0,
+    lanes_per_exact=1,
+    encode=_exact_encode,
+    decode=_exact_decode,
+    encode_np=_exact_encode_np,
+    decode_np=_exact_decode_np,
+)
+
+FP16_CODEC = WireCodec(
+    name="fp16",
+    # int16, not float16: the wire carries bit patterns (half payload lanes
+    # + bitcast f32 scales/tails), and only integer psum lanes are immune
+    # to IEEE NaN-quieting — see _fp16_encode
+    wire_dtype=jnp.int16,
+    np_wire_dtype=np.int16,
+    lanes_per_scale=2,
+    lanes_per_exact=2,
+    encode=_fp16_encode,
+    decode=_fp16_decode,
+    encode_np=_fp16_encode_np,
+    decode_np=_fp16_decode_np,
+)
+
+INT8_CODEC = WireCodec(
+    name="int8",
+    wire_dtype=jnp.int8,
+    np_wire_dtype=np.int8,
+    lanes_per_scale=4,
+    lanes_per_exact=4,
+    encode=_int8_encode,
+    decode=_int8_decode,
+    encode_np=_int8_encode_np,
+    decode_np=_int8_decode_np,
+)
+
+# the dispatched op: one resolution rule (programmatic > METRICS_TPU_SYNC_
+# TRANSPORT > exact) shared by fused_sync, the overlapped metric cycle,
+# ServeLoop's background reduce, and anything else that moves state bytes
+_OP = dispatch.register_op("sync_transport", default="exact", env_var="METRICS_TPU_SYNC_TRANSPORT")
+_OP.impl("exact")(lambda: EXACT_CODEC)
+_OP.impl("fp16")(lambda: FP16_CODEC)
+_OP.impl("int8")(lambda: INT8_CODEC)
+
+
+def validate_transport(name: Optional[str]) -> Optional[str]:
+    """Raise on unknown PROGRAMMATIC transport names (``None`` passes —
+    it means "resolve the env-backed default"). Ctor typos are code bugs
+    and raise eagerly; env-var typos get the warn-once fallback instead.
+    The one definition every `sync_transport=` constructor shares."""
+    if name is not None and name not in TRANSPORTS:
+        raise ValueError(f"`sync_transport` must be one of {TRANSPORTS}, got {name!r}")
+    return name
+
+
+def resolve_codec(choice: Optional[str] = None) -> WireCodec:
+    """The one entry point customers resolve their transport through.
+
+    ``choice=None`` follows the dispatch rule (override > env > ``exact``);
+    a concrete name forces that codec for this call with the env-forced
+    stance (unknown names warn once and fall back to ``exact``). Resolution
+    happens at call time — trace time under jit, so the choice is baked
+    into the compiled graph like every other ``METRICS_TPU_*`` perf knob.
+    """
+    if choice is None:
+        return dispatch.call("sync_transport")
+    return dispatch.call_as("sync_transport", str(choice))
+
+
+# --------------------------------------------------------------------------
+# host wire (self-describing: one int32 length header bit-carried in wire
+# lanes, so ragged per-rank rows — e.g. pre-concat 'cat' states — decode
+# without out-of-band shape)
+# --------------------------------------------------------------------------
+
+
+def host_encode(arr: Any, codec: WireCodec, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    """One host array -> a self-describing 1-D wire (numpy)."""
+    flat = np.asarray(arr, np.float32).reshape(-1)
+    header = np.asarray([flat.shape[0]], np.int32).view(codec.np_wire_dtype)
+    return np.concatenate([header, codec.encode_np(flat, 0, block)])
+
+
+def host_decode(wire: Any, codec: WireCodec, block: int = DEFAULT_BLOCK) -> np.ndarray:
+    """Inverse of :func:`host_encode` -> flat f32 values."""
+    wire = np.asarray(wire, codec.np_wire_dtype).reshape(-1)
+    lanes = np.dtype(np.int32).itemsize // np.dtype(codec.np_wire_dtype).itemsize
+    n = int(wire[:lanes].view(np.int32)[0])
+    return codec.decode_np(wire[lanes:], n, 0, block)
+
+
+def wrap_gather_transport(gather: Callable, codec: WireCodec) -> Callable:
+    """Wrap a process-level gather (``dist_sync_fn`` signature:
+    ``(array, group=None) -> [per-rank arrays]``) so floating leaves ship
+    as the codec's wire and decode per rank.
+
+    Integer / bool leaves (counters, CountMin counts, HLL registers,
+    CatBuffer masks) ALWAYS bypass — lossless paths stay lossless — as do
+    floating leaves smaller than :data:`MIN_HOST_QUANTIZE_SIZE` (scalar
+    aggregates keep full width; there is no byte win on tiny leaves). The
+    wire is self-describing (:func:`host_encode`), so ragged per-rank rows
+    — different 'cat' lengths per rank — decode correctly.
+    """
+    if codec.name == "exact":
+        return gather
+
+    def quantized_gather(x: Any, group: Any = None) -> Any:
+        arr = np.asarray(x)
+        # f64 leaves bypass too: the wire is f32-based, so squeezing a
+        # float64 accumulator through it would silently destroy values
+        # beyond f32 range/precision — outside the documented envelope
+        if (
+            arr.dtype not in (np.float32, np.float16)
+            or arr.size < MIN_HOST_QUANTIZE_SIZE
+        ):
+            return gather(x, group)
+        # rows may be RAGGED in the leading axis (pre-concat 'cat' states);
+        # trailing dims are config-fixed, so each row reshapes to (-1, *rest)
+        trailing = arr.shape[1:]
+        rows = gather(host_encode(arr, codec), group)
+        return [
+            jnp.asarray(
+                host_decode(np.asarray(row), codec).astype(arr.dtype).reshape((-1,) + trailing)
+            )
+            for row in rows
+        ]
+
+    return quantized_gather

@@ -201,6 +201,7 @@ def fused_sync(
     reductions: Sequence[Dict[str, Reduction]],
     axis_name: str,
     defaults: Optional[Sequence[Dict[str, Any]]] = None,
+    transport: Optional[str] = None,
 ) -> List[Dict[str, Any]]:
     """Sync many metrics' states with one collective per (reduction, dtype).
 
@@ -220,11 +221,30 @@ def fused_sync(
     gather-merge payload — a guarded collection with sketch states still
     syncs in ≤2 all-reduces (HLO-pinned in ``tests/streaming``).
 
+    ``transport`` selects the wire codec for the float sum bucket and the
+    sketch gather payload (``ops/quantize.py``; ``None`` resolves
+    programmatic override > ``METRICS_TPU_SYNC_TRANSPORT`` > ``"exact"``
+    at trace time). With a non-``exact`` codec those lanes quantize
+    blockwise, scatter into ONE wire psum (the same collective slot the
+    exact path's gather payload occupies — the ≤2-all-reduce budget is
+    unchanged, pinned by the ``quantized_fused_step`` registry entry), and
+    dequantize after: each device's contribution is quantized once with
+    its own per-block scales, so the error per lane is bounded by the
+    codec's documented per-block envelope times the device count. Integer
+    and counter buckets (int32 states, the uint32 fault channel, CountMin
+    counts, HLL registers) and sketch level counts ALWAYS bypass — the
+    lossless paths stay lossless — and ``transport="exact"`` (the default)
+    takes literally the pre-existing code path, bit-identical.
+
     ``defaults`` (optional, one dict per metric) supplies templates for
     empty list states, as in :func:`sync_state`.
     """
+    from metrics_tpu.ops.quantize import resolve_codec
     from metrics_tpu.utilities.guard import FaultCounters
     from metrics_tpu.utilities.ringbuffer import CatBuffer
+
+    codec = resolve_codec(transport)
+    quantized = codec.name != "exact"
 
     buckets: Dict[Tuple[str, Any], List[Tuple[int, str, Array]]] = {}
     fault_slots: set = set()
@@ -234,6 +254,8 @@ def fused_sync(
     struct_slots: Dict[Tuple[int, str], Any] = {}
     # compaction-merged sketches (quantile) share ONE fused gather payload
     gather_merge: List[Tuple[int, str, Any]] = []
+    # float sum leaves diverted to the quantized wire (non-exact transport)
+    wire_leaves: List[Tuple[int, str, Array]] = []
     passthrough: List[Tuple[int, str, Array, Reduction]] = []
     for i, (state, reds) in enumerate(zip(states, reductions)):
         for name, value in state.items():
@@ -250,11 +272,22 @@ def fused_sync(
                 else:
                     gather_merge.append((i, name, value))
             elif fx in ("sum", "mean", "max", "min") and isinstance(value, jax.Array):
-                buckets.setdefault((fx, value.dtype), []).append((i, name, value))
+                # f64 never rides the (f32-based) wire — the repo-wide no-f64
+                # budget makes this unreachable in audited graphs, but a
+                # user-built f64 state must not lose range silently
+                if (
+                    quantized
+                    and fx == "sum"
+                    and jnp.issubdtype(value.dtype, jnp.floating)
+                    and value.dtype != jnp.float64
+                ):
+                    wire_leaves.append((i, name, value))
+                else:
+                    buckets.setdefault((fx, value.dtype), []).append((i, name, value))
             else:
                 passthrough.append((i, name, value, fx))
 
-    if gather_merge:
+    if gather_merge and not quantized:
         # all quantile-style sketches of the whole collection ride ONE
         # gathered payload — and the gather itself is expressed as
         # scatter-into-zeros + psum (exactly what `_all_gather_invariant`
@@ -284,7 +317,7 @@ def fused_sync(
             else:
                 out[i][name] = leaf
             offset += v.size
-    if gather_merge:
+    if gather_merge and not quantized:
         per_dev = gathered_payload.reshape(-1, sum(v.packed_size for (_, _, v) in gather_merge))
         offset = 0
         for (i, name, v) in gather_merge:
@@ -295,6 +328,8 @@ def fused_sync(
                 merged = s if merged is None else merged.sketch_merge(s)
             out[i][name] = merged
             offset += size
+    if quantized and (wire_leaves or gather_merge):
+        _quantized_wire_sync(out, wire_leaves, gather_merge, codec, axis_name)
     for (i, name, value, fx) in passthrough:
         if isinstance(value, CatBuffer):
             out[i][name] = sync_cat_buffer(value, axis_name)
@@ -309,6 +344,79 @@ def fused_sync(
             fx = "cat" if fx in ("cat", None) else fx
         out[i][name] = sync_leaf(value, fx, axis_name)
     return out
+
+
+def _quantized_wire_sync(
+    out: List[Dict[str, Any]],
+    wire_leaves: List[Tuple[int, str, Array]],
+    gather_merge: List[Tuple[int, str, Any]],
+    codec: Any,
+    axis_name: str,
+) -> None:
+    """The quantized transport wire: encode → one scatter-psum → decode.
+
+    Every diverted float-sum leaf and every quantile-sketch payload encodes
+    PER LEAF (block boundaries never cross leaves — a tiny-magnitude leaf
+    sharing a block with a huge one would be crushed by the shared scale)
+    into one concatenated low-bit wire, scattered into disjoint per-device
+    slices of a ``(ndev * W,)`` zeros vector and ``psum``-ed ONCE — the
+    identical collective structure the exact path's gather payload uses,
+    so the collection's all-reduce budget is unchanged while every wire
+    lane is 1 (int8) or 2 (fp16) bytes instead of 4. Disjoint scatter means
+    the psum never accumulates quantized codes (other devices contribute
+    zeros), so int8 lanes cannot overflow and per-device scales travel
+    bit-exact (bitcast into wire lanes).
+
+    After the psum each device decodes every device's slices: float-sum
+    leaves sum their ``ndev`` dequantized contributions locally (each
+    quantized once with its own per-block scales — per-lane error ≤ ndev ×
+    the codec's block envelope); sketch payloads unpack-and-merge exactly
+    as the exact gather path does, with their level counts and ``n_seen``
+    lanes riding the wire's bit-exact tail (counters NEVER quantize).
+    """
+    segments = []  # (kind, i, name, flat f32 payload, exact_tail, original)
+    for (i, name, v) in wire_leaves:
+        segments.append(("leaf", i, name, v.astype(jnp.float32).ravel(), 0, v))
+    for (i, name, v) in gather_merge:
+        # packed layout (streaming/sketches.py): items (L*k) then counts (L)
+        # and the split n_seen (2) — the last L+2 lanes are exact counters
+        segments.append(("sketch", i, name, v.pack(), v.counts.shape[0] + 2, v))
+    wires = [codec.encode(vec, tail) for (_, _, _, vec, tail, _) in segments]
+    sizes = [w.shape[0] for w in wires]
+    wire = jnp.concatenate(wires)
+    # trace-time observability: the wire bytes each device ships per step
+    # vs the f32 lanes it replaces (a host-side instant, never a graph op)
+    from metrics_tpu.obs import trace as _obs_trace
+
+    _obs_trace.instant(
+        "sync.quantized_wire",
+        transport=codec.name,
+        wire_bytes=int(wire.shape[0] * wire.dtype.itemsize),
+        exact_bytes=int(sum(vec.shape[0] for (_, _, _, vec, _, _) in segments) * 4),
+    )
+    ndev = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    wide = jnp.zeros((ndev * wire.shape[0],), wire.dtype)
+    wide = jax.lax.dynamic_update_slice(wide, wire, (idx * wire.shape[0],))
+    per_dev = jax.lax.psum(wide, axis_name).reshape(-1, wire.shape[0])
+    offset = 0
+    for (kind, i, name, vec, tail, orig), size in zip(segments, sizes):
+        rows = [
+            codec.decode(per_dev[d, offset : offset + size], vec.shape[0], tail)
+            for d in range(per_dev.shape[0])
+        ]
+        if kind == "leaf":
+            total = rows[0]
+            for r in rows[1:]:
+                total = total + r
+            out[i][name] = total.reshape(orig.shape).astype(orig.dtype)
+        else:
+            merged = None
+            for r in rows:
+                s = type(orig).unpack_like(r, orig)
+                merged = s if merged is None else merged.sketch_merge(s)
+            out[i][name] = merged
+        offset += size
 
 
 # --------------------------------------------------------------------------
@@ -333,6 +441,13 @@ def _pad_gather_trim(array: Array, allgather: Any) -> List[Array]:
     # (scalars have nothing to pad — jnp.pad rejects an empty width list)
     pad = [(0, int(m - s)) for s, m in zip(array.shape, max_shape)]
     padded = jnp.pad(array, pad) if pad else array
+    # per-transport byte accounting (obs satellite): what THIS process ships
+    # into the payload gather — a quantized transport hands this function
+    # its encoded wire, so the counter reflects the actual on-wire bytes
+    # (the 8-byte shape gather is noise and not counted)
+    from metrics_tpu.obs.runtime_metrics import registry as _obs_registry
+
+    _obs_registry.counter("sync_payload_bytes").inc(int(padded.size) * padded.dtype.itemsize)
     gathered = allgather(padded)  # (nproc, *max_shape)
     if np.asarray(gathered).shape[0] != all_shapes.shape[0]:
         # one of the two collectives degraded to local-only (see

@@ -348,12 +348,17 @@ class OverlappedDef(NamedTuple):
     dropped: Callable[[Dict[str, Any]], Any] = None
 
 
-def _fused_sync_tree(metric: "Metric", axis_name: str) -> Callable[[Any], Any]:
+def _fused_sync_tree(
+    metric: "Metric", axis_name: str, transport: Optional[str] = None
+) -> Callable[[Any], Any]:
     """Build ``state -> globally-synced state`` as ONE ``fused_sync`` over
     every leaf row of a metric / trace-safe wrapper / collection — one
     overlapped cycle per fused compute-group, preserving the collection's
     per-cycle collective budget (the blocking compute path syncs wrapper
-    members separately; the cycle fuses them into the same buckets)."""
+    members separately; the cycle fuses them into the same buckets).
+    ``transport`` names the wire codec for the float-sum/sketch lanes
+    (``ops/quantize.py``; ``None`` resolves the env-backed default at
+    trace time)."""
     from metrics_tpu.collections import MetricCollection  # local import to avoid cycle
     from metrics_tpu.parallel.sync import fused_sync
 
@@ -378,6 +383,7 @@ def _fused_sync_tree(metric: "Metric", axis_name: str) -> Callable[[Any], Any]:
                 [r for _, _, r, _ in row_meta],
                 axis_name,
                 defaults=[d for _, _, _, d in row_meta],
+                transport=transport,
             )
             out = {
                 name: (list(state[name]) if name in wrapper_names else state[name])
@@ -398,7 +404,9 @@ def _fused_sync_tree(metric: "Metric", axis_name: str) -> Callable[[Any], Any]:
         defs = [n._sync_defaults() for n in nodes]
 
         def sync_tree(states):
-            return fused_sync([dict(s) for s in states], reds, axis_name, defaults=defs)
+            return fused_sync(
+                [dict(s) for s in states], reds, axis_name, defaults=defs, transport=transport
+            )
 
         return sync_tree
 
@@ -406,18 +414,36 @@ def _fused_sync_tree(metric: "Metric", axis_name: str) -> Callable[[Any], Any]:
     defs_one = metric._sync_defaults()
 
     def sync_tree(state):
-        return fused_sync([dict(state)], [reds_one], axis_name, defaults=[defs_one])[0]
+        return fused_sync(
+            [dict(state)], [reds_one], axis_name, defaults=[defs_one], transport=transport
+        )[0]
 
     return sync_tree
 
 
-def overlapped_functionalize(metric: "Metric", axis_name: Optional[str] = None) -> OverlappedDef:
+def overlapped_functionalize(
+    metric: "Metric",
+    axis_name: Optional[str] = None,
+    sync_transport: Optional[str] = None,
+) -> OverlappedDef:
     """Build the overlapped (double-buffered) pure API for a metric or
     collection — see :class:`OverlappedDef` for the state layout and
     semantics. ``axis_name=None`` degrades the cycle's collective to the
     identity snapshot (single-device semantics: the reduced buffer is a
     consistent copy of the live one), which keeps the state layout — and
     its recompile stability — identical across regimes.
+
+    ``sync_transport`` names the wire codec the CYCLE's fused sync ships
+    its float-sum/sketch lanes through (``"exact"`` | ``"fp16"`` |
+    ``"int8"``, ``ops/quantize.py``; ``None`` resolves
+    ``METRICS_TPU_SYNC_TRANSPORT`` > ``"exact"`` at trace time). The
+    overlapped cycle is the natural quantization customer: readers consume
+    an at-most-one-cycle-stale view anyway, so compressed cycles trade
+    precision nobody reads at full width for DCN bandwidth — within the
+    codec's documented per-block error envelope; counters and int states
+    stay bit-exact. ``read_fresh`` — the blocking full-precision escape
+    hatch — ALWAYS syncs with the ``exact`` transport, whatever the cycle
+    ships.
 
     Example (single-device form)::
 
@@ -429,9 +455,20 @@ def overlapped_functionalize(metric: "Metric", axis_name: Optional[str] = None) 
     """
     import jax.numpy as jnp
 
+    from metrics_tpu.ops.quantize import validate_transport
+
+    validate_transport(sync_transport)
     mdef = functionalize(metric)  # NO axis: local update + local compute
     sync_tree = (
-        _fused_sync_tree(metric, axis_name) if axis_name is not None else (lambda s: s)
+        _fused_sync_tree(metric, axis_name, transport=sync_transport)
+        if axis_name is not None
+        else (lambda s: s)
+    )
+    # the blocking escape hatch reads at full width: exact wire, always
+    sync_tree_fresh = (
+        _fused_sync_tree(metric, axis_name, transport="exact")
+        if axis_name is not None
+        else (lambda s: s)
     )
 
     def init() -> Dict[str, Any]:
@@ -462,7 +499,7 @@ def overlapped_functionalize(metric: "Metric", axis_name: Optional[str] = None) 
         return mdef.compute(state["reduced"])
 
     def read_fresh(state: Dict[str, Any]) -> Any:
-        return mdef.compute(sync_tree(state["live"]))
+        return mdef.compute(sync_tree_fresh(state["live"]))
 
     def lag(state: Dict[str, Any]) -> Any:
         return state["steps"] - state["covered"]

@@ -184,6 +184,7 @@ class ServeLoop:
         reduce_every_s: float = 0.25,
         snapshot_manager: Optional[Any] = None,
         snapshot_every_s: Optional[float] = None,
+        sync_transport: Optional[str] = None,
     ) -> None:
         if workers < 1:
             raise ValueError(f"`workers` must be >= 1, got {workers}")
@@ -191,6 +192,16 @@ class ServeLoop:
             raise ValueError(f"`queue_size` must be >= 1, got {queue_size}")
         if snapshot_every_s is not None and snapshot_manager is None:
             raise ValueError("`snapshot_every_s` needs a `snapshot_manager`")
+        # quantized sync transport (ops/quantize.py): the wire codec the
+        # BACKGROUND reduce's cross-process gathers ship float state through
+        # — the served report is a deliberately-stale view already, so a
+        # compressed reduce trades precision nobody reads at full width for
+        # DCN bandwidth (multi-host pods only; the in-process fold is
+        # byte-free either way). None resolves METRICS_TPU_SYNC_TRANSPORT >
+        # 'exact' per reduce; counters / int states always stay bit-exact.
+        from metrics_tpu.ops.quantize import validate_transport
+
+        self.sync_transport = validate_transport(sync_transport)
         self.workers = workers
         self.reduce_every_s = float(reduce_every_s)
         self._proto = metric
@@ -378,6 +389,19 @@ class ServeLoop:
 
     def _reduce_view_inner(self, snaps: List[_Snapshot]) -> Dict[str, Any]:
         reporter = _clone(self._proto)
+        from metrics_tpu.ops.quantize import resolve_codec, wrap_gather_transport
+
+        codec = resolve_codec(self.sync_transport)
+        if codec.name != "exact":
+            # the reporter's compute() runs the members' cross-process sync;
+            # route its gathers through the quantized wire (reporter-local:
+            # the prototype and the worker replicas are never touched)
+            from metrics_tpu.parallel.sync import gather_all_arrays
+
+            for _name, m in _members(reporter):
+                m.dist_sync_fn = wrap_gather_transport(
+                    m.dist_sync_fn or gather_all_arrays, codec
+                )
         for snap in snaps:
             _fold_snapshot(reporter, snap)
         value = reporter.compute() if snaps else None

@@ -79,6 +79,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "transport: the quantized sync transport layer (ops/quantize.py — "
+        "blockwise int8/fp16 wire codecs, the fused_sync quantized wire, "
+        "overlapped-cycle compressed gathers, the int8 fleet view encoding) "
+        "with its error-bound property suite and exact-mode bit-identity "
+        "pins; select with -m transport, or run the lane via "
+        "`make test-transport`",
+    )
+    config.addinivalue_line(
+        "markers",
         "async_sync: the overlapped async sync layer (parallel/async_sync.py "
         "scheduler, Metric(sync_mode='overlapped'), pure.py::"
         "overlapped_functionalize) — double-buffered zero-collective-latency "

@@ -140,6 +140,68 @@ class TestPublishing:
             FleetPublisher(_metric(), RecordingChannel(), host_id="")
 
 
+class TestQuantizedEncoding:
+    """ISSUE 12: the publisher opts into blockwise-int8 + zlib view blobs
+    (programmatic ``encoding=`` > ``METRICS_TPU_FLEET_ENCODING``), and the
+    encoded bytes are observable via the ``fleet_blob_bytes`` counter."""
+
+    def _sketch_metric(self):
+        m = mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5,))
+        m.update(jnp.asarray(np.random.default_rng(7).lognormal(0, 2, 8000).astype(np.float32)))
+        return m
+
+    @pytest.mark.transport
+    def test_encoding_knob_shrinks_blobs_and_feeds_counter(self):
+        from metrics_tpu.fleet.wire import ENCODING_INT8, decode_view
+        from metrics_tpu.obs.runtime_metrics import registry as obs_registry
+
+        m = self._sketch_metric()
+        exact_ch, int8_ch = RecordingChannel(), RecordingChannel()
+        pub_exact = FleetPublisher(m, exact_ch, host_id="h-e", start=False)
+        pub_int8 = FleetPublisher(m, int8_ch, host_id="h-q", start=False, encoding="int8")
+        before = obs_registry.counter("fleet_blob_bytes").value
+        assert pub_exact.publish_now()["default"] == "ok"
+        assert pub_int8.publish_now()["default"] == "ok"
+        shipped = obs_registry.counter("fleet_blob_bytes").value - before
+        assert shipped == len(exact_ch.blobs[0]) + len(int8_ch.blobs[0])
+        # acceptance: the sketch-heavy view blob drops >= 3x under int8
+        assert len(exact_ch.blobs[0]) / len(int8_ch.blobs[0]) >= 3.0
+        header, payload = decode_view(int8_ch.blobs[0])
+        assert header["encoding"] == ENCODING_INT8
+        fresh = mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5,))
+        fresh.load_snapshot_state(payload)
+        ref = float(m.compute())
+        assert abs(float(fresh.compute()) - ref) / abs(ref) < 0.05
+
+    @pytest.mark.transport
+    def test_env_var_opts_in_and_aggregator_folds(self, monkeypatch):
+        from metrics_tpu.fleet.wire import ENCODING_INT8, decode_view, reset_wire_env_state
+
+        monkeypatch.setenv("METRICS_TPU_FLEET_ENCODING", "int8")
+        reset_wire_env_state()
+        try:
+            m = self._sketch_metric()
+            agg = Aggregator(
+                mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5,)),
+                node_id="global",
+            )
+            channel = RecordingChannel(agg.ingest)
+            pub = FleetPublisher(m, channel, host_id="h-env", start=False)
+            assert pub.publish_now()["default"] == "ok"
+            assert decode_view(channel.blobs[0])[0]["encoding"] == ENCODING_INT8
+            # the aggregator (token-driven decode) folds the quantized view
+            ref = float(m.compute())
+            assert abs(agg.report()["value"] - ref) / abs(ref) < 0.05
+        finally:
+            reset_wire_env_state()
+
+    def test_programmatic_typo_raises_at_construction(self):
+        from metrics_tpu.fleet.wire import WireError
+
+        with pytest.raises(WireError, match="unknown fleet encoding"):
+            FleetPublisher(_metric(), RecordingChannel(), host_id="h", encoding="int4")
+
+
 class TestDegradation:
     def test_dead_destination_degrades_never_blocks(self):
         channel = DeadChannel()

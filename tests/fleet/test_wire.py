@@ -10,13 +10,18 @@ import pytest
 
 import metrics_tpu as mt
 from metrics_tpu.fleet.wire import (
+    ENCODING,
+    ENCODING_INT8,
     MAGIC,
     SCHEMA_VERSION,
+    SUPPORTED_ENCODINGS,
     WireCorruptionError,
     WireError,
     WireSchemaError,
     decode_view,
     encode_view,
+    reset_wire_env_state,
+    resolve_fleet_encoding,
 )
 from tests.helpers.fault_injection import bitflip_blob, truncate_blob
 
@@ -126,17 +131,157 @@ class TestRefusals:
         with pytest.raises(WireSchemaError, match="upgrade"):
             decode_view(pickle.dumps(record))
 
-    def test_unknown_encoding_refused(self):
+    def test_unknown_encoding_refused_listing_supported(self):
         """The compressed-transport forward-compatibility gate: an encoding
         token this build does not implement is refused loudly, never
-        mis-decoded."""
+        mis-decoded — and the message lists every encoding this build DOES
+        support, so a mixed-version fleet rollout is actionable."""
         _m, payload = _payload()
         record = pickle.loads(encode_view(payload, host_id="h", seq=1))
-        record["header"]["encoding"] = "equarx-int8-v1"
+        record["header"]["encoding"] = "equarx-int4-v1"
         from metrics_tpu.resilience.snapshot import _checksum_tree
 
         record["checksums"] = _checksum_tree(
             {"header": record["header"], "payload": record["payload"]}
         )
-        with pytest.raises(WireSchemaError, match="encoding"):
+        with pytest.raises(WireSchemaError, match="encoding") as err:
             decode_view(pickle.dumps(record))
+        for token in SUPPORTED_ENCODINGS:
+            assert token in str(err.value)
+
+
+def _sketch_payload(seed: int = 9, n: int = 20000):
+    rng = np.random.default_rng(seed)
+    m = mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5, 0.99))
+    m.update(jnp.asarray(rng.lognormal(0, 3, n).astype(np.float32)))
+    return m, m.snapshot_state()
+
+
+class TestQuantizedEncoding:
+    """The int8-zlib-v1 fleet payload encoding (ISSUE 12)."""
+
+    @pytest.fixture(autouse=True)
+    def _clean_env(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_FLEET_ENCODING", raising=False)
+        reset_wire_env_state()
+        yield
+        reset_wire_env_state()
+
+    def test_int8_blob_folds_within_eps_and_shrinks(self):
+        m, payload = _sketch_payload()
+        blob_exact = encode_view(payload, host_id="h", seq=1)
+        blob_int8 = encode_view(payload, host_id="h", seq=2, encoding="int8")
+        # acceptance: the sketch-heavy view blob drops >= 3x
+        assert len(blob_exact) / len(blob_int8) >= 3.0
+        header, decoded = decode_view(blob_int8)
+        assert header["encoding"] == ENCODING_INT8
+        fresh = mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5, 0.99))
+        fresh.load_snapshot_state(decoded)
+        # quantile reads stay within the extended eps_total rank contract
+        ref = np.asarray(m.compute())
+        out = np.asarray(fresh.compute())
+        stream = np.sort(
+            np.random.default_rng(9).lognormal(0, 3, 20000).astype(np.float32)
+        )
+
+        def rank(v):
+            return np.searchsorted(stream, v) / stream.size
+
+        for r, o in zip(ref.ravel(), out.ravel()):
+            assert abs(rank(r) - rank(o)) <= 0.02 + 0.01, (r, o)
+        # the sketch's exact counters survive bit-exact (lossless leaves)
+        assert decoded["states"]["sketch"]["n_seen"] == payload["states"]["sketch"]["n_seen"]
+        assert np.array_equal(
+            decoded["states"]["sketch"]["counts"], payload["states"]["sketch"]["counts"]
+        )
+
+    def test_corrupt_encoded_payload_refused_naming_host_and_leaf(self):
+        """A bit flip inside the zlib-compressed codes fails that leaf's
+        checksum — refused naming host + leaf, BEFORE any dequantization."""
+        _m, payload = _sketch_payload()
+        blob = encode_view(payload, host_id="host-q", seq=5, encoding="int8")
+        refused = 0
+        for pos in range(len(blob) // 3, len(blob) - 64, len(blob) // 5):
+            try:
+                decode_view(bitflip_blob(blob, position=pos))
+            except WireError:
+                refused += 1
+        assert refused >= 1
+        # mid-blob lands inside the dominant leaf (the zlib-ed items codes):
+        # the refusal names the publishing host and the offending leaf
+        with pytest.raises(WireCorruptionError, match=r"host='host-q'.*leaf"):
+            decode_view(bitflip_blob(blob, position=len(blob) // 2))
+
+    def test_mixed_encoding_fleet_folds(self):
+        """One int8 host among exact hosts: the fold is token-driven per
+        blob, so the merged value matches the all-exact fold within the
+        transport envelope."""
+        rng = np.random.default_rng(4)
+        streams = [rng.lognormal(0, 2, 8000).astype(np.float32) for _ in range(3)]
+        payloads = []
+        for s in streams:
+            m = mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5, 0.99))
+            m.update(jnp.asarray(s))
+            payloads.append(m.snapshot_state())
+        def fold(blobs):
+            merged = None
+            for blob in blobs:
+                _h, payload = decode_view(blob)
+                fresh = mt.QuantileSketch(eps=0.02, max_items=1 << 20, quantiles=(0.5, 0.99))
+                fresh.load_snapshot_state(payload)
+                if merged is None:
+                    merged = fresh
+                else:
+                    merged.sketch = merged.sketch.sketch_merge(fresh.sketch)
+            return np.asarray(merged.compute())
+
+        exact_blobs = [
+            encode_view(p, host_id=f"h{i}", seq=i + 1) for i, p in enumerate(payloads)
+        ]
+        mixed_blobs = [
+            encode_view(
+                p,
+                host_id=f"h{i}",
+                seq=i + 1,
+                encoding="int8" if i == 1 else "exact",
+            )
+            for i, p in enumerate(payloads)
+        ]
+        ref = fold(exact_blobs)
+        out = fold(mixed_blobs)
+        world = np.sort(np.concatenate(streams))
+
+        def rank(v):
+            return np.searchsorted(world, v) / world.size
+
+        for r, o in zip(ref.ravel(), out.ravel()):
+            assert abs(rank(r) - rank(o)) <= 0.02 + 0.01, (r, o)
+
+    def test_env_var_resolution_and_fallback(self, monkeypatch):
+        assert resolve_fleet_encoding() == ENCODING
+        monkeypatch.setenv("METRICS_TPU_FLEET_ENCODING", "int8")
+        reset_wire_env_state()
+        assert resolve_fleet_encoding() == ENCODING_INT8
+        assert resolve_fleet_encoding("exact") == ENCODING  # programmatic wins
+        monkeypatch.setenv("METRICS_TPU_FLEET_ENCODING", "zstd-v9")
+        reset_wire_env_state()
+        import warnings
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert resolve_fleet_encoding() == ENCODING  # warn-once fallback
+            assert resolve_fleet_encoding() == ENCODING
+        assert sum("zstd-v9" in str(w.message) for w in rec) == 1
+        with pytest.raises(WireError, match="unknown fleet encoding"):
+            resolve_fleet_encoding("zstd-v9")  # programmatic typos raise
+
+    def test_int_and_small_float_leaves_ship_raw(self):
+        """Counters and scalar aggregates never quantize: their leaves in
+        the encoded tree are plain arrays, bit-identical after decode."""
+        m = mt.MeanMetric()
+        m.update(jnp.asarray([1.0, 2.0, 3.0]))
+        payload = m.snapshot_state()
+        blob = encode_view(payload, host_id="h", seq=1, encoding="int8")
+        _header, decoded = decode_view(blob)
+        for key, value in payload["states"].items():
+            assert np.array_equal(np.asarray(decoded["states"][key]), np.asarray(value)), key

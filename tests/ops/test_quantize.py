@@ -1,0 +1,268 @@
+"""Quantized transport codecs (``ops/quantize.py``): the round-trip
+property suite the error-bound contract rests on (ISSUE 12).
+
+Every claim the module docstring makes is pinned here across adversarial
+distributions — tie-heavy, 50-decade skew, ±inf, NaN, denormals, all-zero
+and single-value blocks — for both bit widths, both implementations (jax
+and numpy, asserted bit-identical), and the dispatch resolution rule
+(programmatic > ``METRICS_TPU_SYNC_TRANSPORT`` > exact, warn-once
+fallback on a bad env var).
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.ops import dispatch as kdispatch
+from metrics_tpu.ops.quantize import (
+    DEFAULT_BLOCK,
+    EXACT_CODEC,
+    FP16_CODEC,
+    INT8_CODEC,
+    MAX_CODE,
+    MIN_HOST_QUANTIZE_SIZE,
+    TINY_NORMAL,
+    host_decode,
+    host_encode,
+    resolve_codec,
+    wrap_gather_transport,
+)
+
+pytestmark = [pytest.mark.ops, pytest.mark.transport]
+
+RNG = np.random.default_rng(71)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dispatch(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_SYNC_TRANSPORT", raising=False)
+    monkeypatch.delenv("METRICS_TPU_KERNEL_BACKEND", raising=False)
+    kdispatch.reset_dispatch_state()
+    yield
+    kdispatch.reset_dispatch_state()
+
+
+def _int8_bound(x: np.ndarray, h: int) -> np.ndarray:
+    """Per-lane worst-case absolute error of the int8 scheme: the block's
+    (finite) absmax, floored at the smallest normal f32, over ``2*126`` —
+    except denormal lanes, whose documented envelope is "below the
+    smallest normal f32" (XLA flush-to-zero may zero them outright)."""
+    nb = -(-h // DEFAULT_BLOCK) if h else 0
+    x2 = np.zeros((nb * DEFAULT_BLOCK,), np.float32)
+    x2[:h] = np.where(np.isfinite(x[:h]), x[:h], 0)
+    absmax = np.abs(x2.reshape(-1, DEFAULT_BLOCK)).max(axis=1)
+    per_block = np.maximum(absmax, np.float32(TINY_NORMAL)) / (2 * MAX_CODE)
+    base = np.repeat(per_block, DEFAULT_BLOCK)[:h]
+    return np.where(np.abs(x[:h]) < TINY_NORMAL, np.float32(TINY_NORMAL), base)
+
+
+def _fp16_bound(x: np.ndarray, h: int) -> np.ndarray:
+    """Per-lane fp16 bound: relative ``2**-10`` for lanes above the fp16
+    subnormal cutoff of their block, absolute ``absmax * 2**-24`` below."""
+    nb = -(-h // DEFAULT_BLOCK) if h else 0
+    x2 = np.zeros((nb * DEFAULT_BLOCK,), np.float32)
+    x2[:h] = np.where(np.isfinite(x[:h]), x[:h], 0)
+    absmax = np.abs(x2.reshape(-1, DEFAULT_BLOCK)).max(axis=1)
+    absmax = np.maximum(absmax, np.float32(TINY_NORMAL))
+    per_lane_max = np.repeat(absmax, DEFAULT_BLOCK)[:h]
+    base = np.maximum(np.abs(x[:h]) * 2.0 ** -10, per_lane_max * 2.0 ** -24)
+    # denormal lanes share the collapse envelope (FTZ may zero them)
+    return np.where(np.abs(x[:h]) < TINY_NORMAL, np.float32(TINY_NORMAL), base)
+
+
+DISTRIBUTIONS = {
+    "uniform": lambda n: RNG.random(n, dtype=np.float32) * 2 - 1,
+    "tie_heavy": lambda n: RNG.integers(0, 4, n).astype(np.float32) * 0.25,
+    "skew_50_decades": lambda n: np.exp(
+        RNG.uniform(-57, 57, n)
+    ).astype(np.float32) * np.where(RNG.random(n) < 0.5, -1, 1),
+    "normal_sorted": lambda n: np.sort(RNG.standard_normal(n).astype(np.float32)),
+    "with_specials": lambda n: _with_specials(n),
+    "denormals": lambda n: (RNG.random(n).astype(np.float32) * 1e-40),
+}
+
+
+def _with_specials(n: int) -> np.ndarray:
+    x = RNG.standard_normal(n).astype(np.float32) * 1e3
+    if n >= 10:
+        x[::7] = np.inf
+        x[3::11] = -np.inf
+        x[5::13] = np.nan
+    return x
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("dist", sorted(DISTRIBUTIONS))
+    @pytest.mark.parametrize("n,tail", [(1000, 0), (1000, 14), (257, 2), (DEFAULT_BLOCK, 0)])
+    def test_int8_error_bound_and_specials(self, dist, n, tail):
+        x = DISTRIBUTIONS[dist](n)
+        wire = np.asarray(INT8_CODEC.encode(jnp.asarray(x), tail))
+        assert wire.dtype == np.int8
+        assert wire.shape[0] == INT8_CODEC.wire_size(n, tail)
+        dec = np.asarray(INT8_CODEC.decode(jnp.asarray(wire), n, tail))
+        # NaN/±inf passthrough lanes reconstruct their exact class
+        assert np.array_equal(np.isnan(dec), np.isnan(x))
+        assert np.array_equal(dec == np.inf, x == np.inf)
+        assert np.array_equal(dec == -np.inf, x == -np.inf)
+        # the exact tail is bit-identical
+        if tail:
+            assert np.array_equal(dec[n - tail :], x[n - tail :], equal_nan=True)
+        # finite head lanes honor the documented worst-case bound
+        h = n - tail
+        fin = np.isfinite(x[:h])
+        err = np.abs(dec[:h][fin] - x[:h][fin])
+        assert (err <= _int8_bound(x, h)[fin] * (1 + 1e-5)).all()
+
+    @pytest.mark.parametrize("dist", sorted(DISTRIBUTIONS))
+    @pytest.mark.parametrize("n,tail", [(1000, 0), (1000, 14), (257, 2)])
+    def test_fp16_error_bound_and_specials(self, dist, n, tail):
+        x = DISTRIBUTIONS[dist](n)
+        wire = np.asarray(FP16_CODEC.encode(jnp.asarray(x), tail))
+        # int16, not float16: wire lanes are bit patterns — a float psum
+        # would quiet signaling-NaN-shaped scale/tail lanes
+        assert wire.dtype == np.int16
+        assert wire.shape[0] == FP16_CODEC.wire_size(n, tail)
+        dec = np.asarray(FP16_CODEC.decode(jnp.asarray(wire), n, tail))
+        assert np.array_equal(np.isnan(dec), np.isnan(x))
+        assert np.array_equal(dec == np.inf, x == np.inf)
+        assert np.array_equal(dec == -np.inf, x == -np.inf)
+        if tail:
+            assert np.array_equal(dec[n - tail :], x[n - tail :], equal_nan=True)
+        h = n - tail
+        fin = np.isfinite(x[:h])
+        err = np.abs(dec[:h][fin] - x[:h][fin])
+        assert (err <= _fp16_bound(x, h)[fin] * (1 + 1e-5)).all()
+
+    def test_exact_codec_is_the_identity(self):
+        x = _with_specials(333)
+        wire = np.asarray(EXACT_CODEC.encode(jnp.asarray(x)))
+        assert wire.dtype == np.float32 and wire.shape[0] == 333
+        assert np.array_equal(wire, x, equal_nan=True)
+        assert np.array_equal(
+            np.asarray(EXACT_CODEC.decode(jnp.asarray(wire), 333)), x, equal_nan=True
+        )
+
+    def test_all_zero_block_decodes_to_zeros(self):
+        for codec in (INT8_CODEC, FP16_CODEC):
+            dec = np.asarray(codec.decode(codec.encode(jnp.zeros(100)), 100))
+            assert np.array_equal(dec, np.zeros(100, np.float32))
+
+    def test_single_value_blocks_near_lossless(self):
+        """A lone lane IS its block's absmax, so it encodes as ±MAX_CODE and
+        decodes to within 2 ulp (only the two f32 scale roundings remain) —
+        scalar sum states cost essentially nothing under int8."""
+        for v in (127.375, -3.0, 1e30, 1e-30):
+            dec = float(np.asarray(INT8_CODEC.decode(INT8_CODEC.encode(jnp.asarray([v])), 1))[0])
+            assert abs(dec - np.float32(v)) <= 2 * abs(np.float32(v)) * 2.0 ** -23, v
+
+    def test_denormal_collapse_documented_envelope(self):
+        """Denormal lanes collapse toward zero with absolute error below the
+        smallest normal f32 — XLA's flush-to-zero may zero them outright
+        (the documented collapse envelope; numpy, without FTZ, stays inside
+        the same envelope by quantizing against the TINY_NORMAL floor)."""
+        x = (RNG.random(200).astype(np.float32) * 1e-40)
+        assert (np.abs(x[x != 0]) < TINY_NORMAL).all()  # genuinely denormal
+        for decode, encode in (
+            (INT8_CODEC.decode, INT8_CODEC.encode),
+            (INT8_CODEC.decode_np, INT8_CODEC.encode_np),
+        ):
+            dec = np.asarray(decode(encode(x if encode is INT8_CODEC.encode_np else jnp.asarray(x)), 200))
+            assert (np.abs(dec - x) < TINY_NORMAL).all()
+
+    @pytest.mark.parametrize("n,tail", [(0, 0), (1, 0), (3, 3), (1000, 7)])
+    def test_numpy_twin_is_bit_identical(self, n, tail):
+        x = _with_specials(n) if n >= 10 else RNG.standard_normal(n).astype(np.float32)
+        for codec in (INT8_CODEC, FP16_CODEC, EXACT_CODEC):
+            wj = np.asarray(codec.encode(jnp.asarray(x), tail))
+            wn = codec.encode_np(x, tail)
+            assert np.array_equal(wj, wn, equal_nan=True), codec.name
+            dj = np.asarray(codec.decode(jnp.asarray(wj), n, tail))
+            dn = codec.decode_np(wn, n, tail)
+            assert np.array_equal(dj, dn, equal_nan=True), codec.name
+
+    def test_wire_bytes_shrink(self):
+        n = 1 << 16
+        exact = EXACT_CODEC.wire_bytes(n)
+        assert exact / INT8_CODEC.wire_bytes(n) >= 3.5  # 1.125 B/lane vs 4
+        assert exact / FP16_CODEC.wire_bytes(n) >= 1.8
+
+
+class TestResolution:
+    def test_default_is_exact(self):
+        assert resolve_codec().name == "exact"
+
+    def test_env_var_selects(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_TRANSPORT", "int8")
+        kdispatch.reset_dispatch_state()
+        assert resolve_codec().name == "int8"
+
+    def test_programmatic_beats_env(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_TRANSPORT", "int8")
+        kdispatch.reset_dispatch_state()
+        assert resolve_codec("fp16").name == "fp16"
+        with kdispatch.kernel_override(sync_transport="fp16"):
+            assert resolve_codec().name == "fp16"
+
+    def test_bad_env_var_warns_once_and_degrades_to_exact(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_TRANSPORT", "int4")
+        kdispatch.reset_dispatch_state()
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert resolve_codec().name == "exact"
+            assert resolve_codec().name == "exact"
+        assert sum("int4" in str(w.message) for w in rec) == 1  # once, not twice
+
+
+class TestHostWire:
+    def test_self_describing_roundtrip(self):
+        x = _with_specials(500)
+        for codec in (INT8_CODEC, FP16_CODEC):
+            dec = host_decode(host_encode(x, codec), codec)
+            assert dec.shape[0] == 500
+            assert np.array_equal(np.isnan(dec), np.isnan(x))
+
+    def test_wrapped_gather_quantizes_float_and_bypasses_int(self):
+        shipped = []
+
+        def gather(x, group=None):
+            arr = np.asarray(x)
+            shipped.append(arr)
+            return [arr, arr]
+
+        wrapped = wrap_gather_transport(gather, INT8_CODEC)
+        big = RNG.standard_normal(4096).astype(np.float32)
+        rows = wrapped(big)
+        assert shipped[-1].dtype == np.int8  # the wire, not raw f32
+        assert shipped[-1].nbytes < big.nbytes / 3
+        assert len(rows) == 2 and np.asarray(rows[0]).shape == big.shape
+        assert np.max(np.abs(np.asarray(rows[0]) - big)) <= np.abs(big).max() / (2 * MAX_CODE)
+        # integer leaves bypass bit-exact (lossless paths pinned)
+        counts = RNG.integers(0, 1000, 512).astype(np.uint32)
+        rows = wrapped(counts)
+        assert shipped[-1].dtype == np.uint32
+        assert np.array_equal(np.asarray(rows[0]), counts)
+        # small float leaves (scalar aggregates) ship exact too
+        small = RNG.standard_normal(MIN_HOST_QUANTIZE_SIZE - 1).astype(np.float32)
+        rows = wrapped(small)
+        assert shipped[-1].dtype == np.float32
+        assert np.array_equal(np.asarray(rows[0]), small)
+
+    def test_wrapped_gather_handles_ragged_rows(self):
+        """Per-rank 'cat' payloads differ in length; the self-describing
+        header lets each row decode to ITS length."""
+
+        def gather(x, group=None):
+            wire = np.asarray(x)
+            other = host_encode(np.arange(7, dtype=np.float32), INT8_CODEC)
+            return [wire, other]
+
+        wrapped = wrap_gather_transport(gather, INT8_CODEC)
+        mine = np.linspace(0, 1, 300, dtype=np.float32)
+        rows = wrapped(mine)
+        assert np.asarray(rows[0]).shape == (300,)
+        assert np.asarray(rows[1]).shape == (7,)
+
+    def test_exact_codec_wrap_is_identity(self):
+        gather = lambda x, group=None: [x]  # noqa: E731
+        assert wrap_gather_transport(gather, EXACT_CODEC) is gather
