@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -94,6 +94,15 @@ test-fleet:
 # tests the `obs` pytest marker selects).
 test-obs:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/obs/ -q -m 'not slow' -p no:cacheprovider
+
+# The serving cold-start layer (serving/warmup.py — AOT warmup engine +
+# executable dispatch tables + the METRICS_TPU_COMPILE_CACHE_DIR persistent
+# compile cache) and the warmed-sweep audit budget. Includes the slow
+# subprocess acceptance (a restarted process compiles 0 graphs) under a
+# hard timeout — children run in their own process groups and teardown
+# SIGKILLs the group, so a wedged child can never hang the lane.
+test-coldstart:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m coldstart -p no:cacheprovider
 
 # The quantized sync transport layer (ops/quantize.py wire codecs + the
 # fused_sync quantized wire + overlapped-cycle compressed gathers + the

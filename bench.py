@@ -1593,6 +1593,185 @@ def _phase_transport(jax, platform) -> None:
         print(f"bench: transport failed: {err}", file=sys.stderr)
 
 
+def _phase_coldstart(jax, platform) -> None:
+    """Serving cold start (ISSUE 13): the first-request latency wall the
+    steady-state serving numbers never show. Three measurements:
+
+    - **cold vs warmed first request** per ladder tier: a fresh metric's
+      first update at a tier pays trace + lower + XLA compile; a
+      warmup-installed clone's first update calls a ready AOT executable.
+      p50/p99 over fresh instances (each rep is a genuine first touch —
+      fresh jit objects, shared warmed tables). The acceptance ratio is
+      cold/warmed at the TOP tier (>= 10x).
+    - **warmup wall time**: what the background thread spends compiling the
+      whole matrix (the cost serving never waits on).
+    - **warm-restart compile count**: two subprocesses against one
+      METRICS_TPU_COMPILE_CACHE_DIR — the second must compile 0 graphs
+      (counted via jax.monitoring cache hit/miss events).
+    """
+    _stamp("coldstart start")
+    import copy
+
+    import numpy as np
+
+    import metrics_tpu as mt
+    from metrics_tpu.ops import padding
+    from metrics_tpu.serving.warmup import Warmup, WarmupEngine, reset_warmup_state
+
+    LADDER = (64, 256, 1024)
+    os.environ["METRICS_TPU_PAD_LADDER"] = ",".join(str(t) for t in LADDER)
+    os.environ.pop("METRICS_TPU_COMPILE_CACHE_DIR", None)  # honest in-process colds
+    padding.reset_padding_state()
+    reset_warmup_state()
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(23)
+
+    def batch(n):
+        return (
+            jnp.asarray(rng.random((n, 8), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 8, n).astype(np.int32)),
+        )
+
+    def proto():
+        return mt.Accuracy(num_classes=8, on_invalid="drop", pad_batches=True)
+
+    REPS = 7
+    try:
+        cold = {t: [] for t in LADDER}
+        for tier in LADDER:
+            for _ in range(REPS):
+                m = proto()  # fresh jit: a genuinely cold tier
+                p, t = batch(tier)
+                t0 = time.perf_counter()
+                m.update(p, t)
+                jax.block_until_ready(jax.tree_util.tree_leaves(m.metric_state))
+                cold[tier].append(time.perf_counter() - t0)
+
+        base = proto()
+        spec = Warmup(
+            example_args=(np.zeros((16, 8), np.float32), np.zeros((16,), np.int32)),
+            max_rows=LADDER[-1],
+        )
+        engine = WarmupEngine(base, spec)
+        t0 = time.perf_counter()
+        engine.start()
+        if not engine.wait(timeout_s=240) or engine.state()["status"] != "done":
+            raise RuntimeError(f"warmup did not finish: {engine.state()}")
+        warmup_wall = time.perf_counter() - t0
+
+        warmed = {t: [] for t in LADDER}
+        for tier in LADDER:
+            for _ in range(REPS):
+                m = copy.deepcopy(base)  # fresh instance, shared warmed tables
+                m.reset()
+                engine.install(m)
+                p, t = batch(tier)
+                t0 = time.perf_counter()
+                m.update(p, t)
+                jax.block_until_ready(jax.tree_util.tree_leaves(m.metric_state))
+                warmed[tier].append(time.perf_counter() - t0)
+                if m._update_jit.aot_misses:
+                    print(
+                        f"bench: PARITY-MISMATCH coldstart tier {tier} missed the "
+                        "warmed table (measured the jit path, not the executable)",
+                        file=sys.stderr,
+                    )
+
+        top = LADDER[-1]
+        cold_p99 = float(np.percentile(cold[top], 99)) * 1e3
+        warm_p99 = float(np.percentile(warmed[top], 99)) * 1e3
+        per_tier = ", ".join(
+            f"tier {t}: {np.percentile(cold[t], 50) * 1e3:.0f} -> "
+            f"{np.percentile(warmed[t], 50) * 1e3:.2f} ms p50"
+            for t in LADDER
+        )
+        _emit(
+            "coldstart_first_request_cold_p99_ms",
+            round(cold_p99, 2),
+            f"ms first request, tier {top} COLD (trace+lower+compile on the request "
+            f"path; {per_tier}; {platform})",
+        )
+        _emit(
+            "coldstart_first_request_warmed_p99_ms",
+            round(warm_p99, 3),
+            f"ms first request, tier {top} after AOT warmup (ready executable; "
+            f"acceptance >= 10x vs cold, measured {cold_p99 / warm_p99:.0f}x; {platform})",
+        )
+        if cold_p99 / warm_p99 < 10.0:
+            print(
+                f"bench: PARITY-MISMATCH coldstart acceptance: cold/warmed p99 ratio "
+                f"{cold_p99 / warm_p99:.1f} < 10x at tier {top}",
+                file=sys.stderr,
+            )
+        _emit(
+            "coldstart_warmup_wall_s",
+            round(warmup_wall, 2),
+            f"s background warmup wall time ({engine.graphs_compiled} graphs, ladder "
+            f"{LADDER} x guarded Accuracy + compute, {platform})",
+        )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: coldstart first-request failed: {err}", file=sys.stderr)
+
+    try:
+        import tempfile
+
+        child_src = (
+            "import json\n"
+            "import numpy as np\n"
+            "import jax, jax.numpy as jnp\n"
+            "events = {'hits': 0, 'misses': 0}\n"
+            "def _l(name, **kw):\n"
+            "    if name == '/jax/compilation_cache/cache_hits': events['hits'] += 1\n"
+            "    elif name == '/jax/compilation_cache/cache_misses': events['misses'] += 1\n"
+            "jax.monitoring.register_event_listener(_l)\n"
+            "import metrics_tpu as mt\n"
+            "proto = mt.Accuracy(num_classes=8, on_invalid='drop', pad_batches=True)\n"
+            "spec = mt.Warmup(example_args=(np.zeros((16, 8), np.float32),"
+            " np.zeros((16,), np.int32)), max_rows=1024)\n"
+            "with mt.ServeLoop(proto, workers=1, warmup=spec) as loop:\n"
+            "    assert loop.wait_warmup(timeout_s=180)\n"
+            "    rng = np.random.default_rng(0)\n"
+            "    for n in (5, 100, 700):\n"
+            "        loop.offer(jnp.asarray(rng.random((n, 8), dtype=np.float32)),\n"
+            "                   jnp.asarray(rng.integers(0, 8, n).astype(np.int32)))\n"
+            "    loop.drain(60)\n"
+            "print(json.dumps(events))\n"
+        )
+        with tempfile.TemporaryDirectory() as cache_dir:
+            env = _cpu_env()
+            env["METRICS_TPU_PAD_LADDER"] = ",".join(str(t) for t in LADDER)
+            env["METRICS_TPU_COMPILE_CACHE_DIR"] = cache_dir
+            runs = []
+            for _ in range(2):
+                proc = subprocess.run(
+                    [sys.executable, "-c", child_src],
+                    timeout=300,
+                    capture_output=True,
+                    text=True,
+                    env=env,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(f"coldstart child failed: {proc.stderr[-800:]}")
+                runs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        _emit(
+            "coldstart_warm_restart_compiles",
+            runs[1]["misses"],
+            f"XLA compiles in a RESTARTED process sharing the persistent compile "
+            f"cache (first run compiled {runs[0]['misses']}, restart read "
+            f"{runs[1]['hits']} cache hits; acceptance == 0; {platform})",
+        )
+        if runs[1]["misses"] != 0:
+            print(
+                f"bench: PARITY-MISMATCH coldstart warm restart compiled "
+                f"{runs[1]['misses']} graphs (expected 0)",
+                file=sys.stderr,
+            )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: coldstart warm-restart failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -1607,6 +1786,7 @@ _PHASES = {
     "streaming": (_phase_streaming, 300),
     "compactor": (_phase_compactor, 420),
     "serving": (_phase_serving, 300),
+    "coldstart": (_phase_coldstart, 420),
     "async_sync": (_phase_async_sync, 300),
     "obs": (_phase_obs, 300),
     "transport": (_phase_transport, 300),

@@ -10,6 +10,12 @@ one per batch size), NaN rows drop in-graph and are counted, a full queue
 sheds loudly into ``health_report()``, and ``report()`` serves the last
 reduced view without ever blocking the request path.
 
+The cold-start story (ISSUE 13): ``warmup=mt.Warmup(...)`` precompiles the
+whole ladder x collection matrix on a background thread (AOT executables,
+no device steps) while the first requests are already being served — the
+loop goes zero-trace progressively, and ``health()`` + the scrape report
+warmup status and graph counts.
+
 The observability story (ISSUE 10): ``METRICS_TPU_TRACE=1`` turns on the
 span tracer at the hot seams, the self-telemetry histograms (the library's
 own ``QuantileSketch``) collect request-latency quantiles, and a
@@ -58,6 +64,15 @@ def main():
         workers=3,
         queue_size=64,
         snapshot_manager=mt.SnapshotManager(workdir, keep=2),
+        # AOT warmup: one representative request (shapes only, never data)
+        # enumerates the ladder-tier matrix; largest tier compiles first
+        warmup=mt.Warmup(
+            example_args=(
+                np.zeros((64, NUM_CLASSES), np.float32),
+                np.zeros((64,), np.int32),
+            ),
+            max_rows=1024,
+        ),
     )
 
     def driver(seed):
@@ -102,6 +117,14 @@ def main():
     shed_line = next(ln for ln in scrape.splitlines() if ln.startswith("metrics_tpu_serve_shed_total"))
     print("scraped shed counter:", shed_line)
     exporter.close()
+
+    # the cold-start surfaces: warmup ran off the request path and is done
+    # (wait_warmup returns False when METRICS_TPU_WARMUP=0 — the engine is
+    # skipped entirely and serving just pays on-demand tracing)
+    if loop.wait_warmup(timeout_s=240):
+        warm = loop.health()["serving"]["warmup"]
+        assert warm["status"] == "done", warm
+        print("warmup:", warm)
 
     loop.stop()
     loop.save_snapshot()  # crash-safe: one rank per worker, elastic restore

@@ -27,7 +27,7 @@ _apply_force_cpu()
 
 from metrics_tpu import obs  # noqa: E402  — span tracer / self-metrics / exporters
 from metrics_tpu.resilience import SnapshotManager, health_report  # noqa: E402
-from metrics_tpu.serving import ServeLoop  # noqa: E402
+from metrics_tpu.serving import ServeLoop, Warmup  # noqa: E402
 from metrics_tpu.utilities.backend import ensure_backend  # noqa: E402
 
 from metrics_tpu.audio import (  # noqa: E402
@@ -278,4 +278,5 @@ __all__ = [
     "health_report",
     "obs",
     "ServeLoop",
+    "Warmup",
 ]

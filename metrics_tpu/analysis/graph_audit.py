@@ -228,6 +228,8 @@ def audit_recompilation(
     entry: str = "<fn>",
     sweep_sizes: Optional[Sequence[int]] = None,
     max_graphs: Optional[int] = None,
+    warmup_sizes: Optional[Sequence[int]] = None,
+    max_new_graphs: int = 0,
 ) -> List[GraphViolation]:
     """Detect avoidable recompilation of a metric ``update`` entry point.
 
@@ -253,6 +255,15 @@ def audit_recompilation(
     A sweep covering every tier pins the count EXACTLY by auditing twice:
     ``max_graphs=N`` passing and ``max_graphs=N-1`` failing proves the
     sweep compiled exactly N graphs.
+
+    Fourth check — the **warmed-sweep budget** (``warmup_sizes`` +
+    ``sweep_sizes``, the ``serving/warmup.py`` enforcement): every warmup
+    size is AOT-precompiled through ``jitted.lower(...).compile()`` (no
+    device step — exactly what the warmup engine does), the sweep then runs
+    live, and at most ``max_new_graphs`` (default **0**) additional traces
+    may occur. A warmup matrix with a gap — a tier the sweep reaches but
+    the warmup never compiled — retraces at first touch and fails the
+    audit: "zero traces after warmup" as a mechanical budget.
     """
     import jax
 
@@ -288,7 +299,41 @@ def audit_recompilation(
                 "is being missed (unstable weak types or non-hashable statics?)",
             )
         )
-    if sweep_sizes is not None:
+    if warmup_sizes is not None:
+        if sweep_sizes is None:
+            raise ValueError("`warmup_sizes` needs `sweep_sizes` to serve after warmup")
+        # a FRESH jit with its own counter: check 2's calls above already
+        # traced the batch_sizes tier into `jitted`'s cache, and crediting
+        # that graph would hide a warmup-matrix gap at exactly that tier
+        # (the sweep would hit check-2's cache instead of retracing)
+        warm_traces = {"n": 0}
+
+        def warm_counted(*args: Any) -> Any:
+            warm_traces["n"] += 1
+            return fn(*args)
+
+        warm_jitted = jax.jit(warm_counted)
+        for n in warmup_sizes:
+            # the warmup engine's own move: AOT trace+compile against the
+            # tier's avals, no execution — lower() never runs a device step
+            warm_jitted.lower(*make_args(n)).compile()
+        warmed = warm_traces["n"]
+        for n in sweep_sizes:
+            jax.block_until_ready(warm_jitted(*make_args(n)))
+        new = warm_traces["n"] - warmed
+        if new > max_new_graphs:
+            violations.append(
+                GraphViolation(
+                    entry,
+                    "recompilation",
+                    f"{new} NEW trace(s) while serving a {len(tuple(sweep_sizes))}-size "
+                    f"ragged sweep after AOT warmup of sizes {tuple(warmup_sizes)} "
+                    f"(budget: {max_new_graphs}) — the warmup matrix has a gap; a "
+                    "first live request on the missed tier pays the cold trace "
+                    "(serving/warmup.py)",
+                )
+            )
+    elif sweep_sizes is not None:
         if max_graphs is None:
             raise ValueError("`sweep_sizes` needs a `max_graphs` budget")
         for n in sweep_sizes:

@@ -55,6 +55,16 @@ entry                           budget
                                 replicated reduced buffer over the mesh):
                                 **0** collectives — the zero-collective-
                                 latency read the ISSUE 8 acceptance names
+``warmed_ladder_serving``       the ladder-padded serving update behind the
+                                AOT warmup engine (ISSUE 13 —
+                                ``serving/warmup.py``): after every
+                                ``_SERVE_LADDER`` tier is precompiled via
+                                ``jit(...).lower().compile()`` (the warmup
+                                engine's exact move), the full 13-size
+                                ragged sweep serves with **0 new traces**
+                                (``audit_recompilation``'s warmed-sweep
+                                budget); a seeded warmup-matrix gap fails
+                                the entry
 ``instrumented_update_step``    the module runtime's jitted guarded update
                                 lowered with tracing FORCED ON (ISSUE 10 —
                                 ``obs/trace.py``): **0** collectives and **0
@@ -104,6 +114,11 @@ class AuditEntry:
     # sizes must stay <= max_graphs (audit_recompilation's third check)
     sweep_sizes: Optional[Tuple[int, ...]] = None
     max_graphs: Optional[int] = None
+    # warmed-sweep budget (audit_recompilation's fourth check): AOT-compile
+    # these sizes first, then the sweep may trace at most max_new_graphs
+    # (0 = the "zero traces after warmup" serving acceptance)
+    warmup_sizes: Optional[Tuple[int, ...]] = None
+    max_new_graphs: int = 0
 
 
 def _mesh(ndev: int):
@@ -584,6 +599,20 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         max_graphs=3,  # == len(_SERVE_LADDER)
     ),
     AuditEntry(
+        name="warmed_ladder_serving",
+        budget=None,
+        # the ladder_served_update construction served AFTER the warmup
+        # engine's move: AOT-compile every _SERVE_LADDER tier, then the
+        # SAME 13-size ragged sweep must trace 0 new graphs — "zero traces
+        # after warmup" as a registry budget. A seeded warmup-matrix gap
+        # (any tier dropped from warmup_sizes) fails this entry; pinned by
+        # tests/serving/test_warmup.py::test_warmed_audit_seeded_gap_fails
+        build_recompile=lambda: (_build_ladder_raw_step(), _ladder_make_args),
+        sweep_sizes=(1, 3, 7, 8, 9, 20, 31, 32, 33, 57, 100, 127, 128),
+        warmup_sizes=_SERVE_LADDER,
+        max_new_graphs=0,
+    ),
+    AuditEntry(
         name="instrumented_update_step",
         budget=GraphBudget(
             max_all_reduce=0,
@@ -643,6 +672,8 @@ def run_graph_audit(
                     entry=entry.name,
                     sweep_sizes=entry.sweep_sizes,
                     max_graphs=entry.max_graphs,
+                    warmup_sizes=entry.warmup_sizes,
+                    max_new_graphs=entry.max_new_graphs,
                 )
             )
     return violations

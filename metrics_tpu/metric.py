@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.obs import trace as _obs_trace
+from metrics_tpu.obs.runtime_metrics import note_jit_retrace as _note_jit_retrace
 from metrics_tpu.parallel.sync import distributed_available, gather_all_arrays, sync_state
 from metrics_tpu.utilities.data import _flatten, _squeeze_if_scalar, dim_zero_cat
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
@@ -387,12 +388,14 @@ class Metric:
 
     def _make_update_jit(self) -> Callable:
         def pure_update(state: Dict[str, Any], args: tuple, kwargs: dict) -> Dict[str, Any]:
-            # trace-TIME instant, not a graph op: this body runs once per
-            # (re)trace, so the event count IS the retrace count
-            # (audit_recompilation's idiom as live telemetry); the
+            # trace-TIME counter + instant, not a graph op: this body runs
+            # once per (re)trace, so the count IS the retrace count
+            # (audit_recompilation's idiom as live telemetry — the
+            # metric_jit_retrace_total counter increments tracing on or off,
+            # the timeline instant rides when the tracer records); the
             # instrumented_update_step registry entry proves the compiled
             # graph stays free of host callbacks
-            _obs_trace.instant("metric.jit_retrace", metric=type(self).__name__, fn="update")
+            _note_jit_retrace(metric=type(self).__name__, fn="update")
             prev = self.__dict__["_state"]
             object.__setattr__(self, "_state", dict(state))
             try:
@@ -420,8 +423,8 @@ class Metric:
 
     def _make_compute_jit(self) -> Callable:
         def pure_compute(state: Dict[str, Any]) -> Any:
-            # trace-time retrace instant (see _make_update_jit)
-            _obs_trace.instant("metric.jit_retrace", metric=type(self).__name__, fn="compute")
+            # trace-time retrace counter + instant (see _make_update_jit)
+            _note_jit_retrace(metric=type(self).__name__, fn="compute")
             prev = self.__dict__["_state"]
             object.__setattr__(self, "_state", dict(state))
             try:

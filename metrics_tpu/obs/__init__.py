@@ -33,9 +33,11 @@ from metrics_tpu.obs.trace import (
 from metrics_tpu.obs.runtime_metrics import (
     HISTOGRAM_SEAMS,
     Counter,
+    Gauge,
     LatencyHistogram,
     RuntimeMetrics,
     merged,
+    note_jit_retrace,
     registry,
 )
 from metrics_tpu.obs.export import TelemetryExporter, json_text, prometheus_text
@@ -54,10 +56,12 @@ __all__ = [
     "remove_trace_sink",
     "reset_trace_state",
     "Counter",
+    "Gauge",
     "LatencyHistogram",
     "RuntimeMetrics",
     "registry",
     "merged",
+    "note_jit_retrace",
     "HISTOGRAM_SEAMS",
     "TelemetryExporter",
     "prometheus_text",

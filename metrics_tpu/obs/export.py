@@ -59,6 +59,10 @@ def _runtime_lines(runtime: RuntimeMetrics, qs: Sequence[float]) -> List[str]:
         metric = f"{_PREFIX}_{name}"
         lines.append(f"# TYPE {metric} counter")
         lines.append(_line(metric, value))
+    for name, value in sorted(runtime.gauges().items()):
+        metric = f"{_PREFIX}_{name}"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(_line(metric, value))
     for name, hist in sorted(runtime.histograms().items()):
         if hist.count == 0:
             continue

@@ -29,10 +29,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter",
+    "Gauge",
     "LatencyHistogram",
     "RuntimeMetrics",
     "registry",
     "merged",
+    "note_jit_retrace",
     "HISTOGRAM_SEAMS",
     "DEFAULT_QUANTILES",
 ]
@@ -83,6 +85,28 @@ class Counter:
 
     @property
     def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins named value (thread-safe) — point-in-time facts a
+    scraper reads as-is: warmup wall time, warmup graph count, queue depths.
+    ``None`` until first set (exporters skip unset gauges)."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> Optional[float]:
         with self._lock:
             return self._value
 
@@ -241,6 +265,7 @@ class RuntimeMetrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, LatencyHistogram] = {}
         for hist_name in HISTOGRAM_SEAMS.values():
             self._hists[hist_name] = LatencyHistogram(hist_name)
@@ -252,6 +277,13 @@ class RuntimeMetrics:
                 counter = self._counters[name] = Counter(name)
             return counter
 
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            gauge = self._gauges.get(name)
+            if gauge is None:
+                gauge = self._gauges[name] = Gauge(name)
+            return gauge
+
     def histogram(self, name: str, eps: float = _HIST_EPS) -> LatencyHistogram:
         with self._lock:
             hist = self._hists.get(name)
@@ -262,6 +294,11 @@ class RuntimeMetrics:
     def counters(self) -> Dict[str, int]:
         with self._lock:
             return {name: c.value for name, c in self._counters.items()}
+
+    def gauges(self) -> Dict[str, float]:
+        """Set gauges only (a never-set gauge has nothing to scrape)."""
+        with self._lock:
+            return {name: g.value for name, g in self._gauges.items() if g.value is not None}
 
     def histograms(self) -> Dict[str, LatencyHistogram]:
         with self._lock:
@@ -282,12 +319,18 @@ class RuntimeMetrics:
                 hists[name] = hist.snapshot(qs)
             else:
                 hists[name] = {"count": hist.count, "sum_ms": hist.sum_ms, "eps": hist.eps}
-        return {"counters": self.counters(), "histograms": hists}
+        out: Dict[str, Any] = {"counters": self.counters(), "histograms": hists}
+        gauges = self.gauges()
+        if gauges:
+            out["gauges"] = gauges
+        return out
 
     def reset(self) -> None:
-        """Test hook: drop every counter/histogram, re-seed the seam table."""
+        """Test hook: drop every counter/gauge/histogram, re-seed the seam
+        table."""
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
             self._hists.clear()
             for hist_name in HISTOGRAM_SEAMS.values():
                 self._hists[hist_name] = LatencyHistogram(hist_name)
@@ -302,11 +345,16 @@ registry = RuntimeMetrics()
 
 def merged(*registries: RuntimeMetrics) -> RuntimeMetrics:
     """One registry covering every input's streams (the exporter's
-    cross-worker merge): counters add, histograms ``sketch_merge``."""
+    cross-worker merge): counters add, histograms ``sketch_merge``, gauges
+    last-write-wins in argument order (a gauge is a point-in-time fact —
+    there is nothing to sum; the later registry is treated as the fresher
+    report)."""
     out = RuntimeMetrics()
     for reg in registries:
         for name, value in reg.counters().items():
             out.counter(name).inc(value)
+        for name, value in reg.gauges().items():
+            out.gauge(name).set(value)
         for name, hist in reg.histograms().items():
             if hist.count == 0:
                 continue
@@ -319,6 +367,24 @@ def merged(*registries: RuntimeMetrics) -> RuntimeMetrics:
     return out
 
 
+# span names whose occurrence counter is maintained AT SOURCE (always on,
+# tracing enabled or not) — the sink must not double-count their records
+_COUNTED_AT_SOURCE = frozenset({"metric.jit_retrace"})
+
+
+def note_jit_retrace(**attrs: Any) -> None:
+    """One jit (re)trace of a metric entry point: the ``metric.jit_retrace``
+    trace-instant promoted to a REAL counter (``metric_jit_retrace_total``),
+    incremented whether or not the tracer is enabled — so "zero traces after
+    warmup" (``serving/warmup.py``) is a scrapeable production fact, not
+    just an audit result. The timeline instant still fires when tracing is
+    on (the sink skips it — counted here, at source)."""
+    registry.counter("metric_jit_retrace_total").inc()
+    from metrics_tpu.obs.trace import instant
+
+    instant("metric.jit_retrace", **attrs)
+
+
 # memoized span-name -> Counter/LatencyHistogram lookups for the sink (it
 # runs on the instrumented thread per record — a dict hit, not a registry
 # lock round trip); registry.reset() clears both
@@ -327,11 +393,13 @@ _sink_hists: Dict[str, Any] = {}  # name -> LatencyHistogram | None (non-seam)
 
 
 def _trace_sink(name: str, dur_ns: int, attrs: Optional[Dict[str, Any]]) -> None:
-    """The tracer sink: every record counts, seam spans also observe."""
-    counter = _sink_counters.get(name)
-    if counter is None:
-        counter = _sink_counters[name] = registry.counter(name.replace(".", "_") + "_total")
-    counter.inc()
+    """The tracer sink: every record counts (except the counted-at-source
+    names), seam spans also observe."""
+    if name not in _COUNTED_AT_SOURCE:
+        counter = _sink_counters.get(name)
+        if counter is None:
+            counter = _sink_counters[name] = registry.counter(name.replace(".", "_") + "_total")
+        counter.inc()
     if dur_ns:
         hist = _sink_hists.get(name, False)
         if hist is False:

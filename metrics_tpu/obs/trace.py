@@ -44,7 +44,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
-from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce, bool_token
 
 __all__ = [
     "TraceRecord",
@@ -67,17 +67,15 @@ _warn_once = WarnOnce()
 
 
 def _parse_trace(raw: str) -> bool:
-    low = raw.lower()
-    if low in ("1", "true", "on", "yes"):
-        return True
-    if low in ("0", "false", "off", "no"):
+    value = bool_token(raw)
+    if value is None:
+        _warn_once(
+            ("trace", raw),
+            f"METRICS_TPU_TRACE={raw!r} is not a boolean token (1/0/true/false/"
+            "on/off/yes/no); tracing stays disabled.",
+        )
         return False
-    _warn_once(
-        ("trace", raw),
-        f"METRICS_TPU_TRACE={raw!r} is not a boolean token (1/0/true/false/"
-        "on/off/yes/no); tracing stays disabled.",
-    )
-    return False
+    return value
 
 
 def _parse_buffer(raw: str) -> int:

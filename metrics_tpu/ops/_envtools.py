@@ -17,9 +17,22 @@ from typing import Any, Callable, Generic, Tuple, TypeVar
 
 from metrics_tpu.utilities.prints import rank_zero_warn
 
-__all__ = ["WarnOnce", "EnvParse"]
+__all__ = ["WarnOnce", "EnvParse", "bool_token"]
 
 T = TypeVar("T")
+
+
+def bool_token(raw: str) -> "Any":
+    """Parse one boolean env token (``1/0/true/false/on/off/yes/no``,
+    case-insensitive); ``None`` for anything else — the caller owns its own
+    warn-once message and fallback (``METRICS_TPU_TRACE`` defaults off,
+    ``METRICS_TPU_WARMUP`` defaults on)."""
+    low = raw.lower()
+    if low in ("1", "true", "on", "yes"):
+        return True
+    if low in ("0", "false", "off", "no"):
+        return False
+    return None
 
 
 class WarnOnce:

@@ -50,6 +50,7 @@ __all__ = [
     "pad_ladder",
     "next_pow2",
     "tier_for",
+    "ladder_tiers",
     "pad_rows",
     "pad_update_args",
     "supports_row_mask",
@@ -113,6 +114,41 @@ def tier_for(n: int, ladder: Optional[Sequence[int]] = None) -> int:
             "each distinct oversize pow-2 tier compiles one extra graph",
         )
     return next_pow2(n)
+
+
+def ladder_tiers(max_rows: int, ladder: Optional[Sequence[int]] = None) -> Tuple[int, ...]:
+    """Every tier batches of ``1..max_rows`` rows can land on, ascending —
+    the warmup-matrix enumeration surface (``serving/warmup.py``): an AOT
+    warmup that precompiles one update graph per returned tier covers every
+    batch size up to ``max_rows`` with zero first-request traces.
+
+    ``ladder=None`` reads :func:`pad_ladder` (the env var), mirroring
+    :func:`tier_for` exactly: explicit-ladder tiers whose predecessor is
+    below ``max_rows`` are reachable, and sizes above the top tier spill
+    into the pow-2 overflow tiers ``tier_for`` would warn-and-use; pow-2
+    mode yields ``1, 2, 4, ..., next_pow2(max_rows)``.
+    """
+    if max_rows < 1:
+        raise ValueError(f"max_rows must be >= 1, got {max_rows}")
+    lad = pad_ladder() if ladder is None else tuple(sorted(set(ladder)))
+    tiers = []
+    if lad:
+        prev = 0
+        for t in lad:
+            if prev < max_rows:
+                tiers.append(t)
+            prev = t
+        start = lad[-1] + 1  # pow-2 overflow spill above the top tier
+    else:
+        start = 1
+    if start <= max_rows:
+        t = next_pow2(start)
+        while True:
+            tiers.append(t)
+            if t >= max_rows:
+                break
+            t = next_pow2(t + 1)
+    return tuple(tiers)
 
 
 def _row_count(value: Any) -> Optional[int]:

@@ -56,6 +56,21 @@ class MetricDef(NamedTuple):
     dropped: Callable[[Dict[str, Any]], Any] = None
     faults: Callable[[Dict[str, Any]], Any] = None
 
+    def entry_points(self) -> Dict[str, Callable]:
+        """The jittable entry points an AOT warmup should precompile, by
+        name — the ``serving/warmup.py`` enumeration surface for pure-layer
+        consumers::
+
+            mdef = functionalize(metric)
+            state_avals = jax.eval_shape(mdef.init)
+            for name, fn in mdef.entry_points().items():
+                jax.jit(fn).lower(state_avals, *arg_avals[name]).compile()
+
+        ``update`` takes ``(state, *batch)``, ``compute`` takes ``(state,)``
+        — both pure, both safe to ``lower`` against ``eval_shape`` avals
+        (no real data, no device steps)."""
+        return {"update": self.update, "compute": self.compute}
+
 
 def _dropped_in_state(state: Dict[str, Any], independent: bool = False) -> Any:
     """Rows dropped across one metric's ring states — the same rule as
@@ -346,6 +361,29 @@ class OverlappedDef(NamedTuple):
     # MetricDef.faults/dropped contract moved onto the stale-read path)
     faults: Callable[[Dict[str, Any]], Any] = None
     dropped: Callable[[Dict[str, Any]], Any] = None
+
+    def entry_points(self) -> Dict[str, Callable]:
+        """The jittable entry points an AOT warmup should precompile, by
+        name (the ``serving/warmup.py`` enumeration surface): ``update``
+        takes ``(state, *batch)``; ``cycle``, ``read``, ``read_fresh`` and
+        ``lag`` take ``(state,)``. The overlapped state layout is
+        batch-size independent (pinned by the ``overlapped_fused_step``
+        registry entry), so one ``jax.eval_shape(odef.init)`` aval tree
+        serves every entry::
+
+            odef = overlapped_functionalize(coll, axis_name="data")
+            s_avals = jax.eval_shape(odef.init)
+            for name, fn in odef.entry_points().items():
+                if name != "update":
+                    jax.jit(fn).lower(s_avals).compile()   # no device steps
+        """
+        return {
+            "update": self.update,
+            "cycle": self.cycle,
+            "read": self.read,
+            "read_fresh": self.read_fresh,
+            "lag": self.lag,
+        }
 
 
 def _fused_sync_tree(

@@ -56,7 +56,23 @@ from typing import Any, Dict, List, Optional
 #                          seq strictly ABOVE the publisher's (host restarted after a
 #                          backward clock step); the publisher jumped its sequence past
 #                          it (held == ours is the benign idempotent-retry case: no jump)
+#   serve_warmup_done      a ServeLoop's AOT warmup finished precompiling its matrix
+#                          (serving/warmup.py) — INFORMATIONAL: a normal-operation
+#                          milestone that never flips `degraded` (see
+#                          INFORMATIONAL_EVENT_KINDS), recorded so "when did this host
+#                          go zero-trace" is datable next to real degradations
+#   serve_warmup_error     a ServeLoop's AOT warmup thread failed; serving continues on
+#                          the normal tracing path (degraded cold-start latency only)
+#   serve_aot_evicted      a warmed executable rejected its arguments at call time and
+#                          was evicted from the shared table — that shape serves through
+#                          the normal jit path for the rest of the process
+#                          (serving/warmup.py; also counted as serve_aot_evicted_total)
 _MAX_EVENTS = 256
+
+# event kinds that are operational milestones, not degradations: reported,
+# counted, datable — but excluded from the `degraded` flag (the
+# INFORMATIONAL_FAULT_CLASSES stance applied to registry events)
+INFORMATIONAL_EVENT_KINDS = frozenset({"serve_warmup_done"})
 
 
 class HealthRegistry:
@@ -214,10 +230,12 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
                             "staleness_s": age}},
          "degraded": bool}
 
-    ``degraded`` is True when any registry event OR any reported metric
-    fault/overflow exists. Staleness (``last_update_*``/``staleness_s``,
-    or ``never_updated``) is informational — a stalled stream is visible
-    but does not flip the flag by itself.
+    ``degraded`` is True when any non-informational registry event (every
+    kind except :data:`INFORMATIONAL_EVENT_KINDS` — operational milestones
+    like ``serve_warmup_done``) OR any reported metric fault/overflow
+    exists. Staleness (``last_update_*``/``staleness_s``, or
+    ``never_updated``) is informational — a stalled stream is visible but
+    does not flip the flag by itself.
     """
     from metrics_tpu.utilities.backend import backend_status
 
@@ -254,7 +272,9 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
                 # second would silently overwrite the first's faults)
                 seen[name] = seen.get(name, 0) + 1
                 report["metrics"][name if seen[name] == 1 else f"{name}#{seen[name]}"] = entry
-    report["degraded"] = bool(report["event_counts"]) or any(
+    report["degraded"] = bool(
+        set(report["event_counts"]) - INFORMATIONAL_EVENT_KINDS
+    ) or any(
         any(k in entry for k in _DEGRADED_KEYS) for entry in report["metrics"].values()
     )
     return report

@@ -1,10 +1,27 @@
 """Serving hardening: thread-safe serve loop with overload shedding.
 
 See :mod:`metrics_tpu.serving.loop` for the design (thread-confined replica
-accumulation, merged stale-view reads, shed-on-full ingest) and
+accumulation, merged stale-view reads, shed-on-full ingest),
 :mod:`metrics_tpu.ops.padding` for the padding-tier capacity ladder that
-keeps ragged request sizes from recompiling the serving graphs.
+keeps ragged request sizes from recompiling the serving graphs, and
+:mod:`metrics_tpu.serving.warmup` for the AOT warmup engine + persistent
+compile cache that removes the ladder's first-request trace/compile cost
+(``ServeLoop(warmup=Warmup(...))``, ``METRICS_TPU_COMPILE_CACHE_DIR``).
 """
 from metrics_tpu.serving.loop import ServeLoop  # noqa: F401
+from metrics_tpu.serving.warmup import (  # noqa: F401
+    AOTDispatcher,
+    Warmup,
+    WarmupEngine,
+    configure_compile_cache,
+    warmup_enabled,
+)
 
-__all__ = ["ServeLoop"]
+__all__ = [
+    "ServeLoop",
+    "Warmup",
+    "WarmupEngine",
+    "AOTDispatcher",
+    "configure_compile_cache",
+    "warmup_enabled",
+]

@@ -61,15 +61,28 @@ __all__ = ["ServeLoop"]
 _Snapshot = Dict[str, Tuple[Dict[str, Any], int, Dict[str, Dict[str, Any]]]]
 
 
+def _attr_slots(m: Any, prefix: str = "") -> List[Tuple[Tuple[str, str], Any]]:
+    """Every ``_snapshot_attrs`` slot of a metric tree as ``((path, attr),
+    value)`` pairs, in tree order, INCLUDING still-``None`` slots — the one
+    canonical walk behind :func:`_inferred_attrs` and the warmup
+    dispatcher's config key (``serving/warmup.py::_static_key``), so the
+    snapshot/rollback view and the executable-compatibility view can never
+    enumerate the tree differently."""
+    out: List[Tuple[Tuple[str, str], Any]] = [
+        ((prefix, a), getattr(m, a, None)) for a in m._snapshot_attrs
+    ]
+    for name, child in m._named_child_metrics():
+        out.extend(_attr_slots(child, f"{prefix}.{name}" if prefix else name))
+    return out
+
+
 def _inferred_attrs(m: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
     """Data-inferred ``_snapshot_attrs`` of a metric and (recursively) its
-    child metrics, keyed by dotted child path."""
+    child metrics, keyed by dotted child path (non-``None`` values only)."""
     out: Dict[str, Dict[str, Any]] = {}
-    attrs = {a: getattr(m, a) for a in m._snapshot_attrs if getattr(m, a, None) is not None}
-    if attrs:
-        out[prefix] = attrs
-    for name, child in m._named_child_metrics():
-        out.update(_inferred_attrs(child, f"{prefix}.{name}" if prefix else name))
+    for (path, attr), value in _attr_slots(m, prefix):
+        if value is not None:
+            out.setdefault(path, {})[attr] = value
     return out
 
 
@@ -167,6 +180,17 @@ class ServeLoop:
     clone, and reads merge the clones — the caller's instance is never
     touched by the loop's threads.
 
+    ``warmup=`` takes a :class:`~metrics_tpu.serving.Warmup` spec (one
+    representative request's shapes/dtypes) and starts the AOT warmup
+    engine (``serving/warmup.py``): the padding-ladder x metric-tree
+    matrix precompiles on a background thread into shared executable
+    tables, so warmed tiers serve their FIRST live request with zero
+    traces and zero compiles; progress rides
+    ``health()["serving"]["warmup"]``, ``METRICS_TPU_WARMUP=0`` skips it,
+    and ``METRICS_TPU_COMPILE_CACHE_DIR`` persists the compiles across
+    restarts. A warmup failure is loud (``serve_warmup_error``) but never
+    blocks or degrades serving — the untraced path still works.
+
     **Windowed members.** A served :class:`~metrics_tpu.WindowedMetric`
     keeps its time-bucket ring per replica, and replicas rotate buckets at
     their own head positions — so the merged view is the SUM of per-worker
@@ -185,6 +209,7 @@ class ServeLoop:
         snapshot_manager: Optional[Any] = None,
         snapshot_every_s: Optional[float] = None,
         sync_transport: Optional[str] = None,
+        warmup: Optional[Any] = None,
     ) -> None:
         if workers < 1:
             raise ValueError(f"`workers` must be >= 1, got {workers}")
@@ -248,12 +273,31 @@ class ServeLoop:
             name=f"serve-{type(metric).__name__}",
         )
 
+        # AOT warmup (serving/warmup.py): dispatchers with shared executable
+        # tables are installed on every replica BEFORE the workers start (so
+        # no worker can race the slot), then the engine's background thread
+        # fills the tables largest tier first — serving begins immediately
+        # and goes zero-trace progressively. METRICS_TPU_WARMUP=0 is the
+        # operator escape hatch; a warmup failure records serve_warmup_error
+        # and the untraced path keeps serving.
+        self._warmup = None
+        if warmup is not None:
+            from metrics_tpu.serving.warmup import WarmupEngine, warmup_enabled
+
+            if warmup_enabled():
+                engine = WarmupEngine(metric, warmup, name=type(metric).__name__)
+                for replica in self._replicas:
+                    engine.install(replica)
+                self._warmup = engine
+
         self._threads = [
             threading.Thread(target=self._worker, args=(i,), daemon=True, name=f"serve-worker-{i}")
             for i in range(workers)
         ]
         for t in self._threads:
             t.start()
+        if self._warmup is not None:
+            self._warmup.start()
 
     # -- ingestion ------------------------------------------------------
 
@@ -348,6 +392,13 @@ class ServeLoop:
                     # poison the replica's mode check for all later traffic
                     for owner, attr, value in attr_cells:
                         setattr(owner, attr, value)
+                    # the rollback may have un-set attrs the warmup
+                    # dispatchers' verified-config memo assumed stable —
+                    # re-arm their full check so the next hit re-syncs
+                    for jit_slot in (m.__dict__.get("_update_jit"), m.__dict__.get("_compute_jit")):
+                        reset = getattr(jit_slot, "reset_verified", None)
+                        if reset is not None:
+                            reset()
                 with self._stats_lock:
                     self._failed += 1
                 record_degradation(
@@ -389,6 +440,11 @@ class ServeLoop:
 
     def _reduce_view_inner(self, snaps: List[_Snapshot]) -> Dict[str, Any]:
         reporter = _clone(self._proto)
+        if self._warmup is not None:
+            # a fresh clone starts with cold jit slots — every reduce used to
+            # re-trace compute; the warmed tables make the scheduler's
+            # compute graph a ready executable instead
+            self._warmup.install(reporter)
         from metrics_tpu.ops.quantize import resolve_codec, wrap_gather_transport
 
         codec = resolve_codec(self.sync_transport)
@@ -489,6 +545,18 @@ class ServeLoop:
         }
         return out
 
+    def wait_warmup(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until the AOT warmup thread finishes (done, failed, or
+        stopped); True when it did within the deadline. False immediately
+        when no warmup is configured (no ``warmup=`` spec, or
+        ``METRICS_TPU_WARMUP=0``). Serving never requires this — it exists
+        for deploy hooks that want "fully warmed" as a readiness signal and
+        for tests; check ``health()["serving"]["warmup"]["status"]`` for
+        the outcome."""
+        if self._warmup is None:
+            return False
+        return self._warmup.wait(timeout_s=timeout_s)
+
     def stats(self) -> Dict[str, int]:
         """Request accounting. Invariant: ``accepted + shed == offered``."""
         with self._stats_lock:
@@ -524,6 +592,10 @@ class ServeLoop:
             # cycle in flight) — same fields health_report grows per
             # overlapped metric
             "sync": self._scheduler.lag(),
+            # AOT warmup status (serving/warmup.py): pending/running/done/
+            # failed + graph counts. Informational — a failed warmup records
+            # its own serve_warmup_error event; serving itself is unaffected
+            "warmup": self._warmup.state() if self._warmup is not None else None,
         }
         return rep
 
@@ -578,6 +650,9 @@ class ServeLoop:
         and its later publishes are lost with the process)."""
         with self._stats_lock:
             self._stopping = True  # offers now raise; accepted set is final
+        if self._warmup is not None:
+            # stop compiling between entries; published executables stay valid
+            self._warmup.stop(timeout_s=timeout_s)
         if drain:
             self.drain(timeout_s)
         self._stop_workers.set()

@@ -1,0 +1,736 @@
+"""AOT warmup engine + persistent compile cache: zero-trace serving cold start.
+
+The padding ladder (``ops/padding.py``) bounds how MANY graphs ragged
+traffic compiles, but every tier still traces on its FIRST live request —
+the one serving latency wall steady-state numbers never show: a request
+that lands on a cold tier pays trace + lower + XLA compile (hundreds of
+milliseconds on this box) instead of the ~2 ms warm path. This module moves
+that cost off the request path, the same stance T3 takes with collectives
+(PAPERS.md): do the expensive work ahead of time and overlap it with live
+serving.
+
+Three layers:
+
+1. **AOT precompilation** (:class:`WarmupEngine`). The warmup matrix —
+   padding-ladder tiers x the served metric tree's update graphs, plus each
+   member's compute graph (the graph ServeLoop's AsyncSyncScheduler reduce
+   runs per cycle) — is enumerated from a caller-provided example batch
+   (:class:`Warmup`) and precompiled via ``jit(...).lower(avals).compile()``
+   against ``ShapeDtypeStruct`` avals: no real data, no device steps, on a
+   background thread, largest tier first (the most expensive miss wins
+   first). Compiled executables land in shared tables consulted by
+   :class:`AOTDispatcher` — installed as the replicas' ``_update_jit`` /
+   ``_compute_jit`` slots — so a warmed tier's first live request calls a
+   ready executable: **zero traces, zero compiles**. The engine traces on an
+   isolated clone (never a live replica: two concurrent traces through one
+   instance's state-swap would tear), and executables are shared across
+   every replica AND every reporter clone the reduce cycle builds — the
+   per-clone re-trace the reporter path used to pay per reduce is gone too.
+
+2. **Persistent compile cache** (:func:`configure_compile_cache`).
+   ``METRICS_TPU_COMPILE_CACHE_DIR`` points jax's persistent compilation
+   cache at a directory on the shared ``_envtools`` warn-once contract: a
+   restarted host's warmup finds every executable the previous process
+   compiled already serialized and pays deserialization only — a warm
+   restart compiles **0 graphs**. An unwritable/uncreatable path warns once
+   and degrades to normal in-process compilation; a corrupt cache ENTRY is
+   jax's own miss path (it recompiles) — a bad cache can cost compile time,
+   never correctness.
+
+3. **Observability.** Warmup state (``pending/running/done/failed``) rides
+   ``ServeLoop.health()["serving"]["warmup"]``; ``serve_warmup_seconds`` /
+   ``serve_warmup_graphs`` gauges and the always-on
+   ``metric_jit_retrace_total`` counter (``obs/runtime_metrics.py``) make
+   "zero traces after warmup" scrapeable in production; ``serve_warmup_done``
+   (informational — never flips ``degraded``) and ``serve_warmup_error``
+   (loud) land in the :class:`HealthRegistry`. A warmup failure NEVER blocks
+   or degrades serving: the untraced path still works, per the
+   dispatch-layer fallback stance.
+
+**Static-config safety.** A compiled executable is only valid for the
+instance configuration it was traced under. Aval keys cover the dynamic
+side (state/argument shapes+dtypes); the data-inferred side — Accuracy's
+input ``mode``, AUROC's ``num_classes``, everything in ``_snapshot_attrs``
+— is folded into the table key as a *static key* read from the live
+instance at call time, so an example batch that implied a different input
+mode than real traffic can never serve a wrong executable: the key misses
+and the normal jit path takes over (correctness by fallback, the
+``ops/dispatch.py`` rule).
+
+Module import performs python work only (no jax calls, no device arrays —
+the hang-proof bootstrap contract, ``utilities/backend.py``); jax loads
+lazily at the first compile/aval build.
+
+The enforcement story lives in ``analysis/registry.py``'s
+``warmed_ladder_serving`` entry: ``audit_recompilation``'s warmed-sweep
+budget proves a ladder precompiled tier-by-tier serves the 13-size ragged
+sweep with 0 new traces, and a seeded warmup-matrix gap fails the audit.
+"""
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce, bool_token
+
+__all__ = [
+    "Warmup",
+    "WarmupEngine",
+    "AOTDispatcher",
+    "configure_compile_cache",
+    "warmup_enabled",
+    "reset_warmup_state",
+]
+
+_CACHE_ENV = "METRICS_TPU_COMPILE_CACHE_DIR"
+_WARMUP_ENV = "METRICS_TPU_WARMUP"
+
+_warn_once = WarnOnce()
+
+
+def _parse_warmup(raw: str) -> bool:
+    value = bool_token(raw)
+    if value is None:
+        _warn_once(
+            ("warmup", raw),
+            f"{_WARMUP_ENV}={raw!r} is not a boolean token (1/0/true/false/on/off/"
+            "yes/no); warmup stays enabled (a bad env var degrades nothing here).",
+        )
+        return True
+    return value
+
+
+_ENV_WARMUP: "EnvParse[bool]" = EnvParse(_WARMUP_ENV, _parse_warmup, True)
+
+
+def warmup_enabled() -> bool:
+    """Is AOT warmup allowed? ``METRICS_TPU_WARMUP=0`` is the operator
+    escape hatch (skip precompilation, serve with on-demand tracing —
+    degraded cold-start perf, identical correctness); default on."""
+    return _ENV_WARMUP()
+
+
+# -- persistent compile cache ----------------------------------------------
+
+# memoized application: (raw env value, active dir or None) — the jax
+# config write happens once per distinct value, not per warmup run
+_cache_applied: Optional[Tuple[str, Optional[str]]] = None
+
+
+def configure_compile_cache() -> Optional[str]:
+    """Point jax's persistent compilation cache at
+    ``METRICS_TPU_COMPILE_CACHE_DIR`` (creating it if needed).
+
+    Returns the active cache directory, or ``None`` when the var is unset
+    or the path is unusable (not creatable / not writable / jax rejected
+    it) — each failure warns ONCE and degrades to normal in-process
+    compilation, never an error (the shared env contract). The entry-size
+    and min-compile-time floors are dropped to zero so every serving graph
+    is cached: the default jax floors (1 s compile time) would silently
+    skip exactly the small per-tier graphs a restarted host wants back.
+    """
+    global _cache_applied
+    raw = os.environ.get(_CACHE_ENV, "").strip()
+    if _cache_applied is not None and _cache_applied[0] == raw:
+        return _cache_applied[1]
+    if not raw:
+        _cache_applied = (raw, None)
+        return None
+    active: Optional[str] = None
+    try:
+        os.makedirs(raw, exist_ok=True)
+        probe = os.path.join(raw, f".metrics_tpu_probe_{os.getpid()}")
+        with open(probe, "w") as f:
+            f.write("probe")
+        os.remove(probe)
+    except OSError as err:
+        _warn_once(
+            ("cache-dir", raw),
+            f"{_CACHE_ENV}={raw!r} is not a usable directory ({type(err).__name__}: "
+            f"{err}); persistent compile cache disabled — cold starts pay normal "
+            "tracing (correctness unaffected)",
+        )
+        _cache_applied = (raw, None)
+        return None
+    try:
+        import jax
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        jax.config.update("jax_compilation_cache_dir", raw)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax initializes its cache singleton AT MOST ONCE per process — a
+        # compile that ran before this call (or against a previous dir)
+        # already burned that once; reset so the next compile re-reads the
+        # (new) dir
+        _cc.reset_cache()
+        active = raw
+    except Exception as err:  # noqa: BLE001 - a cache is perf, never correctness
+        _warn_once(
+            ("cache-config", raw),
+            f"jax rejected the persistent compile cache at {raw!r} "
+            f"({type(err).__name__}: {err}); continuing without it",
+        )
+        active = None
+    _cache_applied = (raw, active)
+    return active
+
+
+# -- aval keys --------------------------------------------------------------
+
+
+def _aval_key(tree: Any) -> Any:
+    """Hashable structural key of a pytree of arrays: treedef + per-leaf
+    (shape, dtype). Non-array leaves (python scalars a caller passed raw)
+    key by type — they can never match a table entry built from avals, so
+    they fall back to the normal jit path by construction."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            sig.append(("py", type(leaf)))
+        else:
+            sig.append((tuple(shape), str(dtype)))
+    return treedef, tuple(sig)
+
+
+def _avals_of(tree: Any) -> Any:
+    """The tree with every array leaf replaced by its ``ShapeDtypeStruct``
+    (no data, no device buffers) — what ``jit(...).lower`` traces against."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype), tree
+    )
+
+
+def _example_aval(value: Any, rows: Optional[int]) -> Any:
+    """A ``ShapeDtypeStruct`` for one example-batch leaf, its leading axis
+    replaced by ``rows`` (None = keep). The dtype is canonicalized exactly
+    as the padding path's ``jnp.asarray`` would (float64 -> float32 under
+    the default x64-off config), so the warmed aval matches the live one."""
+    import jax
+    import numpy as np
+
+    arr = value if hasattr(value, "shape") and hasattr(value, "dtype") else np.asarray(value)
+    dtype = jax.dtypes.canonicalize_dtype(arr.dtype)
+    shape = tuple(arr.shape)
+    if rows is not None and len(shape) >= 1:
+        shape = (rows,) + shape[1:]
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# -- the dispatcher ---------------------------------------------------------
+
+# sentinel: no config verified yet (None is a legal verified value when the
+# dispatcher has no owner-side statics to compare)
+_UNVERIFIED = object()
+
+# memoized lazy import (serving/loop.py imports this module at class-build
+# time; the reverse import must stay function-local)
+_apply_attrs_fn: Optional[Callable] = None
+
+
+def _apply_attrs(owner: Any, attrs: Any) -> None:
+    global _apply_attrs_fn
+    if _apply_attrs_fn is None:
+        from metrics_tpu.serving.loop import _apply_inferred_attrs
+
+        _apply_attrs_fn = _apply_inferred_attrs
+    _apply_attrs_fn(owner, attrs)
+
+
+class _TableEntry:
+    """One warmed executable plus the configuration it was traced under:
+    ``static`` is the template member's ``_snapshot_attrs`` snapshot AFTER
+    the trace, ``attrs`` the dotted-path attr dict a serving hit applies to
+    its owner (the same values the live trace at these avals would have
+    inferred — under trace, data-inferred config is a deterministic
+    function of the avals, since tracers have no values to branch on)."""
+
+    __slots__ = ("exe", "static", "attrs")
+
+    def __init__(self, exe: Any, static: Any, attrs: Any) -> None:
+        self.exe = exe
+        self.static = static
+        self.attrs = attrs
+
+
+def _static_compatible(live: Any, warmed: Any) -> bool:
+    """May the live instance use an executable traced under ``warmed``
+    config? Every live slot must be still-uninferred (``None`` — the trace
+    at these avals would infer exactly the warmed value) or equal; any
+    diverged non-None slot disqualifies."""
+    if live is warmed or live == warmed:
+        return True
+    if not (isinstance(live, tuple) and isinstance(warmed, tuple) and len(live) == len(warmed)):
+        return False
+    for (slot_l, val_l), (slot_w, val_w) in zip(live, warmed):
+        if slot_l != slot_w:
+            return False
+        if val_l is not None and val_l != val_w:
+            return False
+    return True
+
+
+class AOTDispatcher:
+    """Callable drop-in for a metric's ``_update_jit`` / ``_compute_jit``
+    slot with a shared table of AOT-compiled executables in front.
+
+    A call whose aval key is in the table — and whose owner's data-inferred
+    config is compatible with the entry's (every ``_snapshot_attrs`` slot
+    still-``None`` or equal) — runs the ready executable: zero traces, zero
+    compiles, the warmed fast path. Serving a hit also applies the entry's
+    inferred attrs to the owner (first-non-None-wins, the serving fold's
+    rule): the executable path performs no trace, so the attr inference the
+    trace would have done rides the entry instead — sound because inference
+    under trace is a deterministic function of the avals the entry is keyed
+    on. A miss (unwarmed shape, caller-passed python scalar, DIVERGED
+    config — e.g. live traffic inferred a different input mode than the
+    warmup example implied) falls through to the lazily-built underlying
+    jit: exactly yesterday's behavior, so warmup can only ever remove
+    latency, never change what is computed. An executable that rejects its
+    arguments at call time is dropped from the table and the jit path
+    answers — correctness by fallback, never by trust.
+
+    The table dict is shared across every replica/reporter clone of one
+    served prototype (executables are pure state-in/state-out functions,
+    instance-independent once compiled); entries are installed by the
+    :class:`WarmupEngine` thread via atomic dict assignment.
+    """
+
+    def __init__(
+        self,
+        make_jit: Callable[[], Callable],
+        table: Dict[Any, "_TableEntry"],
+        owner: Optional[Any] = None,
+        exact_static: bool = False,
+    ) -> None:
+        self._make_jit = make_jit
+        self._jit: Optional[Callable] = None
+        self.table = table
+        # weakly held: the dispatcher lives ON the owner metric
+        self._owner = weakref.ref(owner) if owner is not None else None
+        # exact_static: require the owner's data-inferred slots to EQUAL the
+        # entry's (no still-None wildcard). The wildcard is sound only for
+        # UPDATE entries, whose trace would infer the slots from these very
+        # avals; a COMPUTE trace performs no inference — a mode-None
+        # instance's cold compute raises "determine mode first", and a
+        # warmed one must do exactly the same, not fabricate a value
+        self._exact_static = exact_static
+        # the static config the owner was last verified (and attr-synced)
+        # against: a serving hit walks the owner's metric tree once, then
+        # this memo short-circuits every later hit — sound under the
+        # infer-once-then-keep contract. The ONE in-library violation of
+        # that contract is the serve worker's poison-request rollback
+        # (loop.py restores attr cells, possibly back to None), which calls
+        # :meth:`reset_verified` on both slots to re-arm the full check
+        self._verified_static: Any = _UNVERIFIED
+        self.aot_hits = 0
+        self.aot_misses = 0
+
+    def _underlying(self) -> Callable:
+        if self._jit is None:
+            self._jit = self._make_jit()
+        return self._jit
+
+    def _compatible(self, owner: Any, entry: "_TableEntry") -> bool:
+        live = _static_key(owner)
+        if self._exact_static:
+            return live == entry.static
+        return _static_compatible(live, entry.static)
+
+    def __call__(self, *args: Any) -> Any:
+        key = _aval_key(args)
+        entry = self.table.get(key)
+        if entry is not None:
+            owner = self._owner() if self._owner is not None else None
+            verified = owner is None or entry.static == self._verified_static
+            if verified or self._compatible(owner, entry):
+                try:
+                    out = entry.exe(*args)
+                except Exception as err:  # noqa: BLE001 - fall back to the jit, never fail the request
+                    # an executable the key matched but the runtime rejected
+                    # (committed-device / layout mismatch): evict so every
+                    # later call goes straight to the jit, not a re-fail —
+                    # LOUDLY: the table is shared by every replica and
+                    # future reporter clone, so the whole process just lost
+                    # this tier's warmed path for good
+                    self.table.pop(key, None)
+                    self._note_evicted(err)
+                else:
+                    if not verified:
+                        # first hit against this config: sync the owner's
+                        # data-inferred attrs (exactly the writes the trace
+                        # this executable replaced would have made — after
+                        # which owner static == entry.static, so the memo
+                        # spares every later hit the tree walk)
+                        if entry.attrs:
+                            _apply_attrs(owner, entry.attrs)
+                        self._verified_static = entry.static
+                    self.aot_hits += 1
+                    return out
+        self.aot_misses += 1
+        return self._underlying()(*args)
+
+    def reset_verified(self) -> None:
+        """Re-arm the full compatibility check + attr sync (called by the
+        serve worker's poison-request rollback, which may have un-set the
+        owner's data-inferred attrs the memo assumed stable)."""
+        self._verified_static = _UNVERIFIED
+
+    def _note_evicted(self, err: BaseException) -> None:
+        from metrics_tpu.obs.runtime_metrics import registry as _runtime
+        from metrics_tpu.resilience.health import record_degradation
+
+        owner = self._owner() if self._owner is not None else None
+        _runtime.counter("serve_aot_evicted_total").inc()
+        record_degradation(
+            "serve_aot_evicted",
+            f"warmed executable rejected its arguments at call time and was "
+            f"evicted ({type(err).__name__}: {err}); this shape serves through "
+            "the normal jit path for the rest of the process",
+            metric=type(owner).__name__ if owner is not None else "<unowned>",
+        )
+
+    # -- delegation: audits and benches poke the underlying jit -----------
+
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        return self._underlying().lower(*args, **kwargs)
+
+    def _cache_size(self) -> int:
+        jit = self._jit
+        return jit._cache_size() if jit is not None else 0
+
+
+# -- the warmup matrix ------------------------------------------------------
+
+
+class Warmup:
+    """Specification of the warmup matrix for one served metric tree.
+
+    ``example_args`` / ``example_kwargs`` describe ONE representative
+    request — shapes and dtypes only, never data (numpy arrays,
+    ``ShapeDtypeStruct``\\ s, or anything with ``shape``/``dtype`` all
+    work). Every row-aligned leading axis is re-shaped to each padding
+    tier; the tier set comes from ``ladder`` (explicit), else the live
+    ``METRICS_TPU_PAD_LADDER`` resolution via
+    :func:`~metrics_tpu.ops.padding.ladder_tiers`, bounded by ``max_rows``
+    (default: the example's own row count — serve bigger batches, raise
+    it). ``compute=False`` skips the per-member compute graphs (the
+    scheduler-reduce graphs) when only update latency matters.
+
+    The example should look like REAL traffic: data-inferred member config
+    (e.g. Accuracy's input ``mode``) is inferred from these avals during
+    warmup tracing, exactly as the first live request would infer it — a
+    mismatched example costs the warmed fast path (static-key miss, normal
+    tracing), never correctness.
+    """
+
+    def __init__(
+        self,
+        example_args: Sequence[Any],
+        example_kwargs: Optional[Dict[str, Any]] = None,
+        ladder: Optional[Sequence[int]] = None,
+        max_rows: Optional[int] = None,
+        compute: bool = True,
+    ) -> None:
+        if not example_args:
+            raise ValueError("Warmup needs at least one example update argument")
+        self.example_args = tuple(example_args)
+        self.example_kwargs = dict(example_kwargs or {})
+        self.ladder = tuple(ladder) if ladder is not None else None
+        self.max_rows = max_rows
+        self.compute = bool(compute)
+
+    def _example_rows(self) -> int:
+        import numpy as np
+
+        for v in list(self.example_args) + list(self.example_kwargs.values()):
+            shape = getattr(v, "shape", None)
+            if shape is None:
+                shape = np.asarray(v).shape
+            if len(shape) >= 1:
+                return int(shape[0])
+        raise ValueError(
+            "Warmup example has no row-aligned (>=1-dim) argument to enumerate "
+            "padding tiers from"
+        )
+
+    def tiers(self) -> Tuple[int, ...]:
+        """The padding tiers this matrix covers, ascending."""
+        from metrics_tpu.ops.padding import ladder_tiers
+
+        max_rows = self.max_rows if self.max_rows is not None else self._example_rows()
+        return ladder_tiers(max_rows, ladder=self.ladder)
+
+    def tier_avals(self, tier: int, padded: bool = True) -> Tuple[tuple, dict]:
+        """``(args_avals, kwargs_avals)`` of one padded-to-``tier`` request,
+        as the module runtime's padded update sees it: every row-aligned
+        array re-leading-dimmed to ``tier``, plus the ``(tier,)`` bool
+        ``valid`` mask ``pad_update_args`` always attaches.
+
+        ``padded=False`` (a ``pad_batches=False`` member: its live calls
+        carry the caller's raw shapes and never a pad mask) keeps the
+        example's own row count and attaches no pad mask — but a
+        caller-supplied ``valid`` example kwarg (the public row-mask
+        argument, which such traffic DOES carry) passes through like any
+        other kwarg."""
+        import numpy as np
+
+        rows = self._example_rows()
+
+        def leaf(v: Any) -> Any:
+            shape = getattr(v, "shape", None)
+            if shape is None:
+                shape = np.asarray(v).shape
+            aligned = padded and len(shape) >= 1 and int(shape[0]) == rows
+            return _example_aval(v, tier if aligned else None)
+
+        args = tuple(leaf(v) for v in self.example_args)
+        # padded: the caller's valid mask is folded into the pad mask at
+        # live time (pad_update_args ANDs them), so the example's is
+        # replaced by the (tier,) mask; unpadded: it reaches the update
+        # verbatim and must stay in the aval signature
+        kwargs = {
+            k: leaf(v)
+            for k, v in self.example_kwargs.items()
+            if not (padded and k == "valid")
+        }
+        if padded:
+            import jax
+
+            kwargs["valid"] = jax.ShapeDtypeStruct((tier,), np.dtype(bool))
+        return args, kwargs
+
+
+def _static_key(metric: Any) -> Any:
+    """The data-inferred config snapshot of a metric tree — every
+    ``_snapshot_attrs`` slot (``None`` included) as ``((path, attr),
+    value)`` pairs, via the ONE canonical walk (``serving/loop.py::
+    _attr_slots`` — the same enumeration the snapshot/rollback machinery
+    uses), so :func:`_static_compatible` can judge slot-by-slot."""
+    from metrics_tpu.serving.loop import _attr_slots
+
+    return tuple(_attr_slots(metric))
+
+
+class WarmupEngine:
+    """Precompile one served prototype's warmup matrix on a background
+    thread and install shared executable tables on its replicas.
+
+    Lifecycle: construct → :meth:`install` on each live replica (cheap,
+    synchronous — dispatchers with still-empty tables) → :meth:`start` →
+    the thread compiles entries largest tier first, publishing each
+    executable the moment it is ready (serving goes zero-trace
+    progressively). ``status`` walks ``pending → running → done|failed``;
+    a failure records ``serve_warmup_error`` and leaves serving on the
+    normal tracing path — warmup can degrade nothing but cold-start
+    latency.
+    """
+
+    def __init__(self, prototype: Any, spec: Warmup, name: Optional[str] = None) -> None:
+        if not isinstance(spec, Warmup):
+            raise TypeError(
+                f"warmup= expects a metrics_tpu.serving.Warmup spec, got {type(spec).__name__}"
+            )
+        self._proto = prototype
+        self.spec = spec
+        self.name = name or type(prototype).__name__
+        self.status = "pending"
+        self.error: Optional[str] = None
+        self.graphs_compiled = 0
+        self.graphs_skipped = 0
+        self.wall_s: Optional[float] = None
+        self.started_unix: Optional[float] = None
+        # member name -> {"update": table, "compute": table}; tables are the
+        # dicts the dispatchers hold — publishing an entry is one atomic
+        # dict assignment. Each entry carries its own static/attrs snapshot
+        # (see _TableEntry), so install() retains NOTHING: a reporter clone
+        # installing once per reduce for the life of the loop leaves no
+        # trace on the engine.
+        self._tables: Dict[str, Dict[str, Dict[Any, _TableEntry]]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        for member_name, _m in self._iter_members(prototype):
+            self._tables[member_name] = {"update": {}, "compute": {}}
+
+    @staticmethod
+    def _iter_members(obj: Any) -> List[Tuple[str, Any]]:
+        from metrics_tpu.serving.loop import _members
+
+        return _members(obj)
+
+    # -- install -----------------------------------------------------------
+
+    def install(self, obj: Any) -> None:
+        """Wire ``obj``'s members (a replica or reporter clone of the
+        prototype) to the shared executable tables. Synchronous, cheap (no
+        jax work) and retention-free — the engine holds no reference to
+        ``obj``; call before the object serves its first request. The
+        member's data-inferred attrs stay untouched here: a serving HIT
+        applies the matched entry's attrs (the dispatcher's job), and
+        traffic whose config diverges from the warmup example simply
+        misses to the normal tracing path — warmup never forces example
+        config onto live metrics."""
+        for member_name, m in self._iter_members(obj):
+            tables = self._tables.get(member_name)
+            if tables is None:
+                continue
+            m._update_jit = AOTDispatcher(m._make_update_jit, tables["update"], owner=m)
+            m._compute_jit = AOTDispatcher(
+                m._make_compute_jit, tables["compute"], owner=m, exact_static=True
+            )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "WarmupEngine":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"serve-warmup-{self.name}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        """Ask the compile loop to stop between entries (shutdown path —
+        already-published executables stay valid)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def wait(self, timeout_s: Optional[float] = None) -> bool:
+        """Block until the warmup thread finishes; True when it did."""
+        if self._thread is None:
+            return False
+        self._thread.join(timeout=timeout_s)
+        return not self._thread.is_alive()
+
+    def state(self) -> Dict[str, Any]:
+        """Plain-data warmup status for ``health()`` / exporters."""
+        out: Dict[str, Any] = {
+            "status": self.status,
+            "graphs_compiled": self.graphs_compiled,
+            "graphs_skipped": self.graphs_skipped,
+            "wall_s": self.wall_s,
+        }
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+    # -- the compile loop --------------------------------------------------
+
+    def _run(self) -> None:
+        from metrics_tpu.obs import trace as _obs_trace
+        from metrics_tpu.obs.runtime_metrics import registry as _runtime
+        from metrics_tpu.resilience.health import record_degradation
+
+        self.status = "running"
+        self.started_unix = time.time()
+        t0 = time.monotonic()
+        try:
+            with _obs_trace.span("serve.warmup", metric=self.name):
+                configure_compile_cache()
+                self._compile_matrix()
+            self.wall_s = time.monotonic() - t0
+            _runtime.gauge("serve_warmup_seconds").set(self.wall_s)
+            _runtime.gauge("serve_warmup_graphs").set(self.graphs_compiled)
+            if self._stop.is_set():
+                # shutdown interrupted the matrix: not done, not failed —
+                # the published prefix of executables stays valid
+                self.status = "stopped"
+                return
+            self.status = "done"
+            record_degradation(
+                "serve_warmup_done",
+                f"AOT warmup for {self.name} compiled {self.graphs_compiled} graphs "
+                f"({self.graphs_skipped} skipped) in {self.wall_s:.2f}s",
+                metric=self.name,
+                graphs=self.graphs_compiled,
+                wall_s=round(self.wall_s, 3),
+            )
+        except BaseException as err:  # noqa: BLE001 - warmup failure must never kill serving
+            self.wall_s = time.monotonic() - t0
+            self.status = "failed"
+            self.error = f"{type(err).__name__}: {err}"
+            _runtime.gauge("serve_warmup_seconds").set(self.wall_s)
+            _runtime.gauge("serve_warmup_graphs").set(self.graphs_compiled)
+            record_degradation(
+                "serve_warmup_error",
+                f"AOT warmup for {self.name} failed after {self.graphs_compiled} "
+                f"graphs: {self.error} — serving continues on the normal tracing path",
+                metric=self.name,
+            )
+
+    def _compile_matrix(self) -> None:
+        from metrics_tpu.obs.runtime_metrics import registry as _runtime
+        from metrics_tpu.serving.loop import _clone, _inferred_attrs
+
+        # an ISOLATED template: tracing swaps instance state in and out, and
+        # two concurrent traces through one instance would tear — the live
+        # replicas must never be the trace vehicle
+        template = _clone(self._proto)
+        graphs_gauge = _runtime.gauge("serve_warmup_graphs")
+        tiers = sorted(self.spec.tiers(), reverse=True)  # largest miss first
+        for member_name, m in self._iter_members(template):
+            if self._stop.is_set():
+                return
+            tables = self._tables[member_name]
+            # an unpadded member's live calls carry the caller's raw shapes
+            # and no `valid` mask — the tier matrix is meaningless for it
+            # (and tracing a pad-mask kwarg it never receives would fail the
+            # whole warmup every boot); warm its example shape as given
+            padded = bool(getattr(m, "pad_batches", False))
+            member_tiers = tiers if padded else [self.spec._example_rows()]
+            if not m._can_jit_update() or m.compute_on_cpu or m.debug_checks:
+                # eager-only / checkify members never take the jit slot at
+                # runtime either — nothing to precompile, nothing lost; the
+                # skip count is the member's ACTUAL matrix size, so the
+                # compiled+skipped accounting reconciles for mixed trees
+                self.graphs_skipped += len(member_tiers) + (1 if self.spec.compute else 0)
+                continue
+            state_avals = _avals_of(dict(m._defaults))
+            update_jit = m._make_update_jit()
+            for tier in member_tiers:
+                if self._stop.is_set():
+                    return
+                args_avals, kwargs_avals = self.spec.tier_avals(tier, padded=padded)
+                # tracing runs the member's own update body on abstract
+                # values: data-inferred attrs (input mode & co) resolve here
+                # exactly as the first live request AT THESE AVALS would
+                # resolve them — the entry carries that snapshot so a
+                # serving hit can apply it (the trace it replaces would
+                # have), and a diverged live config misses instead
+                exe = update_jit.lower(state_avals, args_avals, kwargs_avals).compile()
+                key = _aval_key((state_avals, args_avals, kwargs_avals))
+                tables["update"][key] = _TableEntry(exe, _static_key(m), _inferred_attrs(m))
+                self.graphs_compiled += 1
+                graphs_gauge.set(self.graphs_compiled)
+            if self.spec.compute and m._can_jit_compute():
+                if self._stop.is_set():
+                    return
+                compute_jit = m._make_compute_jit()
+                exe = compute_jit.lower(state_avals).compile()
+                key = _aval_key((state_avals,))
+                tables["compute"][key] = _TableEntry(exe, _static_key(m), _inferred_attrs(m))
+                self.graphs_compiled += 1
+                graphs_gauge.set(self.graphs_compiled)
+            elif self.spec.compute:
+                self.graphs_skipped += 1
+
+
+def reset_warmup_state() -> None:
+    """Test hook (the shared ``reset_*_state`` contract): clear the
+    warn-once memory, the memoized env parses, and the applied-cache memo;
+    the jax cache-dir config itself is NOT unset (jax treats it as global
+    process state — tests that set it point it at a tmpdir)."""
+    global _cache_applied
+    _warn_once.reset()
+    _ENV_WARMUP.reset()
+    _cache_applied = None

@@ -88,6 +88,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "coldstart: the serving cold-start layer (serving/warmup.py — AOT "
+        "warmup engine, executable dispatch tables, the persistent compile "
+        "cache behind METRICS_TPU_COMPILE_CACHE_DIR) plus the warmed-sweep "
+        "audit budget; select with -m coldstart, or run the lane via "
+        "`make test-coldstart` (the subprocess warm-restart acceptance — a "
+        "second process compiling 0 graphs — is additionally marked slow "
+        "and runs in CI through that target)",
+    )
+    config.addinivalue_line(
+        "markers",
         "async_sync: the overlapped async sync layer (parallel/async_sync.py "
         "scheduler, Metric(sync_mode='overlapped'), pure.py::"
         "overlapped_functionalize) — double-buffered zero-collective-latency "

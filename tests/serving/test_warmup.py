@@ -1,0 +1,675 @@
+"""AOT warmup engine contracts (ISSUE 13, ``serving/warmup.py``).
+
+THE acceptance: a warmed ``ServeLoop`` over the ladder-padded guarded
+metric serves the full ragged sweep with **0 new traces** (the promoted
+``metric_jit_retrace_total`` counter pins it live, the
+``warmed_ladder_serving`` registry entry pins it structurally, and a
+seeded warmup-matrix gap fails the audit). Plus: matrix enumeration,
+dispatcher hit/fallback parity, static-config safety, warmup failure
+isolation (serving never blocks or degrades), health/scrape surfaces, and
+the env contracts for ``METRICS_TPU_WARMUP`` /
+``METRICS_TPU_COMPILE_CACHE_DIR``.
+"""
+import copy
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.analysis.graph_audit import audit_recompilation
+from metrics_tpu.obs.runtime_metrics import registry as runtime_registry
+from metrics_tpu.ops import padding
+from metrics_tpu.resilience.health import health_report
+from metrics_tpu.resilience.health import registry as health_registry
+from metrics_tpu.serving.warmup import (
+    AOTDispatcher,
+    Warmup,
+    WarmupEngine,
+    configure_compile_cache,
+    reset_warmup_state,
+    warmup_enabled,
+)
+
+pytestmark = [pytest.mark.serving, pytest.mark.coldstart]
+
+LADDER = (8, 32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", ",".join(str(t) for t in LADDER))
+    monkeypatch.delenv("METRICS_TPU_WARMUP", raising=False)
+    monkeypatch.delenv("METRICS_TPU_COMPILE_CACHE_DIR", raising=False)
+    padding.reset_padding_state()
+    reset_warmup_state()
+    health_registry.clear()
+    yield
+    padding.reset_padding_state()
+    reset_warmup_state()
+    health_registry.clear()
+    # the cache tests re-point jax's persistent compile cache at pytest
+    # tmpdirs — restore the process default so the REST of the suite never
+    # writes cache entries behind our back
+    if jax.config.jax_compilation_cache_dir is not None:
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        _cc.reset_cache()
+
+
+def _example(rows=16, classes=4):
+    return (np.zeros((rows, classes), np.float32), np.zeros((rows,), np.int32))
+
+
+def _batch(rng, n, classes=4):
+    return (
+        jnp.asarray(rng.random((n, classes), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, classes, n).astype(np.int32)),
+    )
+
+
+def _retraces():
+    return runtime_registry.counters().get("metric_jit_retrace_total", 0)
+
+
+# --------------------------------------------------------------------------
+# matrix enumeration (ops/padding.py::ladder_tiers)
+# --------------------------------------------------------------------------
+
+
+def test_ladder_tiers_explicit_ladder():
+    assert padding.ladder_tiers(100, ladder=(8, 32, 128)) == (8, 32, 128)
+    # only the reachable prefix: nothing past the first tier covering max
+    assert padding.ladder_tiers(5, ladder=(8, 32, 128)) == (8,)
+    assert padding.ladder_tiers(8, ladder=(8, 32, 128)) == (8,)
+    assert padding.ladder_tiers(9, ladder=(8, 32, 128)) == (8, 32)
+    # above the top tier: the pow-2 overflow tiers tier_for would use
+    assert padding.ladder_tiers(200, ladder=(8, 32, 128)) == (8, 32, 128, 256)
+    with pytest.raises(ValueError):
+        padding.ladder_tiers(0)
+
+
+def test_ladder_tiers_pow2_and_env(monkeypatch):
+    assert padding.ladder_tiers(5, ladder=()) == (1, 2, 4, 8)
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "16,64")
+    padding.reset_padding_state()
+    assert padding.ladder_tiers(50) == (16, 64)
+    # every enumerated tier is exactly what tier_for routes a size to
+    for n in range(1, 51):
+        assert padding.tier_for(n) in padding.ladder_tiers(50)
+
+
+def test_warmup_spec_tiers_and_avals():
+    spec = Warmup(example_args=_example(16), max_rows=32)
+    assert spec.tiers() == LADDER
+    args, kwargs = spec.tier_avals(32)
+    assert args[0].shape == (32, 4) and str(args[0].dtype) == "float32"
+    assert args[1].shape == (32,) and str(args[1].dtype) == "int32"
+    assert kwargs["valid"].shape == (32,) and kwargs["valid"].dtype == np.dtype(bool)
+    with pytest.raises(ValueError):
+        Warmup(example_args=())
+
+
+# --------------------------------------------------------------------------
+# dispatcher semantics
+# --------------------------------------------------------------------------
+
+
+def test_dispatcher_hit_fallback_and_parity():
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    engine = WarmupEngine(proto, Warmup(example_args=_example(), max_rows=32))
+    warmed = copy.deepcopy(proto)
+    warmed.reset()
+    engine.install(warmed)
+    engine.start()
+    assert engine.wait(timeout_s=180)
+    assert engine.state()["status"] == "done"
+
+    rng = np.random.default_rng(3)
+    ref = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    sizes = (3, 8, 9, 32, 5)
+    for n in sizes:
+        p, t = _batch(np.random.default_rng(n), n)
+        warmed.update(p, t)
+        ref.update(p, t)
+    # every in-ladder request took the executable path, values bit-equal
+    assert warmed._update_jit.aot_hits == len(sizes)
+    assert warmed._update_jit.aot_misses == 0
+    assert float(warmed.compute()) == float(ref.compute())
+    assert warmed._compute_jit.aot_hits == 1
+
+    # an un-warmed shape (above the matrix) falls back to the jit path —
+    # identical semantics, just traced
+    p, t = _batch(rng, 40)  # pads to pow-2 overflow tier 64: not in matrix
+    warmed.update(p, t)
+    ref.update(p, t)
+    assert warmed._update_jit.aot_misses == 1
+    assert float(warmed.compute(fresh=True)) == float(ref.compute(fresh=True))
+
+
+def test_dispatcher_static_key_guards_inferred_config():
+    # two instances whose STATE avals agree but whose data-inferred config
+    # diverged must not share executables: a diverged static key misses
+    table = {}
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    engine = WarmupEngine(proto, Warmup(example_args=_example(), max_rows=8))
+    m = copy.deepcopy(proto)
+    m.reset()
+    engine.install(m)
+    engine.start()
+    assert engine.wait(timeout_s=180)
+    table = engine._tables[""]["update"]
+    assert table  # warmed entries exist
+    # poison the instance's inferred mode: keys must stop matching
+    before_hits = m._update_jit.aot_hits
+    m.mode = "diverged-mode-token"
+    p, t = _batch(np.random.default_rng(0), 8)
+    try:
+        m.update(p, t)
+    except Exception:
+        pass  # the fake mode may break the eager path — irrelevant here
+    assert m._update_jit.aot_hits == before_hits  # never served a stale exe
+
+
+def test_dispatcher_evicts_rejecting_executable():
+    from metrics_tpu.serving.warmup import _aval_key, _TableEntry
+
+    calls = {"jit": 0}
+
+    def make_jit():
+        def fallback(x):
+            calls["jit"] += 1
+            return x
+
+        return fallback
+
+    class _Rejecting:
+        def __call__(self, *a):
+            raise TypeError("compiled for other avals")
+
+    d = AOTDispatcher(make_jit, table={})
+    x = jnp.ones((4,), jnp.float32)
+    key = _aval_key((x,))
+    d.table[key] = _TableEntry(_Rejecting(), None, None)
+    out = d(x)
+    assert out is x and calls["jit"] == 1
+    assert key not in d.table  # evicted: next call skips the retry
+    d(x)
+    assert calls["jit"] == 2
+    # the eviction is LOUD: the shared table lost this shape for good
+    assert health_registry.counts().get("serve_aot_evicted") == 1
+    assert runtime_registry.counters().get("serve_aot_evicted_total") == 1
+
+
+def test_poison_rollback_rearms_dispatcher_memo(monkeypatch):
+    """A failed request's rollback un-sets the replica's inferred attrs —
+    the dispatcher memo must be re-armed so the NEXT request re-syncs them
+    (regression: the memo's fast path skipped the attr application forever,
+    leaving mode=None — snapshots carried no mode and the reporter's
+    compute raised on every reduce). Trigger: the first request's warm hit
+    applies attrs + sets the memo, then its snapshot build fails (the
+    worker guard covers update AND snapshot), so the rollback restores the
+    pre-request (None) attr cells."""
+    import metrics_tpu.serving.loop as loop_module
+
+    real_snapshot = loop_module._snapshot_of
+    boom = {"armed": True}
+
+    def flaky_snapshot(obj):
+        if boom["armed"]:
+            boom["armed"] = False
+            raise RuntimeError("injected snapshot failure")
+        return real_snapshot(obj)
+
+    monkeypatch.setattr(loop_module, "_snapshot_of", flaky_snapshot)
+
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    spec = Warmup(example_args=_example(), max_rows=8)
+    rng = np.random.default_rng(3)
+    with mt.ServeLoop(proto, workers=1, warmup=spec) as loop:
+        assert loop.wait_warmup(timeout_s=300)
+        p, t = _batch(rng, 8)
+        assert loop.offer(p, t)  # warm hit applied attrs, then snapshot blew up
+        assert loop.drain(60)
+        assert loop.report()["stats"]["failed"] == 1
+        # the rollback un-set the replica's inferred mode with the rest
+        assert all(m.mode is None for m in loop._replicas)
+        # a later request: the warmed hit must RE-sync attrs (memo re-armed
+        # by the rollback), and the reporter must compute a real value
+        good_p, good_t = _batch(rng, 8)
+        assert loop.offer(good_p, good_t)
+        assert loop.drain(60)
+        view = loop.report(fresh=True, deadline_s=60)
+        assert all(m.mode is not None for m in loop._replicas)
+        ref = mt.Accuracy(num_classes=4)
+        ref.update(good_p, good_t)
+        assert view["value"] == pytest.approx(float(ref.compute()), abs=0)
+
+
+def test_compute_on_never_updated_warmed_metric_raises_like_cold():
+    """The compute table is keyed on state avals alone, and a COMPUTE trace
+    performs no config inference — so a never-updated warmed instance must
+    take the jit path and raise exactly as a cold one does (regression: the
+    None-slot-compatible rule let it serve the warmup example's executable,
+    fabricating a value AND stamping the example's mode onto the live
+    metric, which then rejected legitimate diverged traffic)."""
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    engine = WarmupEngine(proto, Warmup(example_args=_example(), max_rows=8))
+    warmed = copy.deepcopy(proto)
+    warmed.reset()
+    engine.install(warmed)
+    engine.start()
+    assert engine.wait(timeout_s=180)
+
+    cold = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    with pytest.raises(Exception) as cold_err, warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # the compute-before-update warning
+        cold.compute()
+    with pytest.raises(Exception) as warm_err, warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        warmed.compute()
+    assert type(warm_err.value) is type(cold_err.value)
+    assert warmed._compute_jit.aot_hits == 0  # never served the example's exe
+    assert warmed.mode is None  # ...and never stamped its config
+
+
+def test_diverged_traffic_mode_misses_and_serves_correctly():
+    """The warmup example implied multi-class, but live traffic is
+    MULTI-LABEL: warmup must never force example-inferred config onto live
+    metrics — the diverged stream takes the normal tracing path and
+    computes correctly (regression: install() used to write the template's
+    inferred `mode` onto replicas, making every multilabel request raise)."""
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    spec = Warmup(example_args=_example(16), max_rows=8)  # multi-class shaped
+    rng = np.random.default_rng(13)
+    with mt.ServeLoop(proto, workers=1, warmup=spec) as loop:
+        assert loop.wait_warmup(timeout_s=300)
+        # multilabel request: (n, 4) float preds + (n, 4) 0/1 int target
+        p = jnp.asarray(rng.random((8, 4), dtype=np.float32))
+        t = jnp.asarray(rng.integers(0, 2, (8, 4)).astype(np.int32))
+        assert loop.offer(p, t)
+        assert loop.drain(60)
+        view = loop.report(fresh=True, deadline_s=60)
+        assert view["stats"]["failed"] == 0  # the request was served, not poisoned
+        ref = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+        ref.update(p, t)
+        assert view["value"] == pytest.approx(float(ref.compute()), abs=0)
+
+
+# --------------------------------------------------------------------------
+# THE acceptance: warmed ServeLoop serves the ragged sweep with 0 new traces
+# --------------------------------------------------------------------------
+
+
+def test_serveloop_zero_traces_after_warmup():
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    spec = Warmup(example_args=_example(), max_rows=32)
+    rng = np.random.default_rng(7)
+    with mt.ServeLoop(proto, workers=2, warmup=spec) as loop:
+        assert loop._warmup is not None
+        assert loop.wait_warmup(timeout_s=300)
+        assert loop.health()["serving"]["warmup"]["status"] == "done"
+
+        sweep = (1, 3, 7, 8, 9, 20, 31, 32, 5, 12, 30, 2, 16)  # 13 ragged sizes
+        batches = [_batch(rng, n) for n in sweep]
+        before = _retraces()
+        for p, t in batches:
+            assert loop.offer(p, t)
+        assert loop.drain(60)
+        view = loop.report(fresh=True, deadline_s=60)
+        assert _retraces() - before == 0  # zero traces after warmup, live
+        hits = sum(m._update_jit.aot_hits for m in loop._replicas)
+        misses = sum(m._update_jit.aot_misses for m in loop._replicas)
+        assert hits == len(sweep) and misses == 0
+        # the single-stream reference (its own jits trace — built only
+        # AFTER the zero-trace window above closed)
+        ref = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+        for p, t in batches:
+            ref.update(p, t)
+        assert view["value"] == pytest.approx(float(ref.compute()), abs=0)
+        # the reporter clone's compute graph is warmed too (the scheduler-
+        # reduce graph: no per-reduce re-trace)
+        assert loop._last_reporter._compute_jit.aot_hits >= 1
+
+
+def test_warmed_collection_serves_zero_trace():
+    coll = mt.MetricCollection(
+        {
+            "acc": mt.Accuracy(num_classes=4, on_invalid="warn", pad_batches=True),
+            "f1": mt.F1Score(
+                num_classes=4, average="macro", on_invalid="warn", pad_batches=True
+            ),
+        }
+    )
+    spec = Warmup(example_args=_example(), max_rows=8)
+    rng = np.random.default_rng(11)
+    with mt.ServeLoop(coll, workers=1, warmup=spec) as loop:
+        assert loop.wait_warmup(timeout_s=300)
+        before = _retraces()
+        for n in (2, 8, 5, 7):
+            p, t = _batch(rng, n)
+            assert loop.offer(p, t)
+        assert loop.drain(60)
+        view = loop.report(fresh=True, deadline_s=60)
+    assert _retraces() - before == 0
+    assert set(view["value"]) == {"acc", "f1"}
+
+
+def test_unpadded_member_warms_example_shape_without_valid_kwarg():
+    """A pad_batches=False prototype must not be traced with the padded
+    call's `valid` mask (its live calls never carry one — that would fail
+    warmup every boot): warmup compiles its example shape as given, and a
+    live request at that shape takes the executable path."""
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop")  # no padding
+    engine = WarmupEngine(proto, Warmup(example_args=_example(16), max_rows=32))
+    warmed = copy.deepcopy(proto)
+    warmed.reset()
+    engine.install(warmed)
+    engine.start()
+    assert engine.wait(timeout_s=180)
+    assert engine.state()["status"] == "done"
+
+    before = _retraces()
+    p, t = _batch(np.random.default_rng(0), 16)  # the example's own shape
+    warmed.update(p, t)
+    assert warmed._update_jit.aot_hits == 1 and warmed._update_jit.aot_misses == 0
+    assert _retraces() - before == 0
+    # a different raw shape is an honest miss (unpadded: no tier to land on)
+    p, t = _batch(np.random.default_rng(1), 9)
+    warmed.update(p, t)
+    assert warmed._update_jit.aot_misses == 1
+
+
+def test_unpadded_member_with_caller_valid_kwarg_warms_matched():
+    """`valid=` is a PUBLIC row-mask kwarg unpadded traffic may carry — an
+    example that includes it must warm an aval signature that includes it
+    (regression: tier_avals dropped the example's `valid` unconditionally,
+    so every live call missed and the compiled entry was dead weight)."""
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop")  # no padding
+    spec = Warmup(
+        example_args=_example(16),
+        example_kwargs={"valid": np.ones((16,), bool)},
+    )
+    engine = WarmupEngine(proto, spec)
+    warmed = copy.deepcopy(proto)
+    warmed.reset()
+    engine.install(warmed)
+    engine.start()
+    assert engine.wait(timeout_s=180)
+    assert engine.state()["status"] == "done"
+
+    rng = np.random.default_rng(2)
+    p, t = _batch(rng, 16)
+    mask = jnp.asarray(np.array([True] * 12 + [False] * 4))
+    warmed.update(p, t, valid=mask)
+    assert warmed._update_jit.aot_hits == 1 and warmed._update_jit.aot_misses == 0
+    ref = mt.Accuracy(num_classes=4, on_invalid="drop")
+    ref.update(p, t, valid=mask)
+    assert float(warmed.compute()) == float(ref.compute())
+
+
+def test_reporter_installs_are_retention_free():
+    """Reporter clones install once per background reduce for the life of
+    the loop — the engine must hold NO reference to installed objects
+    (regression: an earlier draft retained a weakref per install forever);
+    a dispatcher's owner ref must not keep its metric alive either."""
+    import gc
+    import weakref
+
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    engine = WarmupEngine(proto, Warmup(example_args=_example(), max_rows=8))
+    engine.start()
+    assert engine.wait(timeout_s=180)
+    clone = copy.deepcopy(proto)
+    clone.reset()
+    engine.install(clone)
+    ref = weakref.ref(clone)
+    del clone
+    gc.collect()
+    assert ref() is None  # neither the engine nor the dispatcher pins it
+
+
+def test_merged_registries_carry_gauges():
+    from metrics_tpu.obs.runtime_metrics import RuntimeMetrics, merged
+
+    a, b = RuntimeMetrics(), RuntimeMetrics()
+    a.gauge("serve_warmup_graphs").set(4)
+    a.counter("x").inc(2)
+    b.gauge("serve_warmup_graphs").set(7)  # fresher report wins
+    out = merged(a, b)
+    assert out.gauges() == {"serve_warmup_graphs": 7.0}
+    assert out.counters()["x"] == 2
+
+
+# --------------------------------------------------------------------------
+# failure isolation + health surfaces
+# --------------------------------------------------------------------------
+
+
+def test_warmup_failure_never_blocks_serving():
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    # a rank-4 example no classification metric can trace: warmup fails
+    bad = Warmup(example_args=(np.zeros((16, 4, 2, 2), np.float32),), max_rows=8)
+    rng = np.random.default_rng(5)
+    with mt.ServeLoop(proto, workers=2, warmup=bad) as loop:
+        assert loop.wait_warmup(timeout_s=180)
+        state = loop.health()["serving"]["warmup"]
+        assert state["status"] == "failed" and "error" in state
+        # loud: the event is recorded...
+        assert health_registry.counts().get("serve_warmup_error") == 1
+        # ...and serving is entirely unaffected
+        p, t = _batch(rng, 6)
+        assert loop.offer(p, t)
+        assert loop.drain(60)
+        view = loop.report(fresh=True, deadline_s=60)
+        assert view["value"] is not None
+        assert view["stats"]["failed"] == 0
+
+
+def test_warmup_done_event_is_informational():
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    with mt.ServeLoop(proto, workers=1, warmup=Warmup(example_args=_example(), max_rows=8)) as loop:
+        assert loop.wait_warmup(timeout_s=300)
+    assert health_registry.counts().get("serve_warmup_done") == 1
+    report = health_report()
+    assert report["degraded"] is False  # a milestone, not a degradation
+    # a REAL degradation still flips it
+    health_registry.record("serve_warmup_error", "boom")
+    assert health_report()["degraded"] is True
+
+
+def test_warmup_state_and_gauges_scrapeable():
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    with mt.ServeLoop(proto, workers=1, warmup=Warmup(example_args=_example(), max_rows=8)) as loop:
+        assert loop.wait_warmup(timeout_s=300)
+        state = loop.health()["serving"]["warmup"]
+        assert state["status"] == "done"
+        assert state["graphs_compiled"] >= 2  # >=1 update tier + compute
+        assert state["wall_s"] > 0
+        text = loop.scrape()
+    assert "metrics_tpu_serve_warmup_graphs" in text
+    assert "metrics_tpu_serve_warmup_seconds" in text
+    assert "metrics_tpu_metric_jit_retrace_total" in text
+    gauges = runtime_registry.gauges()
+    assert gauges["serve_warmup_graphs"] == state["graphs_compiled"]
+
+
+def test_no_warmup_health_reads_none():
+    with mt.ServeLoop(mt.Accuracy(num_classes=4, pad_batches=True), workers=1) as loop:
+        assert loop.health()["serving"]["warmup"] is None
+
+
+# --------------------------------------------------------------------------
+# env contracts
+# --------------------------------------------------------------------------
+
+
+def test_warmup_env_gate(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_WARMUP", "0")
+    reset_warmup_state()
+    assert warmup_enabled() is False
+    proto = mt.Accuracy(num_classes=4, on_invalid="drop", pad_batches=True)
+    with mt.ServeLoop(proto, workers=1, warmup=Warmup(example_args=_example(), max_rows=8)) as loop:
+        assert loop._warmup is None  # the escape hatch skipped the engine
+        assert loop.wait_warmup(timeout_s=1) is False  # public form agrees
+
+
+def test_warmup_env_malformed_warns_once_and_stays_on(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_WARMUP", "bananas")
+    reset_warmup_state()
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        assert warmup_enabled() is True
+        assert warmup_enabled() is True
+    assert len([w for w in seen if "METRICS_TPU_WARMUP" in str(w.message)]) == 1
+
+
+def test_compile_cache_dir_contract(tmp_path, monkeypatch):
+    # unset -> no cache
+    assert configure_compile_cache() is None
+    # a FILE at the path -> warn once, degrade to no cache
+    bad = tmp_path / "cachefile"
+    bad.write_text("not a directory")
+    monkeypatch.setenv("METRICS_TPU_COMPILE_CACHE_DIR", str(bad))
+    reset_warmup_state()
+    with warnings.catch_warnings(record=True) as seen:
+        warnings.simplefilter("always")
+        assert configure_compile_cache() is None
+        assert configure_compile_cache() is None  # memoized, still None
+    assert len([w for w in seen if "METRICS_TPU_COMPILE_CACHE_DIR" in str(w.message)]) == 1
+    # a good (not yet existing) dir -> created + configured
+    good = tmp_path / "cc" / "nested"
+    monkeypatch.setenv("METRICS_TPU_COMPILE_CACHE_DIR", str(good))
+    reset_warmup_state()
+    assert configure_compile_cache() == str(good)
+    assert good.is_dir()
+    assert jax.config.jax_compilation_cache_dir == str(good)
+
+
+def test_persistent_cache_restart_in_process(tmp_path, monkeypatch):
+    """In-process warm-restart simulation: jax.clear_caches() drops every
+    in-memory trace/executable cache, so a recompile of the same graph must
+    come back from the persistent disk cache with 0 XLA compiles (the
+    subprocess acceptance in test_coldstart.py runs the real two-process
+    form; this pins the mechanism in the fast lane)."""
+    monkeypatch.setenv("METRICS_TPU_COMPILE_CACHE_DIR", str(tmp_path / "cc"))
+    reset_warmup_state()
+    assert configure_compile_cache() == str(tmp_path / "cc")
+
+    events = []
+    jax.monitoring.register_event_listener(lambda name, **kw: events.append(name))
+    try:
+        def step(x):
+            return (jnp.sin(x) * jnp.arange(x.shape[0])).sum()
+
+        x = jnp.linspace(0.0, 1.0, 1000)
+        jax.jit(step)(x).block_until_ready()
+        assert events.count("/jax/compilation_cache/cache_misses") >= 1
+        jax.clear_caches()
+        events.clear()
+        jax.jit(step)(x).block_until_ready()
+        assert events.count("/jax/compilation_cache/cache_misses") == 0
+        assert events.count("/jax/compilation_cache/cache_hits") >= 1
+    finally:
+        jax.monitoring.clear_event_listeners()
+
+
+# --------------------------------------------------------------------------
+# the registry budget: zero traces after warmup, gap regression
+# --------------------------------------------------------------------------
+
+
+def _ladder_entry():
+    from metrics_tpu.analysis.registry import _build_ladder_raw_step, _ladder_make_args
+
+    return _build_ladder_raw_step(), _ladder_make_args
+
+
+@pytest.mark.slow
+def test_warmed_audit_full_matrix_passes():
+    fn, make_args = _ladder_entry()
+    violations = audit_recompilation(
+        fn,
+        make_args,
+        entry="warmed_ladder_serving",
+        sweep_sizes=(1, 3, 7, 8, 9, 20, 31, 32, 33, 57, 100, 127, 128),
+        warmup_sizes=(8, 32, 128),
+        max_new_graphs=0,
+    )
+    assert violations == []
+
+
+def test_warmed_audit_seeded_gap_fails():
+    """Drop one tier from the warmup matrix: its first sweep touch retraces
+    and the warmed budget must fail naming the gap."""
+    fn, make_args = _ladder_entry()
+    violations = audit_recompilation(
+        fn,
+        make_args,
+        entry="gapped",
+        sweep_sizes=(1, 8, 9, 20, 32),
+        warmup_sizes=(8,),  # tier 32 missing: sweep sizes 9..32 must trace
+        max_new_graphs=0,
+    )
+    assert len(violations) == 1
+    assert "warmup matrix has a gap" in violations[0].detail
+
+
+def test_warmed_audit_gap_at_batch_sizes_tier_still_fails():
+    """The gap detector must not credit graphs the audit's OWN earlier
+    checks traced: batch_sizes=(4, 8) both pad to tier 8, and a warmup
+    matrix missing tier 8 used to pass because the sweep hit check-2's
+    cached graph (regression: the warmed sweep now runs a fresh jit)."""
+    fn, make_args = _ladder_entry()
+    violations = audit_recompilation(
+        fn,
+        make_args,
+        entry="gap-at-check2-tier",
+        sweep_sizes=(1, 8, 9, 20, 32),
+        warmup_sizes=(32,),  # tier 8 missing — exactly check 2's tier
+        max_new_graphs=0,
+    )
+    assert len(violations) == 1
+    assert "warmup matrix has a gap" in violations[0].detail
+
+
+def test_warmed_audit_requires_sweep():
+    fn, make_args = _ladder_entry()
+    with pytest.raises(ValueError, match="sweep_sizes"):
+        audit_recompilation(fn, make_args, warmup_sizes=(8,))
+
+
+# --------------------------------------------------------------------------
+# pure-layer entry points (the overlapped defs expose lowerable entries)
+# --------------------------------------------------------------------------
+
+
+def test_pure_entry_points_lower_from_eval_shape_avals():
+    mdef = mt.functionalize(mt.MeanMetric())
+    eps = mdef.entry_points()
+    assert set(eps) == {"update", "compute"}
+    s_avals = jax.eval_shape(mdef.init)
+    batch = jax.ShapeDtypeStruct((64,), jnp.float32)
+    jax.jit(eps["update"]).lower(s_avals, batch).compile()
+    jax.jit(eps["compute"]).lower(s_avals).compile()
+
+
+def test_overlapped_entry_points_lower_from_eval_shape_avals():
+    odef = mt.overlapped_functionalize(mt.MeanMetric())
+    eps = odef.entry_points()
+    assert set(eps) == {"update", "cycle", "read", "read_fresh", "lag"}
+    s_avals = jax.eval_shape(odef.init)
+    batch = jax.ShapeDtypeStruct((64,), jnp.float32)
+    compiled = {}
+    for name, fn in eps.items():
+        args = (s_avals, batch) if name == "update" else (s_avals,)
+        compiled[name] = jax.jit(fn).lower(*args).compile()
+    # the AOT executables are live: run one update->cycle->read round trip
+    s = odef.init()
+    s = compiled["update"](s, jnp.linspace(0.0, 1.0, 64))
+    s = compiled["cycle"](s)
+    assert float(compiled["read"](s)) == pytest.approx(0.5, abs=1e-6)
