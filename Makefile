@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart
+.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -103,6 +103,16 @@ test-obs:
 # SIGKILLs the group, so a wedged child can never hang the lane.
 test-coldstart:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m coldstart -p no:cacheprovider
+
+# The online drift-detection workload (obs/drift.py — reference windows,
+# KS/PSI/churn/cardinality scoring with pinned thresholds, episode-gated
+# drift_detected/drift_recovered alerting, ServeLoop cadence checks, fleet
+# federation of per-host scores): everything the `drift` marker selects,
+# INCLUDING the slow examples/drift_monitor.py subprocess acceptance (hot-
+# swapped traffic distribution crossing the scraped gauge) under a hard
+# timeout.
+test-drift:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m drift -p no:cacheprovider
 
 # The quantized sync transport layer (ops/quantize.py wire codecs + the
 # fused_sync quantized wire + overlapped-cycle compressed gathers + the

@@ -1,0 +1,124 @@
+"""Online drift detection end to end: bless a reference window, serve
+ragged live traffic, hot-swap the traffic distribution mid-stream, and
+watch the rollout regression page — gauge crossing + health event —
+within one window rotation, all from O(sketch) state.
+
+The drift story (ISSUE 14): a model's max-softmax confidence is the
+canary distribution. A :class:`~metrics_tpu.DriftMonitor` freezes a
+``ReferenceWindow`` (QuantileSketch + CountMin + HLL, a few KiB — never
+raw rows) from a blessed traffic period, then rides
+``ServeLoop(drift_monitors=...)``: every accepted request's confidence
+column folds into the live window sketches (O(1) on the offer path), and
+the reducer cadence scores live-vs-reference host-side — KS distance and
+PSI from the sketch CDFs, heavy-hitter churn from CountMin, a
+cardinality-spike ratio from HLL. When the "rollout" degrades the model,
+the scraped ``metrics_tpu_drift_ks`` gauge crosses its threshold, ONE
+episode-gated ``drift_detected`` event lands in ``health_report()``, and
+the same scores federate fleet-ward via ``loop.fleet_extra()`` so a
+global aggregator would name this host.
+
+Run: ``python examples/drift_monitor.py``
+"""
+import os
+
+import numpy as np
+
+import metrics_tpu as mt
+from metrics_tpu.resilience.health import registry
+
+NUM_CLASSES = 10
+WINDOW = 2048
+
+# any ragged batch size pads up to one of these tiers
+os.environ["METRICS_TPU_PAD_LADDER"] = "64,256"
+from metrics_tpu.ops.padding import reset_padding_state
+
+reset_padding_state()
+
+
+def batch(rng, conf, n):
+    """One ragged (preds, target) request; `conf` sets how peaked the
+    model's softmax is — the distribution the monitor watches."""
+    preds = rng.random((n, NUM_CLASSES)).astype(np.float32)
+    preds[np.arange(n), rng.integers(0, NUM_CLASSES, n)] += conf
+    preds /= preds.sum(axis=-1, keepdims=True)
+    return preds, rng.integers(0, NUM_CLASSES, n).astype(np.int32)
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # 1) bless the reference: stream a known-good period through the
+    #    monitor, freeze it, round-trip it through the primitive snapshot
+    #    forms (how a real deployment would store it next to the model)
+    monitor = mt.DriftMonitor(
+        "confidence",
+        window=WINDOW,
+        min_rows=WINDOW // 4,
+        extract=lambda args, kwargs: np.max(np.asarray(args[0]), axis=-1),
+    )
+    for _ in range(32):
+        preds, _target = batch(rng, conf=3.0, n=128)
+        monitor.observe(np.max(preds, axis=-1))
+    blessed = monitor.freeze_reference()
+    monitor.rotate()
+    monitor.set_reference(mt.ReferenceWindow.from_primitives(blessed.to_primitives()))
+    print(f"blessed reference: {blessed.rows} rows, {len(blessed.hh_keys)} heavy hitters")
+
+    # 2) serve ragged live traffic with the monitor riding the loop
+    loop = mt.ServeLoop(
+        mt.Accuracy(num_classes=NUM_CLASSES, on_invalid="drop", pad_batches=True),
+        workers=2,
+        reduce_every_s=0.05,
+        drift_monitors=[monitor],
+    )
+    for _ in range(40):
+        loop.offer(*batch(rng, conf=3.0, n=int(rng.integers(16, 257))))
+    loop.drain(120)
+    import time
+
+    deadline = time.monotonic() + 30
+    while monitor.status()["checks"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    healthy = monitor.status()
+    print("healthy scores:", {k: None if v is None else round(v, 3) for k, v in healthy["scores"].items()})
+    assert not healthy["active"], healthy
+    scrape = loop.scrape()
+    assert 'metrics_tpu_drift_active{monitor="confidence"} 0' in scrape
+
+    # 3) the hot-swap: a bad rollout collapses the confidence distribution
+    print("hot-swapping traffic distribution (simulated bad rollout)...")
+    for _ in range(2 * WINDOW // 128):
+        loop.offer(*batch(rng, conf=0.2, n=int(rng.integers(64, 257))))
+    loop.drain(120)
+    deadline = time.monotonic() + 30
+    while not monitor.status()["active"] and time.monotonic() < deadline:
+        time.sleep(0.05)
+
+    drifted = monitor.status()
+    print("drifted scores:", {k: None if v is None else round(v, 3) for k, v in drifted["scores"].items()})
+    assert drifted["active"], drifted
+
+    # the alerting surface: ONE episode-gated event + the crossed gauge
+    assert registry.counts()["drift_detected"] == 1
+    scrape = loop.scrape()
+    ks_line = next(
+        line for line in scrape.splitlines()
+        if line.startswith('metrics_tpu_drift_ks{monitor="confidence"}')
+    )
+    print("scraped:", ks_line)
+    assert float(ks_line.rsplit(" ", 1)[1]) >= drifted["thresholds"]["ks"]
+    assert 'metrics_tpu_drift_active{monitor="confidence"} 1' in scrape
+    assert 'metrics_tpu_health_events_total{kind="drift_detected"} 1' in scrape
+    event = next(e for e in registry.events("drift_detected"))
+    print("event:", event["message"])
+
+    # 4) what the fleet tier would publish for this host (the global
+    #    aggregator's scrape names the drifting host from exactly this)
+    print("fleet extra:", loop.fleet_extra())
+    loop.stop()
+    return drifted
+
+
+if __name__ == "__main__":
+    main()

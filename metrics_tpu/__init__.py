@@ -26,6 +26,7 @@ from metrics_tpu.utilities.backend import apply_force_cpu_escape_hatch as _apply
 _apply_force_cpu()
 
 from metrics_tpu import obs  # noqa: E402  — span tracer / self-metrics / exporters
+from metrics_tpu.obs.drift import DriftMonitor, ReferenceWindow  # noqa: E402
 from metrics_tpu.resilience import SnapshotManager, health_report  # noqa: E402
 from metrics_tpu.serving import ServeLoop, Warmup  # noqa: E402
 from metrics_tpu.utilities.backend import ensure_backend  # noqa: E402
@@ -187,6 +188,7 @@ __all__ = [
     "CoverageError",
     "DecayedMetric",
     "Dice",
+    "DriftMonitor",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "ExplainedVariance",
     "ExtendedEditDistance",
@@ -236,6 +238,7 @@ __all__ = [
     "ROC",
     "ROUGEScore",
     "Recall",
+    "ReferenceWindow",
     "RetrievalFallOut",
     "RetrievalHitRate",
     "RetrievalMAP",

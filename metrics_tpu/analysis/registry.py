@@ -38,6 +38,13 @@ entry                           budget
                                 AND recompile-stable — sketch state avals are
                                 batch-size independent, cache hit at equal
                                 avals (``audit_recompilation``)
+``drift_live_fold_step``        the drift monitor's live-window fold (ISSUE
+                                14 — ``obs/drift.py::fold_live_window``, the
+                                ONLY graph-side work drift ever does: one
+                                batch into quantile/CountMin/HLL sketches):
+                                **0** collectives, no f64/callbacks/dynamic
+                                shapes, recompile-stable — drift scoring and
+                                alerting stay host-side by audited contract
 ``bucketed_rank_step``          the bucketed-rank kernel step (dispatched
                                 descending order + inverse ranks): **0**
                                 collectives, no f64/callbacks/dynamic shapes
@@ -303,6 +310,29 @@ def _build_qsketch_update_step(ndev: int):
     return jax.jit(_build_qsketch_raw_update()), _qsketch_make_args(96)
 
 
+def _build_drift_raw_fold():
+    from metrics_tpu.obs.drift import fold_live_window
+    from metrics_tpu.streaming.sketches import CountMinState, HllState, QuantileSketchState
+
+    q = QuantileSketchState.create(**_QS)
+    cm = CountMinState.create(depth=4, width=256)
+    hll = HllState.create(precision=8)
+
+    def fold(values):
+        return fold_live_window(q, cm, hll, values)
+
+    return fold
+
+
+def _build_drift_live_fold_step(ndev: int):
+    import jax
+
+    # ONE construction for budget + recompile audits (the auroc stance):
+    # the drift monitor's ONLY graph-side work is this three-sketch fold —
+    # scoring, thresholds, and alerting are host-side python by contract
+    return jax.jit(_build_drift_raw_fold()), _qsketch_make_args(96)
+
+
 def _build_bucketed_rank_step(ndev: int):
     import jax
     import jax.numpy as jnp
@@ -552,6 +582,18 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         ),
         build=_build_qsketch_update_step,
         build_recompile=lambda: (_build_qsketch_raw_update(), _qsketch_make_args),
+    ),
+    AuditEntry(
+        name="drift_live_fold_step",
+        budget=GraphBudget(
+            max_all_reduce=0,
+            max_all_gather=0,
+            max_reduce_scatter=0,
+            max_collective_permute=0,
+            max_all_to_all=0,
+        ),
+        build=_build_drift_live_fold_step,
+        build_recompile=lambda: (_build_drift_raw_fold(), _qsketch_make_args),
     ),
     AuditEntry(
         name="bucketed_rank_step",

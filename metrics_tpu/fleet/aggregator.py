@@ -149,6 +149,11 @@ class Aggregator:
             # (a pod forwarding its hosts): the federation channel that lets
             # the global scrape name a dead leaf host, not just a dead pod
             "downstream": (header.get("extra") or {}).get("hosts") or {},
+            # the publisher's drift scores (ServeLoop.fleet_extra →
+            # obs/drift.py fleet_scores): per-monitor score/episode dicts,
+            # so the global scrape names the drifting HOST, not just "some
+            # host below this node is drifting"
+            "drift": (header.get("extra") or {}).get("drift") or {},
         }
         with self._lock:
             current = self._views.get(host)
@@ -195,6 +200,8 @@ class Aggregator:
                     "staleness_s": age,
                     "stale": stale,
                 }
+                if v.get("drift"):
+                    out[host]["drift"] = v["drift"]
         for host, age, seq in stale_events:
             record_degradation(
                 "fleet_host_stale",
@@ -234,6 +241,10 @@ class Aggregator:
                         "stale": bool(d.get("stale")) or view_age > self.stale_after_s,
                         "via": via,
                     }
+                    if d.get("drift"):
+                        # leaf drift forwarded by the pod: scores pass
+                        # through verbatim (they describe the LEAF's window)
+                        out[name]["drift"] = d["drift"]
             for name, e in out.items():
                 if e["stale"] and not self._downstream_reported.get(name):
                     self._downstream_reported[name] = True
@@ -325,17 +336,22 @@ class Aggregator:
 
     def fleet_extra(self) -> Optional[Dict[str, Any]]:
         """Header extra for this node's upward publishes: the per-host
-        staleness table (direct children + anything they forwarded), so
-        staleness federates to the root along with the values.
-        ``FleetPublisher`` calls this per publish when the source defines
-        it — the staleness sweep therefore runs on the publish cadence,
-        which is exactly when a dead child must be noticed."""
-        table = {
-            name: {"staleness_s": e["staleness_s"], "stale": e["stale"]}
-            for name, e in self._sweep_staleness().items()
-        }
+        staleness table (direct children + anything they forwarded) plus
+        each host's drift scores, so staleness AND drift federate to the
+        root along with the values. ``FleetPublisher`` calls this per
+        publish when the source defines it — the staleness sweep therefore
+        runs on the publish cadence, which is exactly when a dead child
+        must be noticed."""
+
+        def row(e: Dict[str, Any]) -> Dict[str, Any]:
+            out = {"staleness_s": e["staleness_s"], "stale": e["stale"]}
+            if e.get("drift"):
+                out["drift"] = e["drift"]
+            return out
+
+        table = {name: row(e) for name, e in self._sweep_staleness().items()}
         for name, e in self._downstream().items():
-            table.setdefault(name, {"staleness_s": e["staleness_s"], "stale": e["stale"]})
+            table.setdefault(name, row(e))
         return {"hosts": table} if table else None
 
     def view_blob(self) -> Optional[bytes]:

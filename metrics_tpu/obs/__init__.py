@@ -15,6 +15,12 @@ pin that an instrumented graph gains 0 collectives and 0 host callbacks):
 - ``obs/export.py`` — Prometheus text / JSON renders over health +
   telemetry, plus a stdlib HTTP exporter; ``ServeLoop.scrape()`` is the
   one-call in-process scrape.
+- ``obs/drift.py`` — online drift detection: a ``ReferenceWindow``
+  (frozen blessed-period sketches) scored against the live traffic window
+  each check — KS/PSI from sketch CDFs, heavy-hitter churn, cardinality
+  ratio — with episode-gated ``drift_detected``/``drift_recovered``
+  health events and ``metrics_tpu_drift_*`` gauges in every scrape
+  (``ServeLoop(drift_monitors=...)`` runs checks on the reducer cadence).
 """
 from metrics_tpu.obs.trace import (
     TraceRecord,
@@ -41,8 +47,20 @@ from metrics_tpu.obs.runtime_metrics import (
     registry,
 )
 from metrics_tpu.obs.export import TelemetryExporter, json_text, prometheus_text
+from metrics_tpu.obs.drift import (
+    DRIFT_SCORES,
+    DriftMonitor,
+    ReferenceWindow,
+    reset_drift_env_state,
+    resolve_drift_threshold,
+)
 
 __all__ = [
+    "DRIFT_SCORES",
+    "DriftMonitor",
+    "ReferenceWindow",
+    "reset_drift_env_state",
+    "resolve_drift_threshold",
     "TraceRecord",
     "span",
     "instant",

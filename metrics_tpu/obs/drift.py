@@ -1,0 +1,1045 @@
+"""Online drift detection: sketch-native distribution monitoring (ISSUE 14).
+
+The rest of the framework answers "what is the metric's value"; a serving
+runtime with millions of users also has to notice when the *distribution*
+feeding that value shifts — a model rollout that moves the score
+distribution, a traffic mix change that inflates the tail, an id-space
+explosion after a bad join. This module is that answer, built entirely
+from shipped substrate:
+
+- a :class:`ReferenceWindow` freezes the three streaming sketches
+  (``QuantileSketchState`` / ``CountMinState`` / ``HllState``) captured
+  from a blessed traffic period, serialized through their existing
+  ``to_primitives`` snapshot forms — the baseline is a few KiB of sketch
+  state, never raw rows;
+- a :class:`DriftMonitor` folds live traffic into the same three sketches
+  (O(1) bounded-buffer appends on the request path, batch-amortized folds
+  — the ``LatencyHistogram`` stance) and, on each check, scores the live
+  window against the reference **host-side, O(sketch) per check**:
+
+  ============================  ============================================
+  score                         definition
+  ============================  ============================================
+  ``ks``                        Kolmogorov–Smirnov distance: max |live CDF −
+                                reference CDF| over a probe grid of both
+                                sketches' quantiles (``QuantileSketchState.
+                                cdf`` — the vectorized rank helper)
+  ``psi``                       Population Stability Index over reference-
+                                quantile bins: ``sum((p_live − p_ref) *
+                                ln(p_live / p_ref))``, probabilities from
+                                CDF differences, floored so an empty bin
+                                cannot produce an infinite score
+  ``hh_churn``                  heavy-hitter set churn: Jaccard distance
+                                between the reference's top-k key set and
+                                the live top-k (CountMin estimates over a
+                                bounded candidate table). A key qualifies
+                                only above the ``hh_phi`` frequency share
+                                (default 1% of window rows — the standard
+                                phi-heavy-hitter bar, above CountMin's
+                                ``2n/width`` noise floor), so a continuous
+                                value stream with no hot keys scores None
+                                instead of permanently "churned"
+  ``cardinality_ratio``         live HLL distinct count over the distinct
+                                count EXPECTED in a live-window-sized draw
+                                from the reference's key universe. The
+                                universe size ``U`` is fitted from the
+                                reference's observed ``(rows, distinct)``
+                                pair via the uniform coupon-collector
+                                model ``distinct = U * (1 - exp(-rows/U))``
+                                (saturated low-cardinality stream → ``U ≈
+                                distinct``; continuous stream → ``U = ∞``,
+                                expected = rows), so reference and live
+                                windows may differ in length — a spike OR
+                                a collapse pages
+  ============================  ============================================
+
+- verdicts ride the existing alerting surface: a threshold crossing flips
+  the monitor into an **episode** and records ONE loud ``drift_detected``
+  :mod:`~metrics_tpu.resilience.health` event (hysteresis: ``trip_after``
+  consecutive breaching checks to enter, ``clear_after`` clean checks to
+  exit with ``drift_recovered``) — a flapping score can never wheel the
+  bounded event ring; continuous scores export as
+  ``metrics_tpu_drift_{ks,psi,hh_churn,cardinality_ratio}`` gauges through
+  ``ServeLoop.scrape()`` / ``prometheus_text`` (``obs/export.py``), and
+  per-host scores federate up the fleet tree via ``ServeLoop.fleet_extra``
+  so the global aggregator's one scrape names the drifting host.
+
+**Window semantics.** The live window is a row budget: once ``window``
+rows have folded in, the next check *rotates* — live sketches reset and a
+fresh bucket starts — so scores always describe at most the trailing
+``window`` rows. Checks run on the ``ServeLoop`` reducer cadence
+(``ServeLoop(drift_monitors=...)``) or wherever the caller drives
+:meth:`DriftMonitor.check`; scoring starts once ``min_rows`` rows are in
+the bucket, so a regression is scored (and pages) within one window
+rotation of the shift reaching the monitor.
+
+**Error composition.** Every score inherits the sketches' stated error:
+a CDF point is off by at most ``eps`` rank mass per sketch, so KS is off
+by at most ``eps_live + eps_ref`` and each PSI bin probability by
+``2*(eps_live + eps_ref)``; thresholds must sit above that floor (the
+DESIGN.md drift section carries the full ``eps_total`` argument, and
+:meth:`DriftMonitor.score_floor` reports it per monitor). Thresholds
+resolve programmatic > ``METRICS_TPU_DRIFT_*`` env > default on the
+shared ``_envtools`` warn-once contract — a malformed env var degrades
+tuning, never correctness.
+
+Module import performs python work only — jax (via
+``streaming/sketches.py``) loads lazily at the first fold, so the
+hang-proof bootstrap contract (``utilities/backend.py``) holds.
+"""
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+__all__ = [
+    "ReferenceWindow",
+    "DriftMonitor",
+    "DRIFT_SCORES",
+    "resolve_drift_threshold",
+    "reset_drift_env_state",
+]
+
+# the four continuous scores every surface (status dicts, Prometheus
+# gauges, fleet extras) agrees on, in render order
+DRIFT_SCORES: Tuple[str, ...] = ("ks", "psi", "hh_churn", "cardinality_ratio")
+
+# --------------------------------------------------------------------------
+# METRICS_TPU_DRIFT_* threshold knobs (shared _envtools contract)
+# --------------------------------------------------------------------------
+
+_DEFAULT_THRESHOLDS: Dict[str, float] = {
+    "ks": 0.15,
+    "psi": 0.25,  # the classic "major shift" PSI bar
+    "hh_churn": 0.5,
+    "cardinality_ratio": 2.0,  # fires at >= 2x or <= 0.5x distinct-rate
+}
+
+_ENV_VARS: Dict[str, str] = {
+    "ks": "METRICS_TPU_DRIFT_KS",
+    "psi": "METRICS_TPU_DRIFT_PSI",
+    "hh_churn": "METRICS_TPU_DRIFT_HH_CHURN",
+    "cardinality_ratio": "METRICS_TPU_DRIFT_CARDINALITY_RATIO",
+}
+
+_warn_once = WarnOnce()
+
+# exclusive lower bound per score: ks/psi/hh_churn only need > 0, but the
+# cardinality ratio breaches SYMMETRICALLY (>= t or <= 1/t), so any t <= 1
+# makes every possible ratio a breach — permanently-firing config, refused
+_THRESHOLD_FLOORS: Dict[str, float] = {
+    "ks": 0.0,
+    "psi": 0.0,
+    "hh_churn": 0.0,
+    "cardinality_ratio": 1.0,
+}
+
+
+def _threshold_parser(var: str, floor: float) -> Callable[[str], Optional[float]]:
+    def parse(raw: str) -> Optional[float]:
+        try:
+            value = float(raw)
+            # finite required: a NaN threshold slips every >= comparison and
+            # would silently never fire (the fleet/_env.py rationale)
+            if not math.isfinite(value) or value <= floor:
+                raise ValueError(raw)
+            return value
+        except ValueError:
+            _warn_once(
+                (var, raw),
+                f"{var}={raw!r} is not a finite number > {floor:g}; falling back "
+                f"to the built-in default — drift detection keeps running untuned.",
+            )
+            return None
+
+    return parse
+
+
+_ENV: Dict[str, EnvParse] = {
+    score: EnvParse(var, _threshold_parser(var, _THRESHOLD_FLOORS[score]), None)
+    for score, var in _ENV_VARS.items()
+}
+
+
+def resolve_drift_threshold(score: str, programmatic: Optional[float]) -> float:
+    """Programmatic arg > ``METRICS_TPU_DRIFT_*`` env > default (the
+    dispatch-layer resolution rule; malformed env warns once + default)."""
+    floor = _THRESHOLD_FLOORS[score]
+    if programmatic is not None:
+        if not math.isfinite(programmatic) or programmatic <= floor:
+            raise MetricsTPUUserError(
+                f"drift threshold {score!r} must be a finite value > {floor:g}, "
+                f"got {programmatic}"
+                + (
+                    " (the cardinality ratio breaches at >= t or <= 1/t, so any "
+                    "t <= 1 would breach on EVERY check)"
+                    if score == "cardinality_ratio"
+                    else ""
+                )
+            )
+        return float(programmatic)
+    from_env = _ENV[score]()
+    return from_env if from_env is not None else _DEFAULT_THRESHOLDS[score]
+
+
+def reset_drift_env_state() -> None:
+    """Test hook: forget memoized env parses and warn-once history."""
+    _warn_once.reset()
+    for env in _ENV.values():
+        env.reset()
+
+
+# --------------------------------------------------------------------------
+# live-window fold (the ONLY jax work drift ever does — jittable, and
+# audited at 0 collectives by the `drift_live_fold_step` registry entry)
+# --------------------------------------------------------------------------
+
+
+def fold_live_window(
+    q_state: Any, cm_state: Any, hll_state: Any, values: Any, valid: Any = None
+):
+    """Fold one batch into the three live-window sketches. A pure function
+    of ``(states, values[, valid])`` → states: the monitor jits exactly
+    this (behind a small pow-2 pad ladder, so the whole serving lifetime
+    compiles a handful of graphs), and the analysis registry audits it at
+    **zero collectives** — scoring consumes the states but adds nothing to
+    any compiled update path."""
+    return (
+        q_state.insert(values, valid),
+        cm_state.insert(values, valid),
+        hll_state.insert(values, valid),
+    )
+
+
+def _score_cdf_kernel(live_q: Any, ref_q: Any, ref_edges: Any, qgrid: Any):
+    """The fixed-shape CDF comparison (jitted once per sketch geometry):
+    KS over the union probe grid of both sketches' quantiles, plus both
+    CDFs at the reference-quantile bin edges (the PSI inputs) — all
+    through ``QuantileSketchState.cdf``/``quantile``, zero collectives."""
+    import jax.numpy as jnp
+
+    live_edges = live_q.quantile(qgrid)
+    probes = jnp.concatenate([jnp.asarray(ref_edges), live_edges])
+    ks = jnp.max(jnp.abs(live_q.cdf(probes) - ref_q.cdf(probes)))
+    return ks, live_q.cdf(ref_edges), ref_q.cdf(ref_edges)
+
+
+# memoized jitted entry points (one trace per sketch geometry x pad tier;
+# jax's jit cache keys on the state avals, so this stays a pair of
+# module-level callables, not a per-monitor cache)
+_JITTED: Dict[str, Any] = {}
+
+
+def _jitted(name: str, fn: Callable[..., Any]) -> Any:
+    cached = _JITTED.get(name)
+    if cached is None:
+        import jax
+
+        cached = _JITTED[name] = jax.jit(fn)
+    return cached
+
+
+def _pad_rows(n: int) -> int:
+    """Pad tier for one fold batch: pow-2, floored at 64, capped at the
+    pending-buffer bound — the padding-ladder stance (ops/padding.py)
+    applied to the monitor's fold so ragged traffic compiles O(log) fold
+    graphs instead of one per batch size."""
+    tier = 64
+    while tier < n:
+        tier <<= 1
+    return min(tier, _PENDING_ROWS_CAP)
+
+
+def _np_f(value: Any) -> float:
+    return float(np.asarray(value))
+
+
+def _fit_universe(distinct: float, rows: float) -> float:
+    """Key-universe size ``U`` solving the uniform coupon-collector model
+    ``distinct = U * (1 - exp(-rows / U))`` for the reference's observed
+    pair. A saturated stream (``distinct << rows``) fits ``U ~= distinct``;
+    an effectively-continuous one (``distinct ~= rows``) fits ``U = inf``
+    (every new row a new key), so the expectation extrapolates correctly
+    however much longer or shorter the live window is."""
+    if distinct >= rows:
+        return math.inf
+    lo, hi = max(distinct, 1.0), max(distinct, 1.0)
+    fill = lambda u: u * (1.0 - math.exp(-rows / u))
+    while fill(hi) < distinct:
+        hi *= 2.0
+        if hi > 1e15:
+            return math.inf
+    for _ in range(60):
+        mid = (lo + hi) / 2.0
+        if fill(mid) < distinct:
+            lo = mid
+        else:
+            hi = mid
+    return (lo + hi) / 2.0
+
+
+def _expected_distinct(universe: float, rows: float) -> float:
+    return rows if math.isinf(universe) else universe * (1.0 - math.exp(-rows / universe))
+
+
+# --------------------------------------------------------------------------
+# ReferenceWindow — the frozen blessed-period baseline
+# --------------------------------------------------------------------------
+
+
+class ReferenceWindow:
+    """Frozen sketch trio from a blessed traffic period.
+
+    Built by :meth:`DriftMonitor.freeze_reference` (run the monitor over
+    known-good traffic with no reference attached, then freeze), or loaded
+    from the ``to_primitives`` snapshot forms via :meth:`from_primitives`
+    (e.g. out of a config store next to the model checkpoint). The
+    reference is immutable once constructed — live traffic only ever
+    touches the monitor's own window sketches.
+    """
+
+    def __init__(
+        self,
+        quantile: Any,
+        countmin: Any,
+        hll: Any,
+        hh_keys: Sequence[float] = (),
+        rows: int = 0,
+        captured_unix: Optional[float] = None,
+    ) -> None:
+        if rows <= 0:
+            raise MetricsTPUUserError(
+                f"a ReferenceWindow needs the blessed period's row count (> 0), got {rows}"
+            )
+        self.quantile = quantile
+        self.countmin = countmin
+        self.hll = hll
+        self.hh_keys = tuple(float(k) for k in hh_keys)
+        self.rows = int(rows)
+        self.captured_unix = float(captured_unix) if captured_unix is not None else time.time()
+
+    @property
+    def age_s(self) -> float:
+        """Seconds since capture — a stale baseline is visible (status /
+        scrape), judged by the operator: how old is too old depends on the
+        deployment's traffic seasonality, not the library."""
+        return max(0.0, time.time() - self.captured_unix)
+
+    # -- serialization (the existing snapshot primitive forms) -----------
+
+    def to_primitives(self) -> Dict[str, Any]:
+        return {
+            "schema": "drift-reference-v1",
+            "quantile": self.quantile.to_primitives(),
+            "countmin": self.countmin.to_primitives(),
+            "hll": self.hll.to_primitives(),
+            "hh_keys": np.asarray(self.hh_keys, np.float64),
+            "rows": self.rows,
+            "captured_unix": self.captured_unix,
+        }
+
+    @classmethod
+    def from_primitives(cls, prim: Dict[str, Any]) -> "ReferenceWindow":
+        from metrics_tpu.streaming.sketches import (
+            CountMinState,
+            HllState,
+            QuantileSketchState,
+        )
+
+        if not isinstance(prim, dict) or prim.get("schema") != "drift-reference-v1":
+            raise MetricsTPUUserError(
+                "ReferenceWindow loads from a to_primitives() mapping with "
+                f"schema 'drift-reference-v1', got {type(prim).__name__}"
+                + (f" (schema {prim.get('schema')!r})" if isinstance(prim, dict) else "")
+            )
+        import jax.numpy as jnp
+
+        # internal-consistency validation with NAMED refusals (the
+        # from_primitives stance): a corrupted or hand-edited snapshot
+        # must fail here naming the field, never deep inside a jitted
+        # score kernel as an anonymous shape error
+        q = prim["quantile"]
+        items = np.asarray(q["items"])
+        counts = np.asarray(q["counts"]).reshape(-1)
+        if items.ndim != 2:
+            raise MetricsTPUUserError(
+                f"drift reference 'quantile.items' must be a 2-D (levels, k) array, "
+                f"got shape {items.shape}"
+            )
+        if counts.shape[0] != items.shape[0]:
+            raise MetricsTPUUserError(
+                f"drift reference 'quantile.counts' length {counts.shape[0]} != "
+                f"{items.shape[0]} sketch levels"
+            )
+        cm_counts = np.asarray(prim["countmin"]["counts"])
+        if cm_counts.ndim != 2:
+            raise MetricsTPUUserError(
+                f"drift reference 'countmin.counts' must be a 2-D (depth, width) "
+                f"array, got shape {cm_counts.shape}"
+            )
+        registers = np.asarray(prim["hll"]["registers"]).reshape(-1)
+        if registers.shape[0] < 16 or registers.shape[0] & (registers.shape[0] - 1):
+            raise MetricsTPUUserError(
+                f"drift reference 'hll.registers' length must be a power of two "
+                f">= 16, got {registers.shape[0]}"
+            )
+        qs = QuantileSketchState(
+            items=jnp.asarray(items, jnp.float32),
+            counts=jnp.asarray(counts, jnp.int32),
+            n_seen=jnp.asarray(q.get("n_seen", 0), jnp.int32).reshape(()),
+        )
+        cm = CountMinState(counts=jnp.asarray(cm_counts, jnp.uint32))
+        hll = HllState(registers=jnp.asarray(registers, jnp.int32))
+        return cls(
+            quantile=qs,
+            countmin=cm,
+            hll=hll,
+            hh_keys=np.asarray(prim.get("hh_keys", ()), np.float64).reshape(-1).tolist(),
+            rows=int(prim["rows"]),
+            captured_unix=float(prim.get("captured_unix") or time.time()),
+        )
+
+
+# --------------------------------------------------------------------------
+# DriftMonitor — live window + host-side scoring + episode-gated alerting
+# --------------------------------------------------------------------------
+
+# bounded observe buffer: the request path appends (O(1)); the jax sketch
+# fold runs in chunks of this many rows — normally on the check cadence
+# (the scheduler thread), so request threads never pay a fold...
+_PENDING_ROWS_CAP = 4096
+# ...except under sustained burst past this hard bound (the buffer must
+# stay small — bounded retention is the whole design), where the
+# observing thread folds inline once. 8 chunks ≈ 128 KiB of float32.
+_PENDING_HARD_CAP = 8 * _PENDING_ROWS_CAP
+
+# probe-grid resolution for the KS scan (points per sketch; the scan runs
+# over the union of both sketches' quantiles at this grid)
+_KS_GRID = 33
+
+# floor under each PSI bin probability: an empty bin must score large but
+# finite, and the floor also absorbs sketch rank error at the bin edges
+_PSI_FLOOR = 1e-4
+
+
+class DriftMonitor:
+    """Score live traffic against a :class:`ReferenceWindow`, loudly.
+
+    Example::
+
+        mon = DriftMonitor("score", window=4096)
+        for batch in blessed_traffic:
+            mon.observe(batch)
+        mon.set_reference(mon.freeze_reference())   # bless the baseline
+
+        loop = ServeLoop(metric, drift_monitors=[mon])   # checks ride the
+        ...                                              # reducer cadence
+        loop.scrape()      # metrics_tpu_drift_ks{monitor="score"} ...
+
+    ``extract`` maps one ``offer(*args, **kwargs)`` request to the value
+    stream this monitor watches (default: the first positional argument,
+    flattened) — so one loop can run several monitors over different
+    fields of the same traffic. Thresholds default to
+    ``METRICS_TPU_DRIFT_*`` env (then built-ins); ``trip_after`` /
+    ``clear_after`` are the hysteresis widths in consecutive checks.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        reference: Optional[ReferenceWindow] = None,
+        *,
+        window: int = 4096,
+        min_rows: Optional[int] = None,
+        eps: float = 0.05,
+        cm_depth: int = 4,
+        cm_width: int = 2048,
+        hll_precision: int = 11,
+        top_k: int = 16,
+        hh_phi: float = 0.01,
+        ks_threshold: Optional[float] = None,
+        psi_threshold: Optional[float] = None,
+        hh_churn_threshold: Optional[float] = None,
+        cardinality_ratio_threshold: Optional[float] = None,
+        trip_after: int = 1,
+        clear_after: int = 2,
+        extract: Optional[Callable[[tuple, dict], Any]] = None,
+    ) -> None:
+        if not name:
+            raise MetricsTPUUserError("`name` must be a non-empty string")
+        if window < 2:
+            raise MetricsTPUUserError(f"`window` must be >= 2 rows, got {window}")
+        if min_rows is None:
+            min_rows = max(2, window // 4)
+        if not (2 <= min_rows <= window):
+            raise MetricsTPUUserError(
+                f"`min_rows` must be in [2, window={window}], got {min_rows}"
+            )
+        if trip_after < 1 or clear_after < 1:
+            raise MetricsTPUUserError(
+                f"`trip_after`/`clear_after` must be >= 1 checks, got "
+                f"{trip_after}/{clear_after}"
+            )
+        if top_k < 1:
+            raise MetricsTPUUserError(f"`top_k` must be >= 1, got {top_k}")
+        if not (0.0 < hh_phi < 1.0):
+            raise MetricsTPUUserError(
+                f"`hh_phi` must be a frequency fraction in (0, 1), got {hh_phi}"
+            )
+        # geometry params are validated HERE, eagerly (mirroring the sketch
+        # constructors' own rules) — the sketches build lazily at the first
+        # fold, which on a serving loop is the check cadence, and a config
+        # typo must be refused at construction, not retried forever as an
+        # episode-gated drift_check_error
+        if not (0.0 < eps < 1.0):
+            raise MetricsTPUUserError(f"`eps` must be in (0, 1), got {eps}")
+        if cm_depth < 1:
+            raise MetricsTPUUserError(f"`cm_depth` must be >= 1, got {cm_depth}")
+        if cm_width < 2 or cm_width & (cm_width - 1):
+            raise MetricsTPUUserError(
+                f"`cm_width` must be a power of two >= 2, got {cm_width}"
+            )
+        if not (4 <= hll_precision <= 18):
+            raise MetricsTPUUserError(
+                f"`hll_precision` must be in [4, 18], got {hll_precision}"
+            )
+        self.name = name
+        self.window = int(window)
+        self.min_rows = int(min_rows)
+        self.eps = float(eps)
+        self.top_k = int(top_k)
+        self.hh_phi = float(hh_phi)
+        self.trip_after = int(trip_after)
+        self.clear_after = int(clear_after)
+        self.thresholds: Dict[str, float] = {
+            "ks": resolve_drift_threshold("ks", ks_threshold),
+            "psi": resolve_drift_threshold("psi", psi_threshold),
+            "hh_churn": resolve_drift_threshold("hh_churn", hh_churn_threshold),
+            "cardinality_ratio": resolve_drift_threshold(
+                "cardinality_ratio", cardinality_ratio_threshold
+            ),
+        }
+        self._geometry = dict(
+            eps=float(eps),
+            cm_depth=int(cm_depth),
+            cm_width=int(cm_width),
+            hll_precision=int(hll_precision),
+        )
+        self._extract = extract
+        self._lock = threading.RLock()
+        # serializes whole check() passes (scheduler cadence + manual test
+        # drivers) so hysteresis never double-counts; observe() only ever
+        # takes _lock, so the request path never waits behind a check's
+        # scoring (which runs OUTSIDE _lock on immutable sketch states)
+        self._check_lock = threading.Lock()
+        self._reference: Optional[ReferenceWindow] = None
+        # frozen-side score inputs, precomputed per set_reference
+        self._qgrid: Optional[np.ndarray] = None
+        self._ref_edges: Optional[np.ndarray] = None
+        self._ref_distinct = 0.0
+        self._ref_universe = math.inf
+        # live window (built lazily: constructing sketch states touches jax)
+        self._q: Any = None
+        self._cm: Any = None
+        self._hll: Any = None
+        self._rows = 0
+        self._pending: List[np.ndarray] = []
+        self._pending_rows = 0
+        # fold generation: bumps when rows land in (or leave) the live
+        # window, so an idle check can skip rescoring a bit-identical
+        # window (the scheduler's own idle-skip stance)
+        self._fold_gen = 0
+        self._scored_gen = -1
+        self._dropped_rows = 0  # non-finite / non-numeric rows, counted not folded
+        # bounded heavy-hitter candidate table: key -> last CM estimate
+        self._candidates: Dict[float, int] = {}
+        self._candidate_cap = max(4 * self.top_k, 64)
+        # episode / hysteresis state
+        self._active = False
+        self._breach_streak = 0
+        self._clear_streak = 0
+        self._checks = 0
+        self._breaches = 0
+        self._windows = 0  # completed rotations
+        self._detected_events = 0
+        self._recovered_events = 0
+        self._last_scores: Dict[str, Optional[float]] = {s: None for s in DRIFT_SCORES}
+        self._last_breaching: Tuple[str, ...] = ()
+        self._last_check_unix: Optional[float] = None
+        if reference is not None:
+            self.set_reference(reference)
+
+    # -- reference lifecycle --------------------------------------------
+
+    def set_reference(self, reference: ReferenceWindow) -> None:
+        """Attach (or replace) the blessed baseline. Geometry must match
+        the monitor's live sketches — a mismatched reference is a config
+        bug refused here, loudly and early, before it can mis-score every
+        window (the ``sketch_merge`` shape-refusal stance)."""
+        self._ensure_live_locked()
+        with self._lock:
+            if tuple(reference.quantile.items.shape) != tuple(self._q.items.shape):
+                raise MetricsTPUUserError(
+                    f"DriftMonitor {self.name!r}: reference quantile-sketch geometry "
+                    f"{tuple(reference.quantile.items.shape)} != live {tuple(self._q.items.shape)}; "
+                    "capture the reference with the same eps/window configuration"
+                )
+            if tuple(reference.countmin.counts.shape) != tuple(self._cm.counts.shape):
+                raise MetricsTPUUserError(
+                    f"DriftMonitor {self.name!r}: reference CountMin geometry "
+                    f"{tuple(reference.countmin.counts.shape)} != live "
+                    f"{tuple(self._cm.counts.shape)}; match cm_depth/cm_width"
+                )
+            if tuple(reference.hll.registers.shape) != tuple(self._hll.registers.shape):
+                raise MetricsTPUUserError(
+                    f"DriftMonitor {self.name!r}: reference HLL geometry "
+                    f"{tuple(reference.hll.registers.shape)} != live "
+                    f"{tuple(self._hll.registers.shape)}; match hll_precision"
+                )
+            self._reference = reference
+            # precompute the frozen side of every score once per attach:
+            # the reference never changes, so its quantile grid (the PSI
+            # bin edges / KS probes) and HLL estimate are constants
+            grid = np.linspace(1.0 / _KS_GRID, 1.0 - 1.0 / _KS_GRID, _KS_GRID - 1)
+            self._qgrid = np.asarray(grid, np.float32)
+            self._ref_edges = np.asarray(reference.quantile.quantile(self._qgrid), np.float32)
+            self._ref_distinct = _np_f(reference.hll.estimate())
+            self._ref_universe = _fit_universe(self._ref_distinct, float(reference.rows))
+            # new baseline: the next check rescores. Bump the FOLD
+            # generation (not _scored_gen) — a check between its snapshot
+            # and commit phases writes _scored_gen with the generation it
+            # captured, which would clobber a marker stored there (the
+            # _rotate_locked stance: advancing the generation wins races)
+            self._fold_gen += 1
+        from metrics_tpu.resilience.health import record_degradation
+
+        # INFORMATIONAL milestone (never flips `degraded`): "when did this
+        # monitor get its baseline" is datable next to any later detection
+        record_degradation(
+            "drift_baseline_loaded",
+            f"drift monitor {self.name!r} loaded a reference window "
+            f"({reference.rows} blessed rows, captured {reference.age_s:.0f}s ago)",
+            monitor=self.name,
+            reference_rows=reference.rows,
+        )
+
+    def load_reference(self, prim: Dict[str, Any]) -> ReferenceWindow:
+        """``from_primitives`` + :meth:`set_reference` in one call."""
+        reference = ReferenceWindow.from_primitives(prim)
+        self.set_reference(reference)
+        return reference
+
+    def freeze_reference(self) -> ReferenceWindow:
+        """Freeze the CURRENT live window as a blessed baseline (fold the
+        pending buffer first). The live window keeps accumulating — call
+        :meth:`rotate` after freezing when the blessed rows should not
+        also be scored as live traffic."""
+        with self._lock:
+            self._fold_pending_locked()
+            if self._rows < 2:
+                raise MetricsTPUUserError(
+                    f"DriftMonitor {self.name!r}: cannot freeze a reference from "
+                    f"{self._rows} observed rows — stream the blessed period through "
+                    "observe() first"
+                )
+            return ReferenceWindow(
+                quantile=self._q,
+                countmin=self._cm,
+                hll=self._hll,
+                hh_keys=self._top_keys_locked(),
+                rows=self._rows,
+            )
+
+    # -- live window ----------------------------------------------------
+
+    def _ensure_live_locked(self) -> None:
+        if self._q is not None:
+            return
+        from metrics_tpu.streaming.sketches import (
+            CountMinState,
+            HllState,
+            QuantileSketchState,
+        )
+
+        with self._lock:
+            if self._q is None:
+                g = self._geometry
+                # max_items is generous (NOT the live window): the same
+                # geometry also absorbs a blessed reference period of any
+                # realistic length, so reference and live sketches are
+                # merge/CDF-compatible by construction — geometry is a
+                # function of eps alone, a few tens of KiB per monitor
+                self._q = QuantileSketchState.create(
+                    eps=g["eps"], max_items=max(1 << 20, 8 * self.window)
+                )
+                self._cm = CountMinState.create(depth=g["cm_depth"], width=g["cm_width"])
+                self._hll = HllState.create(precision=g["hll_precision"])
+
+    def observe(self, values: Any) -> int:
+        """Fold one batch of the watched value stream into the live window.
+        O(1) on the request path (bounded-buffer append); returns the
+        number of finite rows accepted. Non-numeric input is counted as
+        dropped, never raises — a poison request must not take the monitor
+        (or the offer path it rides) down with it."""
+        try:
+            x = np.asarray(values, np.float64).reshape(-1)
+        except (TypeError, ValueError):
+            with self._lock:
+                self._dropped_rows += 1
+            return 0
+        if x.size == 0:
+            return 0
+        finite = np.isfinite(x)
+        n_dropped = int(x.size - finite.sum())
+        x = x[finite]
+        with self._lock:
+            self._dropped_rows += n_dropped
+            if x.size:
+                self._pending.append(np.asarray(x, np.float32))
+                self._pending_rows += int(x.size)
+                # folds normally run on the check cadence (the scheduler
+                # thread); the request thread folds inline only past the
+                # hard buffer bound under sustained burst
+                if self._pending_rows >= _PENDING_HARD_CAP:
+                    self._fold_pending_locked()
+        return int(x.size)
+
+    def extract_from(self, args: tuple, kwargs: dict) -> Any:
+        """The value stream this monitor watches, out of one serving
+        request's ``(*args, **kwargs)``: the ``extract`` hook when
+        configured, else the first positional argument (``None`` = nothing
+        to observe for this request)."""
+        if self._extract is not None:
+            return self._extract(args, kwargs)
+        return args[0] if args else None
+
+    def _fold_pending_locked(self) -> None:
+        if not self._pending:
+            return
+        self._ensure_live_locked()
+        import jax.numpy as jnp
+
+        batch = np.concatenate(self._pending).astype(np.float32)
+        self._pending = []
+        self._pending_rows = 0
+        fold = _jitted("fold", fold_live_window)
+        for start in range(0, batch.size, _PENDING_ROWS_CAP):
+            chunk = batch[start : start + _PENDING_ROWS_CAP]
+            tier = _pad_rows(chunk.size)
+            vals = np.zeros(tier, np.float32)
+            vals[: chunk.size] = chunk
+            valid = np.zeros(tier, bool)
+            valid[: chunk.size] = True
+            self._q, self._cm, self._hll = fold(
+                self._q, self._cm, self._hll, jnp.asarray(vals), jnp.asarray(valid)
+            )
+        self._rows += int(batch.size)
+        self._fold_gen += 1
+        # heavy-hitter candidates: the batch's distinct keys re-scored by
+        # the LIVE CountMin (estimates only grow within a window), capped
+        # by evicting the coldest — bounded like every other drift state
+        keys = np.unique(batch)
+        query = _jitted("cm_query", lambda cm, k: cm.query(k))
+        for start in range(0, keys.size, _PENDING_ROWS_CAP):
+            kchunk = keys[start : start + _PENDING_ROWS_CAP]
+            tier = _pad_rows(kchunk.size)  # pad with a real key: idempotent
+            padded = np.full(tier, kchunk[0], np.float32)
+            padded[: kchunk.size] = kchunk
+            est = np.asarray(query(self._cm, jnp.asarray(padded)))[: kchunk.size]
+            if kchunk.size > self._candidate_cap:
+                # bound the python-level dict work per chunk to ~cap entries
+                # (a continuous stream makes nearly every key unique — a
+                # globally-hot key's window-total CM estimate keeps it in
+                # any chunk's top slice, so nothing qualifying is dropped)
+                top = np.argpartition(est, -self._candidate_cap)[-self._candidate_cap :]
+                kchunk, est = kchunk[top], est[top]
+            for key, count in zip(kchunk.tolist(), est.tolist()):
+                self._candidates[float(key)] = int(count)
+        if len(self._candidates) > self._candidate_cap:
+            keep = sorted(self._candidates.items(), key=lambda kv: -kv[1])
+            self._candidates = dict(keep[: self._candidate_cap])
+
+    def _hh_min_count(self, rows: int) -> int:
+        """The phi-heavy-hitter qualification bar for a ``rows``-row window
+        (at least 2, so a once-seen key never qualifies)."""
+        return max(2, int(math.ceil(self.hh_phi * rows)))
+
+    def _top_keys_locked(self, rows: Optional[int] = None) -> Tuple[float, ...]:
+        """Top-``top_k`` candidate keys that QUALIFY as heavy hitters — a
+        continuous stream where every key is near-unique yields the empty
+        set (scored as not-applicable), never a permanently-"churned" one."""
+        bar = self._hh_min_count(self._rows if rows is None else rows)
+        top = sorted(
+            ((key, count) for key, count in self._candidates.items() if count >= bar),
+            key=lambda kv: (-kv[1], kv[0]),
+        )[: self.top_k]
+        return tuple(key for key, _count in top)
+
+    def _rotate_locked(self) -> None:
+        """The one bucket-reset body both the manual :meth:`rotate` and the
+        check-time auto-rotation run — they must never diverge."""
+        self._q = self._cm = self._hll = None
+        self._rows = 0
+        self._candidates = {}
+        self._windows += 1
+        self._fold_gen += 1  # the window changed: the next check rescores
+
+    def rotate(self) -> None:
+        """Start a fresh live bucket (sketches reset; episode/hysteresis
+        state carries over — an episode spans rotations by design)."""
+        with self._lock:
+            self._fold_pending_locked()
+            self._rotate_locked()
+
+    @property
+    def window_rows(self) -> int:
+        """Rows currently in the live bucket (pending included)."""
+        with self._lock:
+            return self._rows + self._pending_rows
+
+    # -- scoring --------------------------------------------------------
+
+    def score_floor(self) -> Dict[str, float]:
+        """The sketch-error floor under each CDF-derived score: a KS or
+        per-bin PSI probability is uncertain by ``eps_live + eps_ref``
+        rank mass, so thresholds below ``eps_total`` alarm on sketch noise
+        (DESIGN.md "Drift detection" carries the composition argument)."""
+        eps_live = self._q.eps_bound if self._q is not None else self._geometry["eps"]
+        eps_ref = (
+            self._reference.quantile.eps_bound
+            if self._reference is not None
+            else self._geometry["eps"]
+        )
+        eps_total = float(eps_live) + float(eps_ref)
+        return {"ks": eps_total, "psi_bin_probability": 2.0 * eps_total}
+
+    def _compute_scores(
+        self,
+        live_q: Any,
+        live_hll: Any,
+        live_top: set,
+        rows: int,
+        ref: ReferenceWindow,
+        ref_edges: np.ndarray,
+        qgrid: np.ndarray,
+        ref_universe: float,
+        ref_distinct: float,
+    ) -> Dict[str, Optional[float]]:
+        """Score one snapshot of the live window against the reference —
+        every input is an immutable state or a copied value, so this runs
+        WITHOUT the monitor lock (the first call jit-compiles the CDF
+        kernel; holding the lock through that would stall every
+        concurrent ``observe`` on the request path)."""
+        import jax.numpy as jnp
+
+        scores: Dict[str, Optional[float]] = {s: None for s in DRIFT_SCORES}
+        # -- KS + PSI from the two sketch CDFs (one jitted fixed-shape
+        # kernel over QuantileSketchState.cdf/quantile: the reference side
+        # — edges, distinct count — was precomputed at attach) -----------
+        ks, live_edge, ref_edge = _jitted("score_cdfs", _score_cdf_kernel)(
+            live_q, ref.quantile, jnp.asarray(ref_edges), jnp.asarray(qgrid)
+        )
+        scores["ks"] = float(np.asarray(ks))
+        live_edge = np.asarray(live_edge, np.float64)
+        ref_edge = np.asarray(ref_edge, np.float64)
+        # PSI over reference-quantile bins: edge CDFs, open-ended tails
+        p_live = np.diff(np.concatenate([[0.0], np.sort(live_edge), [1.0]]))
+        p_ref = np.diff(np.concatenate([[0.0], np.sort(ref_edge), [1.0]]))
+        p_live = np.maximum(p_live, _PSI_FLOOR)
+        p_ref = np.maximum(p_ref, _PSI_FLOOR)
+        p_live /= p_live.sum()
+        p_ref /= p_ref.sum()
+        scores["psi"] = float(np.sum((p_live - p_ref) * np.log(p_live / p_ref)))
+        # -- heavy-hitter churn (CountMin top-k Jaccard distance) -------
+        # scored only when the REFERENCE had heavy hitters: a stream with
+        # no hot keys has no churn story (None, never a fake 1.0); the
+        # reference's hot keys all going cold IS churn 1.0
+        if ref.hh_keys:
+            ref_top = set(ref.hh_keys)
+            union = live_top | ref_top
+            if union:
+                scores["hh_churn"] = 1.0 - len(live_top & ref_top) / len(union)
+        # -- cardinality ratio (HLL, coupon-collector normalized) -------
+        # live distinct vs the distinct count EXPECTED in a live-sized draw
+        # from the reference's FITTED key universe (see _fit_universe):
+        # exact in both the saturated regime (the expectation plateaus at
+        # the universe size however long the window) and the continuous one
+        # (the expectation tracks the row count), so reference and live
+        # windows may differ in length without skewing the ratio.
+        live_distinct = _np_f(_jitted("hll_estimate", lambda h: h.estimate())(live_hll))
+        if ref_distinct > 0 and rows > 0:
+            expected = _expected_distinct(ref_universe, float(rows))
+            scores["cardinality_ratio"] = float(max(live_distinct, 1.0) / max(expected, 1.0))
+        return scores
+
+    def _breaching(self, scores: Dict[str, Optional[float]]) -> Tuple[str, ...]:
+        out = []
+        for key in ("ks", "psi", "hh_churn"):
+            value = scores.get(key)
+            if value is not None and value >= self.thresholds[key]:
+                out.append(key)
+        ratio = scores.get("cardinality_ratio")
+        bar = self.thresholds["cardinality_ratio"]
+        # a collapse pages like a spike: the ratio breaches symmetrically
+        if ratio is not None and (ratio >= bar or ratio <= 1.0 / bar):
+            out.append("cardinality_ratio")
+        return tuple(out)
+
+    # -- the check (the reducer-cadence entry point) --------------------
+
+    def check(self) -> Dict[str, Any]:
+        """One drift check: fold pending rows, score the live bucket
+        against the reference (when both are ready), walk the hysteresis
+        state machine, rotate a full bucket. Entirely host-side, O(sketch);
+        returns :meth:`status`. Degradations are graceful, never silent:
+        no reference → scores stay None (status says so); a sparse bucket
+        (< ``min_rows``) is not scored — thin evidence must not page."""
+        events: List[Tuple[str, str, Dict[str, Any]]] = []
+        with self._check_lock:
+            # phase 1 (brief, under the monitor lock): fold pending rows,
+            # snapshot the immutable sketch states + the reference-side
+            # constants the scoring needs
+            with self._lock:
+                self._fold_pending_locked()
+                ref = self._reference
+                rows = self._rows
+                # skip rescoring a bit-identical window: nothing folded (or
+                # rotated, or re-baselined) since the last scored check
+                scored = (
+                    ref is not None
+                    and rows >= self.min_rows
+                    and self._fold_gen != self._scored_gen
+                )
+                fold_gen = self._fold_gen
+                live_q, live_hll = self._q, self._hll
+                live_top = set(self._top_keys_locked()) if scored else set()
+                ref_edges, qgrid = self._ref_edges, self._qgrid
+                ref_universe, ref_distinct = self._ref_universe, self._ref_distinct
+            # phase 2 (NO lock — observe() on the request path never waits
+            # behind this, including the first call's jit compile)
+            if scored:
+                scores = self._compute_scores(
+                    live_q, live_hll, live_top, rows, ref, ref_edges, qgrid,
+                    ref_universe, ref_distinct,
+                )
+                breaching = self._breaching(scores)
+            # phase 3 (brief, under the lock again): commit the verdict to
+            # the hysteresis state machine, rotate a full bucket
+            with self._lock:
+                if scored:
+                    # committed only now, AFTER scoring succeeded: a phase-2
+                    # failure leaves the generation unscored, so the next
+                    # cadence tick genuinely retries this window (checks are
+                    # serialized by _check_lock — no double-commit race)
+                    self._scored_gen = fold_gen
+                    self._checks += 1
+                    self._last_check_unix = time.time()
+                    self._last_scores = scores
+                    self._last_breaching = breaching
+                    if breaching:
+                        self._breaches += 1
+                        self._breach_streak += 1
+                        self._clear_streak = 0
+                        if not self._active and self._breach_streak >= self.trip_after:
+                            self._active = True
+                            self._detected_events += 1
+                            detail = {
+                                k: round(scores[k], 4) for k in breaching if scores[k] is not None
+                            }
+                            events.append(
+                                (
+                                    "drift_detected",
+                                    f"drift monitor {self.name!r}: {', '.join(breaching)} crossed "
+                                    f"threshold over the last {rows} rows "
+                                    f"(scores {detail}, thresholds "
+                                    f"{ {k: self.thresholds[k] for k in breaching} })",
+                                    {
+                                        "monitor": self.name,
+                                        "breaching": list(breaching),
+                                        "scores": detail,
+                                        "window_rows": rows,
+                                    },
+                                )
+                            )
+                    else:
+                        self._clear_streak += 1
+                        self._breach_streak = 0
+                        if self._active and self._clear_streak >= self.clear_after:
+                            self._active = False
+                            self._recovered_events += 1
+                            events.append(
+                                (
+                                    "drift_recovered",
+                                    f"drift monitor {self.name!r}: all scores back under "
+                                    f"threshold for {self._clear_streak} consecutive checks",
+                                    {"monitor": self.name},
+                                )
+                            )
+                if self._rows >= self.window:
+                    # full bucket: rotate so the next scores describe fresh
+                    # traffic only (episode state deliberately survives)
+                    self._rotate_locked()
+                status = self._status_locked()
+        # events record OUTSIDE the lock: the health registry has its own
+        # lock and nothing orders them — keep the pair unnestable
+        from metrics_tpu.resilience.health import record_degradation
+
+        for kind, message, details in events:
+            record_degradation(kind, message, **details)
+        return status
+
+    # -- status / export surfaces ---------------------------------------
+
+    def _status_locked(self) -> Dict[str, Any]:
+        ref = self._reference
+        return {
+            "name": self.name,
+            "active": self._active,
+            "scores": dict(self._last_scores),
+            "breaching": list(self._last_breaching),
+            "thresholds": dict(self.thresholds),
+            "window": self.window,
+            "window_rows": self._rows + self._pending_rows,
+            "min_rows": self.min_rows,
+            "windows": self._windows,
+            "checks": self._checks,
+            "breaches": self._breaches,
+            "dropped_rows": self._dropped_rows,
+            "detected_events": self._detected_events,
+            "recovered_events": self._recovered_events,
+            "last_check_unix": self._last_check_unix,
+            "reference": (
+                None
+                if ref is None
+                else {
+                    "rows": ref.rows,
+                    "captured_unix": ref.captured_unix,
+                    "age_s": ref.age_s,
+                    "hh_keys": len(ref.hh_keys),
+                }
+            ),
+        }
+
+    def status(self) -> Dict[str, Any]:
+        """Plain-data view for ``health()``/exporters: latest scores,
+        episode state, window/check accounting, reference age."""
+        with self._lock:
+            return self._status_locked()
+
+    def fleet_scores(self) -> Dict[str, Any]:
+        """The compact per-host form that federates up the fleet tree
+        (``ServeLoop.fleet_extra`` → wire header → aggregator scrape):
+        the four scores + the episode flag, a few dozen bytes per host."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                k: (None if v is None else round(float(v), 6))
+                for k, v in self._last_scores.items()
+            }
+            out["active"] = self._active
+            out["windows"] = self._windows
+            return out

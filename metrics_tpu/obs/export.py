@@ -113,6 +113,43 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
                 metric = f"{_PREFIX}_{gauge}"
                 lines.append(f"# TYPE {metric} gauge")
                 lines.append(_line(metric, sync[key]))
+    drift = health.get("drift")
+    if drift:
+        # the drift-monitor surface (obs/drift.py): continuous scores as
+        # labeled gauges — None scores (no reference / thin bucket) are
+        # skipped, the episode flag and window/check counters always render
+        score_lines: Dict[str, List[str]] = {}
+        flag_lines: List[str] = []
+        window_lines: List[str] = []
+        check_lines: List[str] = []
+        for name, st in sorted(drift.items()):
+            for score, value in (st.get("scores") or {}).items():
+                if value is not None:
+                    score_lines.setdefault(score, []).append(
+                        _line(f"{_PREFIX}_drift_{score}", value, monitor=name)
+                    )
+            flag_lines.append(
+                _line(f"{_PREFIX}_drift_active", bool(st.get("active")), monitor=name)
+            )
+            if st.get("windows") is not None:
+                window_lines.append(
+                    _line(f"{_PREFIX}_drift_windows_total", st["windows"], monitor=name)
+                )
+            if st.get("checks") is not None:
+                check_lines.append(
+                    _line(f"{_PREFIX}_drift_checks_total", st["checks"], monitor=name)
+                )
+        for score in sorted(score_lines):
+            lines.append(f"# TYPE {_PREFIX}_drift_{score} gauge")
+            lines.extend(score_lines[score])
+        lines.append(f"# TYPE {_PREFIX}_drift_active gauge")
+        lines.extend(flag_lines)
+        if window_lines:
+            lines.append(f"# TYPE {_PREFIX}_drift_windows_total counter")
+            lines.extend(window_lines)
+        if check_lines:
+            lines.append(f"# TYPE {_PREFIX}_drift_checks_total counter")
+            lines.extend(check_lines)
     fleet = health.get("fleet")
     if fleet:
         # the federated surface: one scrape at the global aggregator shows
@@ -139,6 +176,38 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
         stale_host_lines: List[str] = []
         flag_lines: List[str] = []
         update_lines: List[str] = []
+        # per-host drift scores federated through the wire-header extra
+        # (obs/drift.py fleet_scores): the global aggregator's one scrape
+        # names WHICH host is drifting, per monitor
+        host_drift_lines: Dict[str, List[str]] = {}
+        host_drift_flags: List[str] = []
+
+        def _drift_host_lines(host: str, entry: Dict[str, Any], **extra_labels: Any) -> None:
+            for monitor, sc in sorted((entry.get("drift") or {}).items()):
+                for score, value in sorted((sc or {}).items()):
+                    if score in ("active", "windows") or value is None:
+                        continue
+                    host_drift_lines.setdefault(score, []).append(
+                        _line(
+                            f"{_PREFIX}_fleet_host_drift_{score}",
+                            value,
+                            host=host,
+                            monitor=monitor,
+                            node=node,
+                            **extra_labels,
+                        )
+                    )
+                host_drift_flags.append(
+                    _line(
+                        f"{_PREFIX}_fleet_host_drift_active",
+                        bool((sc or {}).get("active")),
+                        host=host,
+                        monitor=monitor,
+                        node=node,
+                        **extra_labels,
+                    )
+                )
+
         if isinstance(hosts, dict):
             for host, entry in sorted(hosts.items()):
                 if entry.get("staleness_s") is not None:
@@ -152,6 +221,7 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
                     update_lines.append(
                         _line(f"{_PREFIX}_fleet_host_updates", entry["updates"], host=host, node=node)
                     )
+                _drift_host_lines(host, entry)
         if isinstance(downstream, dict):
             # hosts observed through a child node (pod-forwarded staleness):
             # the `via` label names the reporting child, so one global scrape
@@ -178,6 +248,7 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
                         via=entry.get("via", ""),
                     )
                 )
+                _drift_host_lines(host, entry, via=entry.get("via", ""))
         if stale_host_lines:
             lines.append(f"# TYPE {_PREFIX}_fleet_host_staleness_seconds gauge")
             lines.extend(stale_host_lines)
@@ -187,6 +258,12 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
         if update_lines:
             lines.append(f"# TYPE {_PREFIX}_fleet_host_updates gauge")
             lines.extend(update_lines)
+        for score in sorted(host_drift_lines):
+            lines.append(f"# TYPE {_PREFIX}_fleet_host_drift_{score} gauge")
+            lines.extend(host_drift_lines[score])
+        if host_drift_flags:
+            lines.append(f"# TYPE {_PREFIX}_fleet_host_drift_active gauge")
+            lines.extend(host_drift_flags)
     metrics = health.get("metrics") or {}
     fault_lines: List[str] = []
     lag_lines: List[str] = []

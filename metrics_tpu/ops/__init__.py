@@ -24,11 +24,12 @@ from metrics_tpu.ops.bucketed_rank import (  # noqa: F401
     sharded_descending_ranks,
     stable_key_order,
 )
-from metrics_tpu.ops.binning import halving_map, key_to_float32  # noqa: F401
+from metrics_tpu.ops.binning import halving_level, halving_map, key_to_float32  # noqa: F401
 from metrics_tpu.ops.compactor import (  # noqa: F401
     fold_cascade,
     fold_level,
     precompact_batch,
+    weighted_cdf,
     weighted_quantiles,
     weighted_rank,
 )

@@ -63,18 +63,33 @@ def key_to_float32(key: Array) -> Array:
     return jax.lax.bitcast_convert_type(u, jnp.float32)
 
 
+def halving_level(n: int, k: int) -> int:
+    """Number of alternating-pair halving rounds pre-compaction applies to
+    an ``n``-row batch (its output items' level / weight exponent) —
+    :func:`halving_map`'s round count without materializing the O(n) index
+    map (each round keeps ``count // 2`` items, so the count-only
+    recurrence is exact). The ONE source of the level rule: callers that
+    must predict the level (``QuantileSketchState.insert``'s oversized-
+    batch split) share it with the map itself, so they can never
+    diverge."""
+    level = 0
+    while n > k:
+        n //= 2
+        level += 1
+    return level
+
+
 def halving_map(n: int, k: int) -> Tuple[np.ndarray, int]:
     """Compose the alternating-pair halving rounds into one static index
     map: ``idx[j]`` is the sorted-batch position the ``j``-th kept item of
     ``precompact`` comes from, ``level`` the number of rounds (item weight
-    ``2**level``). Pure numpy at trace time — the map depends only on the
-    static batch size."""
+    ``2**level``, == ``halving_level(n, k)``). Pure numpy at trace time —
+    the map depends only on the static batch size."""
     idx = np.arange(n, dtype=np.int64)
-    level = 0
-    while idx.shape[0] > k:
+    level = halving_level(n, k)
+    for _ in range(level):
         j = np.arange(idx.shape[0] // 2)
         idx = idx[2 * j + (j & 1)]
-        level += 1
     return idx.astype(np.int32), level
 
 

@@ -257,3 +257,19 @@ def weighted_rank(items: Array, counts: Array, v: Array) -> Array:
     """Estimated number of inserted rows ``<= v`` (float32)."""
     w = level_weights(items, counts)
     return jnp.sum(jnp.where(items <= jnp.asarray(v, jnp.float32), w, 0.0))
+
+
+def weighted_cdf(items: Array, counts: Array, points: Array) -> Array:
+    """Estimated CDF at many probe points in ONE pass: ``(P,)`` fractions of
+    inserted rows ``<= points[i]`` (the vectorized form of
+    :func:`weighted_rank` — one ``(P, L, k)`` broadcast compare instead of
+    ``P`` scans). Each value is off by at most the sketch's rank-error
+    fraction ``eps``; an empty sketch answers NaN everywhere."""
+    w = level_weights(items, counts)
+    pts = jnp.atleast_1d(jnp.asarray(points, jnp.float32))
+    ranks = jnp.sum(
+        jnp.where(items[None, :, :] <= pts[:, None, None], w[None, :, :], 0.0),
+        axis=(1, 2),
+    )
+    total = jnp.sum(w)
+    return jnp.where(total > 0, ranks / jnp.maximum(total, 1.0), jnp.nan)

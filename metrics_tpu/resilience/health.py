@@ -67,12 +67,26 @@ from typing import Any, Dict, List, Optional
 #                          was evicted from the shared table — that shape serves through
 #                          the normal jit path for the rest of the process
 #                          (serving/warmup.py; also counted as serve_aot_evicted_total)
+#   drift_detected         a DriftMonitor's live traffic window crossed a drift
+#                          threshold vs its blessed reference (obs/drift.py) — recorded
+#                          ONCE per episode (hysteresis-gated: a flapping score cannot
+#                          wheel this ring), naming the monitor and breaching scores
+#   drift_recovered        the drift episode ended: every score back under threshold
+#                          for `clear_after` consecutive checks (the recovery edge, so
+#                          drift episodes are bounded in the log like fleet staleness)
+#   drift_check_error      a drift check/observe raised on the serving cadence; the
+#                          monitor keeps its previous scores and the cadence retries
+#                          (episode-gated once per monitor — metrics_tpu/serving)
+#   drift_baseline_loaded  a DriftMonitor attached a ReferenceWindow — INFORMATIONAL:
+#                          a normal-operation milestone that never flips `degraded`,
+#                          recorded so "when was this baseline blessed" is datable
+#                          next to any later drift_detected
 _MAX_EVENTS = 256
 
 # event kinds that are operational milestones, not degradations: reported,
 # counted, datable — but excluded from the `degraded` flag (the
 # INFORMATIONAL_FAULT_CLASSES stance applied to registry events)
-INFORMATIONAL_EVENT_KINDS = frozenset({"serve_warmup_done"})
+INFORMATIONAL_EVENT_KINDS = frozenset({"serve_warmup_done", "drift_baseline_loaded"})
 
 
 class HealthRegistry:
@@ -224,18 +238,24 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
          "event_counts": {kind: n},
          "event_kinds": {kind: {"count", "first_unix", "last_unix",
                                 "last_mono"}},   # never evicts (ring does)
+         "informational_event_kinds": [...],  # the milestone kinds, always
          "runtime": {"counters": {...}, "histograms": {...}},  # when any
          "metrics": {name: {"faults": {...}, "overflow_dropped": n,
                             "last_update_unix": t, "last_update_step": s,
                             "staleness_s": age}},
          "degraded": bool}
 
-    ``degraded`` is True when any non-informational registry event (every
-    kind except :data:`INFORMATIONAL_EVENT_KINDS` — operational milestones
-    like ``serve_warmup_done``) OR any reported metric fault/overflow
-    exists. Staleness (``last_update_*``/``staleness_s``, or
-    ``never_updated``) is informational — a stalled stream is visible but
-    does not flip the flag by itself.
+    ``event_counts``/``event_kinds`` list EVERY recorded kind — loud
+    degradations and informational milestones side by side (the table is
+    the one never-evicting record, so a milestone must be datable there
+    too); ``informational_event_kinds`` names which kinds are milestones
+    (:data:`INFORMATIONAL_EVENT_KINDS` — ``serve_warmup_done``,
+    ``drift_baseline_loaded``), so a consumer can partition the table
+    without importing this module. ``degraded`` is True when any
+    NON-informational registry event OR any reported metric
+    fault/overflow exists. Staleness (``last_update_*``/``staleness_s``,
+    or ``never_updated``) is informational — a stalled stream is visible
+    but does not flip the flag by itself.
     """
     from metrics_tpu.utilities.backend import backend_status
 
@@ -244,6 +264,7 @@ def health_report(*metrics: Any) -> Dict[str, Any]:
         "events": registry.events(),
         "event_counts": registry.counts(),
         "event_kinds": registry.kinds(),
+        "informational_event_kinds": sorted(INFORMATIONAL_EVENT_KINDS),
         "metrics": {},
     }
     # self-telemetry summary (obs/runtime_metrics.py), LIGHT form only:

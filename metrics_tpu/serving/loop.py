@@ -191,6 +191,16 @@ class ServeLoop:
     restarts. A warmup failure is loud (``serve_warmup_error``) but never
     blocks or degrades serving — the untraced path still works.
 
+    ``drift_monitors=`` takes :class:`~metrics_tpu.obs.DriftMonitor`
+    instance(s) (one, a list, or a ``{name: monitor}`` dict): each watches
+    one value stream of the ACCEPTED traffic (its ``extract`` hook;
+    default first positional argument, O(1) on the offer path) and runs
+    its host-side check on the reducer cadence — a distribution shift vs
+    the blessed reference records a ``drift_detected`` health event and
+    crosses the scraped ``metrics_tpu_drift_*`` gauges within one window
+    rotation, and per-host scores federate through ``fleet_extra()``
+    (``obs/drift.py``).
+
     **Windowed members.** A served :class:`~metrics_tpu.WindowedMetric`
     keeps its time-bucket ring per replica, and replicas rotate buckets at
     their own head positions — so the merged view is the SUM of per-worker
@@ -210,6 +220,7 @@ class ServeLoop:
         snapshot_every_s: Optional[float] = None,
         sync_transport: Optional[str] = None,
         warmup: Optional[Any] = None,
+        drift_monitors: Optional[Any] = None,
     ) -> None:
         if workers < 1:
             raise ValueError(f"`workers` must be >= 1, got {workers}")
@@ -255,6 +266,54 @@ class ServeLoop:
         self._snapshot_every_s = snapshot_every_s
         self._snapshot_step = itertools.count(1)
         self._last_snapshot_unix = time.time()
+
+        # drift monitors (obs/drift.py): each watches one value stream of
+        # the offered traffic (its `extract` hook; default first positional
+        # arg). Feeding is an O(1) bounded-buffer append on the offer path;
+        # checks — the O(sketch) host-side scoring — ride the scheduler's
+        # wake cadence below, so a distribution shift pages within one
+        # window rotation without any work in a compiled graph.
+        self._drift: Dict[str, Any] = {}
+        self._drift_error_reported: Dict[str, bool] = {}  # episode-gated
+        # set by ANY failing observe/check since the last cadence tick; the
+        # tick only re-arms the episode after a fully-clean interval, so a
+        # persistently failing extract hook (whose failures live on the
+        # offer path, which a successful check says nothing about) still
+        # records ONE event per episode, never one per tick
+        self._drift_error_recent: Dict[str, bool] = {}
+        if drift_monitors is not None:
+            if isinstance(drift_monitors, dict):
+                # the dict form is labels-as-keys: a key that contradicts
+                # the monitor's own name would silently split the surface
+                # (events under monitor.name, the caller expecting the key)
+                for key, monitor in drift_monitors.items():
+                    if key != getattr(monitor, "name", None):
+                        raise MetricsTPUUserError(
+                            f"drift_monitors key {key!r} != monitor.name "
+                            f"{getattr(monitor, 'name', None)!r}; gauges and events are "
+                            "labeled by the monitor's own name — use matching keys "
+                            "(or pass a list)"
+                        )
+                monitors = list(drift_monitors.values())
+            elif isinstance(drift_monitors, (list, tuple)):
+                monitors = list(drift_monitors)
+            else:
+                monitors = [drift_monitors]
+            for monitor in monitors:
+                name = getattr(monitor, "name", None)
+                if not name or not callable(getattr(monitor, "check", None)):
+                    raise MetricsTPUUserError(
+                        "`drift_monitors` must be DriftMonitor instances (or a "
+                        f"list/dict of them), got {type(monitor).__name__}"
+                    )
+                if name in self._drift:
+                    raise MetricsTPUUserError(
+                        f"duplicate drift monitor name {name!r}: each monitor needs a "
+                        "distinct name (it labels the exported gauges)"
+                    )
+                self._drift[name] = monitor
+                self._drift_error_reported[name] = False
+                self._drift_error_recent[name] = False
 
         # the background reducer IS an async-sync scheduler cycle: snapshot =
         # sweep the workers' published states (+ any restored base), reduce =
@@ -331,6 +390,16 @@ class ServeLoop:
                 metric=type(self._proto).__name__,
             )
             return False
+        # drift monitors watch ACCEPTED traffic (the stream the metric will
+        # see); observe() is an O(1) bounded append — a monitor failure
+        # degrades loudly and never takes the request with it
+        for name, monitor in self._drift.items():
+            try:
+                values = monitor.extract_from(args, kwargs)
+                if values is not None:
+                    monitor.observe(values)
+            except Exception as err:  # noqa: BLE001 — drift degrades, never sheds
+                self._record_drift_error(name, err, during="observe")
         return True
 
     def _worker(self, i: int) -> None:
@@ -483,12 +552,50 @@ class ServeLoop:
             metric=type(self._proto).__name__,
         )
 
+    def _record_drift_error(self, name: str, err: BaseException, during: str) -> None:
+        """Episode-gated per monitor (the fleet-publisher encode-error
+        stance): a persistently failing check on a fast cadence must not
+        wheel the bounded event ring; the next successful check re-arms."""
+        with self._stats_lock:
+            due = not self._drift_error_reported.get(name)
+            self._drift_error_reported[name] = True
+            self._drift_error_recent[name] = True
+        if due:
+            record_degradation(
+                "drift_check_error",
+                f"drift monitor {name!r} {during} raised {type(err).__name__}: {err} "
+                "(reported once per episode; the cadence keeps retrying)",
+                monitor=name,
+            )
+
+    def _drift_tick(self) -> None:
+        """Run every monitor's check on the scheduler's wake cadence (the
+        reducer cadence): fold pending rows, score vs the reference, fire
+        or clear episodes — all host-side, off the request path."""
+        for name, monitor in self._drift.items():
+            try:
+                with _obs_trace.span("serve.drift_check", monitor=name):
+                    monitor.check()
+                with self._stats_lock:
+                    # re-arm the episode only after a FULLY clean interval:
+                    # a check succeeding says nothing about extract/observe
+                    # failures on the offer path since the last tick
+                    if self._drift_error_recent[name]:
+                        self._drift_error_recent[name] = False
+                    else:
+                        self._drift_error_reported[name] = False
+            except Exception as err:  # noqa: BLE001 — drift degrades, never kills the reducer
+                self._record_drift_error(name, err, during="check")
+
     def _snapshot_tick(self) -> Optional[float]:
-        """Scheduler tick hook: the periodic-snapshot side cadence. Returns
-        seconds until the next snapshot is due so the scheduler's wait wakes
-        for whichever of reduce/snapshot cadence fires first — a
-        ``snapshot_every_s`` shorter than ``reduce_every_s`` is honored even
-        on an idle loop."""
+        """Scheduler tick hook: the periodic-snapshot side cadence (plus
+        the drift-check cadence — every scheduler wake runs the monitors'
+        host-side checks first). Returns seconds until the next snapshot is
+        due so the scheduler's wait wakes for whichever of reduce/snapshot
+        cadence fires first — a ``snapshot_every_s`` shorter than
+        ``reduce_every_s`` is honored even on an idle loop."""
+        if self._drift:
+            self._drift_tick()
         if self._snapshot_every_s is None:
             return None
         due_in = self._last_snapshot_unix + self._snapshot_every_s - time.time()
@@ -597,6 +704,11 @@ class ServeLoop:
             # its own serve_warmup_error event; serving itself is unaffected
             "warmup": self._warmup.state() if self._warmup is not None else None,
         }
+        if self._drift:
+            # the drift surface (obs/drift.py): latest scores, episode
+            # flags, window/check accounting per monitor — what the
+            # exporters render as metrics_tpu_drift_* gauges
+            rep["drift"] = {name: m.status() for name, m in self._drift.items()}
         return rep
 
     def fleet_view(self) -> Optional[Dict[str, Any]]:
@@ -607,6 +719,17 @@ class ServeLoop:
         clone), so snapshotting it here never races the scheduler."""
         reporter = self._last_reporter
         return None if reporter is None else reporter.snapshot_state()
+
+    def fleet_extra(self) -> Optional[Dict[str, Any]]:
+        """Header extra for this host's fleet publishes (the
+        ``FleetPublisher`` source hook, same surface as
+        ``Aggregator.fleet_extra``): the per-monitor drift scores +
+        episode flags, so the global aggregator's one scrape names WHICH
+        host is drifting — a few dozen bytes per host, never sketch
+        state."""
+        if not self._drift:
+            return None
+        return {"drift": {name: m.fleet_scores() for name, m in self._drift.items()}}
 
     def scrape(self, fmt: str = "prometheus") -> str:
         """One exporter scrape over this loop: :meth:`health` (request

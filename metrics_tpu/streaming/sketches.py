@@ -43,6 +43,7 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 from metrics_tpu.ops.compactor import (
     fold_cascade,
     precompact_batch,
+    weighted_cdf,
     weighted_quantiles,
     weighted_rank,
 )
@@ -144,7 +145,32 @@ class QuantileSketchState(NamedTuple):
         ``L`` (``ops/compactor.py``)."""
         x = jnp.asarray(values, jnp.float32).reshape(-1)
         v = jnp.ones(x.shape, bool) if valid is None else jnp.asarray(valid, bool).reshape(-1)
-        inc, inc_count, level = precompact_batch(x, v, self.items.shape[1])
+        L, k = self.items.shape
+        # predict the pre-compaction level WITHOUT running the kernel —
+        # shared with the halving map itself, so prediction and the
+        # kernel's actual level can never diverge
+        from metrics_tpu.ops.binning import halving_level
+
+        level = halving_level(x.shape[0], k)
+        if level >= L:
+            # a single batch so large its pre-compaction would promote PAST
+            # the top level (> k * 2**(L-1) rows, i.e. max_items was
+            # configured below one batch's size): fold_cascade would drop
+            # the whole increment on the floor. Split into the smallest
+            # chunk count that lands within the cascade instead — a static
+            # python loop, so jit-compatible, decided BEFORE any kernel
+            # runs; the eps contract still degrades per
+            # `_check_cat_overflow`, but the rows are never silently lost.
+            # (`valid` may be a broadcastable scalar/length-1 on the normal
+            # path — materialize it to x's shape so the slices pair up.)
+            v = jnp.broadcast_to(v, x.shape)
+            chunks = 1 << (level - (L - 1))
+            step = -(-x.shape[0] // chunks)
+            state = self
+            for i in range(0, x.shape[0], step):
+                state = state.insert(x[i : i + step], v[i : i + step])
+            return state
+        inc, inc_count, level = precompact_batch(x, v, k)
         items, counts = fold_cascade(self.items, self.counts, inc, inc_count, level)
         n = jnp.sum((v & jnp.isfinite(x)).astype(jnp.int32))
         return QuantileSketchState(items=items, counts=counts, n_seen=self.n_seen + n)
@@ -189,6 +215,16 @@ class QuantileSketchState(NamedTuple):
     def rank(self, v: Any) -> Array:
         """Estimated rows ``<= v`` (error ``<= eps * n``)."""
         return weighted_rank(self.items, self.counts, v)
+
+    def cdf(self, points: Any) -> Array:
+        """Estimated CDF at many probe points in one vectorized pass:
+        ``cdf(points)[i]`` is the fraction of inserted rows ``<= points[i]``,
+        each off by at most the sketch's rank-error fraction (``eps_bound``;
+        ``eps`` as constructed) — the many-point form of :meth:`rank` that
+        drift scoring (``obs/drift.py``) and any CDF-distance consumer
+        needs, instead of hand-rolling a per-point rank loop. An empty
+        sketch answers NaN everywhere."""
+        return weighted_cdf(self.items, self.counts, points)
 
     @property
     def eps_bound(self) -> float:
@@ -539,6 +575,11 @@ class QuantileSketch(_SketchMetric):
         from metrics_tpu.utilities.data import _squeeze_if_scalar
 
         return _squeeze_if_scalar(self.sketch.quantile(qs))
+
+    def cdf(self, points: Any) -> Array:
+        """Ad-hoc vectorized CDF query against the current (local) state
+        (see :meth:`QuantileSketchState.cdf`)."""
+        return self.sketch.cdf(points)
 
 
 class CountMinSketch(_SketchMetric):

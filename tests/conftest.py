@@ -98,6 +98,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "drift: the online drift-detection workload (obs/drift.py — reference "
+        "windows, KS/PSI/churn/cardinality scoring, episode-gated alerting, "
+        "ServeLoop(drift_monitors=...) cadence checks, fleet federation of "
+        "per-host scores); select with -m drift, or run the lane via "
+        "`make test-drift` (which also runs the examples/drift_monitor.py "
+        "subprocess acceptance — additionally marked slow)",
+    )
+    config.addinivalue_line(
+        "markers",
         "async_sync: the overlapped async sync layer (parallel/async_sync.py "
         "scheduler, Metric(sync_mode='overlapped'), pure.py::"
         "overlapped_functionalize) — double-buffered zero-collective-latency "

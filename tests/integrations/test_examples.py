@@ -30,15 +30,23 @@ _HEAVY = {
     # cadences) — the same machinery tier-1 covers in-process via
     # tests/fleet/ and the mini multiprocess parity test
     "fleet.py",
+    # drift hot-swap demo (~15 s subprocess replay of machinery tier-1
+    # covers in-process via tests/obs/test_drift.py + the ServeLoop drift
+    # suite); also rides the `drift` marker so `make test-drift` runs it
+    "drift_monitor.py",
 }
+
+
+def _marks(p):
+    marks = [pytest.mark.slow] if p.name in _HEAVY else []
+    if p.name == "drift_monitor.py":
+        marks.append(pytest.mark.drift)
+    return marks
 
 
 @pytest.mark.parametrize(
     "script",
-    [
-        pytest.param(p, id=p.name, marks=[pytest.mark.slow] if p.name in _HEAVY else [])
-        for p in _EXAMPLES
-    ],
+    [pytest.param(p, id=p.name, marks=_marks(p)) for p in _EXAMPLES],
 )
 def test_example_runs(script):
     env = dict(os.environ)

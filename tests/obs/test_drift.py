@@ -1,0 +1,517 @@
+"""Drift-detection contracts (ISSUE 14): sketch-native scoring, pinned
+alerting thresholds, hysteresis episode gating, reference serialization,
+and the degradation table (missing reference / geometry mismatch / thin
+bucket / poison input).
+
+The acceptance pins live here at the monitor level (deterministic check
+driving): seeded mean-shift / tail-inflation / cardinality-spike streams
+must fire ``drift_detected`` within ONE bucket rotation at pinned
+thresholds, and a steady stream over >= 20 rotations must fire ZERO false
+alarms. ``tests/serving/test_drift_serving.py`` re-runs the story through
+live ``ServeLoop`` traffic and the fleet tier.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.obs.drift import (
+    DRIFT_SCORES,
+    DriftMonitor,
+    ReferenceWindow,
+    reset_drift_env_state,
+    resolve_drift_threshold,
+)
+from metrics_tpu.resilience.health import (
+    INFORMATIONAL_EVENT_KINDS,
+    health_report,
+    registry,
+)
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+pytestmark = [pytest.mark.drift, pytest.mark.obs]
+
+# pinned thresholds for every alerting test below: the library defaults,
+# stated explicitly so a default change cannot silently move the acceptance
+THRESHOLDS = dict(
+    ks_threshold=0.15,
+    psi_threshold=0.25,
+    hh_churn_threshold=0.5,
+    cardinality_ratio_threshold=2.0,
+)
+
+WINDOW, MIN_ROWS = 512, 128
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    registry.clear()
+    reset_drift_env_state()
+    yield
+    registry.clear()
+    reset_drift_env_state()
+
+
+def _blessed_monitor(rng, sampler, name="m", rows=4096, **kwargs):
+    """A monitor with a frozen reference captured from `sampler` traffic."""
+    opts = dict(window=WINDOW, min_rows=MIN_ROWS, **THRESHOLDS)
+    opts.update(kwargs)
+    mon = DriftMonitor(name, **opts)
+    for _ in range(rows // 256):
+        mon.observe(sampler(rng, 256))
+    mon.set_reference(mon.freeze_reference())
+    mon.rotate()
+    return mon
+
+
+def _normal(rng, n):
+    return rng.normal(0.0, 1.0, n)
+
+
+# --------------------------------------------------------------------------
+# alerting acceptance: seeded shifts fire within one rotation, steady fires
+# never
+# --------------------------------------------------------------------------
+
+
+def test_steady_stream_zero_false_alarms_over_20_rotations():
+    rng = np.random.default_rng(0)
+    mon = _blessed_monitor(rng, _normal)
+    for _rotation in range(20):
+        mon.observe(_normal(rng, WINDOW))
+        status = mon.check()  # scores + rotates the full bucket
+        assert not status["active"], status
+        assert not status["breaching"], status
+    assert status["windows"] >= 20
+    counts = registry.counts()
+    assert "drift_detected" not in counts, counts
+    assert "drift_recovered" not in counts, counts
+    # the whole run stayed non-degraded (baseline load is informational)
+    assert health_report()["degraded"] is False
+
+
+def test_mean_shift_fires_within_one_rotation():
+    rng = np.random.default_rng(1)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(rng.normal(1.5, 1.0, WINDOW))  # one shifted window
+    status = mon.check()
+    assert status["active"], status
+    assert "ks" in status["breaching"], status
+    assert registry.counts().get("drift_detected") == 1
+
+
+def test_tail_inflation_fires_within_one_rotation():
+    rng = np.random.default_rng(2)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(rng.normal(0.0, 3.0, WINDOW))  # same mean, 3x scale
+    status = mon.check()
+    assert status["active"], status
+    assert status["scores"]["ks"] >= 0.15, status["scores"]
+    assert registry.counts().get("drift_detected") == 1
+
+
+def test_cardinality_spike_fires_within_one_rotation():
+    rng = np.random.default_rng(3)
+    sampler = lambda r, n: r.integers(0, 50, n)  # ~50 distinct ids
+    mon = _blessed_monitor(rng, sampler)
+    mon.observe(rng.integers(0, 1_000_000, WINDOW))  # id-space explosion
+    status = mon.check()
+    assert status["active"], status
+    assert "cardinality_ratio" in status["breaching"], status
+    assert status["scores"]["cardinality_ratio"] >= 2.0
+    assert registry.counts().get("drift_detected") == 1
+
+
+def test_cardinality_collapse_fires_symmetrically():
+    rng = np.random.default_rng(4)
+    sampler = lambda r, n: r.integers(0, 10_000, n)
+    mon = _blessed_monitor(rng, sampler)
+    mon.observe(np.full(WINDOW, 7.0))  # every id collapses onto one
+    status = mon.check()
+    assert "cardinality_ratio" in status["breaching"], status
+    assert status["scores"]["cardinality_ratio"] <= 0.5
+
+
+def test_heavy_hitter_churn_fires_on_hot_set_swap():
+    rng = np.random.default_rng(5)
+    sampler = lambda r, n: r.integers(0, 8, n)  # 8 hot ids
+    mon = _blessed_monitor(rng, sampler)
+    mon.observe(rng.integers(8, 16, WINDOW))  # disjoint hot set
+    status = mon.check()
+    assert status["scores"]["hh_churn"] == 1.0
+    assert "hh_churn" in status["breaching"]
+
+
+def test_continuous_stream_has_no_hh_story():
+    """A stream with no hot keys scores hh_churn as None (not applicable),
+    never a permanently-breaching 1.0 — the phi-heavy-hitter gate."""
+    rng = np.random.default_rng(6)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(_normal(rng, WINDOW))
+    status = mon.check()
+    assert status["scores"]["hh_churn"] is None
+
+
+# --------------------------------------------------------------------------
+# hysteresis / episode gating: a flapping signal records ONE event pair
+# --------------------------------------------------------------------------
+
+
+def test_flapping_signal_records_one_episode():
+    rng = np.random.default_rng(7)
+    mon = _blessed_monitor(rng, _normal, trip_after=1, clear_after=2)
+    mon.observe(rng.normal(2.0, 1.0, WINDOW))
+    assert mon.check()["active"]
+    # flap: clean/shifted alternating — the clean streak never reaches
+    # clear_after, so the episode holds and NO further events record
+    for _ in range(6):
+        mon.observe(_normal(rng, WINDOW))
+        assert mon.check()["active"]
+        mon.observe(rng.normal(2.0, 1.0, WINDOW))
+        assert mon.check()["active"]
+    counts = registry.counts()
+    assert counts.get("drift_detected") == 1, counts
+    assert "drift_recovered" not in counts, counts
+    # sustained recovery ends the episode exactly once
+    for _ in range(2):
+        mon.observe(_normal(rng, WINDOW))
+        status = mon.check()
+    assert not status["active"]
+    counts = registry.counts()
+    assert counts.get("drift_detected") == 1 and counts.get("drift_recovered") == 1
+
+
+def test_trip_after_requires_consecutive_breaches():
+    rng = np.random.default_rng(8)
+    mon = _blessed_monitor(rng, _normal, trip_after=2, clear_after=1)
+    mon.observe(rng.normal(2.0, 1.0, WINDOW))
+    assert not mon.check()["active"]  # 1 breach < trip_after
+    mon.observe(_normal(rng, WINDOW))
+    assert not mon.check()["active"]  # streak reset by the clean check
+    assert "drift_detected" not in registry.counts()
+    mon.observe(rng.normal(2.0, 1.0, WINDOW))
+    mon.check()
+    mon.observe(rng.normal(2.0, 1.0, WINDOW))
+    assert mon.check()["active"]  # 2 consecutive → episode
+    assert registry.counts().get("drift_detected") == 1
+
+
+# --------------------------------------------------------------------------
+# degradation table: missing reference / thin bucket / geometry mismatch /
+# poison input
+# --------------------------------------------------------------------------
+
+
+def test_idle_checks_skip_rescoring():
+    """Nothing folded since the last scored check → phase 2 is skipped
+    entirely (the scheduler's idle-skip stance): the checks counter and
+    scores stay put however often the cadence ticks."""
+    rng = np.random.default_rng(40)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(_normal(rng, MIN_ROWS))  # scored but below rotation
+    assert mon.check()["checks"] == 1
+    assert mon.check()["checks"] == 1  # idle tick: no rescoring
+    mon.observe(_normal(rng, 8))  # any new fold re-arms scoring
+    assert mon.check()["checks"] == 2
+
+
+def test_failed_scoring_retries_next_check(monkeypatch):
+    """A phase-2 failure must not mark the window as scored: the next
+    cadence tick genuinely retries it (the drift_check_error contract)."""
+    rng = np.random.default_rng(41)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(rng.normal(3.0, 1.0, MIN_ROWS))
+    original = mon._compute_scores
+    calls = {"n": 0}
+
+    def flaky(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient")
+        return original(*args, **kwargs)
+
+    monkeypatch.setattr(mon, "_compute_scores", flaky)
+    with pytest.raises(RuntimeError):
+        mon.check()
+    status = mon.check()  # same window, zero new folds — still rescored
+    assert status["active"], status
+    assert registry.counts().get("drift_detected") == 1
+
+
+def test_no_reference_checks_are_inert():
+    rng = np.random.default_rng(9)
+    mon = DriftMonitor("bare", window=WINDOW, min_rows=MIN_ROWS, **THRESHOLDS)
+    mon.observe(_normal(rng, WINDOW))
+    status = mon.check()
+    assert status["reference"] is None
+    assert all(status["scores"][s] is None for s in DRIFT_SCORES)
+    assert not status["active"]
+    assert not registry.counts()  # nothing recorded, not even baseline
+
+
+def test_thin_bucket_is_not_scored():
+    rng = np.random.default_rng(10)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(rng.normal(5.0, 1.0, MIN_ROWS - 2))  # wildly shifted but thin
+    status = mon.check()
+    assert not status["active"]
+    assert status["checks"] == 0  # thin evidence must not page
+
+
+def test_geometry_mismatch_is_refused_loudly():
+    """Sketch geometry is a function of the monitor's accuracy config (eps /
+    cm_width / hll_precision, NOT the window length — windows may differ);
+    a reference captured under a different config is refused at attach."""
+    rng = np.random.default_rng(11)
+    donor = DriftMonitor("donor", window=WINDOW, eps=0.2, **THRESHOLDS)
+    donor.observe(_normal(rng, WINDOW))
+    ref = donor.freeze_reference()
+    mon = DriftMonitor("mine", window=WINDOW, eps=0.05, **THRESHOLDS)
+    with pytest.raises(MetricsTPUUserError, match="geometry"):
+        mon.set_reference(ref)
+    with pytest.raises(MetricsTPUUserError, match="cm_depth/cm_width"):
+        DriftMonitor("cm", window=WINDOW, eps=0.2, cm_width=512, **THRESHOLDS).set_reference(ref)
+    # windows MAY differ: a long blessed period scores a short live window
+    short = DriftMonitor("short", window=WINDOW // 2, eps=0.2, **THRESHOLDS)
+    short.set_reference(ref)
+
+
+def test_poison_observe_is_counted_never_raises():
+    rng = np.random.default_rng(12)
+    mon = _blessed_monitor(rng, _normal)
+    assert mon.observe(object()) == 0
+    assert mon.observe([np.nan, np.inf, 1.0]) == 1  # one finite row folds
+    assert mon.status()["dropped_rows"] >= 3
+
+
+def test_freeze_reference_needs_rows():
+    mon = DriftMonitor("empty", window=WINDOW, **THRESHOLDS)
+    with pytest.raises(MetricsTPUUserError, match="observe"):
+        mon.freeze_reference()
+
+
+def test_geometry_params_refused_at_construction():
+    """A config typo is refused eagerly, not retried forever as a
+    drift_check_error at the first lazy sketch build on the cadence."""
+    for kwargs, match in (
+        (dict(eps=1.5), "eps"),
+        (dict(cm_depth=0), "cm_depth"),
+        (dict(cm_width=100), "power of two"),
+        (dict(hll_precision=1), "hll_precision"),
+    ):
+        with pytest.raises(MetricsTPUUserError, match=match):
+            DriftMonitor("bad", window=WINDOW, **kwargs)
+
+
+def test_rebaseline_rescores_even_without_new_folds():
+    """Swapping the reference must force the next check to rescore the
+    unchanged live window against the NEW baseline (the set_reference
+    fold-generation bump — idle-skip must not pin stale-baseline scores)."""
+    rng = np.random.default_rng(42)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(rng.normal(2.0, 1.0, MIN_ROWS))
+    assert mon.check()["active"]  # drifted vs the N(0,1) baseline
+    donor = DriftMonitor("donor", window=WINDOW, **THRESHOLDS)
+    donor.observe(rng.normal(2.0, 1.0, 4 * WINDOW))
+    mon.set_reference(donor.freeze_reference())  # bless the shifted stream
+    status = mon.check()  # zero new folds — must still rescore
+    assert status["checks"] == 2
+    # scored against the NEW baseline: the KS that breached at ~0.9 vs the
+    # old one is now under the bar (PSI stays noisy at a min_rows-thin
+    # bucket — 32 bins over 128 rows — so only KS is asserted)
+    assert status["scores"]["ks"] < 0.15, status["scores"]
+    assert "ks" not in status["breaching"]
+
+
+def test_score_floor_composes_both_sketch_eps():
+    rng = np.random.default_rng(13)
+    mon = _blessed_monitor(rng, _normal)
+    floor = mon.score_floor()
+    assert 0 < floor["ks"] < THRESHOLDS["ks_threshold"], floor
+    assert floor["psi_bin_probability"] == pytest.approx(2 * floor["ks"])
+
+
+# --------------------------------------------------------------------------
+# reference serialization (the to_primitives snapshot forms)
+# --------------------------------------------------------------------------
+
+
+def test_reference_round_trips_through_primitives():
+    rng = np.random.default_rng(14)
+    mon = _blessed_monitor(rng, lambda r, n: r.integers(0, 8, n))
+    ref = mon._reference
+    clone = ReferenceWindow.from_primitives(ref.to_primitives())
+    assert clone.rows == ref.rows
+    assert clone.hh_keys == ref.hh_keys
+    np.testing.assert_array_equal(np.asarray(clone.quantile.items), np.asarray(ref.quantile.items))
+    np.testing.assert_array_equal(np.asarray(clone.countmin.counts), np.asarray(ref.countmin.counts))
+    np.testing.assert_array_equal(np.asarray(clone.hll.registers), np.asarray(ref.hll.registers))
+    # a fresh monitor scoring against the clone behaves identically
+    mon2 = DriftMonitor("clone", window=WINDOW, min_rows=MIN_ROWS, **THRESHOLDS)
+    mon2.set_reference(clone)
+    mon2.observe(rng.integers(0, 8, WINDOW))
+    assert not mon2.check()["active"]
+
+
+def test_reference_refuses_unknown_schema():
+    with pytest.raises(MetricsTPUUserError, match="drift-reference-v1"):
+        ReferenceWindow.from_primitives({"schema": "bogus"})
+    with pytest.raises(MetricsTPUUserError, match="drift-reference-v1"):
+        ReferenceWindow.from_primitives("not a mapping")
+
+
+def test_reference_refuses_corrupt_fields_by_name():
+    """A hand-edited/corrupted snapshot fails at load naming the field,
+    never deep inside a jitted score kernel as an anonymous shape error."""
+    rng = np.random.default_rng(18)
+    mon = _blessed_monitor(rng, _normal)
+    prim = mon._reference.to_primitives()
+    bad = dict(prim)
+    bad["countmin"] = {"counts": np.asarray(prim["countmin"]["counts"]).ravel()}
+    with pytest.raises(MetricsTPUUserError, match="countmin.counts"):
+        ReferenceWindow.from_primitives(bad)
+    bad = dict(prim)
+    bad["hll"] = {"registers": np.zeros(100, np.int32)}  # not a power of two
+    with pytest.raises(MetricsTPUUserError, match="hll.registers"):
+        ReferenceWindow.from_primitives(bad)
+    bad = dict(prim)
+    bad["quantile"] = {**prim["quantile"], "counts": np.zeros(3, np.int32)}
+    with pytest.raises(MetricsTPUUserError, match="quantile.counts"):
+        ReferenceWindow.from_primitives(bad)
+
+
+# --------------------------------------------------------------------------
+# METRICS_TPU_DRIFT_* knobs (shared _envtools warn-once contract)
+# --------------------------------------------------------------------------
+
+
+def test_threshold_resolution_env_then_default(monkeypatch):
+    assert resolve_drift_threshold("ks", None) == 0.15
+    monkeypatch.setenv("METRICS_TPU_DRIFT_KS", "0.3")
+    reset_drift_env_state()
+    assert resolve_drift_threshold("ks", None) == 0.3
+    # programmatic wins over env
+    assert resolve_drift_threshold("ks", 0.07) == 0.07
+    mon = DriftMonitor("envy", window=WINDOW)
+    assert mon.thresholds["ks"] == 0.3
+    assert mon.thresholds["psi"] == 0.25  # untouched knob keeps its default
+
+
+def test_malformed_env_warns_once_and_falls_back(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_DRIFT_PSI", "not-a-number")
+    reset_drift_env_state()
+    with pytest.warns(UserWarning, match="METRICS_TPU_DRIFT_PSI"):
+        assert resolve_drift_threshold("psi", None) == 0.25
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # second resolve is silent
+        assert resolve_drift_threshold("psi", None) == 0.25
+
+
+def test_invalid_programmatic_threshold_raises():
+    with pytest.raises(MetricsTPUUserError, match="finite"):
+        resolve_drift_threshold("ks", -1.0)
+    with pytest.raises(MetricsTPUUserError, match="finite"):
+        DriftMonitor("bad", window=WINDOW, ks_threshold=float("nan"))
+
+
+def test_cardinality_threshold_must_exceed_one(monkeypatch):
+    """The ratio breaches symmetrically (>= t or <= 1/t): any t <= 1 would
+    breach on EVERY check — refused programmatically, env warns once."""
+    with pytest.raises(MetricsTPUUserError, match="EVERY check"):
+        resolve_drift_threshold("cardinality_ratio", 0.5)
+    with pytest.raises(MetricsTPUUserError, match="> 1"):
+        DriftMonitor("bad", window=WINDOW, cardinality_ratio_threshold=1.0)
+    monkeypatch.setenv("METRICS_TPU_DRIFT_CARDINALITY_RATIO", "0.5")
+    reset_drift_env_state()
+    with pytest.warns(UserWarning, match="METRICS_TPU_DRIFT_CARDINALITY_RATIO"):
+        assert resolve_drift_threshold("cardinality_ratio", None) == 2.0
+
+
+# --------------------------------------------------------------------------
+# the health surface: informational kinds listed alongside the loud ones
+# --------------------------------------------------------------------------
+
+
+def test_baseline_load_is_informational_and_listed():
+    rng = np.random.default_rng(15)
+    _blessed_monitor(rng, _normal)
+    report = health_report()
+    # the milestone is counted and datable in the never-evicting table...
+    assert report["event_counts"]["drift_baseline_loaded"] == 1
+    assert "drift_baseline_loaded" in report["event_kinds"]
+    assert "last_mono" in report["event_kinds"]["drift_baseline_loaded"]
+    # ...named as informational so consumers can partition without imports...
+    assert "drift_baseline_loaded" in report["informational_event_kinds"]
+    assert "serve_warmup_done" in report["informational_event_kinds"]
+    assert report["informational_event_kinds"] == sorted(INFORMATIONAL_EVENT_KINDS)
+    # ...and never flips the degraded flag by itself
+    assert report["degraded"] is False
+
+
+def test_drift_detected_flips_degraded():
+    rng = np.random.default_rng(16)
+    mon = _blessed_monitor(rng, _normal)
+    mon.observe(rng.normal(3.0, 1.0, WINDOW))
+    mon.check()
+    report = health_report()
+    assert report["degraded"] is True
+    assert "drift_detected" not in report["informational_event_kinds"]
+
+
+# --------------------------------------------------------------------------
+# exporter rendering (the scrape surface over a drift-bearing health dict)
+# --------------------------------------------------------------------------
+
+
+def test_prometheus_renders_drift_gauges():
+    rng = np.random.default_rng(17)
+    mon = _blessed_monitor(rng, _normal, name="scores")
+    mon.observe(_normal(rng, WINDOW))
+    mon.check()
+    health = health_report()
+    health["drift"] = {"scores": mon.status()}
+    from metrics_tpu.obs.export import prometheus_text
+
+    text = prometheus_text(health=health)
+    assert '# TYPE metrics_tpu_drift_ks gauge' in text
+    assert 'metrics_tpu_drift_ks{monitor="scores"}' in text
+    assert 'metrics_tpu_drift_psi{monitor="scores"}' in text
+    assert 'metrics_tpu_drift_cardinality_ratio{monitor="scores"}' in text
+    assert 'metrics_tpu_drift_active{monitor="scores"} 0' in text
+    assert 'metrics_tpu_drift_windows_total{monitor="scores"}' in text
+    # hh_churn was None (continuous stream) — the gauge is absent, not NaN
+    assert 'metrics_tpu_drift_hh_churn' not in text
+
+
+def test_prometheus_renders_fleet_host_drift():
+    from metrics_tpu.obs.export import prometheus_text
+
+    health = {
+        "degraded": False,
+        "fleet": {
+            "node_id": "global",
+            "hosts_total": 1,
+            "hosts": {
+                "host-3": {
+                    "staleness_s": 0.5,
+                    "stale": False,
+                    "drift": {"scores": {"ks": 0.4, "psi": None, "active": True, "windows": 2}},
+                }
+            },
+            "downstream": {
+                "leaf-9": {
+                    "staleness_s": 1.0,
+                    "stale": False,
+                    "via": "pod-0",
+                    "drift": {"scores": {"ks": 0.1, "active": False, "windows": 1}},
+                }
+            },
+        },
+    }
+    text = prometheus_text(health=health)
+    assert 'metrics_tpu_fleet_host_drift_ks{host="host-3",monitor="scores",node="global"} 0.4' in text
+    assert 'metrics_tpu_fleet_host_drift_active{host="host-3",monitor="scores",node="global"} 1' in text
+    # the pod-forwarded leaf renders with its `via` label
+    assert 'via="pod-0"' in text
+    assert 'metrics_tpu_fleet_host_drift_ks{host="leaf-9",monitor="scores",node="global",via="pod-0"} 0.1' in text

@@ -1,0 +1,217 @@
+"""ServeLoop drift integration (ISSUE 14 acceptance, live-traffic form):
+a seeded distribution shift injected into live ``ServeLoop`` traffic
+records ``drift_detected`` and crosses the scraped Prometheus gauge
+within one window rotation, a steady stream stays silent, monitor
+failures degrade loudly without shedding requests, and per-host scores
+federate through the fleet tier so the global aggregator's scrape names
+the drifting host.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.obs.drift import DriftMonitor
+from metrics_tpu.ops import padding
+from metrics_tpu.resilience.health import registry
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+pytestmark = [pytest.mark.drift, pytest.mark.serving]
+
+NUM_CLASSES = 4
+WINDOW, MIN_ROWS = 512, 128
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_PAD_LADDER", "64")
+    padding.reset_padding_state()
+    registry.clear()
+    yield
+    registry.clear()
+    padding.reset_padding_state()
+
+
+def _batch(rng, conf, n=64):
+    """One (preds, target) request whose max-prob distribution encodes the
+    model's confidence — `conf` high = blessed, low = regressed rollout."""
+    preds = rng.random((n, NUM_CLASSES)).astype(np.float32)
+    preds[np.arange(n), rng.integers(0, NUM_CLASSES, n)] += conf
+    preds /= preds.sum(axis=-1, keepdims=True)
+    return preds, rng.integers(0, NUM_CLASSES, n).astype(np.int32)
+
+
+def _extract_confidence(args, kwargs):
+    return np.max(np.asarray(args[0]), axis=-1)
+
+
+def _blessed_monitor(rng, **kwargs):
+    opts = dict(window=WINDOW, min_rows=MIN_ROWS, extract=_extract_confidence)
+    opts.update(kwargs)
+    mon = DriftMonitor("confidence", **opts)
+    for _ in range(16):
+        preds, _t = _batch(rng, conf=3.0)
+        mon.observe(np.max(preds, axis=-1))
+    mon.set_reference(mon.freeze_reference())
+    mon.rotate()
+    return mon
+
+
+def _wait_for(predicate, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return predicate()
+
+
+def test_rollout_regression_pages_within_one_rotation_and_steady_does_not():
+    rng = np.random.default_rng(0)
+    mon = _blessed_monitor(rng)
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=NUM_CLASSES, pad_batches=True),
+        workers=2,
+        reduce_every_s=0.05,
+        drift_monitors=[mon],
+    ) as loop:
+        # steady phase: several windows of blessed-distribution traffic
+        for _ in range(4 * WINDOW // 64):
+            assert loop.offer(*_batch(rng, conf=3.0))
+        assert loop.drain(30)
+        assert _wait_for(lambda: mon.status()["checks"] > 0)
+        status = mon.status()
+        assert not status["active"], status
+        assert "drift_detected" not in registry.counts()
+        scrape = loop.scrape()
+        assert 'metrics_tpu_drift_ks{monitor="confidence"}' in scrape
+        assert 'metrics_tpu_drift_active{monitor="confidence"} 0' in scrape
+
+        # the rollout regression: confidence collapses; within ONE window
+        # of shifted rows the cadence check fires and the gauge crosses
+        for _ in range(WINDOW // 64):
+            assert loop.offer(*_batch(rng, conf=0.2))
+        assert loop.drain(30)
+        assert _wait_for(lambda: mon.status()["active"]), mon.status()
+        assert registry.counts().get("drift_detected") == 1
+        scrape = loop.scrape()
+        assert 'metrics_tpu_drift_active{monitor="confidence"} 1' in scrape
+        assert 'metrics_tpu_health_events_total{kind="drift_detected"} 1' in scrape
+        ks_line = next(
+            line
+            for line in scrape.splitlines()
+            if line.startswith('metrics_tpu_drift_ks{monitor="confidence"}')
+        )
+        assert float(ks_line.rsplit(" ", 1)[1]) >= 0.15  # over the pinned bar
+        # the drift surface rides health() for any consumer
+        assert loop.health()["drift"]["confidence"]["active"] is True
+
+
+def test_monitor_failure_degrades_loudly_never_sheds():
+    rng = np.random.default_rng(1)
+
+    def broken_extract(args, kwargs):
+        raise RuntimeError("boom")
+
+    mon = DriftMonitor("broken", window=WINDOW, extract=broken_extract)
+    with mt.ServeLoop(
+        mt.Accuracy(num_classes=NUM_CLASSES, pad_batches=True),
+        workers=1,
+        reduce_every_s=0.05,
+        drift_monitors=[mon],
+    ) as loop:
+        for _ in range(8):
+            assert loop.offer(*_batch(rng, conf=3.0))  # never shed/raised
+        assert loop.drain(30)
+        stats = loop.stats()
+    assert stats["accepted"] == 8 and stats["shed"] == 0
+    # episode-gated: 8 failing observes recorded ONE drift_check_error
+    assert registry.counts().get("drift_check_error") == 1
+
+
+def test_drift_monitor_validation():
+    metric = mt.Accuracy(num_classes=NUM_CLASSES, pad_batches=True)
+    with pytest.raises(MetricsTPUUserError, match="DriftMonitor"):
+        mt.ServeLoop(metric, drift_monitors=["nope"])
+    mon = DriftMonitor("dup", window=WINDOW)
+    with pytest.raises(MetricsTPUUserError, match="duplicate"):
+        mt.ServeLoop(metric, drift_monitors=[mon, DriftMonitor("dup", window=WINDOW)])
+    # dict form: a key contradicting the monitor's own name is refused (it
+    # would silently split the labeling surface), a matching key works
+    with pytest.raises(MetricsTPUUserError, match="monitor.name"):
+        mt.ServeLoop(metric, drift_monitors={"other": mon})
+    loop = mt.ServeLoop(metric, drift_monitors={"dup": mon})
+    assert "dup" in loop._drift
+    loop.stop()
+
+
+def test_fleet_federation_names_the_drifting_host():
+    """host → pod → global: the leaf's drift scores ride the wire-header
+    extra up both hops, and the GLOBAL scrape names the drifting host."""
+    from metrics_tpu.fleet import Aggregator, FleetPublisher
+
+    rng = np.random.default_rng(2)
+    mon = _blessed_monitor(rng, min_rows=64)
+    proto = lambda: mt.Accuracy(num_classes=NUM_CLASSES, pad_batches=True)
+    pod = Aggregator(proto(), node_id="pod-0")
+    root = Aggregator(proto(), node_id="global")
+    with mt.ServeLoop(
+        proto(), workers=1, reduce_every_s=0.05, drift_monitors=[mon]
+    ) as loop:
+        for _ in range(WINDOW // 64):
+            assert loop.offer(*_batch(rng, conf=0.2))  # drifting traffic
+        assert loop.drain(30)
+        assert _wait_for(lambda: mon.status()["active"]), mon.status()
+        host_pub = FleetPublisher(
+            loop, destinations=pod.ingest, host_id="host-7", start=False
+        )
+        assert host_pub.publish_now() == {"default": "ok"}
+
+    # hop 1: the pod's own scrape names the host
+    pod_health = pod.health()
+    assert pod_health["fleet"]["hosts"]["host-7"]["drift"]["confidence"]["active"] is True
+    pod_scrape = pod.scrape()
+    assert (
+        'metrics_tpu_fleet_host_drift_active{host="host-7",monitor="confidence",node="pod-0"} 1'
+        in pod_scrape
+    )
+
+    # hop 2: the pod re-publishes upward; the GLOBAL scrape still names the
+    # drifting LEAF host (via the pod), not just "pod-0 has drift somewhere"
+    assert root.ingest(pod.view_blob()) == "accepted"
+    root_health = root.health()
+    downstream = root_health["fleet"]["downstream"]["host-7"]
+    assert downstream["via"] == "pod-0"
+    assert downstream["drift"]["confidence"]["active"] is True
+    root_scrape = root.scrape()
+    drift_lines = [
+        line
+        for line in root_scrape.splitlines()
+        if line.startswith("metrics_tpu_fleet_host_drift_ks")
+    ]
+    assert any('host="host-7"' in line and 'via="pod-0"' in line for line in drift_lines), (
+        root_scrape
+    )
+
+
+def test_report_and_reduce_unaffected_by_drift_monitors():
+    """The drift hook must not perturb the serving values: same traffic,
+    with and without monitors, reduces to the same accuracy."""
+    rng_a = np.random.default_rng(3)
+    rng_b = np.random.default_rng(3)
+    mon = _blessed_monitor(np.random.default_rng(4))
+    values = {}
+    for key, rng, monitors in (("with", rng_a, [mon]), ("without", rng_b, None)):
+        with mt.ServeLoop(
+            mt.Accuracy(num_classes=NUM_CLASSES, pad_batches=True),
+            workers=1,
+            reduce_every_s=0.05,
+            drift_monitors=monitors,
+        ) as loop:
+            for _ in range(8):
+                loop.offer(*_batch(rng, conf=1.0))
+            assert loop.drain(30)
+            loop.stop()
+            values[key] = float(loop.report()["value"])
+    assert values["with"] == values["without"]
