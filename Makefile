@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift
+.PHONY: test doctest bench dryrun lint profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -43,6 +43,14 @@ dryrun:
 # by construction; new findings (not in lint_baseline.txt) fail the build.
 lint:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m metrics_tpu.analysis all
+
+# Compiled-graph cost profiler (ISSUE 15): per-registry-entry flops / bytes
+# accessed / collective payload bytes (from the optimized HLO) joined with
+# QuantileSketch wall p50/p99 per entry and per padding-ladder tier, dumped
+# as COST_PROFILE.json next to BENCH_HISTORY.json. Run verbatim at the next
+# TPU window for the TPU column (ROADMAP item 5b's measurement harness).
+profile:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m metrics_tpu.analysis profile
 
 # Fast feedback on the analysis subsystem itself (same tests the `analysis`
 # pytest marker selects; the compile-heavy full-registry audit is `slow`).

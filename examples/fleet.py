@@ -24,6 +24,9 @@ import time
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# tracing on in the aggregator process too: the fleet.fold spans (and their
+# links to host publish spans) must land in the merged /trace.json document
+os.environ.setdefault("METRICS_TPU_TRACE", "1")
 
 import metrics_tpu as mt
 from metrics_tpu.fleet import Aggregator, FleetServer
@@ -63,6 +66,9 @@ def spawn_host(h: int, publish_url: str) -> subprocess.Popen:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONUNBUFFERED"] = "1"
+    # fleet-correlated tracing (ISSUE 15): every host ships its span ring +
+    # causal contexts in the wire header; GET /trace.json below merges them
+    env["METRICS_TPU_TRACE"] = "1"
     return subprocess.Popen(
         [sys.executable, "-c", textwrap.dedent(_HOST), str(h), publish_url],
         stdout=subprocess.PIPE,
@@ -164,6 +170,44 @@ def main():
                 lambda: aggregator.report()["updates"] > before,
                 15.0,
                 "surviving hosts' traffic to keep flowing",
+            )
+
+            # ONE merged Perfetto trace for the whole fleet (ISSUE 15): every
+            # host process is a named track, and a request's causal chain —
+            # serve.offer → serve.update → serve.reduce → fleet.publish →
+            # fleet.fold — reads as flow arrows across process boundaries.
+            # The dead host's shipped spans are still in the document: the
+            # aggregator keeps what it received, which is the flight-recorder
+            # stance applied to timelines.
+            import json as _json
+
+            doc = _json.loads(
+                urllib.request.urlopen(server.url + "/trace.json", timeout=10).read()
+            )
+            events = doc["traceEvents"]
+            process_names = {
+                e["args"]["name"]
+                for e in events
+                if e.get("ph") == "M" and e["name"] == "process_name"
+            }
+            assert {"host-1", "host-2", "aggregator:global"} <= process_names, process_names
+            assert "host-0" in process_names, "the SIGKILLed host's spans survived the kill"
+            names = {e["name"] for e in events}
+            assert {"serve.offer", "serve.update", "fleet.publish", "fleet.fold"} <= names
+            # the cross-process causal edge: the fold's flow arrow keys on a
+            # publish span shipped by a HOST process
+            publish_ids = {
+                e["args"]["span_id"]
+                for e in events
+                if e["name"] == "fleet.publish" and "span_id" in e.get("args", {})
+            }
+            fold_edges = {
+                e["id"] for e in events if e.get("cat") == "causal" and e["ph"] == "f"
+            }
+            assert publish_ids & fold_edges, "no publish→fold flow arrow in the merged trace"
+            print(
+                f"merged fleet trace: {len(events)} events across "
+                f"{len(process_names)} named processes, publish→fold arrows present"
             )
             print("survivors kept publishing; fleet degraded loudly, never wedged. OK")
         finally:

@@ -5,6 +5,14 @@ Subcommands::
     python -m metrics_tpu.analysis lint    # AST rules over metrics_tpu/
     python -m metrics_tpu.analysis audit   # compiled-graph budget registry
     python -m metrics_tpu.analysis all     # both (the `make lint` target)
+    python -m metrics_tpu.analysis profile # per-entry cost table (ISSUE 15):
+                                           #   flops / bytes accessed /
+                                           #   collective payload bytes +
+                                           #   wall p50/p99 (QuantileSketch)
+                                           #   per entry and per ladder tier,
+                                           #   dumped as COST_PROFILE.json
+                                           #   (the `make profile` target and
+                                           #   the TPU-window harness)
 
 Lint findings print as ``path:line:col: RULEID message`` (clickable,
 CI-greppable); exit code 1 when any NEW finding (not in the baseline) or
@@ -73,6 +81,39 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     return 1 if violations else 0
 
 
+def _cmd_profile(args: argparse.Namespace) -> int:
+    # same bootstrap as the audit: profiled entries lower shard_mapped
+    # graphs, so the virtual CPU mesh must exist before any backend init
+    from metrics_tpu.utilities.backend import force_cpu_backend
+
+    force_cpu_backend(max(args.ndev, args.mesh_ndev))
+
+    from metrics_tpu.analysis.registry import REGISTRY
+    from metrics_tpu.obs.profile import (
+        profile_registry,
+        render_table,
+        write_profile,
+    )
+
+    entries = None
+    if args.entry:
+        by_name = {e.name: e for e in REGISTRY}
+        unknown = sorted(set(args.entry) - set(by_name))
+        if unknown:
+            print(
+                f"profile: unknown entr(y/ies) {unknown} — have {sorted(by_name)}",
+                file=sys.stderr,
+            )
+            return 1
+        entries = tuple(by_name[name] for name in args.entry)
+    doc = profile_registry(entries, ndev=args.mesh_ndev, reps=args.reps)
+    print(render_table(doc))
+    if not args.no_write:
+        path = write_profile(doc, args.out)
+        print(f"profile: wrote {len(doc['entries'])} entr(y/ies) to {path}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m metrics_tpu.analysis",
@@ -82,8 +123,10 @@ def main(argv=None) -> int:
         "command",
         nargs="?",
         default="all",
-        choices=("lint", "audit", "all", "rules"),
-        help="which pass to run (default: all); `rules` prints the rule catalog",
+        choices=("lint", "audit", "all", "rules", "profile"),
+        help="which pass to run (default: all); `rules` prints the rule catalog; "
+        "`profile` dumps the per-entry cost table (flops/bytes/collective "
+        "payload bytes + wall p50/p99)",
     )
     parser.add_argument("--baseline", help="baseline file path (default: <repo>/lint_baseline.txt)")
     parser.add_argument(
@@ -97,6 +140,20 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--mesh-ndev", type=int, default=4, help="mesh size for sharded audit entries (default 4)"
     )
+    parser.add_argument(
+        "--reps", type=int, default=20, help="wall-time samples per profiled entry (default 20)"
+    )
+    parser.add_argument(
+        "--entry",
+        action="append",
+        help="profile only this registry entry (repeatable; default: all)",
+    )
+    parser.add_argument(
+        "--out", help="cost-table output path (default: <repo>/COST_PROFILE.json)"
+    )
+    parser.add_argument(
+        "--no-write", action="store_true", help="print the table without writing the JSON"
+    )
     args = parser.parse_args(argv)
 
     if args.command == "rules":
@@ -105,6 +162,9 @@ def main(argv=None) -> int:
         for rule in ALL_RULES:
             print(f"{rule.rule_id}  {rule.name}\n    {rule.description}")
         return 0
+
+    if args.command == "profile":
+        return _cmd_profile(args)
 
     rc = 0
     if args.command in ("lint", "all"):

@@ -82,6 +82,15 @@ entry                           budget
                                 tracing on: the guarded-collection **≤ 2**
                                 all-reduce budget holds UNCHANGED under
                                 instrumentation
+``traced_fleet_publish``        the same guarded fused collection lowered
+                                with tracing forced on AND a live causal
+                                trace context installed (ISSUE 15 — the
+                                id-propagating tracer a fleet publish rides:
+                                offer → worker-update → reduce → publish):
+                                the **≤ 2** all-reduce budget holds and **0
+                                host callbacks** appear — trace/span/parent
+                                ids are host-side bookkeeping that can never
+                                become graph ops
 ``ladder_served_update``        ladder-padded guarded serving update (ISSUE 7
                                 — ``ops/padding.py``): **0** collectives, no
                                 f64/callbacks/dynamic shapes, AND a ragged
@@ -480,6 +489,31 @@ def _build_instrumented_fused_step(ndev: int):
     return _TracedLower(fn), args
 
 
+class _ContextTracedLower(_TracedLower):
+    """``_TracedLower`` with a LIVE causal trace context installed around
+    the lowering (ISSUE 15): the id-propagating configuration every fleet
+    publish runs under — an open span whose trace/span ids any nested
+    instrumentation would inherit. The entry proves id propagation is
+    host-side bookkeeping: the lowered graph is identical to the
+    uninstrumented one (same collective budget, zero host callbacks)."""
+
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        from metrics_tpu.obs.trace import force_tracing, span
+
+        with force_tracing(True):
+            with span("audit.traced_fleet_publish"):
+                return self._fn.lower(*args, **kwargs)
+
+
+def _build_traced_fleet_publish(ndev: int):
+    # the serving graph whose results a FleetPublisher ships (the guarded
+    # fused collection), lowered inside an active causal trace — the seam
+    # chain offer → worker-update → reduce → publish runs exactly this
+    # configuration when METRICS_TPU_TRACE is on in a fleet deployment
+    fn, args = _build_guarded_collection(ndev)
+    return _ContextTracedLower(fn), args
+
+
 # the serving ladder under audit: pinned programmatically (not via the env
 # var) so the audit result cannot depend on ambient METRICS_TPU_PAD_LADDER
 _SERVE_LADDER = (8, 32, 128)
@@ -669,6 +703,11 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         name="instrumented_fused_step",
         budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
         build=_build_instrumented_fused_step,
+    ),
+    AuditEntry(
+        name="traced_fleet_publish",
+        budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
+        build=_build_traced_fleet_publish,
     ),
 )
 

@@ -34,15 +34,42 @@ jit'd update/sync paths; ``make lint`` budgets stay untouched).
 """
 import threading
 import time
+from collections import OrderedDict, deque
 from typing import Any, Dict, Optional
 
 from metrics_tpu.fleet.wire import WireError, decode_view, encode_view, next_seq
 from metrics_tpu.fleet._env import resolve_fleet_knob
+from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.resilience.health import health_report, record_degradation
 from metrics_tpu.serving.loop import _clone, _fold_snapshot, _members, _snapshot_of
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 
 __all__ = ["Aggregator"]
+
+# per-host timeline retention (events accumulated from wire trace sections)
+# and the cap on events a pod forwards per host when re-publishing upward
+_TRACE_EVENTS_PER_HOST = 4096
+_TRACE_EVENTS_FORWARDED = 512
+
+
+def _trace_event_key(ev: Any) -> Any:
+    """Identity of one Chrome event for ingest dedup. Span/instant/flow
+    rows are identified by (phase, name, thread, start µs, duration, flow
+    id); metadata (``ph='M'``) rows carry their payload in ``args``, so two
+    metadata rows differ only if their args do."""
+    if not isinstance(ev, dict):
+        return repr(ev)
+    key = (
+        ev.get("ph"),
+        ev.get("name"),
+        ev.get("tid"),
+        ev.get("ts"),
+        ev.get("dur"),
+        ev.get("id"),
+    )
+    if ev.get("ph") == "M":
+        key += (repr(sorted((ev.get("args") or {}).items(), key=repr)),)
+    return key
 
 
 class Aggregator:
@@ -85,6 +112,13 @@ class Aggregator:
         self._fold_cache: Optional[Any] = None  # (accepted_count, reporter)
         self._seq = 0  # this node's own publish sequence (multi-hop)
         self._publish_lock = threading.Lock()  # (payload, seq) pairing order
+        # per-host timeline sections accumulated from wire header trace
+        # extras: host_id -> {"clock", "events" (bounded), "offset_s"} —
+        # what fleet_trace() merges into ONE Perfetto document
+        self._trace_sections: Dict[str, Dict[str, Any]] = {}
+        # the newest accepted view's publish-span context: the fold span
+        # links to it (the cross-process leg of the causal chain)
+        self._last_trace_ctx: Optional[_obs_trace.TraceContext] = None
 
     # -- ingest ---------------------------------------------------------
 
@@ -125,6 +159,13 @@ class Aggregator:
             # skew duration while both ends report healthy.
             with self._lock:
                 self._duplicates += 1
+            # the trace section still folds: a duplicate VIEW seq (seq
+            # regression after a host restart, retry re-delivery) can carry
+            # a FRESH timeline delta — the publisher treats the duplicate
+            # answer as delivered and advances its cursor, so dropping the
+            # section here would hole the merged trace for the whole
+            # regression window (ingest dedup makes re-folds idempotent)
+            self._ingest_trace(host, header)
             return f"duplicate:{current_seq}"
         # structural validation against the prototype: load_snapshot_state
         # is transactional and refuses unknown states/children/shapes naming
@@ -159,10 +200,72 @@ class Aggregator:
             current = self._views.get(host)
             if current is not None and header["seq"] <= current["seq"]:
                 self._duplicates += 1
-                return f"duplicate:{current['seq']}"
-            self._views[host] = entry
-            self._accepted += 1
+                duplicate_seq = current["seq"]
+            else:
+                self._views[host] = entry
+                self._accepted += 1
+                duplicate_seq = None
+        self._ingest_trace(host, header)  # idempotent; see the pre-check note
+        if duplicate_seq is not None:
+            return f"duplicate:{duplicate_seq}"
         return "accepted"
+
+    def _ingest_trace(self, host: str, header: Dict[str, Any]) -> None:
+        """Fold the wire header's timeline section (and any pod-forwarded
+        child sections) into the per-host accumulators behind
+        :meth:`fleet_trace`; remembers the publish span's context so the
+        next fold links to it. Absent sections (tracing off at the host)
+        cost nothing."""
+        extra = header.get("extra") or {}
+        sections: Dict[str, Any] = {}
+        section = extra.get("trace")
+        if isinstance(section, dict):
+            sections[host] = section
+        children = extra.get("trace_children")
+        if isinstance(children, dict):
+            for child, child_section in children.items():
+                if isinstance(child_section, dict):
+                    sections.setdefault(str(child), child_section)
+        if not sections:
+            return
+        # one-way clock-offset estimate (receive wall - publish wall):
+        # contaminated by network latency, so it is REPORTED per process in
+        # the merged trace, never silently applied to timestamps
+        offset = None
+        if isinstance(header.get("published_unix"), float):
+            offset = time.time() - header["published_unix"]
+        with self._lock:
+            for name, sec in sections.items():
+                acc = self._trace_sections.get(name)
+                if acc is None:
+                    acc = self._trace_sections[name] = {
+                        "clock": None,
+                        "events": deque(maxlen=_TRACE_EVENTS_PER_HOST),
+                        "offset_s": None,
+                        # bounded seen-key window: re-delivered deltas (a
+                        # publisher re-ships after a failed pass) and
+                        # pod-re-forwarded child timelines (children send
+                        # their last-N on EVERY cadence) must fold once —
+                        # blind extend() would stack every span N times and
+                        # evict the real history from the bounded deque
+                        "seen": OrderedDict(),
+                    }
+                if sec.get("clock"):
+                    acc["clock"] = sec["clock"]
+                if offset is not None and name == host:
+                    acc["offset_s"] = offset
+                seen = acc["seen"]
+                for ev in sec.get("events") or []:
+                    key = _trace_event_key(ev)
+                    if key in seen:
+                        continue
+                    seen[key] = None
+                    if len(seen) > 2 * _TRACE_EVENTS_PER_HOST:
+                        seen.popitem(last=False)
+                    acc["events"].append(ev)
+            ctx = section.get("ctx") if isinstance(section, dict) else None
+            if ctx and ctx.get("trace_id") is not None:
+                self._last_trace_ctx = _obs_trace.TraceContext(ctx["trace_id"], ctx["span_id"])
 
     def _reject(self, host: str, message: str) -> None:
         with self._lock:
@@ -281,9 +384,14 @@ class Aggregator:
             if cached is not None and cached[0] == key:
                 return cached[1]
             snaps = [self._views[h]["snap"] for h in sorted(self._views)]
-        reporter = _clone(self._proto)
-        for snap in snaps:
-            _fold_snapshot(reporter, snap)
+            link = self._last_trace_ctx
+        # the fold span links to the newest accepted view's publish span —
+        # the final hop of the causal chain (offer → worker-update → reduce
+        # → publish → THIS fold), drawn as one flow line in the merged trace
+        with _obs_trace.span("fleet.fold", link_to=link, node=self.node_id, hosts=len(snaps)):
+            reporter = _clone(self._proto)
+            for snap in snaps:
+                _fold_snapshot(reporter, snap)
         with self._lock:
             # racing folds both computed from >= this key's views; keep the
             # newer key (another ingest may have landed mid-fold, in which
@@ -352,7 +460,22 @@ class Aggregator:
         table = {name: row(e) for name, e in self._sweep_staleness().items()}
         for name, e in self._downstream().items():
             table.setdefault(name, row(e))
-        return {"hosts": table} if table else None
+        out: Dict[str, Any] = {"hosts": table} if table else {}
+        # forward the children's timelines up the tree (bounded per host):
+        # the publisher adds THIS process's own ring as extra["trace"], so
+        # with this the global node merges leaf hosts it never met directly
+        with self._lock:
+            children = {
+                name: {
+                    "clock": acc["clock"],
+                    "events": list(acc["events"])[-_TRACE_EVENTS_FORWARDED:],
+                }
+                for name, acc in self._trace_sections.items()
+                if acc["clock"] is not None or acc["events"]
+            }
+        if children:
+            out["trace_children"] = children
+        return out or None
 
     def view_blob(self) -> Optional[bytes]:
         """Encode the merged view under this node's identity for the next
@@ -385,6 +508,35 @@ class Aggregator:
         )
 
     # -- observability --------------------------------------------------
+
+    def fleet_trace(self) -> Dict[str, Any]:
+        """ONE merged Perfetto-loadable trace document for the whole
+        subtree under this node: every host's shipped timeline section
+        (span events + causal flow arrows, rebased from each host's
+        monotonic clock onto its wall clock via the shipped
+        ``clock_sync()`` pairing) plus this process's own ring — load it
+        at ui.perfetto.dev and a request's chain reads host offer →
+        worker-update → serve reduce → fleet publish → this node's fold,
+        with each process a named track (``FleetServer`` serves it at
+        ``GET /trace.json``). Per-host ``clock_offset_estimate_s``
+        (receive-publish wall delta, latency-contaminated) rides each
+        process's metadata for skew diagnosis."""
+        with self._lock:
+            sections = [
+                {
+                    "host_id": name,
+                    "clock": acc["clock"],
+                    "events": list(acc["events"]),
+                    "clock_offset_estimate": acc["offset_s"],
+                }
+                for name, acc in sorted(self._trace_sections.items())
+            ]
+        own = {
+            "host_id": f"aggregator:{self.node_id}",
+            "clock": _obs_trace.clock_sync(),
+            "events": _obs_trace.chrome_trace_events(host_id=f"aggregator:{self.node_id}"),
+        }
+        return _obs_trace.merge_chrome_sections([own] + sections)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -28,17 +28,28 @@ tests (``tests/helpers/fault_injection.py`` network shapes).
 """
 import threading
 import time
-from typing import Any, Callable, Dict, Mapping, Optional, Union
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Union
 
 from metrics_tpu.fleet.wire import encode_view, next_seq
 from metrics_tpu.fleet._env import resolve_fleet_knob
+from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.parallel.retry import CircuitOpenError, RetryBudgetExceededError, RetryPolicy
 from metrics_tpu.resilience.health import record_degradation
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 
+# spans shipped per publish (the incremental timeline export): bounds the
+# wire cost of a busy host's ring delta to a few hundred KB worst case
+_TRACE_EVENTS_PER_PUBLISH = 2048
+
 __all__ = ["FleetPublisher"]
 
 Channel = Callable[[bytes], Any]
+
+
+def _metric_token(name: str) -> str:
+    """A destination name as a metric-name-safe token (the per-destination
+    histogram suffix: ``fleet_publish_ms_<token>``)."""
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
 
 
 def _payload_updates(payload: Dict[str, Any]) -> int:
@@ -121,6 +132,17 @@ class FleetPublisher:
         # optional source hook: header extra per publish (an Aggregator
         # forwards its per-host staleness table up the tree through this)
         self._extra_fn = getattr(source, "fleet_extra", None)
+        # optional causal hook (obs/trace.py): the trace context of the
+        # reduce that built the published view (ServeLoop/Aggregator), so
+        # the publish span links back to it and the aggregator's fold can
+        # link forward — one unbroken chain from host offer to global fold
+        self._trace_ctx_fn = getattr(source, "fleet_trace_context", None)
+        # incremental timeline-export watermark (TraceRecord.seq of the
+        # newest record delivered to EVERY attempted destination): a pass
+        # with any failed destination re-ships its delta next cadence, so
+        # no destination's merged fleet trace is left with a hole (the
+        # aggregator dedups re-delivered events, so re-sends fold once)
+        self._trace_shipped_seq = 0
         self.host_id = host_id
         self.publish_every_s = resolve_fleet_knob("publish_every_s", publish_every_s)
         self.stale_after_s = resolve_fleet_knob("stale_after_s", stale_after_s)
@@ -239,29 +261,66 @@ class FleetPublisher:
             to_push.append((name, channel))
         if not to_push:
             return outcomes
-        # snapshot and seq are taken under ONE lock: two concurrent passes
-        # snapshotting then seq-assigning in opposite orders would pair an
-        # OLDER payload with a NEWER seq, and the aggregator's last-write-
-        # wins fold would then pin the stale view until the next cadence
-        with self._snapshot_lock:
-            payload = self._view_fn()
-            if payload is None:
-                for name, _channel in to_push:
-                    outcomes[name] = "skipped:empty"
-                return outcomes
-            seq = self._next_seq()
-            extra = self._extra_fn() if self._extra_fn is not None else None
-        blob = encode_view(
-            payload,
-            host_id=self.host_id,
-            seq=seq,
-            updates=_payload_updates(payload),
-            extra=extra,
-            encoding=self._encoding,
-        )
+        # the publish span links the shipped view back to the reduce that
+        # built it (its ctx rides the wire header, so the aggregator's fold
+        # links forward — the cross-process leg of the causal chain)
+        link = self._trace_ctx_fn() if self._trace_ctx_fn is not None else None
+        with _obs_trace.span("fleet.publish", link_to=link, host=self.host_id):
+            # snapshot and seq are taken under ONE lock: two concurrent passes
+            # snapshotting then seq-assigning in opposite orders would pair an
+            # OLDER payload with a NEWER seq, and the aggregator's last-write-
+            # wins fold would then pin the stale view until the next cadence
+            with self._snapshot_lock:
+                payload = self._view_fn()
+                if payload is None:
+                    for name, _channel in to_push:
+                        outcomes[name] = "skipped:empty"
+                    return outcomes
+                seq = self._next_seq()
+                extra = self._extra_fn() if self._extra_fn is not None else None
+                # under the same lock: the watermark read below must pair
+                # with exactly one delta per pass — two concurrent passes
+                # reading the same watermark would ship one batch twice
+                extra, trace_mark = self._trace_extra(extra)
+            blob = encode_view(
+                payload,
+                host_id=self.host_id,
+                seq=seq,
+                updates=_payload_updates(payload),
+                extra=extra,
+                encoding=self._encoding,
+            )
+            # payload-size distribution: once per ENCODE (the quantized-
+            # transport tuning reads blob sizes — observing per destination
+            # would weight quantiles by fan-out and failure rate instead);
+            # the per-attempt on-wire total stays in the fleet_blob_bytes
+            # counter inside _push
+            from metrics_tpu.obs.runtime_metrics import registry as _obs_registry
+
+            _obs_registry.histogram("fleet_publish_bytes").observe(float(len(blob)))
         with self._lock:
             self._encode_error_reported = False  # snapshot+encode healthy again
         workers: Dict[str, threading.Thread] = {}
+        # the trace watermark commits only when EVERY attempted destination
+        # accepted this pass's blob: committing on the first success would
+        # leave each failed destination permanently missing this delta
+        # (the next pass starts past it); the full re-ship after a partial
+        # failure folds once at the destinations that already accepted
+        # (the aggregator's ingest dedup)
+        pass_state = {"left": 0, "all_ok": True, "spawning": True}
+
+        def _finish_push(out: str) -> None:
+            with self._lock:
+                pass_state["left"] -= 1
+                pass_state["all_ok"] = pass_state["all_ok"] and out == "ok"
+                commit = (
+                    not pass_state["spawning"]
+                    and pass_state["left"] == 0
+                    and pass_state["all_ok"]
+                )
+            if commit:
+                self._commit_trace_mark(trace_mark)
+
         for name, channel in to_push:
             with self._lock:
                 prev = self._inflight[name]
@@ -273,7 +332,9 @@ class FleetPublisher:
                     continue
 
                 def run(name: str = name, channel: Channel = channel) -> None:
-                    outcomes[name] = self._push(name, channel, blob)
+                    out = self._push(name, channel, blob)
+                    outcomes[name] = out
+                    _finish_push(out)
 
                 t = threading.Thread(
                     target=run, daemon=True, name=f"metrics-tpu-fleet-push-{name}"
@@ -281,15 +342,64 @@ class FleetPublisher:
                 self._inflight[name] = t
                 workers[name] = t
                 outcomes[name] = "spawned"
+                pass_state["left"] += 1  # under self._lock
                 # started INSIDE the lock: a not-yet-started thread reads
                 # is_alive() False, so starting outside would let a racing
                 # publish_now slip a second push past the in-flight guard
                 # onto the same (not thread-safe) policy
                 t.start()
+        with self._lock:
+            pass_state["spawning"] = False
+            commit = bool(workers) and pass_state["left"] == 0 and pass_state["all_ok"]
+        if commit:
+            # every push already finished (fast channels) before spawning
+            # closed — _finish_push deferred the commit to here
+            self._commit_trace_mark(trace_mark)
         if wait:
             for t in workers.values():
                 t.join()
         return outcomes
+
+    def _trace_extra(
+        self, extra: Optional[Dict[str, Any]]
+    ) -> Tuple[Optional[Dict[str, Any]], Optional[int]]:
+        """Attach the causal/timeline section to the wire header extra
+        (only while tracing is on — a fleet with tracing off ships not one
+        extra byte): the ACTIVE trace context (the publish span — what the
+        aggregator's fold links to), a ``clock_sync()`` pairing so the
+        aggregator can rebase this host's span timestamps onto the shared
+        wall-clock timebase, and the ring's NEW records since the last
+        DELIVERED publish as ready Chrome events (append-seq watermarked,
+        capped per publish — the merged fleet trace at ``GET /trace.json``
+        is these sections folded together). Returns ``(extra, mark)``:
+        the caller commits ``mark`` via :meth:`_commit_trace_mark` once a
+        destination accepts the blob (must run under ``_snapshot_lock`` so
+        concurrent passes never ship one batch twice)."""
+        if not _obs_trace.tracing_enabled():
+            return extra, None
+        ctx = _obs_trace.current_context()
+        # OLDEST cap records first: the committed cursor stays contiguous,
+        # so a >cap burst drains over subsequent cadences instead of the
+        # over-cap tail being skipped forever (sustained overload is
+        # bounded by ring eviction, same as before the cursor existed)
+        records = _obs_trace.records_since(self._trace_shipped_seq)[:_TRACE_EVENTS_PER_PUBLISH]
+        mark = records[-1].seq if records else None
+        section: Dict[str, Any] = {
+            "ctx": {"trace_id": ctx.trace_id, "span_id": ctx.span_id} if ctx else None,
+            "clock": _obs_trace.clock_sync(),
+            "events": _obs_trace.chrome_events_for(records, host_id=self.host_id),
+        }
+        out = dict(extra) if extra else {}
+        out["trace"] = section
+        return out, mark
+
+    def _commit_trace_mark(self, mark: Optional[int]) -> None:
+        """Advance the timeline watermark after a successful push (max() —
+        two passes completing out of order keep the newest mark)."""
+        if mark is None:
+            return
+        with self._snapshot_lock:
+            self._trace_shipped_seq = max(self._trace_shipped_seq, mark)
 
     def _note_duplicate(self, name: str, result: Any) -> None:
         """Watch the aggregator's answers for a persistent seq regression.
@@ -353,6 +463,19 @@ class FleetPublisher:
             _obs_registry.counter("fleet_blob_bytes").inc(len(blob))
             return channel(blob)
 
+        # publisher self-metrics (always on — the publish path runs per
+        # cadence, never per request): per-destination publish wall time
+        # covering the full retry/timeout budget of one push. Observed for
+        # ATTEMPTED pushes only — a breaker-open skip sent nothing, so it
+        # must not thin the distributions with zeros (the payload-size
+        # histogram is fed once per encode, at the publish-pass site)
+        t0 = time.perf_counter()
+
+        def _observe_push() -> None:
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            _obs_registry.histogram("fleet_publish_ms").observe(dur_ms)
+            _obs_registry.histogram(f"fleet_publish_ms_{_metric_token(name)}").observe(dur_ms)
+
         policy = self._policies[name]
         try:
             result = policy.call(send)
@@ -364,6 +487,7 @@ class FleetPublisher:
             self._check_stale(name)
             return "skipped:circuit_open"
         except RetryBudgetExceededError as err:
+            _observe_push()
             with self._lock:
                 self._stats[name]["failed"] += 1
             record_degradation(
@@ -376,6 +500,7 @@ class FleetPublisher:
             )
             self._check_stale(name)
             return f"failed:{type(err.cause).__name__}"
+        _observe_push()
         self._note_duplicate(name, result)
         with self._lock:
             self._stats[name]["published"] += 1

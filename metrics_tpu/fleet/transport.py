@@ -9,7 +9,9 @@ machinery, mirroring ``obs/export.py``'s ``TelemetryExporter``:
   the refusal message — corrupt payloads are rejected server-side and
   recorded there), ``GET /metrics`` / ``/metrics.json`` serve the node's
   federated scrape (the whole-fleet Prometheus surface at the global
-  node), ``GET /report`` the JSON fold report.
+  node), ``GET /report`` the JSON fold report, ``GET /trace.json`` the
+  merged fleet Perfetto trace (every publishing host's shipped timeline
+  folded onto one timebase — ISSUE 15's one-load causal view).
 - :class:`HttpViewChannel` — the publisher-side channel: POST one blob,
   raise on anything but 200 (the :class:`~metrics_tpu.parallel.retry.
   RetryPolicy` wrapping it owns the retry/breaker budget; this callable
@@ -109,6 +111,13 @@ class FleetServer:
                         ctype = "application/json"
                     elif path == "/report":
                         body = json.dumps(server.aggregator.report(), default=str).encode()
+                        ctype = "application/json"
+                    elif path == "/trace.json":
+                        # the merged fleet timeline (aggregator.fleet_trace):
+                        # one Perfetto-loadable document covering every host
+                        # below this node — save it and load at
+                        # ui.perfetto.dev / chrome://tracing
+                        body = json.dumps(server.aggregator.fleet_trace(), default=str).encode()
                         ctype = "application/json"
                     else:
                         self.send_error(404)

@@ -245,6 +245,13 @@ def encode_view(
     (re-deliveries and reorderings of old blobs are then folded at most
     once). ``updates`` (optional) records the view's total update count
     for observability; ``extra`` is recorded verbatim in the header.
+    Two extra keys are conventionally structured (both optional, both
+    ignored by builds that predate them): ``"trace"`` — the publisher's
+    causal/timeline section (``{"ctx": {trace_id, span_id}, "clock":
+    clock_sync(), "events": [chrome events]}``, ``obs/trace.py``) the
+    aggregator links its fold to and merges into ``GET /trace.json`` —
+    and ``"trace_children"`` — ``{host: {clock, events}}`` sections a pod
+    aggregator forwards so leaf timelines reach the global node.
     ``encoding`` picks the payload encoding (module docstring): a token or
     alias (``"exact"``/``"int8"``), ``None`` resolving
     ``METRICS_TPU_FLEET_ENCODING`` > ``pickle-v1``. Checksums always cover

@@ -476,6 +476,10 @@ class Metric:
         if self._can_jit_update() and not self.compute_on_cpu:
             if self._update_jit is None:
                 self._update_jit = self._make_update_jit()
+            # the profiler's live join (obs/profile.py): per-tier dispatch
+            # wall of the jitted update — priced only while tracing is on,
+            # so the default request path gains one amortized env read
+            tap_t0 = time.perf_counter() if _obs_trace.tracing_enabled() else None
             try:
                 new_state = self._update_jit(dict(self._state), args, kwargs)
             except (_TRACE_ERRORS + (TypeError,)):
@@ -486,6 +490,19 @@ class Metric:
                 update(*args, **kwargs)
             else:
                 object.__setattr__(self, "_state", new_state)
+                if tap_t0 is not None and getattr(self._update_jit, "_tap_kind", None) is None:
+                    # an AOTDispatcher slot carries its own (serve_aot_update) tap
+                    from metrics_tpu.obs.runtime_metrics import observe_jit_wall
+                    from metrics_tpu.ops.padding import leading_rows
+
+                    # per-tier attribution only when the row count IS a
+                    # ladder tier (pad_batches) — unpadded ragged traffic
+                    # would mint one never-evicted histogram per distinct
+                    # batch size, bloating every scrape without bound
+                    rows = leading_rows(args) if self.pad_batches else None
+                    observe_jit_wall(
+                        "metric_update_jit", rows, (time.perf_counter() - tap_t0) * 1e3
+                    )
         else:
             update(*args, **kwargs)
         if n_padded:

@@ -21,18 +21,38 @@ pin that an instrumented graph gains 0 collectives and 0 host callbacks):
   ratio — with episode-gated ``drift_detected``/``drift_recovered``
   health events and ``metrics_tpu_drift_*`` gauges in every scrape
   (``ServeLoop(drift_monitors=...)`` runs checks on the reducer cadence).
+- ``obs/flightrec.py`` — the degradation flight recorder: on every
+  degraded-edge health transition (episode-gated, never informational
+  kinds) and on SIGTERM/atexit, atomically dump spans + event-kind table
+  + the last scrape + attached live state (ServeLoop health) to a rolling
+  last-K directory (``METRICS_TPU_FLIGHTREC_DIR``); torn dumps are
+  skipped loudly on load.
+- ``obs/profile.py`` — the compiled-graph cost profiler: per analysis-
+  registry entry, ``cost_analysis()`` flops/bytes + collective payload
+  bytes parsed from the optimized HLO, joined with QuantileSketch wall
+  quantiles per entry and per padding-ladder tier (``python -m
+  metrics_tpu.analysis profile`` / ``make profile`` dumps the table as
+  ``COST_PROFILE.json``).
 """
 from metrics_tpu.obs.trace import (
+    TraceContext,
     TraceRecord,
     add_trace_sink,
+    chrome_events_for,
     chrome_trace_events,
     clear_trace,
+    clock_sync,
+    current_context,
     export_chrome_trace,
     force_tracing,
     instant,
+    merge_chrome_sections,
+    new_trace_id,
+    records_since,
     remove_trace_sink,
     reset_trace_state,
     span,
+    trace_context,
     trace_records,
     tracing_enabled,
 )
@@ -47,6 +67,17 @@ from metrics_tpu.obs.runtime_metrics import (
     registry,
 )
 from metrics_tpu.obs.export import TelemetryExporter, json_text, prometheus_text
+from metrics_tpu.obs.flightrec import (
+    FlightRecordError,
+    FlightRecorder,
+    active_flight_recorder,
+    attach_source,
+    detach_source,
+    install_flight_recorder,
+    load_flight_record,
+    load_flight_records,
+    reset_flightrec_state,
+)
 from metrics_tpu.obs.drift import (
     DRIFT_SCORES,
     DriftMonitor,
@@ -56,20 +87,37 @@ from metrics_tpu.obs.drift import (
 )
 
 __all__ = [
+    "FlightRecorder",
+    "FlightRecordError",
+    "install_flight_recorder",
+    "active_flight_recorder",
+    "attach_source",
+    "detach_source",
+    "load_flight_record",
+    "load_flight_records",
+    "reset_flightrec_state",
     "DRIFT_SCORES",
     "DriftMonitor",
     "ReferenceWindow",
     "reset_drift_env_state",
     "resolve_drift_threshold",
     "TraceRecord",
+    "TraceContext",
     "span",
     "instant",
     "tracing_enabled",
     "force_tracing",
+    "current_context",
+    "trace_context",
+    "new_trace_id",
+    "clock_sync",
     "trace_records",
+    "records_since",
     "clear_trace",
     "chrome_trace_events",
+    "chrome_events_for",
     "export_chrome_trace",
+    "merge_chrome_sections",
     "add_trace_sink",
     "remove_trace_sink",
     "reset_trace_state",

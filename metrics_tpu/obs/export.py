@@ -67,7 +67,9 @@ def _runtime_lines(runtime: RuntimeMetrics, qs: Sequence[float]) -> List[str]:
         if hist.count == 0:
             continue
         metric = f"{_PREFIX}_{name}"
-        lines.append(f"# HELP {metric} latency summary (QuantileSketch, rank error <= eps*n, eps={hist.eps:g})")
+        # units ride the metric name (*_ms / *_bytes): the histogram layer
+        # is unit-agnostic since fleet_publish_bytes joined the registry
+        lines.append(f"# HELP {metric} summary (QuantileSketch, rank error <= eps*n, eps={hist.eps:g})")
         lines.append(f"# TYPE {metric} summary")
         quantiles = hist.quantiles(qs)
         for q in qs:

@@ -1,0 +1,297 @@
+"""Compiled-graph cost profiler: static XLA costs joined with wall time.
+
+The analysis registry (``analysis/registry.py``) pins *structural* budgets
+— how many collectives a flagship graph may lower — but nothing attributes
+WHICH compiled graph burns the FLOPs, memory traffic, or collective
+payload bytes (the fine-grained compute-vs-collective tracking T3 argues
+for, PAPERS.md), and the pending TPU-window validation (ROADMAP item 5b)
+has no measurement harness to run. This module is both:
+
+- **Static cost** per registry entry, from the compiled executable itself:
+  ``compiled.cost_analysis()`` (flops, bytes accessed — XLA's own model)
+  plus per-op **collective payload bytes** parsed from the optimized HLO
+  (the result shapes of every ``all-reduce``/``all-gather``/... line,
+  async ``-start`` forms included once) — the number the quantized
+  transport (ISSUE 12) and the fleet tier actually pay for.
+- **Wall time** per entry — and per padding-ladder tier for the serving
+  entries — measured by driving the same compiled callable the audit
+  lowers and feeding a :class:`~metrics_tpu.obs.runtime_metrics.
+  LatencyHistogram` (the library's own QuantileSketch: p50/p99 carry the
+  KLL rank-error contract, dogfooded like every other self-metric).
+
+``python -m metrics_tpu.analysis profile`` runs the whole registry and
+dumps the table as ``COST_PROFILE.json`` next to ``BENCH_HISTORY.json``
+(+ a human-readable table on stdout) — run it verbatim at the next TPU
+window and the TPU column of the cost story fills itself in. The LIVE
+side of the same join — per-tier wall-time histograms fed from the
+``AOTDispatcher`` and the module runtime's jit call sites whenever
+tracing is on — exports through ``scrape()`` like every runtime metric
+(``serve_aot_update_ms`` / ``metric_update_jit_t{tier}_ms`` & co).
+
+Profiling compiles graphs, so this module needs a live jax backend (run
+under ``JAX_PLATFORMS=cpu`` + the forced virtual mesh, exactly like the
+audit CLI); import stays python-only per the bootstrap contract.
+"""
+import json
+import os
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "COST_PROFILE_FILENAME",
+    "collective_payload_bytes",
+    "profile_entry",
+    "profile_registry",
+    "render_table",
+    "write_profile",
+    "default_profile_path",
+]
+
+COST_PROFILE_FILENAME = "COST_PROFILE.json"
+
+# bytes per element for the dtype tokens optimized HLO prints
+_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1,
+    "u8": 1,
+    "f16": 2,
+    "bf16": 2,
+    "s16": 2,
+    "u16": 2,
+    "f32": 4,
+    "s32": 4,
+    "u32": 4,
+    "f64": 8,
+    "s64": 8,
+    "u64": 8,
+    "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(
+    r"\b(" + "|".join(sorted(_DTYPE_BYTES, key=len, reverse=True)) + r")\[([0-9,]*)\]"
+)
+
+
+def _shape_bytes(dtype: str, dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES[dtype]
+
+
+def collective_payload_bytes(hlo: str) -> Dict[str, int]:
+    """Total on-wire payload bytes per collective op in one optimized HLO
+    module: for every collective instruction line, the byte size of its
+    RESULT shape(s) (combined tuple-shaped ops sum their members; an async
+    ``-start``/``-done`` pair counts once, on the start — the same
+    counting rule as ``analysis/graph_audit.py::collective_counts``)."""
+    from metrics_tpu.analysis.graph_audit import COLLECTIVE_OPS
+
+    out = {op: 0 for op in COLLECTIVE_OPS}
+    for line in hlo.splitlines():
+        for op in COLLECTIVE_OPS:
+            token = None
+            if f"{op}-start(" in line:
+                token = f"{op}-start("
+            elif f"{op}(" in line:
+                token = f"{op}("
+            if token is None:
+                continue
+            # the result shape(s) sit between `=` and the op token; the
+            # operand shapes (inside the parens) must not double-count
+            head = line.split(token, 1)[0]
+            if "=" in head:
+                head = head.split("=", 1)[1]
+            out[op] += sum(_shape_bytes(d, dims) for d, dims in _SHAPE_RE.findall(head))
+            break  # one instruction per line
+    return out
+
+
+def _rows_of(tree: Any) -> Optional[int]:
+    """Leading-axis row count of the first >=1-dim array leaf (the padding
+    tier of a padded request)."""
+    from metrics_tpu.ops.padding import leading_rows
+
+    return leading_rows(tree)
+
+
+def _wall_quantiles(
+    fn: Callable, args: Tuple, reps: int, name: str
+) -> Dict[str, Any]:
+    """Drive ``fn(*args)`` ``reps`` times (after one warm call) feeding a
+    QuantileSketch-backed histogram; report p50/p99 milliseconds."""
+    import jax
+
+    from metrics_tpu.obs.runtime_metrics import LatencyHistogram
+
+    hist = LatencyHistogram(name)
+    jax.block_until_ready(fn(*args))  # warm: compile/dispatch outside the timing
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        hist.observe((time.perf_counter() - t0) * 1e3)
+    qs = hist.quantiles((0.5, 0.99))
+    return {
+        "p50_ms": qs[0.5],
+        "p99_ms": qs[0.99],
+        "mean_ms": hist.sum_ms / max(1, hist.count),
+        "reps": hist.count,
+    }
+
+
+def _compiled_of(entry: Any, ndev: int) -> Tuple[Callable, Tuple, Any]:
+    """(callable, args, compiled) for one registry entry — the budget
+    builder when it exists, else the recompile builder at a fixed batch
+    (so EVERY entry gets a cost row, ``mean_update_stability`` and the
+    warmed-sweep entry included)."""
+    import jax
+
+    if entry.build is not None:
+        fn, args = entry.build(ndev)
+    elif entry.build_recompile is not None:
+        raw, make_args = entry.build_recompile()
+        fn, args = jax.jit(raw), make_args(32)
+    else:  # pragma: no cover — every registry entry has a builder
+        raise ValueError(f"registry entry {entry.name!r} has no builder to profile")
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    compiled = fn.lower(*args).compile()
+    return fn, args, compiled
+
+
+def _cost_dict(compiled: Any) -> Dict[str, Any]:
+    """Normalize ``compiled.cost_analysis()`` across jax versions (list of
+    one dict on 0.4.x, a plain dict later); absent/unsupported → empty."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort per backend
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if isinstance(ca, dict) else {}
+
+
+def profile_entry(
+    entry: Any, ndev: int = 4, reps: int = 20, tier_reps: int = 10
+) -> Dict[str, Any]:
+    """One cost-table row for one :class:`~metrics_tpu.analysis.registry.
+    AuditEntry`: static costs off the compiled executable + wall-time
+    quantiles off ``reps`` driven calls; serving entries with a tier sweep
+    additionally get per-ladder-tier wall rows (one representative batch
+    per distinct padded tier)."""
+    import jax
+
+    from metrics_tpu.analysis.graph_audit import collective_counts
+
+    fn, args, compiled = _compiled_of(entry, ndev)
+    hlo = compiled.as_text()
+    cost = _cost_dict(compiled)
+    counts = {op: n for op, n in collective_counts(hlo).items() if n}
+    payload = {op: b for op, b in collective_payload_bytes(hlo).items() if b}
+    row: Dict[str, Any] = {
+        "entry": entry.name,
+        "flops": cost.get("flops"),
+        "bytes_accessed": cost.get("bytes accessed"),
+        "collectives": counts,
+        "collective_bytes": payload,
+        "collective_bytes_total": sum(payload.values()),
+        "wall": _wall_quantiles(fn, args, reps, f"profile_{entry.name}_ms"),
+    }
+    sweep = entry.warmup_sizes or entry.sweep_sizes
+    if entry.build_recompile is not None and sweep:
+        raw, make_args = entry.build_recompile()
+        jitted = jax.jit(raw)
+        tiers: Dict[int, Tuple] = {}
+        for n in sweep:
+            tier_args = make_args(n)
+            tier = _rows_of(tier_args)
+            if tier is not None and tier not in tiers:
+                tiers[tier] = tier_args
+        row["tiers"] = {
+            str(tier): _wall_quantiles(
+                jitted, tier_args, tier_reps, f"profile_{entry.name}_t{tier}_ms"
+            )
+            for tier, tier_args in sorted(tiers.items())
+        }
+    return row
+
+
+def profile_registry(
+    entries: Optional[Sequence[Any]] = None,
+    ndev: int = 4,
+    reps: int = 20,
+    tier_reps: int = 10,
+) -> Dict[str, Any]:
+    """The full cost table: one row per registry entry (default: all of
+    ``analysis/registry.py::REGISTRY``)."""
+    import jax
+
+    from metrics_tpu.analysis.registry import REGISTRY
+
+    rows = [
+        profile_entry(entry, ndev=ndev, reps=reps, tier_reps=tier_reps)
+        for entry in (entries if entries is not None else REGISTRY)
+    ]
+    return {
+        "created_unix": time.time(),
+        "platform": jax.default_backend(),
+        "ndev": ndev,
+        "reps": reps,
+        "entries": rows,
+    }
+
+
+def _fmt_num(value: Any) -> str:
+    if value is None:
+        return "-"
+    value = float(value)
+    for unit, scale in (("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(value) >= scale:
+            return f"{value / scale:.2f}{unit}"
+    return f"{value:.0f}"
+
+
+def render_table(doc: Dict[str, Any]) -> str:
+    """The cost table as aligned text (the CLI's stdout form)."""
+    header = (
+        f"{'entry':<28} {'flops':>9} {'bytes':>9} {'coll-B':>8} "
+        f"{'wall p50':>10} {'wall p99':>10}  tiers(p50 ms)"
+    )
+    lines = [header, "-" * len(header)]
+    for row in doc["entries"]:
+        wall = row["wall"]
+        tiers = row.get("tiers") or {}
+        tier_txt = " ".join(
+            f"{tier}:{t['p50_ms']:.2f}" for tier, t in sorted(tiers.items(), key=lambda kv: int(kv[0]))
+        )
+        lines.append(
+            f"{row['entry']:<28} {_fmt_num(row['flops']):>9} "
+            f"{_fmt_num(row['bytes_accessed']):>9} "
+            f"{_fmt_num(row['collective_bytes_total']):>8} "
+            f"{wall['p50_ms']:>8.3f}ms {wall['p99_ms']:>8.3f}ms  {tier_txt}"
+        )
+    lines.append(
+        f"({len(doc['entries'])} entries, platform={doc['platform']}, "
+        f"ndev={doc['ndev']}, reps={doc['reps']})"
+    )
+    return "\n".join(lines)
+
+
+def default_profile_path() -> str:
+    """``COST_PROFILE.json`` next to ``BENCH_HISTORY.json`` (repo root)."""
+    from metrics_tpu.analysis.lint import package_root
+
+    return os.path.join(package_root(), COST_PROFILE_FILENAME)
+
+
+def write_profile(doc: Dict[str, Any], path: Optional[str] = None) -> str:
+    """Persist one cost table (atomic — the tmp-fsync-replace discipline,
+    so a killed profiler never leaves a torn table)."""
+    from metrics_tpu.resilience.snapshot import atomic_write_bytes
+
+    path = path or default_profile_path()
+    atomic_write_bytes(path, (json.dumps(doc, indent=1, default=str) + "\n").encode())
+    return path

@@ -27,6 +27,10 @@ contract (``utilities/backend.py``) holds.
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from metrics_tpu.ops._envtools import WarnOnce
+
+_warn_once = WarnOnce()
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -35,6 +39,7 @@ __all__ = [
     "registry",
     "merged",
     "note_jit_retrace",
+    "observe_jit_wall",
     "HISTOGRAM_SEAMS",
     "DEFAULT_QUANTILES",
 ]
@@ -338,6 +343,8 @@ class RuntimeMetrics:
             # the sink's memoized lookups point at the dropped objects
             _sink_counters.clear()
             _sink_hists.clear()
+            _tier_seen.clear()
+            _warn_once.reset()
 
 
 registry = RuntimeMetrics()
@@ -365,6 +372,40 @@ def merged(*registries: RuntimeMetrics) -> RuntimeMetrics:
                 else:
                     out._hists[name] = mine.merged(hist)
     return out
+
+
+# distinct per-tier histograms allowed per kind: registry histograms are
+# never evicted, so a caller that passed raw (unpadded) batch sizes would
+# otherwise grow one sketch per distinct size for the life of the process
+_TIER_HISTOGRAM_CAP = 64
+_tier_seen: Dict[str, set] = {}
+
+
+def observe_jit_wall(kind: str, rows: Optional[int], dur_ms: float) -> None:
+    """One timed compiled-graph dispatch (the profiler's LIVE join, ISSUE
+    15): feeds ``<kind>_ms`` and — when the call's padded row count is
+    known — the per-ladder-tier ``<kind>_t{rows}_ms`` histogram, so a
+    scrape attributes wall time per compiled graph tier, not just per
+    seam. Callers gate on ``tracing_enabled()`` (the taps sit on the jit
+    call sites in ``metric.py`` and ``serving/warmup.py::AOTDispatcher``;
+    the disabled path must stay free). ``rows`` must be a ladder tier, not
+    a raw batch size — past ``_TIER_HISTOGRAM_CAP`` distinct values per
+    kind, new tiers observe into the base histogram only (bounded scrape,
+    warned once)."""
+    registry.histogram(f"{kind}_ms").observe(dur_ms)
+    if rows is not None:
+        seen = _tier_seen.setdefault(kind, set())
+        if rows not in seen and len(seen) >= _TIER_HISTOGRAM_CAP:
+            _warn_once(
+                ("tier-cap", kind),
+                f"observe_jit_wall({kind!r}): over {_TIER_HISTOGRAM_CAP} distinct "
+                "row tiers observed — per-tier histograms are capped (rows should "
+                "be padding-ladder tiers); further tiers fold into the base "
+                f"{kind}_ms histogram only",
+            )
+            return
+        seen.add(rows)
+        registry.histogram(f"{kind}_t{rows}_ms").observe(dur_ms)
 
 
 # span names whose occurrence counter is maintained AT SOURCE (always on,

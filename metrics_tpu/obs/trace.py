@@ -20,6 +20,23 @@ sanctioned in-graph-adjacent probe is :func:`instant` at *trace time*
 per trace, so an instant there is exactly ``audit_recompilation``'s
 counting idiom — a retrace counter, not a graph op.
 
+**Causal ids (ISSUE 15).** Enabled spans carry ``trace_id`` / ``span_id``
+/ ``parent_id``: a span entered while another span is open *on the same
+thread* becomes its child (thread-local propagation — ids never leak
+across threads by accident), and :func:`trace_context` hands a captured
+:class:`TraceContext` to another thread explicitly (the ServeLoop
+offer → worker-update seam). Fan-in seams that merge MANY producers into
+one consumer (reduce over N publishes, aggregator fold over N host views)
+record a ``link`` to one representative producer instead of a parent —
+exported as Perfetto flow arrows, so one trace load shows a request's
+causal chain from host offer to the global aggregator's fold. Span ids are
+< 2^52 (20-bit per-process prefix + 32-bit counter): unique across a fleet
+AND exactly representable in JSON floats, which trace viewers parse with.
+:func:`clock_sync` pairs this process's monotonic clock (span timestamps)
+with wall clock, so :func:`merge_chrome_sections` can rebase N hosts'
+timelines onto one shared timebase (``fleet/aggregator.py`` serves the
+merged document at ``GET /trace.json``).
+
 Enablement rides the shared ``METRICS_TPU_*`` env contract
 (``ops/_envtools.py``): ``METRICS_TPU_TRACE=1`` turns tracing on at call
 time (malformed values warn once and stay off — a bad env var costs
@@ -27,20 +44,24 @@ observability, never correctness or latency), ``METRICS_TPU_TRACE_BUFFER``
 sizes the ring (default 65536 records; malformed → warn once + default).
 ``force_tracing(True)`` is the programmatic override (programmatic > env >
 default, the dispatch-layer rule). When tracing is off, ``span()`` returns
-one module-level no-op singleton — no record, no attrs retention, no
-allocation beyond the caller's kwargs — so the disabled path prices at a
-dict-build plus one memoized env read (pinned ≤1% of the compiled guarded
-fused step by ``tests/obs/test_overhead.py`` and the ``obs`` bench phase).
+one module-level no-op singleton — no record, no ids, no attrs retention,
+no allocation beyond the caller's kwargs — so the disabled path prices at
+a dict-build plus one memoized env read (pinned ≤1% of the compiled
+guarded fused step by ``tests/obs/test_overhead.py`` and the ``obs`` bench
+phase; the id bookkeeping rides the ENABLED path only, inside its ≤5%
+budget).
 
 Module import performs python work only (stdlib + the shared env tools) —
 the hang-proof bootstrap contract (``utilities/backend.py``) holds, and
 the tracer stays usable precisely when the accelerator stack is wedged.
 """
 import contextlib
+import itertools
 import json
 import os
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
@@ -48,14 +69,22 @@ from metrics_tpu.ops._envtools import EnvParse, WarnOnce, bool_token
 
 __all__ = [
     "TraceRecord",
+    "TraceContext",
     "span",
     "instant",
     "tracing_enabled",
     "force_tracing",
+    "current_context",
+    "trace_context",
+    "new_trace_id",
+    "clock_sync",
     "trace_records",
+    "records_since",
     "clear_trace",
     "chrome_trace_events",
+    "chrome_events_for",
     "export_chrome_trace",
+    "merge_chrome_sections",
     "add_trace_sink",
     "remove_trace_sink",
     "reset_trace_state",
@@ -137,19 +166,105 @@ def force_tracing(enabled: bool) -> Iterator[None]:
 
 
 class TraceRecord(NamedTuple):
-    """One completed span (``dur_ns == 0`` marks an instant event)."""
+    """One completed span (``dur_ns == 0`` marks an instant event).
+
+    ``trace_id``/``span_id``/``parent_id`` are the causal ids (``None`` on
+    records written before ids existed, or by a build with ids disabled);
+    ``link`` is an optional explicit cross-thread/cross-process causal
+    edge ``(trace_id, span_id)`` — the fan-in form parent_id cannot
+    express (a reduce covering N publishes links ONE representative
+    producer; the exporter renders it as a Perfetto flow arrow).
+    ``seq`` is the ring-append sequence number (monotone per process,
+    stamped at span EXIT) — the incremental-export cursor. A watermark on
+    ``t_start_ns`` would permanently skip any span still OPEN at export
+    time (it starts before the watermark but lands in the ring after);
+    append order cannot."""
 
     name: str
     tid: int
     t_start_ns: int
     dur_ns: int
     attrs: Optional[Dict[str, Any]]
+    trace_id: Optional[str] = None
+    span_id: Optional[int] = None
+    parent_id: Optional[int] = None
+    link: Optional[Tuple[str, int]] = None
+    seq: int = 0
 
 
-# the ring: deque.append is atomic under the GIL, so the record path never
-# takes the lock — the lock only guards reconfiguration (capacity change /
-# clear) and consistent snapshots
+class TraceContext(NamedTuple):
+    """The propagatable half of an open span: hand it to another thread
+    (``trace_context``) or another process (the fleet wire header
+    ``extra["trace"]``) to parent/link later spans under it."""
+
+    trace_id: str
+    span_id: int
+
+
+# span-id allocation: a 20-bit per-process random prefix + a 32-bit counter
+# (itertools.count.__next__ is GIL-atomic) — ids are unique across a fleet
+# of processes with overwhelming probability AND stay < 2^52, exactly
+# representable in the JSON floats trace viewers parse with
+_PROC_PREFIX = uuid.uuid4().int & 0xFFFFF
+_SPAN_COUNTER = itertools.count(1)
+_TRACE_COUNTER = itertools.count(1)
+# ring-append sequence (GIL-atomic __next__): stamps TraceRecord.seq so
+# incremental exporters cursor on APPEND order, never on start time
+_RECORD_SEQ = itertools.count(1)
+
+
+def _next_span_id() -> int:
+    return (_PROC_PREFIX << 32) | (next(_SPAN_COUNTER) & 0xFFFFFFFF)
+
+
+def new_trace_id() -> str:
+    """A fleet-unique trace id (per-process random prefix + counter)."""
+    return f"{_PROC_PREFIX:05x}{os.getpid() & 0xFFFF:04x}{next(_TRACE_COUNTER):08x}"
+
+
+# the thread-local context stack top: each thread sees only ids IT opened
+# (or was explicitly handed via trace_context) — no cross-thread leaks
+_tls = threading.local()
+
+
+def current_context() -> Optional[TraceContext]:
+    """The innermost open span's context on THIS thread (None outside any
+    span, or while tracing is disabled — disabled spans never push)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextlib.contextmanager
+def trace_context(ctx: Optional[TraceContext]) -> Iterator[None]:
+    """Install ``ctx`` as this thread's ambient trace context (restored on
+    exit) — the explicit cross-thread propagation hook: capture
+    ``current_context()`` where work is produced, enter it where the work
+    is consumed, and the consumer's spans parent under the producer's.
+    ``None`` installs "no context" (a span inside starts a fresh trace)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+def clock_sync() -> Dict[str, float]:
+    """One ``{mono_ns, unix}`` pairing of this process's monotonic clock
+    (what span timestamps use) with wall clock — shipped alongside exported
+    events so :func:`merge_chrome_sections` can rebase every host's
+    timeline onto one shared (unix) timebase; the residual error is each
+    host's wall-clock skew, which the fleet merge reports per host as a
+    ``clock_offset_estimate`` from publish/receive stamps."""
+    return {"mono_ns": time.monotonic_ns(), "unix": time.time()}
+
+
+# the ring: _ring_lock guards reconfiguration (capacity change / clear)
+# and consistent snapshots; the record path takes only _append_lock — a
+# tiny critical section making seq allocation + append ONE step, so seq
+# order IS append order and an incremental-export cursor can never commit
+# past a record whose seq was allocated but not yet appended
 _ring_lock = threading.Lock()
+_append_lock = threading.Lock()
 _ring: "deque[TraceRecord]" = deque(maxlen=_DEFAULT_BUFFER)
 
 # populated at import: obs/__init__.py imports runtime_metrics, whose
@@ -178,8 +293,41 @@ def _current_ring() -> "deque[TraceRecord]":
     return _ring
 
 
-def _record(name: str, t_start_ns: int, dur_ns: int, attrs: Optional[Dict[str, Any]]) -> None:
-    _current_ring().append(TraceRecord(name, threading.get_ident(), t_start_ns, dur_ns, attrs))
+# tid -> thread name, captured at the first record from each thread (the
+# dict-membership check is the only per-record cost) so exported traces
+# carry real thread_name metadata instead of bare integer tids
+_TID_NAMES: Dict[int, str] = {}
+
+
+def _record(
+    name: str,
+    t_start_ns: int,
+    dur_ns: int,
+    attrs: Optional[Dict[str, Any]],
+    trace_id: Optional[str] = None,
+    span_id: Optional[int] = None,
+    parent_id: Optional[int] = None,
+    link: Optional[Tuple[str, int]] = None,
+) -> None:
+    tid = threading.get_ident()
+    if tid not in _TID_NAMES:
+        _TID_NAMES[tid] = threading.current_thread().name
+    ring = _current_ring()
+    with _append_lock:
+        ring.append(
+            TraceRecord(
+                name,
+                tid,
+                t_start_ns,
+                dur_ns,
+                attrs,
+                trace_id,
+                span_id,
+                parent_id,
+                link,
+                next(_RECORD_SEQ),
+            )
+        )
     for sink in _SINKS:
         try:
             sink(name, dur_ns, attrs)
@@ -192,19 +340,54 @@ def _record(name: str, t_start_ns: int, dur_ns: int, attrs: Optional[Dict[str, A
 
 
 class _LiveSpan:
-    __slots__ = ("name", "attrs", "_t0")
+    __slots__ = ("name", "attrs", "link", "_t0", "_prev", "trace_id", "span_id", "parent_id")
 
-    def __init__(self, name: str, attrs: Optional[Dict[str, Any]]) -> None:
+    def __init__(
+        self,
+        name: str,
+        attrs: Optional[Dict[str, Any]],
+        link: Optional[TraceContext] = None,
+    ) -> None:
         self.name = name
         self.attrs = attrs
+        self.link = link
 
     def __enter__(self) -> "_LiveSpan":
+        ctx = getattr(_tls, "ctx", None)
+        self.span_id = _next_span_id()
+        if ctx is not None:
+            self.trace_id = ctx.trace_id
+            self.parent_id = ctx.span_id
+        else:
+            self.trace_id = self.link.trace_id if self.link is not None else new_trace_id()
+            self.parent_id = None
+        self._prev = ctx
+        _tls.ctx = TraceContext(self.trace_id, self.span_id)
         self._t0 = time.monotonic_ns()
         return self
 
+    def set(self, **attrs: Any) -> None:
+        """Attach attrs discovered mid-span (e.g. the padding tier a batch
+        resolved to) — recorded with the span at exit."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+
     def __exit__(self, *exc: Any) -> bool:
         t0 = self._t0
-        _record(self.name, t0, time.monotonic_ns() - t0, self.attrs)
+        dur = time.monotonic_ns() - t0
+        _tls.ctx = self._prev
+        _record(
+            self.name,
+            t0,
+            dur,
+            self.attrs,
+            trace_id=self.trace_id,
+            span_id=self.span_id,
+            parent_id=self.parent_id,
+            link=tuple(self.link) if self.link is not None else None,
+        )
         return False
 
 
@@ -216,6 +399,9 @@ class _NoopSpan:
     def __enter__(self) -> "_NoopSpan":
         return self
 
+    def set(self, **attrs: Any) -> None:
+        pass
+
     def __exit__(self, *exc: Any) -> bool:
         return False
 
@@ -223,10 +409,13 @@ class _NoopSpan:
 _NOOP_SPAN = _NoopSpan()
 
 
-def span(name: str, /, **attrs: Any):
+def span(name: str, /, link_to: Optional[TraceContext] = None, **attrs: Any):
     """Context manager timing one host-side seam. Disabled → the shared
     no-op singleton (zero record-path allocation). ``name`` is
-    positional-only so an attr may also be called ``name``."""
+    positional-only so an attr may also be called ``name``; ``link_to`` is
+    the one reserved kwarg — a :class:`TraceContext` this span causally
+    descends from across a thread/process boundary (fan-in seams), drawn
+    as a Perfetto flow arrow by the exporter."""
     # the enabled check is inlined (one function call saved per span —
     # these sit on every metric update)
     global _env_enabled, _env_countdown
@@ -241,15 +430,25 @@ def span(name: str, /, **attrs: Any):
         enabled = _FORCED
     if not enabled:
         return _NOOP_SPAN
-    return _LiveSpan(name, attrs or None)
+    return _LiveSpan(name, attrs or None, link=link_to)
 
 
 def instant(name: str, /, **attrs: Any) -> None:
     """Record a point event (``dur_ns == 0``) — occurrence counting:
-    retrace events, dispatch decisions, coalesced triggers."""
+    retrace events, dispatch decisions, coalesced triggers. Inherits the
+    thread's ambient trace context (parented under the open span)."""
     if not tracing_enabled():
         return
-    _record(name, time.monotonic_ns(), 0, attrs or None)
+    ctx = getattr(_tls, "ctx", None)
+    _record(
+        name,
+        time.monotonic_ns(),
+        0,
+        attrs or None,
+        trace_id=ctx.trace_id if ctx is not None else None,
+        span_id=_next_span_id(),
+        parent_id=ctx.span_id if ctx is not None else None,
+    )
 
 
 # -- readers / export ------------------------------------------------------
@@ -264,44 +463,174 @@ def trace_records(name: Optional[str] = None) -> List[TraceRecord]:
     return records
 
 
+def records_since(seq: int) -> List[TraceRecord]:
+    """Records APPENDED after sequence number ``seq`` — the
+    incremental-export cursor the fleet publisher ships deltas with (pair
+    with the newest record's ``seq`` as the next watermark). Cursoring on
+    append order, not ``t_start_ns``, means a span that was still open at
+    the previous export (started before it, closed after) ships with the
+    next delta instead of being skipped forever.
+
+    Cost is O(delta), not O(ring): seq allocation + append are one step
+    under ``_append_lock``, so ring order is exactly seq order and the
+    reverse scan stops at the first already-shipped record."""
+    with _ring_lock:
+        snap = list(_ring)  # one C-level copy; the scan runs lock-free
+    out: List[TraceRecord] = []
+    for r in reversed(snap):
+        if r.seq <= seq:
+            break
+        out.append(r)
+    out.reverse()
+    return out
+
+
 def clear_trace() -> None:
     with _ring_lock:
         _ring.clear()
 
 
-def chrome_trace_events() -> List[Dict[str, Any]]:
-    """The ring as Chrome/Perfetto trace events (``ph='X'`` complete spans,
-    ``ph='i'`` instants; timestamps/durations in microseconds, per the
-    trace-event format)."""
-    pid = os.getpid()
-    events: List[Dict[str, Any]] = []
-    for rec in trace_records():
-        event: Dict[str, Any] = {
-            "name": rec.name,
+def chrome_events_for(
+    records: List[TraceRecord], host_id: Optional[str] = None, pid: Optional[int] = None
+) -> List[Dict[str, Any]]:
+    """``records`` as Chrome/Perfetto trace events (the reusable core of
+    :func:`chrome_trace_events` — the fleet publisher renders incremental
+    record batches through it). Emits, in order: ``M`` metadata rows
+    (process/thread names), the span/instant events themselves (causal ids
+    in ``args``), and the causal flow arrows — a ``ph='s'`` flow start
+    bound at each identified span plus a ``ph='f'`` (bind-to-enclosing)
+    finish at each span that has a ``parent_id`` or an explicit ``link``,
+    keyed on the fleet-unique span ids so arrows survive a cross-process
+    merge."""
+    pid = os.getpid() if pid is None else pid
+    events: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
             "pid": pid,
-            "tid": rec.tid,
-            "ts": rec.t_start_ns / 1e3,
+            "tid": 0,
+            "args": {"name": host_id or f"metrics_tpu pid {pid}"},
         }
+    ]
+    for tid in sorted({r.tid for r in records}):
+        name = _TID_NAMES.get(tid)
+        if name:
+            events.append(
+                {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid, "args": {"name": name}}
+            )
+    flows: List[Dict[str, Any]] = []
+    for rec in records:
+        ts = rec.t_start_ns / 1e3
+        event: Dict[str, Any] = {"name": rec.name, "pid": pid, "tid": rec.tid, "ts": ts}
         if rec.dur_ns:
             event["ph"] = "X"
             event["dur"] = rec.dur_ns / 1e3
         else:
             event["ph"] = "i"
             event["s"] = "t"  # thread-scoped instant
-        if rec.attrs:
-            event["args"] = dict(rec.attrs)
+        args: Dict[str, Any] = dict(rec.attrs) if rec.attrs else {}
+        if rec.trace_id is not None:
+            args["trace_id"] = rec.trace_id
+            args["span_id"] = rec.span_id
+            if rec.parent_id is not None:
+                args["parent_id"] = rec.parent_id
+        if args:
+            event["args"] = args
         events.append(event)
-    return events
+        if rec.span_id is None:
+            continue
+        if rec.dur_ns:
+            # a flow START bound inside this span (at its start, so the
+            # arrow runs forward in time to nested children AND to
+            # later cross-process descendants): descendants draw FROM here
+            flows.append(
+                {
+                    "name": "causal",
+                    "cat": "causal",
+                    "ph": "s",
+                    "id": rec.span_id,
+                    "pid": pid,
+                    "tid": rec.tid,
+                    "ts": ts,
+                }
+            )
+        for origin in (rec.parent_id, rec.link[1] if rec.link else None):
+            if origin is None:
+                continue
+            flows.append(
+                {
+                    "name": "causal",
+                    "cat": "causal",
+                    "ph": "f",
+                    "bp": "e",  # bind to the enclosing slice
+                    "id": origin,
+                    "pid": pid,
+                    "tid": rec.tid,
+                    "ts": ts,
+                }
+            )
+    return events + flows
 
 
-def export_chrome_trace(path: Optional[str] = None) -> str:
+def chrome_trace_events(host_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """The ring as Chrome/Perfetto trace events: ``M`` metadata rows first
+    (``process_name`` = ``host_id`` or ``metrics_tpu pid N``, one
+    ``thread_name`` per seen tid — merged fleet traces read as named
+    processes/threads instead of bare integers), then ``ph='X'`` complete
+    spans / ``ph='i'`` instants (timestamps/durations in microseconds),
+    then the causal flow arrows (``ph='s'``/``'f'`` pairs keyed on span
+    ids) for every parented or linked span."""
+    return chrome_events_for(trace_records(), host_id=host_id)
+
+
+def export_chrome_trace(path: Optional[str] = None, host_id: Optional[str] = None) -> str:
     """The ring as a Chrome/Perfetto-loadable JSON document; optionally
     written to ``path`` (load via ``chrome://tracing`` or ui.perfetto.dev)."""
-    doc = json.dumps({"traceEvents": chrome_trace_events(), "displayTimeUnit": "ms"})
+    doc = json.dumps(
+        {"traceEvents": chrome_trace_events(host_id=host_id), "displayTimeUnit": "ms"}
+    )
     if path is not None:
         with open(path, "w") as f:
             f.write(doc)
     return doc
+
+
+def merge_chrome_sections(sections: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-host event sections into ONE Perfetto-loadable document.
+
+    Each section is ``{"host_id": str, "clock": clock_sync() output,
+    "events": [chrome events]}`` (what the fleet publisher ships in the
+    wire header ``extra["trace"]``, accumulated per host by the
+    aggregator). Every section's span timestamps are monotonic-clock-local
+    to its process; the merge rebases them onto the section's wall clock
+    (``ts_unix_us = ts - mono_ns/1e3 + unix*1e6``) so the hosts share one
+    timebase, and assigns each host a synthetic ``pid`` (+ a
+    ``process_name`` metadata row naming it). Flow arrows (span ids are
+    fleet-unique) survive the merge, so a cross-process link renders as an
+    arrow between two hosts' tracks. Sections may carry an optional
+    ``clock_offset_estimate`` (seconds, receiver-measured) — recorded as a
+    process metadata arg for skew diagnosis, never silently applied (it is
+    contaminated by one-way network latency)."""
+    events: List[Dict[str, Any]] = []
+    for pid, section in enumerate(sections, start=1):
+        host = section.get("host_id") or f"section-{pid}"
+        clock = section.get("clock") or {}
+        shift_us = None
+        if "mono_ns" in clock and "unix" in clock:
+            shift_us = clock["unix"] * 1e6 - clock["mono_ns"] / 1e3
+        meta_args: Dict[str, Any] = {"name": host}
+        if section.get("clock_offset_estimate") is not None:
+            meta_args["clock_offset_estimate_s"] = section["clock_offset_estimate"]
+        events.append(
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0, "args": meta_args}
+        )
+        for ev in section.get("events") or []:
+            out = dict(ev)
+            out["pid"] = pid
+            if shift_us is not None and "ts" in out and out.get("ph") != "M":
+                out["ts"] = out["ts"] + shift_us
+            events.append(out)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 # -- sinks -----------------------------------------------------------------
@@ -321,14 +650,17 @@ def remove_trace_sink(sink: Callable[[str, int, Optional[Dict[str, Any]]], None]
 
 
 def reset_trace_state() -> None:
-    """Test hook: clear the ring, the forced mode, warn-once memory, and
-    the memoized env parses (the shared ``reset_*_state`` contract); the
-    next enablement check and record re-read the env."""
+    """Test hook: clear the ring, the forced mode, warn-once memory, the
+    memoized env parses, and the CALLING thread's trace context (other
+    threads' contexts die with their spans); the next enablement check and
+    record re-read the env."""
     global _FORCED, _env_enabled, _env_countdown, _ring_dirty
     _FORCED = None
     _env_enabled = False
     _env_countdown = 0
     _ring_dirty = True
+    _tls.ctx = None
+    _TID_NAMES.clear()
     _warn_once.reset()
     _ENV_TRACE.reset()
     _ENV_BUFFER.reset()

@@ -151,6 +151,20 @@ def ladder_tiers(max_rows: int, ladder: Optional[Sequence[int]] = None) -> Tuple
     return tuple(tiers)
 
 
+def leading_rows(tree: Any) -> Optional[int]:
+    """Leading-axis row count of the first >=1-dim array leaf of ``tree``
+    (for a padded request: its ladder tier). One implementation shared by
+    the AOT warmup matrix (``serving/warmup.py``), the cost profiler
+    (``obs/profile.py``), and the per-tier jit-wall tap (``metric.py``)."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and len(shape) >= 1:
+            return int(shape[0])
+    return None
+
+
 def _row_count(value: Any) -> Optional[int]:
     """Concrete leading-axis length of an array-like, else None."""
     shape = getattr(value, "shape", None)

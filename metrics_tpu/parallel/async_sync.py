@@ -390,7 +390,16 @@ class AsyncSyncScheduler:
 
     def _cycle(self, seq: int) -> None:
         """One snapshot → reduce → publish pass. ``seq`` was read BEFORE the
-        snapshot, so it is a sound lower bound on the view's coverage."""
+        snapshot, so it is a sound lower bound on the view's coverage.
+
+        Causal ids (ISSUE 15): the ``async_sync.cycle`` span is the root of
+        this cycle's trace on the worker thread, and the nested
+        snapshot/reduce/publish phase spans parent under it via the
+        tracer's thread-local propagation — so one Perfetto load shows the
+        cycle's phase breakdown as a real tree, and a consumer reduce
+        running inside ``reduce_fn`` (ServeLoop's ``serve.reduce``) both
+        nests here AND links back to the traffic it covers. The covered
+        seq rides the cycle span so a stall is attributable to a cycle."""
         with self._lock:
             # notifies absorbed since the last cycle attempt: >1 means the
             # cadence coalesced triggers into this single pass
@@ -400,7 +409,7 @@ class AsyncSyncScheduler:
             self._cycle_seq = seq
         self._last_attempt_mono = time.monotonic()
         snapshot_unix = time.time()
-        with _obs_trace.span("async_sync.cycle", name=self.name, coalesced=coalesced):
+        with _obs_trace.span("async_sync.cycle", name=self.name, coalesced=coalesced, seq=seq):
             try:
                 with _obs_trace.span("async_sync.snapshot", name=self.name):
                     payload, steps = self.snapshot_fn()

@@ -106,6 +106,22 @@ class HealthRegistry:
         self._lock = threading.Lock()
         self._events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
         self._kinds: Dict[str, Dict[str, Any]] = {}
+        # event listeners (obs/flightrec.py's degraded-edge trigger): called
+        # per recorded event, OUTSIDE the lock, on the recording thread — a
+        # raising listener is dropped from the record path, never the caller
+        self._listeners: List[Any] = []
+
+    def add_listener(self, fn: Any) -> None:
+        """Register ``fn(event_dict)``, called per recorded event on the
+        recording thread (after the ring/table update, outside the lock).
+        Listeners must be cheap and must not re-enter :meth:`record` for
+        the same trigger (the flight recorder guards its own re-entrancy)."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn: Any) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
 
     def record(self, kind: str, message: str, **details: Any) -> Dict[str, Any]:
         now_unix, now_mono = time.time(), time.monotonic()
@@ -131,6 +147,11 @@ class HealthRegistry:
                 entry["count"] += 1
                 entry["last_unix"] = now_unix
                 entry["last_mono"] = now_mono
+        for fn in list(self._listeners):
+            try:
+                fn(event)
+            except Exception:  # noqa: BLE001 — telemetry degrades, never the caller's seam
+                pass
         return event
 
     def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:

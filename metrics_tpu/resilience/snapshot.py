@@ -103,6 +103,29 @@ def _checksum_tree(payload: Any) -> Dict[str, str]:
     return {path: _leaf_digest(leaf) for path, leaf in _iter_leaves(payload)}
 
 
+def atomic_write_bytes(path: str, blob: bytes) -> None:
+    """The tmp-fsync-replace write discipline, factored once: write to a
+    pid-suffixed ``.tmp`` sibling, flush, fsync, ``os.replace`` into place,
+    fsync the directory — a crash mid-write leaves the previous file
+    untouched and at worst a stale tmp. Shared by the snapshot writer and
+    the flight recorder (``obs/flightrec.py``), so the atomicity argument
+    lives in exactly one implementation."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)  # atomic on POSIX: readers see old or new, never torn
+    try:
+        dir_fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+    except OSError:  # pragma: no cover - platform-dependent (e.g. no dir fsync)
+        pass
+
+
 # --------------------------------------------------------------------------
 # elastic merge: per-rank payloads -> one payload, through the registered
 # reductions of the live target object
@@ -388,25 +411,9 @@ class SnapshotManager:
                 protocol=4,
             )
             final = os.path.join(self.directory, self._filename(step, rank, world_size))
-            tmp = f"{final}.tmp.{os.getpid()}"
-            with open(tmp, "wb") as f:
-                f.write(blob)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, final)  # atomic on POSIX: readers see old or new, never torn
-            self._fsync_dir()
+            atomic_write_bytes(final, blob)
             self._prune(rank)
             return final
-
-    def _fsync_dir(self) -> None:
-        try:
-            dir_fd = os.open(self.directory, os.O_RDONLY)
-            try:
-                os.fsync(dir_fd)
-            finally:
-                os.close(dir_fd)
-        except OSError:  # pragma: no cover - platform-dependent (e.g. no dir fsync)
-            pass
 
     def _prune(self, rank: int) -> None:
         """Keep the newest ``self.keep`` steps of THIS rank's files (each

@@ -245,7 +245,7 @@ class ServeLoop:
         self._published: List[Optional[_Snapshot]] = [None] * workers
         self._base_snap: Optional[_Snapshot] = None  # restored pre-crash state
 
-        self._queue: "queue.Queue[Tuple[tuple, dict]]" = queue.Queue(maxsize=queue_size)
+        self._queue: "queue.Queue[Tuple[tuple, dict, Any]]" = queue.Queue(maxsize=queue_size)
         self._stats_lock = threading.Lock()
         self._offered = 0
         self._accepted = 0
@@ -349,6 +349,22 @@ class ServeLoop:
                     engine.install(replica)
                 self._warmup = engine
 
+        # causal tracing (obs/trace.py): the ctx of the newest worker-update
+        # span (set at publish — GIL-atomic slot write) and of the reduce
+        # that built _last_reporter, so the reduce links back to the traffic
+        # it covered and a fleet publish links back to the reduce it ships
+        self._publish_ctx = None
+        self._last_reporter_ctx = None
+
+        # flight recorder (obs/flightrec.py): this loop's health() —
+        # serving/warmup/sync/drift state — rides every black-box dump;
+        # detached on stop() so a dump after shutdown reads no dead loop
+        from metrics_tpu.obs import flightrec as _flightrec
+
+        self._flightrec_token = _flightrec.attach_source(
+            f"serve:{type(metric).__name__}", self.health
+        )
+
         self._threads = [
             threading.Thread(target=self._worker, args=(i,), daemon=True, name=f"serve-worker-{i}")
             for i in range(workers)
@@ -372,12 +388,17 @@ class ServeLoop:
         # ``_stats_lock``, so holding both here cannot deadlock.
         shed = None
         with _obs_trace.span("serve.offer"):
+            # the offer span's context rides the queue item: the worker's
+            # update span (another thread) becomes its causal child, so a
+            # request's chain starts here and survives every hop to the
+            # global aggregator's fold (None while tracing is off)
+            ctx = _obs_trace.current_context()
             with self._stats_lock:
                 if self._stopping:
                     raise MetricsTPUUserError("ServeLoop.offer called after stop()")
                 self._offered += 1
                 try:
-                    self._queue.put_nowait((args, kwargs))
+                    self._queue.put_nowait((args, kwargs, ctx))
                     self._accepted += 1
                 except queue.Full:
                     self._shed += 1
@@ -428,7 +449,7 @@ class ServeLoop:
         replica = self._replicas[i]
         while True:
             try:
-                args, kwargs = self._queue.get(timeout=0.05)
+                args, kwargs, offer_ctx = self._queue.get(timeout=0.05)
             except queue.Empty:
                 if self._stop_workers.is_set():
                     return
@@ -444,13 +465,18 @@ class ServeLoop:
                 (m, m._copy_state(), m._update_count, m.jittable_update, _attr_cells(m))
                 for _, m in _members(replica)
             ]
+            update_ctx = None
             try:
                 # the request-latency seam (serve_update_ms): replica update
                 # plus the snapshot build — the full per-request cost on the
-                # worker (the slot write + notify below are trivial)
-                with _obs_trace.span("serve.update", worker=i):
-                    replica.update(*args, **kwargs)
-                    snapshot = _snapshot_of(replica)
+                # worker (the slot write + notify below are trivial). The
+                # offer's context is installed for the span's duration, so
+                # this span is the offer span's causal child across threads.
+                with _obs_trace.trace_context(offer_ctx):
+                    with _obs_trace.span("serve.update", worker=i):
+                        update_ctx = _obs_trace.current_context()
+                        replica.update(*args, **kwargs)
+                        snapshot = _snapshot_of(replica)
             except Exception as err:  # noqa: BLE001 - one bad request must not kill the worker
                 for m, state, count, jittable, attr_cells in bookkeeping:
                     object.__setattr__(m, "_state", state)
@@ -481,6 +507,7 @@ class ServeLoop:
                 # The notify lands after the slot write, so the scheduler's
                 # coverage watermark is always a sound lower bound.
                 self._published[i] = snapshot
+                self._publish_ctx = update_ctx  # newest publish's causal ctx
                 self._scheduler.notify()
             finally:
                 with self._stats_lock:
@@ -503,9 +530,14 @@ class ServeLoop:
         """Scheduler reduce hook: one full clone + fold + compute pass over
         the swept snapshots. Raises on failure — the scheduler then keeps
         the previous view (loudly, via :meth:`_on_reduce_error`) and the
-        next cadence tick retries."""
-        with _obs_trace.span("serve.reduce", snapshots=len(snaps)):
-            return self._reduce_view_inner(snaps)
+        next cadence tick retries. The span links to the NEWEST publish's
+        update span (a reduce fans in many publishes; parent_id cannot hold
+        N edges, so one representative producer carries the causal chain
+        from offer to this fold and onward to any fleet publish)."""
+        with _obs_trace.span("serve.reduce", link_to=self._publish_ctx, snapshots=len(snaps)):
+            out = self._reduce_view_inner(snaps)
+            self._last_reporter_ctx = _obs_trace.current_context()
+            return out
 
     def _reduce_view_inner(self, snaps: List[_Snapshot]) -> Dict[str, Any]:
         reporter = _clone(self._proto)
@@ -720,6 +752,14 @@ class ServeLoop:
         reporter = self._last_reporter
         return None if reporter is None else reporter.snapshot_state()
 
+    def fleet_trace_context(self):
+        """The trace context of the reduce that built the current
+        ``fleet_view()`` reporter — the ``FleetPublisher`` source hook that
+        lets a publish span link back to the reduce it ships (and through
+        it to the offer that fed the reduce). ``None`` while tracing is
+        off or before the first reduce."""
+        return self._last_reporter_ctx
+
     def fleet_extra(self) -> Optional[Dict[str, Any]]:
         """Header extra for this host's fleet publishes (the
         ``FleetPublisher`` source hook, same surface as
@@ -773,6 +813,10 @@ class ServeLoop:
         and its later publishes are lost with the process)."""
         with self._stats_lock:
             self._stopping = True  # offers now raise; accepted set is final
+        # a black-box dump after shutdown must not read a dead loop
+        from metrics_tpu.obs import flightrec as _flightrec
+
+        _flightrec.detach_source(self._flightrec_token)
         if self._warmup is not None:
             # stop compiling between entries; published executables stay valid
             self._warmup.stop(timeout_s=timeout_s)

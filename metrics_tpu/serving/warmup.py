@@ -199,6 +199,16 @@ def _aval_key(tree: Any) -> Any:
     return treedef, tuple(sig)
 
 
+def _leading_rows(call_args: tuple) -> Optional[int]:
+    """The padded request's row count (= its ladder tier): leading axis of
+    the first >=1-dim array leaf of the call's ARGUMENT trees — position 0
+    is the state dict, whose leading axes are state geometry, not tiers
+    (a compute call has no argument tree and reports None)."""
+    from metrics_tpu.ops.padding import leading_rows
+
+    return leading_rows(call_args[1:])
+
+
 def _avals_of(tree: Any) -> Any:
     """The tree with every array leaf replaced by its ``ShapeDtypeStruct``
     (no data, no device buffers) — what ``jit(...).lower`` traces against."""
@@ -310,10 +320,14 @@ class AOTDispatcher:
         table: Dict[Any, "_TableEntry"],
         owner: Optional[Any] = None,
         exact_static: bool = False,
+        kind: str = "update",
     ) -> None:
         self._make_jit = make_jit
         self._jit: Optional[Callable] = None
         self.table = table
+        # wall-time tap name (obs/profile.py's live join): serve_aot_update
+        # / serve_aot_compute, plus the per-ladder-tier _t{rows} histogram
+        self._tap_kind = f"serve_aot_{kind}"
         # weakly held: the dispatcher lives ON the owner metric
         self._owner = weakref.ref(owner) if owner is not None else None
         # exact_static: require the owner's data-inferred slots to EQUAL the
@@ -346,6 +360,23 @@ class AOTDispatcher:
         return _static_compatible(live, entry.static)
 
     def __call__(self, *args: Any) -> Any:
+        from metrics_tpu.obs.trace import tracing_enabled
+
+        if tracing_enabled():
+            # the profiler's live join (obs/profile.py): dispatch wall time
+            # per warmed graph and per padding tier — priced only while
+            # tracing is on, so the warmed hot path stays untouched by
+            # default (the cost of this check is one amortized env read)
+            t0 = time.perf_counter()
+            out = self._dispatch(*args)
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            from metrics_tpu.obs.runtime_metrics import observe_jit_wall
+
+            observe_jit_wall(self._tap_kind, _leading_rows(args), dur_ms)
+            return out
+        return self._dispatch(*args)
+
+    def _dispatch(self, *args: Any) -> Any:
         key = _aval_key(args)
         entry = self.table.get(key)
         if entry is not None:
@@ -582,9 +613,11 @@ class WarmupEngine:
             tables = self._tables.get(member_name)
             if tables is None:
                 continue
-            m._update_jit = AOTDispatcher(m._make_update_jit, tables["update"], owner=m)
+            m._update_jit = AOTDispatcher(
+                m._make_update_jit, tables["update"], owner=m, kind="update"
+            )
             m._compute_jit = AOTDispatcher(
-                m._make_compute_jit, tables["compute"], owner=m, exact_static=True
+                m._make_compute_jit, tables["compute"], owner=m, exact_static=True, kind="compute"
             )
 
     # -- lifecycle ---------------------------------------------------------

@@ -1,0 +1,177 @@
+"""Compiled-graph cost profiler (ISSUE 15): HLO collective-payload
+parsing goldens, the fused 4-metric registry entry's cost-table row, the
+per-ladder-tier wall rows, CLI round trip, and full-registry coverage
+(slow lane)."""
+import json
+
+import pytest
+
+from metrics_tpu.obs import profile as prof
+
+pytestmark = [pytest.mark.analysis, pytest.mark.obs]
+
+
+# --------------------------------------------------------------------------
+# collective payload parsing: synthetic-HLO goldens
+# --------------------------------------------------------------------------
+
+
+def test_payload_bytes_parses_result_shapes_only():
+    hlo = "\n".join(
+        [
+            "  %x = f32[128]{0} parameter(0)",
+            "  %all-reduce.1 = f32[128]{0} all-reduce(f32[128]{0} %x), to_apply=%add",
+            "  %all-gather.2 = u32[4,8]{1,0} all-gather(u32[1,8]{1,0} %y), dimensions={0}",
+        ]
+    )
+    payload = prof.collective_payload_bytes(hlo)
+    assert payload["all-reduce"] == 128 * 4  # the RESULT shape, not operands twice
+    assert payload["all-gather"] == 4 * 8 * 4
+    assert payload["reduce-scatter"] == 0
+
+
+def test_payload_bytes_counts_tuple_and_async_forms_once():
+    hlo = "\n".join(
+        [
+            # a combined tuple-shaped all-reduce (optimized HLO merges
+            # compatible ops): both members sum
+            "  %all-reduce.3 = (s8[512]{0}, u32[6]{0}) all-reduce(s8[512]{0} %a, u32[6]{0} %b)",
+            # an async pair: the -start carries the payload, -done must not
+            # double-count
+            "  %all-reduce-start.4 = f16[32]{0} all-reduce-start(f16[32]{0} %c)",
+            "  %all-reduce-done.5 = f16[32]{0} all-reduce-done(f16[32]{0} %all-reduce-start.4)",
+        ]
+    )
+    payload = prof.collective_payload_bytes(hlo)
+    assert payload["all-reduce"] == 512 * 1 + 6 * 4 + 32 * 2
+
+
+def test_payload_bytes_scalar_and_empty_shapes():
+    hlo = "  %all-reduce.9 = f32[] all-reduce(f32[] %s)"
+    assert prof.collective_payload_bytes(hlo)["all-reduce"] == 4
+
+
+# --------------------------------------------------------------------------
+# the fused 4-metric registry entry: THE golden row
+# --------------------------------------------------------------------------
+
+
+def _entry(name):
+    from metrics_tpu.analysis.registry import REGISTRY
+
+    return next(e for e in REGISTRY if e.name == name)
+
+
+def test_fused_collection_cost_row_golden():
+    """The ISSUE 15 acceptance row: the fused 4-metric collection's cost
+    table entry carries real static costs (XLA's own model), EXACTLY one
+    all-reduce whose payload-byte count matches an independent parse of
+    the same compiled HLO, and QuantileSketch wall quantiles."""
+    entry = _entry("fused_stat_collection")
+    row = prof.profile_entry(entry, ndev=4, reps=4)
+    assert row["entry"] == "fused_stat_collection"
+    assert row["flops"] and row["flops"] > 0
+    assert row["bytes_accessed"] and row["bytes_accessed"] > 0
+    # the fused_sync north star: ONE all-reduce, and its payload is what
+    # the independent HLO parse says it is
+    assert row["collectives"] == {"all-reduce": 1}
+    _fn, args, compiled = prof._compiled_of(entry, 4)
+    independent = prof.collective_payload_bytes(compiled.as_text())
+    assert row["collective_bytes"]["all-reduce"] == independent["all-reduce"] > 0
+    assert row["collective_bytes_total"] == independent["all-reduce"]
+    wall = row["wall"]
+    assert wall["reps"] == 4
+    assert 0 < wall["p50_ms"] <= wall["p99_ms"]
+
+
+def test_zero_collective_entry_reports_empty_payload():
+    row = prof.profile_entry(_entry("auroc_capacity_step"), ndev=4, reps=2)
+    assert row["collectives"] == {} and row["collective_bytes_total"] == 0
+    assert row["flops"] and row["flops"] > 0
+
+
+def test_ladder_entry_gets_per_tier_wall_rows():
+    row = prof.profile_entry(_entry("ladder_served_update"), ndev=4, reps=2, tier_reps=2)
+    # _SERVE_LADDER tiers exactly — the sweep's 13 ragged sizes pad to 3
+    assert sorted(int(t) for t in row["tiers"]) == [8, 32, 128]
+    for tier_row in row["tiers"].values():
+        assert 0 < tier_row["p50_ms"] <= tier_row["p99_ms"]
+
+
+def test_recompile_only_entry_still_gets_a_row():
+    row = prof.profile_entry(_entry("mean_update_stability"), ndev=4, reps=2)
+    assert row["flops"] and row["flops"] > 0
+    assert row["wall"]["reps"] == 2
+
+
+def test_traced_fleet_publish_entry_profiles_and_audits():
+    """The new registry entry: id-propagating tracing adds nothing to the
+    compiled graph (audit passes) and its cost row matches the
+    uninstrumented guarded collection's collective structure."""
+    from metrics_tpu.analysis.registry import run_graph_audit
+
+    entry = _entry("traced_fleet_publish")
+    assert run_graph_audit((entry,)) == []
+    row = prof.profile_entry(entry, ndev=4, reps=2)
+    assert row["collectives"].get("all-reduce", 0) <= 2
+    assert row["collective_bytes_total"] > 0
+
+
+# --------------------------------------------------------------------------
+# table / persistence / CLI
+# --------------------------------------------------------------------------
+
+
+def test_profile_doc_renders_and_writes_atomically(tmp_path):
+    entries = (_entry("fused_stat_collection"),)
+    doc = prof.profile_registry(entries, ndev=4, reps=2)
+    table = prof.render_table(doc)
+    assert "fused_stat_collection" in table and "wall p50" in table
+    out = tmp_path / "COST_PROFILE.json"
+    path = prof.write_profile(doc, str(out))
+    loaded = json.loads(out.read_text())
+    assert path == str(out)
+    assert loaded["entries"][0]["entry"] == "fused_stat_collection"
+    assert loaded["platform"] == "cpu"
+
+
+def test_cli_profile_subcommand(tmp_path, capsys):
+    from metrics_tpu.analysis.__main__ import main
+
+    out = tmp_path / "table.json"
+    rc = main(
+        [
+            "profile",
+            "--entry",
+            "fused_stat_collection",
+            "--reps",
+            "2",
+            "--out",
+            str(out),
+        ]
+    )
+    assert rc == 0
+    assert json.loads(out.read_text())["entries"][0]["collectives"] == {"all-reduce": 1}
+    assert "fused_stat_collection" in capsys.readouterr().out
+
+
+def test_cli_profile_unknown_entry_fails_loudly(capsys):
+    from metrics_tpu.analysis.__main__ import main
+
+    rc = main(["profile", "--entry", "no_such_entry", "--no-write"])
+    assert rc == 1
+    assert "no_such_entry" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_full_registry_profile_covers_every_entry():
+    """The `make profile` form: one cost row per registry entry (15+),
+    each with static costs and wall quantiles present."""
+    from metrics_tpu.analysis.registry import REGISTRY
+
+    doc = prof.profile_registry(ndev=4, reps=2, tier_reps=2)
+    assert len(doc["entries"]) == len(REGISTRY) >= 15
+    names = {row["entry"] for row in doc["entries"]}
+    assert names == {e.name for e in REGISTRY}
+    for row in doc["entries"]:
+        assert row["wall"]["p50_ms"] > 0, row["entry"]

@@ -1,0 +1,338 @@
+"""Fleet-correlated tracing (ISSUE 15): the publisher ships causal
+context + clock pairing + incremental timeline deltas in the wire header
+extra, the aggregator accumulates per-host sections and links its fold,
+``GET /trace.json`` serves ONE merged Perfetto document, and an
+end-to-end in-process run shows the causal chain from a ServeLoop offer
+to the aggregator's fold."""
+import json
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.fleet import Aggregator, FleetPublisher, FleetServer
+from metrics_tpu.fleet.wire import decode_view
+from metrics_tpu.obs import runtime_metrics as rm
+from metrics_tpu.obs import trace
+from metrics_tpu.resilience.health import registry as health_registry
+from tests.helpers.fault_injection import DeadChannel, RecordingChannel
+
+pytestmark = [pytest.mark.fleet, pytest.mark.obs]
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    health_registry.clear()
+    trace.reset_trace_state()
+    rm.registry.reset()
+    yield
+    health_registry.clear()
+    trace.reset_trace_state()
+    rm.registry.reset()
+
+
+def _metric(seed: int = 0, n: int = 32):
+    rng = np.random.default_rng(seed)
+    m = mt.Accuracy(num_classes=4)
+    m.update(jnp.asarray(rng.integers(0, 4, n)), jnp.asarray(rng.integers(0, 4, n)))
+    return m
+
+
+# --------------------------------------------------------------------------
+# the wire extra: ctx + clock + incremental events
+# --------------------------------------------------------------------------
+
+
+def test_publisher_ships_trace_section_when_tracing_on():
+    channel = RecordingChannel()
+    pub = FleetPublisher(_metric(), channel, host_id="host-0", start=False)
+    with trace.force_tracing(True):
+        with trace.span("pre.publish"):
+            pass
+        pub.publish_now()
+    header, _payload = decode_view(channel.blobs[-1])
+    section = header["extra"]["trace"]
+    # the ACTIVE publish span's ctx — what the aggregator's fold links to
+    assert section["ctx"]["trace_id"] and section["ctx"]["span_id"]
+    assert {"mono_ns", "unix"} <= set(section["clock"])
+    names = [e["name"] for e in section["events"]]
+    assert "pre.publish" in names and "process_name" in names
+    pub.stop(flush=False)
+
+
+def test_publisher_ships_nothing_when_tracing_off():
+    channel = RecordingChannel()
+    pub = FleetPublisher(_metric(), channel, host_id="host-0", start=False)
+    pub.publish_now()
+    header, _payload = decode_view(channel.blobs[-1])
+    assert (header.get("extra") or {}).get("trace") is None
+    pub.stop(flush=False)
+
+
+def test_publisher_ships_incremental_deltas():
+    channel = RecordingChannel()
+    pub = FleetPublisher(_metric(), channel, host_id="host-0", start=False)
+    with trace.force_tracing(True):
+        with trace.span("first.window"):
+            pass
+        pub.publish_now()
+        with trace.span("second.window"):
+            pass
+        pub.publish_now()
+    first = decode_view(channel.blobs[0])[0]["extra"]["trace"]["events"]
+    second = decode_view(channel.blobs[1])[0]["extra"]["trace"]["events"]
+    assert "first.window" in [e["name"] for e in first]
+    second_spans = [e["name"] for e in second if e.get("ph") in ("X", "i")]
+    # the second publish ships only records newer than the watermark
+    assert "second.window" in second_spans and "first.window" not in second_spans
+    pub.stop(flush=False)
+
+
+def test_partial_failure_reships_delta_to_all():
+    """With two destinations, a pass where one fails must NOT commit the
+    trace cursor: committing on the first success would leave the failed
+    destination permanently missing the delta. The healthy destination
+    sees the overlap again (ingest dedup folds it once)."""
+    good = RecordingChannel()
+    pub = FleetPublisher(
+        _metric(),
+        {"good": good, "dead": DeadChannel()},
+        host_id="host-0",
+        start=False,
+        deadline_s=0.2,
+        max_retries=0,
+        stale_after_s=60.0,
+    )
+    with trace.force_tracing(True):
+        with trace.span("must.reach.everyone"):
+            pass
+        pub.publish_now()
+        pub.publish_now()
+    assert len(good.blobs) == 2
+    header, _payload = decode_view(good.blobs[1])
+    names = [e["name"] for e in (header["extra"].get("trace") or {}).get("events") or []]
+    assert "must.reach.everyone" in names, "failed destination's miss was committed away"
+    pub.stop(flush=False)
+
+
+def test_duplicate_view_still_folds_trace_delta():
+    """A duplicate VIEW seq (restart seq regression, retry re-delivery)
+    can carry a FRESH timeline delta — and the publisher treats the
+    duplicate answer as delivered, so the aggregator must fold the
+    section instead of dropping it with the view."""
+    from metrics_tpu.fleet.wire import encode_view
+
+    agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+    payload = _metric().snapshot_state()
+    clock = {"mono_ns": 0, "unix": 0.0}
+    ev = {"ph": "X", "name": "during.regression", "tid": 1, "ts": 1.0, "dur": 2.0}
+    b1 = encode_view(
+        payload, host_id="host-0", seq=5, updates=1,
+        extra={"trace": {"ctx": None, "clock": clock, "events": []}},
+    )
+    b2 = encode_view(
+        payload, host_id="host-0", seq=5, updates=1,
+        extra={"trace": {"ctx": None, "clock": clock, "events": [ev]}},
+    )
+    assert agg.ingest(b1) == "accepted"
+    assert agg.ingest(b2).startswith("duplicate")
+    events = list(agg._trace_sections["host-0"]["events"])
+    assert any(e.get("name") == "during.regression" for e in events)
+
+
+def test_over_cap_burst_drains_across_cadences(monkeypatch):
+    """A burst larger than the per-publish event cap ships OLDEST first,
+    so the committed cursor stays contiguous and later cadences drain the
+    tail — a newest-first cap would commit past the tail and skip it
+    forever."""
+    from metrics_tpu.fleet import publisher as pub_mod
+
+    monkeypatch.setattr(pub_mod, "_TRACE_EVENTS_PER_PUBLISH", 4)
+    channel = RecordingChannel()
+    pub = FleetPublisher(_metric(), channel, host_id="host-0", start=False)
+    with trace.force_tracing(True):
+        trace.clear_trace()
+        for i in range(6):
+            with trace.span(f"burst.{i}"):
+                pass
+        for _ in range(5):  # each publish drains <= 4, appends its own span
+            pub.publish_now()
+    shipped = []
+    for blob in channel.blobs:
+        header, _payload = decode_view(blob)
+        events = (header["extra"].get("trace") or {}).get("events") or []
+        shipped += [e["name"] for e in events if e.get("ph") in ("X", "i")]
+    for i in range(6):
+        assert f"burst.{i}" in shipped, f"burst.{i} never drained"
+    pub.stop(flush=False)
+
+
+# --------------------------------------------------------------------------
+# publisher self-metrics (the ISSUE 15 satellite)
+# --------------------------------------------------------------------------
+
+
+def test_publish_bytes_and_duration_histograms():
+    channel = RecordingChannel()
+    pub = FleetPublisher(
+        _metric(), {"pod-a": channel}, host_id="host-0", start=False
+    )
+    pub.publish_now()
+    pub.publish_now()
+    hists = rm.registry.histograms()
+    assert hists["fleet_publish_bytes"].count == 2
+    # the observed sizes ARE the wire blobs' sizes
+    sizes = {len(b) for b in channel.blobs}
+    q = hists["fleet_publish_bytes"].quantiles((0.5,))[0.5]
+    assert min(sizes) <= q <= max(sizes)
+    assert hists["fleet_publish_ms"].count == 2
+    assert hists["fleet_publish_ms_pod_a"].count == 2  # per destination
+    pub.stop(flush=False)
+    # the export surface carries them (scrape() renders the runtime registry)
+    from metrics_tpu.obs.export import prometheus_text
+
+    text = prometheus_text()
+    assert "metrics_tpu_fleet_publish_bytes_count 2" in text
+    assert 'metrics_tpu_fleet_publish_ms_pod_a{quantile="0.5"}' in text
+
+
+def test_failed_push_still_observes_duration():
+    pub = FleetPublisher(
+        _metric(),
+        {"dead": DeadChannel()},
+        host_id="host-0",
+        start=False,
+        deadline_s=0.2,
+        max_retries=0,
+        stale_after_s=60.0,
+    )
+    pub.publish_now()
+    hists = rm.registry.histograms()
+    assert hists["fleet_publish_ms_dead"].count == 1  # the budget wall was paid
+    pub.stop(flush=False)
+
+
+# --------------------------------------------------------------------------
+# aggregator: accumulation, fold link, merged document
+# --------------------------------------------------------------------------
+
+
+def test_aggregator_merges_host_sections_and_links_fold():
+    agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+    channel = RecordingChannel(agg.ingest)
+    pub = FleetPublisher(_metric(), channel, host_id="host-0", start=False)
+    with trace.force_tracing(True):
+        pub.publish_now()
+        report = agg.report()  # runs the fold under tracing
+    assert report["updates"] == 1  # one update call folded from host-0
+    doc = agg.fleet_trace()
+    events = doc["traceEvents"]
+    process_names = {
+        e["args"]["name"] for e in events if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert {"host-0", "aggregator:global"} <= process_names
+    # the fold span links to the publish span shipped in the wire header
+    publish_ctx = decode_view(channel.blobs[-1])[0]["extra"]["trace"]["ctx"]
+    fold = next(r for r in trace.trace_records("fleet.fold"))
+    assert fold.link == (publish_ctx["trace_id"], publish_ctx["span_id"])
+    # and the merged doc carries a flow arrow keyed on the publish span
+    assert any(
+        e.get("cat") == "causal" and e["ph"] == "f" and e["id"] == publish_ctx["span_id"]
+        for e in events
+    )
+    pub.stop(flush=False)
+
+
+def test_pod_forwards_child_timelines_upward():
+    """Multi-hop: a pod aggregator's fleet_extra forwards its hosts'
+    timeline sections, so the global node's merged trace names a LEAF host
+    it never met directly."""
+    pod = Aggregator(mt.Accuracy(num_classes=4), node_id="pod-0")
+    pod_channel = RecordingChannel(pod.ingest)
+    host_pub = FleetPublisher(_metric(), pod_channel, host_id="leaf-7", start=False)
+    glob = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+    glob_channel = RecordingChannel(glob.ingest)
+    pod_pub = FleetPublisher(pod, glob_channel, host_id="pod-0", start=False)
+    with trace.force_tracing(True):
+        host_pub.publish_now()
+        pod_pub.publish_now()
+    doc = glob.fleet_trace()
+    process_names = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert "leaf-7" in process_names  # the leaf crossed two hops
+    host_pub.stop(flush=False)
+    pod_pub.stop(flush=False)
+
+
+def test_trace_json_endpoint_serves_merged_document():
+    agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+    with FleetServer(agg) as server:
+        pub = FleetPublisher(
+            _metric(), server.channel(), host_id="host-0", start=False
+        )
+        with trace.force_tracing(True):
+            pub.publish_now()
+            agg.report()
+        with urllib.request.urlopen(f"{server.url}/trace.json", timeout=10) as resp:
+            doc = json.loads(resp.read())
+        pub.stop(flush=False)
+    assert "traceEvents" in doc
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "fleet.publish" in names and "fleet.fold" in names
+
+
+# --------------------------------------------------------------------------
+# THE end-to-end causal chain: offer → update → reduce → publish → fold
+# --------------------------------------------------------------------------
+
+
+def test_causal_chain_offer_to_fold():
+    rng = np.random.default_rng(3)
+    agg = Aggregator(mt.Accuracy(num_classes=4), node_id="global")
+    channel = RecordingChannel(agg.ingest)
+    with trace.force_tracing(True):
+        loop = mt.ServeLoop(mt.Accuracy(num_classes=4), workers=1, reduce_every_s=0.05)
+        pub = FleetPublisher(loop, channel, host_id="host-0", start=False)
+        try:
+            assert loop.offer(
+                jnp.asarray(rng.random((16, 4), dtype=np.float32)),
+                jnp.asarray(rng.integers(0, 4, 16).astype(np.int32)),
+            )
+            assert loop.drain(30)
+            assert loop.report(fresh=True, deadline_s=30.0)["updates"] == 1
+            pub.publish_now()
+            agg.report()
+        finally:
+            pub.stop(flush=False)
+            loop.stop()
+    by_span = {r.span_id: r for r in trace.trace_records() if r.span_id is not None}
+    recs = {r.name: r for r in trace.trace_records()}
+    offer, update = recs["serve.offer"], recs["serve.update"]
+    reduce_rec, publish = recs["serve.reduce"], recs["fleet.publish"]
+    fold = recs["fleet.fold"]
+    # the chain, edge by edge: worker update is the offer's child across
+    # threads; the reduce links the newest publish (the update span); the
+    # fleet publish links the reduce; the fold links the publish
+    assert update.parent_id == offer.span_id and update.trace_id == offer.trace_id
+    assert reduce_rec.link is not None and by_span[reduce_rec.link[1]].name == "serve.update"
+    assert publish.link is not None and by_span[publish.link[1]].name == "serve.reduce"
+    assert fold.link is not None and by_span[fold.link[1]].name == "fleet.publish"
+    # walking the links, the offer is the fold's causal ancestor
+    def ancestors(rec):
+        seen = set()
+        frontier = [rec]
+        while frontier:
+            r = frontier.pop()
+            for edge in (r.parent_id, r.link[1] if r.link else None):
+                if edge is not None and edge not in seen and edge in by_span:
+                    seen.add(edge)
+                    frontier.append(by_span[edge])
+        return {by_span[s].name for s in seen}
+
+    assert "serve.offer" in ancestors(fold)

@@ -1,0 +1,311 @@
+"""Degradation flight recorder (ISSUE 15): dump→load round trip, the
+torn-write survivor, episode gating, informational-kind exclusion, env
+arming on the warn-once contract, rolling retention, and the ServeLoop
+source attach (warmup/serving state in the black box)."""
+import json
+import os
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.obs import flightrec, trace
+from metrics_tpu.obs import runtime_metrics as rm
+from metrics_tpu.resilience.health import record_degradation
+from metrics_tpu.resilience.health import registry as health_registry
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_FLIGHTREC_DIR", raising=False)
+    monkeypatch.delenv("METRICS_TPU_FLIGHTREC_KEEP", raising=False)
+    monkeypatch.delenv("METRICS_TPU_TRACE", raising=False)
+    flightrec.reset_flightrec_state()
+    trace.reset_trace_state()
+    rm.registry.reset()
+    health_registry.clear()
+    yield
+    flightrec.reset_flightrec_state()
+    trace.reset_trace_state()
+    rm.registry.reset()
+    health_registry.clear()
+
+
+def _arm(tmp_path, **kwargs):
+    rec = flightrec.FlightRecorder(str(tmp_path), **kwargs)
+    flightrec.install_flight_recorder(rec)
+    return rec
+
+
+# --------------------------------------------------------------------------
+# dump → load round trip
+# --------------------------------------------------------------------------
+
+
+def test_degraded_event_dumps_and_round_trips(tmp_path):
+    rec = _arm(tmp_path)
+    with trace.force_tracing(True):
+        with trace.span("pre.incident", metric="Accuracy"):
+            pass
+        record_degradation("gather_degraded", "fell back to local", attempts=2)
+        rec.flush()  # degraded-edge dumps run off-thread; join before reading
+    (payload,) = flightrec.load_flight_records(str(tmp_path))
+    # the dump NAMES the degrading event kind (the acceptance wording)
+    assert payload["trigger"]["kind"] == "gather_degraded"
+    assert payload["trigger"]["reason"] == "degraded-edge"
+    assert payload["event_kinds"]["gather_degraded"]["count"] == 1
+    assert any(e["kind"] == "gather_degraded" for e in payload["events"])
+    # recent spans ride along, causal ids included
+    span_names = [s["name"] for s in payload["spans"]]
+    assert "pre.incident" in span_names
+    assert all("span_id" in s for s in payload["spans"])
+    # and the last scrape a production scraper would have read
+    assert "metrics_tpu_health_degraded 1" in payload["scrape"]
+
+
+def test_informational_kinds_never_dump(tmp_path):
+    _arm(tmp_path)
+    record_degradation("serve_warmup_done", "warmed 4 graphs")
+    record_degradation("drift_baseline_loaded", "reference attached")
+    assert flightrec.load_flight_records(str(tmp_path)) == []
+
+
+def test_episode_gating_one_dump_per_kind_per_interval(tmp_path):
+    rec = _arm(tmp_path, min_interval_s=3600.0)
+    for i in range(5):
+        record_degradation("overload_shed", f"shed {i}")
+    record_degradation("serve_update_error", "poison request")
+    rec.flush()
+    payloads = flightrec.load_flight_records(str(tmp_path))
+    kinds = sorted(p["trigger"]["kind"] for p in payloads)
+    # the flood dumped once; the DISTINCT kind still got its own dump
+    assert kinds == ["overload_shed", "serve_update_error"]
+
+
+def test_rolling_retention_keeps_newest_k(tmp_path):
+    rec = _arm(tmp_path, keep=3, min_interval_s=0.0)
+    for i in range(7):
+        rec.dump("snapshot_fallback", f"dump {i}")
+    payloads = flightrec.load_flight_records(str(tmp_path))
+    assert len(payloads) == 3
+    assert payloads[0]["trigger"]["message"] == "dump 6"  # newest first
+
+
+def test_shared_dir_retention_is_per_pid(tmp_path, monkeypatch):
+    """Two processes sharing one dump directory (one env var per node):
+    filenames are pid-tagged so same-millisecond dumps cannot clobber each
+    other, and pruning keeps last-K PER pid — a surviving process must
+    never eat a dead sibling's black box."""
+    rec = _arm(tmp_path, keep=2, min_interval_s=0.0)
+    monkeypatch.setattr("os.getpid", lambda: 11111)  # the "dead sibling"
+    rec.dump("gather_degraded", "dead sibling 0")
+    rec.dump("gather_degraded", "dead sibling 1")
+    monkeypatch.undo()  # back to the real pid
+    for i in range(4):
+        rec.dump("overload_shed", f"live {i}")
+    msgs = [p["trigger"]["message"] for p in flightrec.load_flight_records(str(tmp_path))]
+    assert "dead sibling 0" in msgs and "dead sibling 1" in msgs  # untouched
+    assert sum(m.startswith("live") for m in msgs) == 2  # own window pruned
+
+
+def test_torn_write_survivor(tmp_path):
+    """A torn/bit-flipped newest dump is skipped loudly; the older intact
+    dumps keep loading — one bad file never hides the history."""
+    rec = _arm(tmp_path, min_interval_s=0.0)
+    rec.dump("snapshot_fallback", "intact older")
+    newest = rec.dump("gather_degraded", "will be torn")
+    blob = open(newest, "rb").read()
+    with open(newest, "wb") as f:
+        f.write(blob[: len(blob) // 2])  # SIGKILL-shaped truncation
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        payloads = flightrec.load_flight_records(str(tmp_path))
+    assert [p["trigger"]["message"] for p in payloads] == ["intact older"]
+    assert any("corrupt" in str(w.message) for w in caught)
+    with pytest.raises(flightrec.FlightRecordError, match="unreadable|checksum"):
+        flightrec.load_flight_record(newest)
+
+
+def test_bit_flip_fails_checksum(tmp_path):
+    rec = _arm(tmp_path, min_interval_s=0.0)
+    path = rec.dump("gather_degraded", "to be flipped")
+    doc = json.loads(open(path).read())
+    doc["payload"]["trigger"]["message"] = "tampered"
+    with open(path, "w") as f:
+        f.write(json.dumps(doc))
+    with pytest.raises(flightrec.FlightRecordError, match="checksum"):
+        flightrec.load_flight_record(path)
+
+
+# --------------------------------------------------------------------------
+# arming: env contract + process-exit dump
+# --------------------------------------------------------------------------
+
+
+def test_env_var_arms_the_recorder(tmp_path, monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_FLIGHTREC_DIR", str(tmp_path))
+    record_degradation("forced_cpu", "probe fallback")
+    flightrec.active_flight_recorder().flush()
+    (payload,) = flightrec.load_flight_records(str(tmp_path))
+    assert payload["trigger"]["kind"] == "forced_cpu"
+
+
+def test_unusable_env_dir_warns_once_and_degrades(tmp_path, monkeypatch):
+    bad = tmp_path / "not_a_dir"
+    bad.write_text("a FILE where a directory should be")
+    monkeypatch.setenv("METRICS_TPU_FLIGHTREC_DIR", str(bad))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        record_degradation("forced_cpu", "first")
+        record_degradation("gather_degraded", "second")
+    assert sum("METRICS_TPU_FLIGHTREC_DIR" in str(w.message) for w in caught) == 1
+    # the degradations themselves recorded fine — forensics degraded, not serving
+    assert health_registry.counts() == {"forced_cpu": 1, "gather_degraded": 1}
+
+
+def test_programmatic_recorder_beats_env(tmp_path, monkeypatch):
+    env_dir = tmp_path / "env"
+    env_dir.mkdir()
+    prog_dir = tmp_path / "prog"
+    prog_dir.mkdir()
+    monkeypatch.setenv("METRICS_TPU_FLIGHTREC_DIR", str(env_dir))
+    rec = _arm(prog_dir)
+    record_degradation("gather_degraded", "routed to the programmatic recorder")
+    rec.flush()
+    assert flightrec.load_flight_records(str(prog_dir))
+    assert flightrec.load_flight_records(str(env_dir)) == []
+
+
+def test_exit_dump_writes_shutdown_record(tmp_path):
+    _arm(tmp_path)
+    path = flightrec._exit_dump(reason="atexit")
+    payload = flightrec.load_flight_record(path)
+    assert payload["trigger"]["kind"] == "shutdown"
+    assert payload["trigger"]["reason"] == "atexit"
+
+
+def test_sigterm_arm_retries_until_main_thread(monkeypatch):
+    """The FIRST arm often runs on a worker thread (the env recorder
+    resolves lazily from a health event recorded by a serve worker), where
+    ``signal.signal`` raises — the SIGTERM half must stay un-armed there
+    and retry on a later main-thread arm, not be marked done and lost for
+    the life of the process."""
+    import signal as _signal
+    import threading
+
+    prev_handler = _signal.getsignal(_signal.SIGTERM)
+    monkeypatch.setattr(flightrec, "_atexit_armed", True)  # keep atexit single
+    monkeypatch.setattr(flightrec, "_sigterm_armed", False)
+    monkeypatch.setattr(flightrec, "_prev_sigterm", None)
+    try:
+        t = threading.Thread(target=flightrec._arm_process_hooks)
+        t.start()
+        t.join()
+        assert flightrec._sigterm_armed is False  # could not install there
+        flightrec._arm_process_hooks()  # a later main-thread arm succeeds
+        assert flightrec._sigterm_armed is True
+        assert _signal.getsignal(_signal.SIGTERM) is flightrec._on_sigterm
+    finally:
+        _signal.signal(_signal.SIGTERM, prev_handler)
+
+
+def test_keep_env_knob_malformed_warns_and_defaults(tmp_path, monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_FLIGHTREC_KEEP", "many")
+    rec = _arm(tmp_path, min_interval_s=0.0)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert rec.keep == 8  # the default window
+    assert any("METRICS_TPU_FLIGHTREC_KEEP" in str(w.message) for w in caught)
+
+
+# --------------------------------------------------------------------------
+# sources: live state riding the black box
+# --------------------------------------------------------------------------
+
+
+def test_sources_ride_the_dump_and_failures_degrade(tmp_path):
+    rec = _arm(tmp_path, min_interval_s=0.0)
+    tok_ok = flightrec.attach_source("good", lambda: {"answer": 42})
+
+    def bad():
+        raise RuntimeError("source died")
+
+    tok_bad = flightrec.attach_source("bad", bad)
+    try:
+        path = rec.dump("gather_degraded", "x")
+        payload = flightrec.load_flight_record(path)
+        assert payload["sources"]["good"] == {"answer": 42}
+        assert "RuntimeError: source died" in payload["sources"]["bad"]["error"]
+    finally:
+        flightrec.detach_source(tok_ok)
+        flightrec.detach_source(tok_bad)
+
+
+def test_serve_loop_health_rides_the_dump(tmp_path):
+    """Killing a degraded host must leave a dump that shows the serving +
+    warmup state: ServeLoop attaches its health() as a source for its
+    lifetime (and detaches on stop, so later dumps read no dead loop)."""
+    rec = _arm(tmp_path, min_interval_s=0.0)
+    rng = np.random.default_rng(0)
+    loop = mt.ServeLoop(mt.Accuracy(num_classes=4), workers=1)
+    try:
+        loop.offer(
+            jnp.asarray(rng.random((8, 4), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 4, 8).astype(np.int32)),
+        )
+        assert loop.drain(30)
+        path = rec.dump("serve_update_error", "simulated incident")
+        payload = flightrec.load_flight_record(path)
+        (serve_key,) = [k for k in payload["sources"] if k.startswith("serve:")]
+        serving = payload["sources"][serve_key]["serving"]
+        assert serving["accepted"] == 1
+        assert "warmup" in serving and "sync" in serving
+    finally:
+        loop.stop()
+    # post-stop dumps no longer carry the detached loop
+    payload = flightrec.load_flight_record(rec.dump("gather_degraded", "after stop"))
+    assert not any(k.startswith("serve:") for k in payload["sources"])
+
+
+def test_dump_failure_warns_once_never_raises(tmp_path, monkeypatch):
+    rec = _arm(tmp_path, min_interval_s=0.0)
+
+    def broken_write(path, blob):  # the disk went away after arming
+        raise OSError("No space left on device")
+
+    monkeypatch.setattr(flightrec, "atomic_write_bytes", broken_write)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert rec.dump("gather_degraded", "x") is None
+        assert rec.dump("gather_degraded", "y") is None
+    assert sum("flight-recorder dump" in str(w.message) for w in caught) == 1
+    assert rec.stats()["failed"] == 2
+
+
+def test_listener_reentrancy_guard(tmp_path):
+    """A dump triggered by an event that itself records an event (via a
+    source provider) must not recurse into a second dump on the same
+    thread."""
+    rec = _arm(tmp_path, min_interval_s=0.0)
+
+    def noisy_source():
+        record_degradation("gather_degraded", "recorded mid-dump")
+        return {"ok": True}
+
+    tok = flightrec.attach_source("noisy", noisy_source)
+    try:
+        record_degradation("serve_update_error", "outer trigger")
+        rec.flush()
+    finally:
+        flightrec.detach_source(tok)
+    payloads = flightrec.load_flight_records(str(tmp_path))
+    assert [p["trigger"]["kind"] for p in payloads] == ["serve_update_error"]
+    # the mid-dump event still landed in the registry (only the DUMP was
+    # suppressed), so the evidence is in the payload's event list
+    assert health_registry.counts()["gather_degraded"] == 1

@@ -146,10 +146,41 @@ def test_chrome_trace_export_is_valid_trace_event_json(tmp_path, monkeypatch):
     doc = json.loads(trace.export_chrome_trace(str(path)))
     assert json.loads(path.read_text()) == doc
     events = doc["traceEvents"]
-    assert len(events) == 2
     complete = next(e for e in events if e["name"] == "phase.a")
     assert complete["ph"] == "X" and complete["dur"] > 0
-    assert complete["args"] == {"metric": "Accuracy"}
+    assert complete["args"]["metric"] == "Accuracy"
+    assert {"trace_id", "span_id"} <= set(complete["args"])  # causal ids ride args
     assert {"pid", "tid", "ts"} <= set(complete)
     marker = next(e for e in events if e["name"] == "phase.marker")
-    assert marker["ph"] == "i" and marker["args"] == {"n": 3}
+    assert marker["ph"] == "i" and marker["args"]["n"] == 3
+
+
+def test_chrome_trace_export_names_processes_and_threads(monkeypatch):
+    """The ISSUE 15 readability satellite: metadata rows name the process
+    (host_id when given) and every seen thread, so a merged fleet trace
+    reads as named tracks instead of bare integer pids/tids."""
+    monkeypatch.setenv("METRICS_TPU_TRACE", "1")
+    done = threading.Event()
+
+    def side_thread():
+        with trace.span("side.work"):
+            done.set()
+
+    t = threading.Thread(target=side_thread, name="named-side-thread")
+    t.start()
+    t.join()
+    assert done.is_set()
+    with trace.span("main.work"):
+        pass
+    events = trace.chrome_trace_events(host_id="host-7")
+    proc = next(e for e in events if e["name"] == "process_name")
+    assert proc["ph"] == "M" and proc["args"]["name"] == "host-7"
+    thread_names = {
+        e["args"]["name"] for e in events if e["name"] == "thread_name" and e["ph"] == "M"
+    }
+    assert "named-side-thread" in thread_names
+    # default process naming (no host_id): still a named process row
+    default_proc = next(
+        e for e in trace.chrome_trace_events() if e["name"] == "process_name"
+    )
+    assert "pid" in default_proc["args"]["name"]

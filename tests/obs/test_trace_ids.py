@@ -1,0 +1,250 @@
+"""Causal trace ids (ISSUE 15): thread-local propagation with no
+cross-thread parent leaks (8-thread hammering), explicit cross-thread
+handoff via ``trace_context``, fan-in links, Perfetto flow/metadata
+export, and the multi-host timeline merge."""
+import json
+import threading
+
+import pytest
+
+from metrics_tpu.obs import trace
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_TRACE", raising=False)
+    monkeypatch.delenv("METRICS_TPU_TRACE_BUFFER", raising=False)
+    trace.reset_trace_state()
+    yield
+    trace.reset_trace_state()
+
+
+# --------------------------------------------------------------------------
+# id assignment + nesting
+# --------------------------------------------------------------------------
+
+
+def test_nested_spans_share_trace_and_chain_parents():
+    with trace.force_tracing(True):
+        with trace.span("root"):
+            with trace.span("child"):
+                trace.instant("leaf")
+    recs = {r.name: r for r in trace.trace_records()}
+    root, child, leaf = recs["root"], recs["child"], recs["leaf"]
+    assert root.parent_id is None and root.trace_id is not None
+    assert child.trace_id == root.trace_id and child.parent_id == root.span_id
+    assert leaf.trace_id == root.trace_id and leaf.parent_id == child.span_id
+    assert len({root.span_id, child.span_id, leaf.span_id}) == 3
+
+
+def test_sibling_roots_get_distinct_traces():
+    with trace.force_tracing(True):
+        with trace.span("a"):
+            pass
+        with trace.span("b"):
+            pass
+    a, b = trace.trace_records()
+    assert a.trace_id != b.trace_id
+    assert a.parent_id is None and b.parent_id is None
+
+
+def test_span_ids_stay_json_float_exact():
+    with trace.force_tracing(True):
+        with trace.span("x"):
+            pass
+    (rec,) = trace.trace_records()
+    assert rec.span_id < 2**52  # survives a JSON round trip through floats
+    assert float(int(float(rec.span_id))) == float(rec.span_id)
+
+
+def test_context_restored_after_span_exit():
+    with trace.force_tracing(True):
+        assert trace.current_context() is None
+        with trace.span("outer"):
+            outer = trace.current_context()
+            with trace.span("inner"):
+                assert trace.current_context().span_id != outer.span_id
+            assert trace.current_context() == outer
+        assert trace.current_context() is None
+
+
+def test_disabled_path_has_no_context_and_noop_set():
+    assert trace.current_context() is None
+    sp = trace.span("x", k=1)
+    with sp:
+        sp.set(extra=2)  # the mid-span attr hook must be a no-op too
+        assert trace.current_context() is None
+    assert trace.trace_records() == []
+
+
+def test_span_set_attaches_mid_span_attrs():
+    with trace.force_tracing(True):
+        with trace.span("padded") as sp:
+            sp.set(tier=128)
+    (rec,) = trace.trace_records()
+    assert rec.attrs == {"tier": 128}
+
+
+# --------------------------------------------------------------------------
+# cross-thread propagation
+# --------------------------------------------------------------------------
+
+
+def test_explicit_handoff_parents_across_threads():
+    captured = {}
+    with trace.force_tracing(True):
+        with trace.span("producer"):
+            ctx = trace.current_context()
+
+        def consumer():
+            with trace.trace_context(ctx):
+                with trace.span("consumer"):
+                    captured["ctx"] = trace.current_context()
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        t.join()
+    recs = {r.name: r for r in trace.trace_records()}
+    assert recs["consumer"].parent_id == recs["producer"].span_id
+    assert recs["consumer"].trace_id == recs["producer"].trace_id
+    assert recs["consumer"].tid != recs["producer"].tid
+
+
+def test_link_to_records_fanin_edge_without_parenting():
+    with trace.force_tracing(True):
+        with trace.span("producer"):
+            ctx = trace.current_context()
+        with trace.span("fanin", link_to=ctx):
+            pass
+    recs = {r.name: r for r in trace.trace_records()}
+    fanin = recs["fanin"]
+    assert fanin.parent_id is None  # a link is not a parent
+    assert fanin.link == (recs["producer"].trace_id, recs["producer"].span_id)
+
+
+def test_eight_thread_hammering_no_cross_thread_parent_leaks(monkeypatch):
+    """THE ISSUE 15 propagation acceptance: 8 threads nesting spans
+    concurrently — every parented record's parent lives on ITS OWN thread
+    and shares its trace id; sibling threads never contaminate each
+    other's chains."""
+    monkeypatch.setenv("METRICS_TPU_TRACE", "1")
+    monkeypatch.setenv("METRICS_TPU_TRACE_BUFFER", str(64 * 1024))
+    trace.reset_trace_state()
+    errors = []
+
+    def hammer(worker: int) -> None:
+        try:
+            for i in range(400):
+                with trace.span("outer", worker=worker, i=i):
+                    with trace.span("inner", worker=worker, i=i):
+                        pass
+        except Exception as err:  # noqa: BLE001 - surfaced via the errors list
+            errors.append(err)
+
+    threads = [threading.Thread(target=hammer, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    records = trace.trace_records()
+    assert len(records) == 8 * 400 * 2  # ring big enough: nothing evicted
+    by_span_id = {r.span_id: r for r in records}
+    inner = [r for r in records if r.name == "inner"]
+    assert len(inner) == 8 * 400
+    for r in inner:
+        parent = by_span_id[r.parent_id]
+        assert parent.name == "outer"
+        assert parent.tid == r.tid, "parent leaked across threads"
+        assert parent.trace_id == r.trace_id
+        assert parent.attrs["worker"] == r.attrs["worker"]
+    # every worker thread's roots started their own traces
+    outer = [r for r in records if r.name == "outer"]
+    assert all(r.parent_id is None for r in outer)
+    assert len({r.trace_id for r in outer}) == len(outer)
+
+
+# --------------------------------------------------------------------------
+# export: flow arrows + merge
+# --------------------------------------------------------------------------
+
+
+def test_flow_events_connect_parent_and_link_edges():
+    with trace.force_tracing(True):
+        with trace.span("parent"):
+            with trace.span("kid"):
+                pass
+            ctx = trace.current_context()
+        with trace.span("linked", link_to=ctx):
+            pass
+    recs = {r.name: r for r in trace.trace_records()}
+    events = trace.chrome_trace_events()
+    starts = {e["id"] for e in events if e.get("cat") == "causal" and e["ph"] == "s"}
+    finishes = {e["id"] for e in events if e.get("cat") == "causal" and e["ph"] == "f"}
+    # the parent's flow start exists, and both the nested child and the
+    # linked span draw an arrow back to it
+    assert recs["parent"].span_id in starts
+    assert recs["parent"].span_id in finishes
+    for e in events:
+        if e.get("cat") == "causal" and e["ph"] == "f":
+            assert e["bp"] == "e"
+
+
+def test_merge_chrome_sections_rebases_and_names_hosts():
+    sections = [
+        {
+            "host_id": "host-a",
+            "clock": {"mono_ns": 1_000_000, "unix": 100.0},
+            "events": [{"name": "x", "ph": "X", "ts": 1_500.0, "dur": 10.0, "pid": 7, "tid": 1}],
+        },
+        {
+            "host_id": "host-b",
+            "clock": {"mono_ns": 2_000_000, "unix": 100.0},
+            "events": [{"name": "y", "ph": "X", "ts": 2_500.0, "dur": 10.0, "pid": 8, "tid": 1}],
+            "clock_offset_estimate": 0.25,
+        },
+    ]
+    doc = trace.merge_chrome_sections(sections)
+    events = doc["traceEvents"]
+    names = {
+        e["pid"]: e["args"]["name"] for e in events if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert set(names.values()) == {"host-a", "host-b"}
+    x = next(e for e in events if e["name"] == "x")
+    y = next(e for e in events if e["name"] == "y")
+    # both events were 500 us after their host's clock_sync pairing at the
+    # same wall time: after rebasing they land on the SAME shared timebase
+    assert x["ts"] == pytest.approx(100.0 * 1e6 + 500.0)
+    assert y["ts"] == pytest.approx(100.0 * 1e6 + 500.0)
+    assert x["pid"] != y["pid"]
+    offmeta = next(e for e in events if e.get("ph") == "M" and e["args"].get("name") == "host-b")
+    assert offmeta["args"]["clock_offset_estimate_s"] == 0.25
+    json.dumps(doc)  # the merged doc is a loadable JSON document
+
+
+def test_records_since_watermark():
+    with trace.force_tracing(True):
+        with trace.span("first"):
+            pass
+        mark = trace.trace_records()[-1].seq
+        with trace.span("second"):
+            pass
+    newer = trace.records_since(mark)
+    assert [r.name for r in newer] == ["second"]
+    assert trace.records_since(0) == trace.trace_records()
+
+
+def test_records_since_ships_spans_open_across_the_cursor():
+    """A span still OPEN when the cursor was taken (started before, closed
+    after — an async_sync.cycle straddling a publish cadence) must ship
+    with the NEXT delta: the cursor is append order, not start time."""
+    with trace.force_tracing(True):
+        with trace.span("outer"):
+            with trace.span("inner.before"):
+                pass
+            mark = trace.trace_records()[-1].seq
+        # "outer" started before the mark but landed in the ring after it
+    newer = trace.records_since(mark)
+    assert [r.name for r in newer] == ["outer"]
