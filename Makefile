@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 870
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift
+.PHONY: test doctest bench dryrun lint profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift test-overlap
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -121,6 +121,15 @@ test-coldstart:
 # timeout.
 test-drift:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m drift -p no:cacheprovider
+
+# The chunked-overlap + delta-publishing layer (ISSUE 16): chunked
+# fused_sync schedule bit-identity + logical collective counting, the
+# run_gather_jobs issue/fold pipeline, METRICS_TPU_SYNC_CHUNKS resolution,
+# and fleet delta publishing with its re-base chaos coverage (reject
+# mid-stream, seq regression, aggregator restart, flapping destination) —
+# everything the `overlap` marker selects.
+test-overlap:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'overlap and not slow' -p no:cacheprovider
 
 # The quantized sync transport layer (ops/quantize.py wire codecs + the
 # fused_sync quantized wire + overlapped-cycle compressed gathers + the

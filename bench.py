@@ -1772,6 +1772,245 @@ def _phase_coldstart(jax, platform) -> None:
         print(f"bench: coldstart warm-restart failed: {err}", file=sys.stderr)
 
 
+def _phase_overlap(jax, platform) -> None:
+    """Chunked gather overlap (ISSUE 16): the host-tier issue/fold pipeline
+    priced on its stated customer. Each job is ``Metric._gathered_state``'s
+    sketch job verbatim — ``issue`` gathers every leaf of a real seeded
+    0.01-eps QuantileSketch state over a simulated 2-rank DCN-shaped
+    transport (fixed RTT + bytes/bandwidth), ``fold`` rebuilds the per-rank
+    sketches and merges them through ``sketch_merge`` (the ~30 ms host-side
+    compactor run) — and a K-job sequence runs through ``run_gather_jobs``
+    both ways: sequential (fold i completes before issue i+1 starts, the
+    pre-ISSUE-16 schedule) and pipelined (issues on the daemon thread,
+    folds one job behind on the caller). Issue order is identical in both
+    modes (the cross-host collective pairing contract), so the only
+    variable is whether fold compute hides wire time. Acceptance: the
+    pipelined wall recovers >= 30% of the sequential wall, and the folded
+    merges are bit-equal between the two modes."""
+    _stamp("overlap start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu.parallel.sync import run_gather_jobs
+
+    # DCN shape: 0.5 ms RTT per gather + 8 MB/s effective per-flow
+    # bandwidth (the heavily-congested tail of the cross-region regime the
+    # transport phase prices at 25 MB/s) — a ~256 KiB sketch state costs
+    # ~34 ms of wire per job, comparable to its ~30 ms merge fold, the
+    # regime where overlapping the two halves pays
+    BASE_RTT_S = 0.0005
+    BYTES_PER_S = 8e6
+    JOBS = 8
+    RANKS = 2
+
+    def dcn_transport(a):
+        arr = np.asarray(a)
+        time.sleep(BASE_RTT_S + arr.nbytes / BYTES_PER_S)
+        return np.stack([arr] * RANKS)
+
+    def make_state(seed):
+        m = mt.QuantileSketch(eps=0.01, k=16384, levels=4, quantiles=(0.5, 0.99))
+        r = np.random.default_rng(seed)
+        for _ in range(4):
+            m.update(jnp.asarray(r.lognormal(0, 2, 8192).astype(np.float32)))
+        return m._state["sketch"]
+
+    states = [make_state(seed) for seed in range(JOBS)]
+    state_bytes = sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(states[0]))
+
+    def make_jobs():
+        jobs = []
+        for i, st in enumerate(states):
+            leaves, treedef = jax.tree_util.tree_flatten(st)
+
+            def issue(leaves=leaves):
+                return [dcn_transport(leaf) for leaf in leaves]
+
+            def fold(gathered, treedef=treedef):
+                ranks = [
+                    jax.tree_util.tree_unflatten(treedef, [g[r] for g in gathered])
+                    for r in range(RANKS)
+                ]
+                merged = ranks[0]
+                for other in ranks[1:]:
+                    merged = merged.sketch_merge(other)
+                jax.block_until_ready(jax.tree_util.tree_leaves(merged))
+                return merged
+
+            jobs.append((f"sketch_{i}", issue, fold))
+        return jobs
+
+    try:
+        warm = make_jobs()[0]  # compile the merge graph outside the timing
+        warm[2](warm[1]())
+        walls = {False: [], True: []}
+        outs = {}
+        for _rep in range(3):
+            for pipeline in (False, True):  # interleaved: same jitter per rep
+                t0 = time.perf_counter()
+                outs[pipeline] = run_gather_jobs(make_jobs(), pipeline=pipeline)
+                walls[pipeline].append(time.perf_counter() - t0)
+
+        for key, seq_v in outs[False].items():
+            seq_leaves = jax.tree_util.tree_leaves(seq_v)
+            pipe_leaves = jax.tree_util.tree_leaves(outs[True][key])
+            if not all(np.array_equal(a, b) for a, b in zip(seq_leaves, pipe_leaves)):
+                print(
+                    f"bench: PARITY-MISMATCH overlap {key}: pipelined merge != "
+                    f"sequential merge",
+                    file=sys.stderr,
+                )
+
+        seq_s, pipe_s = min(walls[False]), min(walls[True])
+        frac = (seq_s - pipe_s) / seq_s if seq_s else 0.0
+        wire_ms = (3 * BASE_RTT_S + state_bytes / BYTES_PER_S) * 1e3
+        _emit(
+            "sync_gather_sequential_ms",
+            round(seq_s * 1e3, 1),
+            f"ms wall for the {JOBS}-sketch gather+merge sequence, sequential "
+            f"schedule (simulated {RANKS}-rank pod, {state_bytes / 1024:.0f} KiB "
+            f"state -> {wire_ms:.0f} ms DCN-shaped wire per job, min-of-3, "
+            f"{platform})",
+        )
+        _emit(
+            "sync_gather_pipelined_ms",
+            round(pipe_s * 1e3, 1),
+            f"ms wall, same jobs through the run_gather_jobs issue/fold "
+            f"pipeline — fold i overlaps job i+1's wire time ({platform})",
+        )
+        _emit(
+            "sync_chunk_overlap_frac",
+            round(frac, 3),
+            f"fraction of the sequential wall recovered by overlapping folds "
+            f"with wire time ({seq_s * 1e3:.0f} -> {pipe_s * 1e3:.0f} ms; "
+            f"acceptance >= 0.30, {platform})",
+        )
+        if frac < 0.30:
+            print(
+                f"bench: PARITY-MISMATCH overlap acceptance: recovered fraction "
+                f"{frac:.3f} < 0.30 ({seq_s * 1e3:.0f} -> {pipe_s * 1e3:.0f} ms)",
+                file=sys.stderr,
+            )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: overlap failed: {err}", file=sys.stderr)
+
+
+def _phase_fleet_bytes(jax, platform) -> None:
+    """Delta fleet publishing (ISSUE 16): steady-state wire bytes per
+    publish cadence, delta vs full, at three simulated fleet scales. Every
+    host holds the stated production shape — a large mostly-idle state (a
+    0.01-eps QuantileSketch of a seeded latency distribution) next to a
+    small hot one (an Accuracy that absorbs a batch every cadence) — and
+    publishes through a real ``FleetPublisher`` into a real ``Aggregator``,
+    one delta-enabled fleet and one full-view twin fed the identical
+    updates. Acceptance: steady-state delta bytes <= 10% of the full-view
+    bytes at every scale, with each host's held view in the delta
+    aggregator bit-equal to the full twin's (the re-base protocol never
+    traded bytes for correctness)."""
+    _stamp("fleet_bytes start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+    from metrics_tpu.fleet import Aggregator, FleetPublisher
+    from metrics_tpu.fleet.wire import _checksum_tree
+
+    SCALES = (8, 32, 128)
+    CADENCES = 5  # steady-state cadences after the first (full) publish
+
+    def make_coll():
+        return mt.MetricCollection(
+            {
+                "lat": mt.QuantileSketch(eps=0.01, k=16384, levels=4, quantiles=(0.5, 0.99)),
+                "acc": mt.Accuracy(num_classes=4),
+            }
+        )
+
+    rng = np.random.default_rng(61)
+
+    def acc_batch():
+        return (
+            jnp.asarray(rng.random((16, 4), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 4, 16).astype(np.int32)),
+        )
+
+    try:
+        for n_hosts in SCALES:
+            agg_delta = Aggregator(make_coll(), node_id=f"pod-delta-{n_hosts}")
+            agg_full = Aggregator(make_coll(), node_id=f"pod-full-{n_hosts}")
+            delta_bytes, full_bytes = [], []
+
+            hosts = []
+            for h in range(n_hosts):
+                coll = make_coll()
+                coll["lat"].update(jnp.asarray(rng.lognormal(0, 2, 4096).astype(np.float32)))
+                coll["acc"].update(*acc_batch())
+                hosts.append(
+                    (
+                        coll,
+                        FleetPublisher(
+                            coll,
+                            lambda blob: (delta_bytes.append(len(blob)) or agg_delta.ingest(blob)),
+                            host_id=f"h{h}",
+                            start=False,
+                            delta=True,
+                        ),
+                        FleetPublisher(
+                            coll,
+                            lambda blob: (full_bytes.append(len(blob)) or agg_full.ingest(blob)),
+                            host_id=f"h{h}",
+                            start=False,
+                            delta=False,
+                        ),
+                    )
+                )
+
+            for _coll, pub_d, pub_f in hosts:  # cadence 0: both ship full
+                pub_d.publish_now()
+                pub_f.publish_now()
+            first_full = sum(full_bytes)
+            delta_bytes.clear()
+            full_bytes.clear()
+            for _c in range(CADENCES):  # steady state: only `acc` moves
+                for coll, pub_d, pub_f in hosts:
+                    coll["acc"].update(*acc_batch())
+                    pub_d.publish_now()
+                    pub_f.publish_now()
+
+            delta_per_cad = sum(delta_bytes) / CADENCES
+            full_per_cad = sum(full_bytes) / CADENCES
+            ratio = delta_per_cad / full_per_cad if full_per_cad else 1.0
+            for h in range(n_hosts):
+                with agg_delta._lock:
+                    dd = _checksum_tree(agg_delta._views[f"h{h}"]["payload"])
+                with agg_full._lock:
+                    df = _checksum_tree(agg_full._views[f"h{h}"]["payload"])
+                if dd != df:
+                    print(
+                        f"bench: PARITY-MISMATCH fleet_bytes h{h}@{n_hosts}: delta "
+                        f"aggregator's held view != full twin's",
+                        file=sys.stderr,
+                    )
+            _emit(
+                f"fleet_delta_bytes_ratio_{n_hosts}hosts",
+                round(ratio, 4),
+                f"steady-state delta bytes / full-view bytes per publish cadence "
+                f"({n_hosts} hosts x {CADENCES} cadences, "
+                f"{first_full / n_hosts / 1024:.0f} KiB/host full view, "
+                f"{delta_per_cad / 1024:.0f} vs {full_per_cad / 1024:.0f} KiB/cadence "
+                f"fleet-wide; acceptance <= 0.10, {platform})",
+            )
+            if ratio > 0.10:
+                print(
+                    f"bench: PARITY-MISMATCH fleet_bytes acceptance: delta/full "
+                    f"ratio {ratio:.4f} > 0.10 at {n_hosts} hosts",
+                    file=sys.stderr,
+                )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: fleet_bytes failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -1790,6 +2029,8 @@ _PHASES = {
     "async_sync": (_phase_async_sync, 300),
     "obs": (_phase_obs, 300),
     "transport": (_phase_transport, 300),
+    "overlap": (_phase_overlap, 240),
+    "fleet_bytes": (_phase_fleet_bytes, 420),
 }
 
 _HEADLINE_METRIC = "fused_collection_step_ms"

@@ -116,12 +116,54 @@ def hlo_of(fn: Callable, *args: Any, **kwargs: Any) -> str:
     return fn.lower(*args, **kwargs).compile().as_text()
 
 
+# the chunked fused_sync schedule (parallel/sync.py::_chunked_sync_leaf)
+# tags each per-chunk collective with a named scope that lowers into the
+# instruction's op_name metadata: .../fused_sync_chunk_<i>of<k>/...
+_CHUNK_MARK_RE = re.compile(r"fused_sync_chunk_(\d+)of(\d+)")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
 def collective_counts(hlo: str) -> Dict[str, int]:
-    """Cross-device collective ops in one HLO module, by op name.
+    """LOGICAL cross-device collective ops in one HLO module, by op name.
 
     Counts instruction forms only (``op(`` / ``op-start(``): an async pair
     (``-start`` + ``-done``) is ONE collective on the wire, and result
     names like ``%all-reduce.3`` never carry the open paren.
+
+    A chunked ``fused_sync`` pipeline (ISSUE 16) also counts ONCE: its k
+    per-chunk ops carry ``fused_sync_chunk_<i>of<k>`` markers in their
+    ``op_name`` metadata and move the same fused payload one slice at a
+    time — one collective's worth of wire traffic split for overlap, not k
+    extra collectives. Ops sharing (op kind, scope prefix around the
+    marker, k) fold into one logical count, so the registry's "≤2
+    all-reduces" budgets hold unchanged under the equivalent chunked
+    schedule. Use :func:`physical_collective_counts` when the raw
+    instruction count is the question (e.g. pinning that chunking actually
+    emitted k ops).
+    """
+    counts = {op: 0 for op in COLLECTIVE_OPS}
+    seen_pipelines: set = set()
+    for line in hlo.splitlines():
+        for op in COLLECTIVE_OPS:
+            if f"{op}-start(" in line or f"{op}(" in line:
+                mark = _CHUNK_MARK_RE.search(line)
+                if mark is None:
+                    counts[op] += 1
+                else:
+                    name = _OP_NAME_RE.search(line)
+                    scope = name.group(1) if name else line
+                    pipeline = (op, _CHUNK_MARK_RE.sub("", scope, count=1), mark.group(2))
+                    if pipeline not in seen_pipelines:
+                        seen_pipelines.add(pipeline)
+                        counts[op] += 1
+                break  # HLO is one instruction per line
+    return counts
+
+
+def physical_collective_counts(hlo: str) -> Dict[str, int]:
+    """Raw collective instruction counts — chunk-pipeline ops counted
+    individually (async pairs still count once). The schedule-shape probe:
+    ``physical - logical`` per op is exactly the extra ops chunking emitted.
     """
     return {op: hlo.count(f"{op}(") + hlo.count(f"{op}-start(") for op in COLLECTIVE_OPS}
 

@@ -58,6 +58,16 @@ entry                           budget
                                 overlapped cycle), AND recompile-stable
                                 (double-buffered state avals are batch-size
                                 independent, cache hit at equal avals)
+``chunked_fused_step``          the overlapped cycle lowered with the ISSUE
+                                16 pipelined chunk schedule
+                                (``sync_chunks=4``): the guarded-collection
+                                **≤ 2** budget holds as LOGICAL collectives
+                                (``collective_counts`` folds each marked
+                                ``fused_sync_chunk_<i>of<k>`` pipeline into
+                                one count) and the chunk markers are
+                                require-pinned in the compiled HLO — the "≤2
+                                all-reduces OR an equivalent chunked
+                                schedule" budget
 ``overlapped_read_step``        the stale-read path alone (``read`` on a
                                 replicated reduced buffer over the mesh):
                                 **0** collectives — the zero-collective-
@@ -444,6 +454,32 @@ def _build_overlapped_read_step(ndev: int):
     return fn, (state0,)
 
 
+def _build_chunked_fused_step(ndev: int):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    # the SAME overlapped cycle as overlapped_fused_step, lowered with the
+    # ISSUE 16 pipelined chunk schedule (explicit sync_chunks=4 — the env
+    # knob's auto-floor would keep this small state monolithic)
+    odef = mt.overlapped_functionalize(_overlapped_coll(), axis_name="data", sync_chunks=4)
+
+    def step(p, t):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+        )
+        s = odef.update(s, p, t)
+        s = odef.cycle(s)
+        return odef.read(s)
+
+    p, t = _overlapped_make_args(8 * ndev)
+    fn = jax.jit(
+        jax.shard_map(step, mesh=_mesh(ndev), in_specs=(P("data"), P("data")), out_specs=P())
+    )
+    return fn, (p, t)
+
+
 class _TracedLower:
     """``hlo_of``-compatible wrapper that lowers its jitted function with
     tracing FORCED ON (``obs/trace.py``), so the audited trace runs the
@@ -645,6 +681,20 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
         build=_build_overlapped_fused_step,
         build_recompile=lambda: (_build_overlapped_raw_step(), _overlapped_make_args),
+    ),
+    AuditEntry(
+        name="chunked_fused_step",
+        budget=GraphBudget(
+            # the "≤2 all-reduces OR an equivalent chunked schedule" budget:
+            # collective_counts folds each marked chunk pipeline into ONE
+            # logical collective, so the guarded-collection ceiling holds
+            # unchanged; the require pin proves the chunk schedule actually
+            # lowered (markers survive into compiled-HLO op_name metadata)
+            max_all_reduce=2,
+            max_all_gather=0,
+            require_patterns=(r"fused_sync_chunk_0of4",),
+        ),
+        build=_build_chunked_fused_step,
     ),
     AuditEntry(
         name="overlapped_read_step",

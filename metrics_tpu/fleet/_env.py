@@ -12,11 +12,12 @@ idempotent last-write-wins; a wrong cadence just changes staleness).
 | ``METRICS_TPU_FLEET_DEADLINE_S`` | per-publish-attempt deadline | 10.0 |
 | ``METRICS_TPU_FLEET_BREAKER_COOLDOWN_S`` | breaker open time after an exhausted budget | 30.0 |
 | ``METRICS_TPU_FLEET_STALE_AFTER_S`` | age past which a host view / publish channel is loudly stale | 10.0 |
+| ``METRICS_TPU_FLEET_DELTA`` | ship per-leaf delta views between all-accepted full views (ISSUE 16) | off |
 """
 import math
 from typing import Optional
 
-from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce, bool_token
 
 __all__ = [
     "DEFAULT_PUBLISH_EVERY_S",
@@ -24,6 +25,7 @@ __all__ = [
     "DEFAULT_BREAKER_COOLDOWN_S",
     "DEFAULT_STALE_AFTER_S",
     "resolve_fleet_knob",
+    "resolve_fleet_delta",
     "reset_fleet_env_state",
 ]
 
@@ -95,8 +97,35 @@ def resolve_fleet_knob(name: str, programmatic: Optional[float]) -> float:
     return from_env if from_env is not None else _DEFAULTS[name]
 
 
+def _parse_delta(raw: str) -> Optional[bool]:
+    token = bool_token(raw)
+    if token is None:
+        _warn_once(
+            ("METRICS_TPU_FLEET_DELTA", raw),
+            f"METRICS_TPU_FLEET_DELTA={raw!r} is not a boolean token "
+            "(1/0/true/false/on/off/yes/no); delta publishing stays OFF — "
+            "a bad env var costs bytes, never correctness.",
+        )
+    return token
+
+
+_ENV_DELTA: "EnvParse[Optional[bool]]" = EnvParse("METRICS_TPU_FLEET_DELTA", _parse_delta, None)
+
+
+def resolve_fleet_delta(programmatic: Optional[bool] = None) -> bool:
+    """Whether the publisher ships per-leaf deltas between all-accepted
+    full views (ISSUE 16): programmatic arg > ``METRICS_TPU_FLEET_DELTA`` >
+    off. Off by default — deltas change bytes and answer traffic, and a
+    fleet with pre-delta aggregators would re-base every cadence."""
+    if programmatic is not None:
+        return bool(programmatic)
+    token = _ENV_DELTA()
+    return False if token is None else token
+
+
 def reset_fleet_env_state() -> None:
     """Test hook: forget memoized env parses and warn-once history."""
     _warn_once.reset()
     for env in _ENV.values():
         env.reset()
+    _ENV_DELTA.reset()

@@ -37,7 +37,14 @@ import time
 from collections import OrderedDict, deque
 from typing import Any, Dict, Optional
 
-from metrics_tpu.fleet.wire import WireError, decode_view, encode_view, next_seq
+from metrics_tpu.fleet.wire import (
+    WireError,
+    apply_delta,
+    decode_view,
+    encode_view,
+    is_delta_payload,
+    next_seq,
+)
 from metrics_tpu.fleet._env import resolve_fleet_knob
 from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.resilience.health import health_report, record_degradation
@@ -78,7 +85,7 @@ class Aggregator:
     Example (one pod node)::
 
         agg = Aggregator(Accuracy(num_classes=10), node_id="pod-0")
-        status = agg.ingest(blob)        # "accepted" | "duplicate:<seq>"; raises WireError on corruption
+        status = agg.ingest(blob)        # "accepted" | "duplicate:<seq>" | "rebase:<seq|none>"
         rep = agg.report()               # value + per-host staleness
         text = agg.scrape()              # Prometheus text for the whole subtree
 
@@ -125,11 +132,16 @@ class Aggregator:
     def ingest(self, blob: bytes, source: Optional[str] = None) -> str:
         """Decode-validate-or-refuse one published view blob.
 
-        Returns ``"accepted"`` (the host's view advanced) or
+        Returns ``"accepted"`` (the host's view advanced),
         ``"duplicate:<held_seq>"`` (re-delivered/reordered blob with a
         known or older ``seq`` — folded once by construction, so this is a
         no-op, not an error; the held seq lets a publisher detect a
-        persistent seq regression and jump past it).
+        persistent seq regression and jump past it), or
+        ``"rebase:<held_seq|none>"`` (a DELTA blob whose ``base_seq`` does
+        not match the seq this node holds for the host — after an
+        aggregator restart, or when the base publish never landed here; an
+        answer, not an error: the held view keeps serving and the
+        publisher re-ships a full view next pass).
         Raises :class:`~metrics_tpu.fleet.wire.WireError` when the
         blob fails checksum/schema verification or does not match the
         aggregator's metric configuration — recorded as a
@@ -167,6 +179,27 @@ class Aggregator:
             # regression window (ingest dedup makes re-folds idempotent)
             self._ingest_trace(host, header)
             return f"duplicate:{current_seq}"
+        if is_delta_payload(payload):
+            # a delta folds onto the EXACT view named by its base_seq: the
+            # publisher commits a base only after this node answered
+            # "accepted", so held_seq != base_seq means this node missed
+            # that publish (restart, never reached) — answer rebase and
+            # keep serving the held view; the publisher re-ships full
+            with self._lock:
+                held = self._views.get(host)
+                base_payload = held.get("payload") if held else None
+                held_seq = held["seq"] if held else None
+            if base_payload is None or held_seq != payload["base_seq"]:
+                return f"rebase:{held_seq if held_seq is not None else 'none'}"
+            try:
+                payload = apply_delta(base_payload, payload)
+            except WireError as err:
+                # seq matched but a changed path is absent from the base:
+                # corruption or a structural diff the publisher must never
+                # ship — refuse loudly, exactly like a checksum failure
+                msg = f"delta view from host {host!r} refused: {err}"
+                self._reject(host, msg)
+                raise WireError(f"{self.node_id}: {msg}")
         # structural validation against the prototype: load_snapshot_state
         # is transactional and refuses unknown states/children/shapes naming
         # the offender — a checksum-intact view from a mis-configured host
@@ -181,6 +214,9 @@ class Aggregator:
         entry = {
             "seq": header["seq"],
             "snap": _snapshot_of(scratch),
+            # the decoded FULL payload (delta blobs store their rebuilt
+            # view): the base the next delta from this host folds onto
+            "payload": payload,
             "updates": header.get("updates"),
             "published_unix": header.get("published_unix"),
             "received_unix": time.time(),

@@ -30,8 +30,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Union
 
-from metrics_tpu.fleet.wire import encode_view, next_seq
-from metrics_tpu.fleet._env import resolve_fleet_knob
+from metrics_tpu.fleet.wire import delta_changes, encode_delta_view, encode_view, next_seq
+from metrics_tpu.fleet._env import resolve_fleet_delta, resolve_fleet_knob
 from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.parallel.retry import CircuitOpenError, RetryBudgetExceededError, RetryPolicy
 from metrics_tpu.resilience.health import record_degradation
@@ -108,6 +108,7 @@ class FleetPublisher:
         stale_after_s: Optional[float] = None,
         start: bool = True,
         encoding: Optional[str] = None,
+        delta: Optional[bool] = None,
     ) -> None:
         if not host_id:
             raise MetricsTPUUserError("`host_id` must be a non-empty string")
@@ -120,6 +121,17 @@ class FleetPublisher:
 
             resolve_fleet_encoding(encoding)  # validate eagerly
         self._encoding = encoding
+        # delta publishing (ISSUE 16): tri-state kept as given; each pass
+        # resolves programmatic > METRICS_TPU_FLEET_DELTA > off, so the env
+        # knob can flip a running fleet without reconstruction. The commit
+        # protocol is the trace cursor's (PR 15): `_delta_base` holds the
+        # (per-leaf digest table, seq) of the last view EVERY attempted
+        # destination ACCEPTED; a pass with a valid base ships only dirty
+        # leaves, and any reject / non-accept / seq jump / `rebase:` answer
+        # clears the base so the next pass re-ships a full view.
+        self._delta = delta
+        self._delta_base: Optional[Tuple[Dict[str, str], int]] = None
+        self._last_full_bytes: Optional[int] = None
         if hasattr(source, "fleet_view"):
             self._view_fn = source.fleet_view
         elif hasattr(source, "snapshot_state"):
@@ -180,6 +192,11 @@ class FleetPublisher:
         self._last_ok_mono: Dict[str, Optional[float]] = {name: None for name in self._channels}
         self._started_mono = time.monotonic()
         self._stale_reported: Dict[str, bool] = {name: False for name in self._channels}
+        # one `fleet_delta_rebase` event per episode per destination: a
+        # flapping destination re-basing every cadence must not wheel the
+        # bounded health ring (the stale-episode stance); an accepted
+        # publish to that destination re-arms it
+        self._rebase_reported: Dict[str, bool] = {name: False for name in self._channels}
         self._encode_error_reported = False  # snapshot/encode failure episode
         self._dup_streak: Dict[str, int] = {name: 0 for name in self._channels}
         self._seq = 0
@@ -282,14 +299,42 @@ class FleetPublisher:
                 # with exactly one delta per pass — two concurrent passes
                 # reading the same watermark would ship one batch twice
                 extra, trace_mark = self._trace_extra(extra)
-            blob = encode_view(
-                payload,
-                host_id=self.host_id,
-                seq=seq,
-                updates=_payload_updates(payload),
-                extra=extra,
-                encoding=self._encoding,
-            )
+                # delta decision under the SAME lock: the diff must pair
+                # with this pass's (payload, seq) and the CURRENT committed
+                # base — a base committed/cleared mid-decision would ship a
+                # delta against a view some destination no longer holds
+                delta_mark: Optional[Tuple[Dict[str, Any], int]] = None
+                delta_changed: Optional[Dict[str, Any]] = None
+                delta_base_seq: Optional[int] = None
+                if resolve_fleet_delta(self._delta):
+                    base = self._delta_base
+                    changed, digests = delta_changes(payload, base[0] if base else {})
+                    delta_mark = (digests, seq)  # the next base, if all accept
+                    # ship a delta only when it can WIN: with every leaf
+                    # dirty it is the full payload plus path-key overhead,
+                    # so a full view is strictly smaller (and commits the
+                    # same base on accept)
+                    if base is not None and changed is not None and len(changed) < len(digests):
+                        delta_changed, delta_base_seq = changed, base[1]
+            if delta_changed is not None:
+                blob = encode_delta_view(
+                    delta_changed,
+                    base_seq=delta_base_seq,
+                    host_id=self.host_id,
+                    seq=seq,
+                    updates=_payload_updates(payload),
+                    extra=extra,
+                    encoding=self._encoding,
+                )
+            else:
+                blob = encode_view(
+                    payload,
+                    host_id=self.host_id,
+                    seq=seq,
+                    updates=_payload_updates(payload),
+                    extra=extra,
+                    encoding=self._encoding,
+                )
             # payload-size distribution: once per ENCODE (the quantized-
             # transport tuning reads blob sizes — observing per destination
             # would weight quantiles by fan-out and failure rate instead);
@@ -298,6 +343,21 @@ class FleetPublisher:
             from metrics_tpu.obs.runtime_metrics import registry as _obs_registry
 
             _obs_registry.histogram("fleet_publish_bytes").observe(float(len(blob)))
+            # the delta win and every re-base in one scrape: full vs delta
+            # encode counters, plus this blob's size relative to the last
+            # full view (1.0 while full views ship; the steady-state delta
+            # ratio is the ISSUE 16 ≤0.1 acceptance, benched in bench.py)
+            if delta_changed is not None:
+                _obs_registry.counter("fleet_publish_delta_total").inc()
+            else:
+                _obs_registry.counter("fleet_publish_full_total").inc()
+                with self._lock:
+                    self._last_full_bytes = len(blob)
+            with self._lock:
+                full_bytes = self._last_full_bytes
+            _obs_registry.gauge("fleet_delta_ratio").set(
+                len(blob) / full_bytes if full_bytes else 1.0
+            )
         with self._lock:
             self._encode_error_reported = False  # snapshot+encode healthy again
         workers: Dict[str, threading.Thread] = {}
@@ -306,20 +366,46 @@ class FleetPublisher:
         # leave each failed destination permanently missing this delta
         # (the next pass starts past it); the full re-ship after a partial
         # failure folds once at the destinations that already accepted
-        # (the aggregator's ingest dedup)
-        pass_state = {"left": 0, "all_ok": True, "spawning": True}
+        # (the aggregator's ingest dedup). The delta base rides the same
+        # pass-completion machinery but with a STRICTER bar: `all_ok`
+        # tolerates "duplicate" answers (the view is held either way),
+        # `accepted_all` does not — a duplicate answer means the aggregator
+        # kept its OLD entry, so the next delta must diff against that, and
+        # the only safe move is to drop the base and re-ship a full view.
+        pass_state = {"left": 0, "all_ok": True, "accepted_all": True, "spawning": True}
 
-        def _finish_push(out: str) -> None:
+        def _finish_pass(ok: bool, accepted: bool) -> None:
+            """Pass completion — called OUTSIDE self._lock (it takes
+            _snapshot_lock, and the snapshot block above takes the locks in
+            the opposite order): commit the marks or clear the base."""
+            if ok:
+                self._commit_trace_mark(trace_mark)
+            if delta_mark is None:
+                return
+            with self._snapshot_lock:
+                if ok and accepted:
+                    # newest-seq-wins: two passes completing out of order
+                    # must leave the base at the NEWER shipped view — the
+                    # aggregator's last-write-wins fold holds that one
+                    if self._delta_base is None or self._delta_base[1] <= delta_mark[1]:
+                        self._delta_base = delta_mark
+                elif self._delta_base is not None and self._delta_base[1] <= delta_mark[1]:
+                    # some destination did not accept this pass: it may hold
+                    # an older view than the committed base, so the next
+                    # pass must re-base to a full ship (clearing is cheap —
+                    # one full view — and always safe). A NEWER committed
+                    # base (a later pass already landed everywhere) stays.
+                    self._delta_base = None
+
+        def _finish_push(out: str, accepted: bool) -> None:
             with self._lock:
                 pass_state["left"] -= 1
                 pass_state["all_ok"] = pass_state["all_ok"] and out == "ok"
-                commit = (
-                    not pass_state["spawning"]
-                    and pass_state["left"] == 0
-                    and pass_state["all_ok"]
-                )
-            if commit:
-                self._commit_trace_mark(trace_mark)
+                pass_state["accepted_all"] = pass_state["accepted_all"] and accepted
+                done = not pass_state["spawning"] and pass_state["left"] == 0
+                ok, acc = pass_state["all_ok"], pass_state["accepted_all"]
+            if done:
+                _finish_pass(ok, acc)
 
         for name, channel in to_push:
             with self._lock:
@@ -332,9 +418,9 @@ class FleetPublisher:
                     continue
 
                 def run(name: str = name, channel: Channel = channel) -> None:
-                    out = self._push(name, channel, blob)
+                    out, accepted = self._push(name, channel, blob)
                     outcomes[name] = out
-                    _finish_push(out)
+                    _finish_push(out, accepted)
 
                 t = threading.Thread(
                     target=run, daemon=True, name=f"metrics-tpu-fleet-push-{name}"
@@ -350,11 +436,12 @@ class FleetPublisher:
                 t.start()
         with self._lock:
             pass_state["spawning"] = False
-            commit = bool(workers) and pass_state["left"] == 0 and pass_state["all_ok"]
-        if commit:
+            done = bool(workers) and pass_state["left"] == 0
+            ok, acc = pass_state["all_ok"], pass_state["accepted_all"]
+        if done:
             # every push already finished (fast channels) before spawning
-            # closed — _finish_push deferred the commit to here
-            self._commit_trace_mark(trace_mark)
+            # closed — _finish_push deferred pass completion to here
+            _finish_pass(ok, acc)
         if wait:
             for t in workers.values():
                 t.join()
@@ -441,6 +528,12 @@ class FleetPublisher:
                 self._seq = held  # the next publish issues next_seq(held) > held
                 self._dup_streak[name] = 0
         if jump:
+            # the aggregator holds a FUTURE seq for us (pre-restart views):
+            # any delta base we committed describes a view it may not hold
+            # anymore — drop it so the next publish re-ships a full view
+            # under the jumped sequence
+            with self._snapshot_lock:
+                self._delta_base = None
             record_degradation(
                 "fleet_seq_regression",
                 f"host {self.host_id}: {streak} consecutive publishes answered "
@@ -451,7 +544,32 @@ class FleetPublisher:
                 held_seq=held,
             )
 
-    def _push(self, name: str, channel: Channel, blob: bytes) -> str:
+    def _note_rebase(self, name: str, text: str) -> None:
+        """An aggregator answered ``rebase:<held|none>`` to a delta blob: it
+        holds no base (restarted, or never saw our full view) so it refused
+        to fold the delta. Not an error — the pass reports it, the base
+        clears, and the next cadence ships a full view — but a destination
+        stuck re-basing every cadence is a real degradation (delta savings
+        gone), so it is surfaced once per episode like staleness."""
+        with self._lock:
+            due = not self._rebase_reported[name]
+            self._rebase_reported[name] = True
+        if due:
+            record_degradation(
+                "fleet_delta_rebase",
+                f"host {self.host_id}: {name!r} answered {text!r} to a delta publish "
+                "(no matching base view held — aggregator restart?); re-basing to a "
+                "full view next pass (reported once per episode)",
+                host=self.host_id,
+                destination=name,
+            )
+
+    def _push(self, name: str, channel: Channel, blob: bytes) -> Tuple[str, bool]:
+        """One destination push. Returns ``(outcome, accepted)`` where
+        ``accepted`` means the destination POSITIVELY answered
+        ``"accepted"`` — the only answer that lets this pass commit a delta
+        base for it (a duplicate/rebase answer or a silent fake channel
+        proves nothing about what the destination now holds)."""
         from metrics_tpu.obs.runtime_metrics import registry as _obs_registry
 
         def send() -> Any:
@@ -485,7 +603,7 @@ class FleetPublisher:
             with self._lock:
                 self._stats[name]["skipped_open"] += 1
             self._check_stale(name)
-            return "skipped:circuit_open"
+            return "skipped:circuit_open", False
         except RetryBudgetExceededError as err:
             _observe_push()
             with self._lock:
@@ -499,14 +617,26 @@ class FleetPublisher:
                 attempts=err.attempts,
             )
             self._check_stale(name)
-            return f"failed:{type(err.cause).__name__}"
+            return f"failed:{type(err.cause).__name__}", False
         _observe_push()
         self._note_duplicate(name, result)
+        text = (
+            result.decode("utf-8", "replace")
+            if isinstance(result, (bytes, bytearray))
+            else result
+            if isinstance(result, str)
+            else None
+        )
+        accepted = text == "accepted"
+        if isinstance(text, str) and text.startswith("rebase:"):
+            self._note_rebase(name, text)
         with self._lock:
             self._stats[name]["published"] += 1
             self._last_ok_mono[name] = time.monotonic()
             was_stale = self._stale_reported[name]
             self._stale_reported[name] = False
+            if accepted:
+                self._rebase_reported[name] = False  # re-base episode over
         if was_stale:
             record_degradation(
                 "fleet_publish_recovered",
@@ -515,7 +645,7 @@ class FleetPublisher:
                 host=self.host_id,
                 destination=name,
             )
-        return "ok"
+        return "ok", accepted
 
     def _record_encode_error(self, err: BaseException, during: str = "view snapshot/encode") -> None:
         """Episode-gated like every other failure path: a persistently

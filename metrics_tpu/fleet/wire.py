@@ -27,7 +27,7 @@ Blobs are Python pickles of numpy trees, the same **trusted** transport
 model as the snapshot files (your own hosts, your own aggregators — the
 checksums defend against corruption, not adversaries). The format is
 deliberately payload-opaque and versioned: the reserved ``encoding`` token
-now carries two implementations —
+now carries three implementations —
 
 - ``pickle-v1`` (the default): raw numpy leaves, bit-exact.
 - ``int8-zlib-v1``: the EQuARX-style compressed transport (PAPERS.md).
@@ -43,6 +43,16 @@ now carries two implementations —
   corrupt blob is refused (naming host + leaf) before any dequantization
   runs, and a build that doesn't know the token refuses it loudly —
   listing the encodings it does support — instead of mis-decoding bytes.
+
+- ``delta-v1`` (ISSUE 16): a per-leaf DIFF against the last view every
+  destination accepted, not a full tree — :func:`encode_delta_view` ships
+  only the dirty leaves (``delta_changes``' ``_checksum_tree``-keyed
+  paths), :func:`apply_delta` folds them onto the aggregator's held base
+  bit-equal to the full view they replace, and the changed leaves carry an
+  ``inner`` coding token (``pickle-v1``/``int8-zlib-v1``) so delta × int8
+  makes the steady-state wire near-constant in state size. Riding the
+  ``encoding`` header means pre-delta aggregators refuse delta blobs
+  loudly instead of folding a partial tree as a full view.
 
 Which encoding a publisher ships resolves programmatic ``encoding=`` >
 ``METRICS_TPU_FLEET_ENCODING`` (``exact``/``pickle`` | ``int8``) >
@@ -63,20 +73,25 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce
-from metrics_tpu.resilience.snapshot import _checksum_tree
+from metrics_tpu.resilience.snapshot import _checksum_tree, _iter_leaves
 
 __all__ = [
     "MAGIC",
     "SCHEMA_VERSION",
     "ENCODING",
     "ENCODING_INT8",
+    "ENCODING_DELTA",
     "SUPPORTED_ENCODINGS",
     "QUANTIZE_MIN_SIZE",
     "WireError",
     "WireCorruptionError",
     "WireSchemaError",
     "encode_view",
+    "encode_delta_view",
     "decode_view",
+    "delta_changes",
+    "is_delta_payload",
+    "apply_delta",
     "next_seq",
     "resolve_fleet_encoding",
     "reset_wire_env_state",
@@ -88,13 +103,24 @@ SCHEMA_VERSION = 1
 # refused loudly (listing these) instead of mis-decoding bytes
 ENCODING = "pickle-v1"
 ENCODING_INT8 = "int8-zlib-v1"
-SUPPORTED_ENCODINGS = (ENCODING, ENCODING_INT8)
+# delta-v1 (ISSUE 16): the payload is a per-leaf diff against the last view
+# every destination accepted, NOT a full tree. It rides the same `encoding`
+# header token precisely so a build that predates deltas refuses the blob
+# loudly (naming its SUPPORTED_ENCODINGS) instead of folding a partial tree
+# as a full view. It is deliberately NOT an _ENCODING_ALIASES member:
+# METRICS_TPU_FLEET_ENCODING selects how full views encode; delta shipping
+# is a separate publisher mode (METRICS_TPU_FLEET_DELTA, fleet/_env.py).
+ENCODING_DELTA = "delta-v1"
+SUPPORTED_ENCODINGS = (ENCODING, ENCODING_INT8, ENCODING_DELTA)
 # floating leaves smaller than this ship raw even under int8: no byte win,
 # and scalar aggregates (a MeanMetric value) keep full width
 QUANTIZE_MIN_SIZE = 16
 # the sentinel key marking an encoded leaf inside the payload tree; state
 # names are python identifiers, so it can never collide with real state
 _QKEY = "__quantized__"
+# the sentinel key marking a decoded DELTA payload (a per-leaf diff, never
+# a full tree — `apply_delta` folds it onto the held base view)
+_DELTA_KEY = "__delta__"
 
 _ENCODING_ALIASES = {
     "exact": ENCODING,
@@ -283,6 +309,126 @@ def encode_view(
     )
 
 
+# --------------------------------------------------------------------------
+# delta-v1 (ISSUE 16): per-leaf dirty tracking + diff blobs + base folding
+# --------------------------------------------------------------------------
+
+
+def delta_changes(
+    payload: Dict[str, Any], base_digests: Dict[str, str]
+) -> Tuple[Optional[Dict[str, Any]], Dict[str, str]]:
+    """Diff ``payload``'s leaves against a committed base's digest table.
+
+    Returns ``(changed, digests)`` where ``digests`` is the payload's own
+    per-leaf digest table (the next base candidate — the snapshot layer's
+    ``_checksum_tree`` walk verbatim, so dirty detection can never disagree
+    with the wire checksums) and ``changed`` maps each dirty leaf's tree
+    path to its CURRENT value. ``changed`` is ``None`` when the leaf path
+    set differs from the base (structural change — a list state grew, a
+    member appeared): a delta replaces values in an identical structure
+    only, so anything structural re-bases to a full view.
+    """
+    digests = _checksum_tree(payload)
+    if set(digests) != set(base_digests):
+        return None, digests
+    leaves = dict(_iter_leaves(payload))
+    changed = {p: leaves[p] for p, d in digests.items() if base_digests[p] != d}
+    return changed, digests
+
+
+def encode_delta_view(
+    changed: Dict[str, Any],
+    base_seq: int,
+    host_id: str,
+    seq: int,
+    updates: Optional[int] = None,
+    extra: Optional[Dict[str, Any]] = None,
+    encoding: Optional[str] = None,
+) -> bytes:
+    """Encode a per-leaf delta against an aggregator-held base view.
+
+    ``changed`` maps leaf tree paths (``delta_changes``' keys) to current
+    values; ``base_seq`` names the publish every attempted destination
+    ACCEPTED that this delta applies on top of — an aggregator holding any
+    other seq for this host answers ``rebase:<held>`` and the publisher's
+    next pass ships a full view. The blob's header ``encoding`` token is
+    ``delta-v1``, so pre-delta builds refuse it loudly. ``encoding``
+    (same resolution as :func:`encode_view`) selects the INNER coding of
+    the changed leaves: ``int8`` quantizes large floating leaves
+    blockwise — delta × int8, the near-constant steady-state wire.
+    Checksums cover the delta payload as encoded, exactly like full views.
+    """
+    if not host_id:
+        raise WireError("`host_id` must be a non-empty string")
+    inner = resolve_fleet_encoding(encoding)
+    wire_changed = (
+        {p: _encode_payload_int8(v) for p, v in changed.items()}
+        if inner == ENCODING_INT8
+        else dict(changed)
+    )
+    wire_payload = {
+        _DELTA_KEY: 1,
+        "base_seq": int(base_seq),
+        "inner": inner,
+        "changed": wire_changed,
+    }
+    header = {
+        "host_id": str(host_id),
+        "seq": int(seq),
+        "encoding": ENCODING_DELTA,
+        "published_unix": time.time(),
+        "updates": None if updates is None else int(updates),
+        "extra": dict(extra) if extra else None,
+    }
+    return pickle.dumps(
+        {
+            "magic": MAGIC,
+            "schema_version": SCHEMA_VERSION,
+            "header": header,
+            "payload": wire_payload,
+            "checksums": _checksum_tree({"header": header, "payload": wire_payload}),
+        },
+        protocol=4,
+    )
+
+
+def is_delta_payload(payload: Any) -> bool:
+    """True when a decoded payload is a delta diff (fold it with
+    :func:`apply_delta` onto the held base, never load it as a full view)."""
+    return isinstance(payload, dict) and payload.get(_DELTA_KEY) == 1
+
+
+def apply_delta(base_payload: Dict[str, Any], delta_payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Rebuild the full view: the held base tree with every changed leaf
+    replaced. Changed leaves arrive verbatim from the publisher's current
+    payload (or its deterministic int8 coding), so the folded result is
+    bit-equal to the full-view publish the delta replaced — pinned in
+    ``tests/fleet/test_delta.py``. Raises :class:`WireError` when any
+    changed path does not exist in the base (the publisher diffed against
+    a view this node never held — the caller answers ``rebase``)."""
+    changed = delta_payload["changed"]
+    unused = set(changed)
+
+    def rebuild(node: Any, path: str) -> Any:
+        if isinstance(node, dict):
+            return {k: rebuild(v, f"{path}/{k}") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rebuild(v, f"{path}/[{i}]") for i, v in enumerate(node))
+        if path in changed:
+            unused.discard(path)
+            return changed[path]
+        return node
+
+    out = rebuild(base_payload, "")
+    if unused:
+        first = sorted(unused, key=str)[0]
+        raise WireError(
+            f"delta names {len(unused)} leaf path(s) absent from the held base view "
+            f"(first: {first!r}) — base mismatch, re-base to a full view"
+        )
+    return out
+
+
 def _header_hint(record: Any) -> str:
     """Best-effort ``host=<id> seq=<n>`` naming for error messages — the
     header may itself be the corrupt part, so this never trusts it beyond
@@ -366,6 +512,31 @@ def decode_view(blob: bytes) -> Tuple[Dict[str, Any], Dict[str, Any]]:
             "(the idempotent fold cannot key it)"
         )
     payload = record["payload"]
+    if encoding == ENCODING_DELTA:
+        if (
+            not is_delta_payload(payload)
+            or not isinstance(payload.get("base_seq"), int)
+            or not isinstance(payload.get("changed"), dict)
+            or payload.get("inner") not in (ENCODING, ENCODING_INT8)
+        ):
+            raise WireCorruptionError(
+                f"fleet view ({_header_hint(record)}) claims {ENCODING_DELTA} but carries "
+                "no well-formed delta payload (base_seq/changed/inner) — refused"
+            )
+        if payload["inner"] == ENCODING_INT8:
+            try:
+                payload = {
+                    **payload,
+                    "changed": {
+                        p: _decode_payload_int8(v) for p, v in payload["changed"].items()
+                    },
+                }
+            except Exception as err:  # noqa: BLE001 — refusals stay typed (WireError)
+                raise WireCorruptionError(
+                    f"fleet view ({_header_hint(record)}) failed {ENCODING_INT8} delta-leaf "
+                    f"decode ({type(err).__name__}: {err}) — refused"
+                )
+        return header, payload
     if encoding == ENCODING_INT8:
         try:
             payload = _decode_payload_int8(payload)

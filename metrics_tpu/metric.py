@@ -1086,98 +1086,142 @@ class Metric:
         hosts by issue order, so a scheduler cycle and a concurrent
         blocking sync on another thread must serialize, never interleave
         their per-leaf gathers (ordering contract in
-        ``parallel/async_sync.py``)."""
-        from metrics_tpu.parallel.sync import gather_sequence_lock
+        ``parallel/async_sync.py``).
 
+        With ``METRICS_TPU_SYNC_CHUNKS`` > 1 and at least two states, the
+        sequence pipelines (ISSUE 16): per-state gathers still ISSUE in the
+        exact pre-existing order (the cross-host pairing contract), but each
+        state's fold — sketch rebuild+merge, stack+reduce — runs one job
+        behind on this thread while the next state's wire time elapses on
+        the issuer thread. Same knob as the in-graph chunk schedule, same
+        bit-identical guarantee (folds are order-preserving per state)."""
+        from metrics_tpu.parallel.sync import gather_sequence_lock, resolve_sync_chunks
+
+        pipeline = resolve_sync_chunks(None) > 1
         with gather_sequence_lock:
-            return self._gathered_state_seq(state, dist_sync_fn, process_group)
+            return self._gathered_state_seq(state, dist_sync_fn, process_group, pipeline=pipeline)
 
     def _gathered_state_seq(
         self,
         state: Dict[str, Any],
         dist_sync_fn: Callable,
         process_group: Optional[Any],
+        pipeline: bool = False,
     ) -> Dict[str, Any]:
+        from metrics_tpu.parallel.sync import run_gather_jobs
         from metrics_tpu.utilities.ringbuffer import CatBuffer
 
         from metrics_tpu.utilities.guard import FaultCounters
 
         state = dict(state)
-        input_dict = {attr: state[attr] for attr in self._reductions}
-        # CatBuffer states: gather data and mask; the union of valid rows is
-        # the stacked buffers (masked rows stay masked)
-        for attr, value in list(input_dict.items()):
+        group = self.process_group if process_group is None else process_group
+        gather = lambda x: dist_sync_fn(x, group)  # noqa: E731
+
+        # Each state becomes one (attr, issue, fold) job: `issue` performs
+        # its transport gathers, `fold` builds the synced value. Job order —
+        # special states (sketch/FaultCounters/CatBuffer) in state order,
+        # then plain/list states in state order — is the pre-refactor issue
+        # order, so cross-host collective pairing is unchanged whether the
+        # jobs run sequentially or pipelined (run_gather_jobs).
+        special_jobs = []
+        plain_attrs = []
+        for attr in self._reductions:
+            value = state[attr]
             if getattr(type(value), "is_sketch_state", False):
                 # gather every leaf per rank, rebuild the per-rank sketches,
                 # fold them through the sketch's own merge — the process-level
                 # analogue of fused_sync's sketch handling
-                group = self.process_group if process_group is None else process_group
                 leaves, treedef = jax.tree_util.tree_flatten(value)
-                gathered = [dist_sync_fn(leaf, group) for leaf in leaves]
-                n_ranks = len(gathered[0])
-                ranks = [
-                    jax.tree_util.tree_unflatten(treedef, [g[r] for g in gathered])
-                    for r in range(n_ranks)
-                ]
-                merged = ranks[0]
-                for other in ranks[1:]:
-                    merged = merged.sketch_merge(other)
-                state[attr] = merged
-                del input_dict[attr]
-                continue
-            if isinstance(value, FaultCounters):
-                group = self.process_group if process_group is None else process_group
-                gathered = dist_sync_fn(value.counts, group)
-                state[attr] = FaultCounters(counts=sum(jnp.asarray(g) for g in gathered))
-                del input_dict[attr]
-                continue
-            if isinstance(value, CatBuffer):
-                group = self.process_group if process_group is None else process_group
-                data = jnp.concatenate(dist_sync_fn(value.data, group), axis=0)
-                mask = jnp.concatenate(dist_sync_fn(value.mask, group), axis=0)
-                local_dropped = value.dropped if value.dropped is not None else jnp.zeros((), jnp.int32)
-                dropped = sum(dist_sync_fn(local_dropped, group))
-                state[attr] = CatBuffer(data=data, mask=mask, dropped=dropped)
-                del input_dict[attr]
-        if not input_dict:
-            return state
-        for attr in input_dict:
-            # pre-concat list states to minimize gathers (reference ``metric.py:352-354``)
-            if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
-                input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
-        output_dict = {
-            attr: [dist_sync_fn(x, self.process_group if process_group is None else process_group) for x in v]
-            if isinstance(v, list)
-            else dist_sync_fn(v, self.process_group if process_group is None else process_group)
-            for attr, v in input_dict.items()
-        }
+                def issue(leaves=leaves):
+                    return [gather(leaf) for leaf in leaves]
 
-        for attr, reduction_fn in self._reductions.items():
-            if attr not in output_dict:  # CatBuffer states handled above
-                continue
-            out = output_dict[attr]
-            if isinstance(state[attr], list):
-                state[attr] = _flatten(out) if out else []
-                continue
-            # out is a list of per-rank arrays
-            stacked = jnp.stack(out, axis=0)
-            if reduction_fn == "sum":
-                state[attr] = jnp.sum(stacked, axis=0)
-            elif reduction_fn == "mean":
-                state[attr] = jnp.mean(stacked, axis=0)
-            elif reduction_fn == "max":
-                state[attr] = jnp.max(stacked, axis=0)
-            elif reduction_fn == "min":
-                state[attr] = jnp.min(stacked, axis=0)
-            elif reduction_fn == "cat":
-                state[attr] = jnp.concatenate([jnp.atleast_1d(o) for o in out], axis=0)
-            elif callable(reduction_fn):
-                state[attr] = reduction_fn(stacked)
-            elif reduction_fn is None:
-                state[attr] = stacked
+                def fold(gathered, treedef=treedef):
+                    n_ranks = len(gathered[0])
+                    ranks = [
+                        jax.tree_util.tree_unflatten(treedef, [g[r] for g in gathered])
+                        for r in range(n_ranks)
+                    ]
+                    merged = ranks[0]
+                    for other in ranks[1:]:
+                        merged = merged.sketch_merge(other)
+                    return merged
+
+                special_jobs.append((attr, issue, fold))
+            elif isinstance(value, FaultCounters):
+
+                def issue(value=value):
+                    return gather(value.counts)
+
+                def fold(gathered):
+                    return FaultCounters(counts=sum(jnp.asarray(g) for g in gathered))
+
+                special_jobs.append((attr, issue, fold))
+            elif isinstance(value, CatBuffer):
+                # gather data and mask; the union of valid rows is the
+                # stacked buffers (masked rows stay masked)
+
+                def issue(value=value):
+                    local_dropped = (
+                        value.dropped if value.dropped is not None else jnp.zeros((), jnp.int32)
+                    )
+                    return (gather(value.data), gather(value.mask), gather(local_dropped))
+
+                def fold(gathered):
+                    data, mask, dropped = gathered
+                    return CatBuffer(
+                        data=jnp.concatenate(data, axis=0),
+                        mask=jnp.concatenate(mask, axis=0),
+                        dropped=sum(dropped),
+                    )
+
+                special_jobs.append((attr, issue, fold))
             else:
-                raise MetricsTPUUserError(f"Unsupported reduction: {reduction_fn}")
+                plain_attrs.append(attr)
+
+        jobs = special_jobs
+        for attr in plain_attrs:
+            value = state[attr]
+            reduction_fn = self._reductions[attr]
+            if isinstance(value, list):
+                # pre-concat list states to minimize gathers (reference
+                # ``metric.py:352-354``)
+                pre = [dim_zero_cat(value)] if len(value) >= 1 else []
+
+                def issue(pre=pre):
+                    return [gather(x) for x in pre]
+
+                def fold(out):
+                    return _flatten(out) if out else []
+
+                jobs.append((attr, issue, fold))
+            else:
+
+                def issue(value=value):
+                    return gather(value)
+
+                def fold(out, reduction_fn=reduction_fn):
+                    # out is a list of per-rank arrays
+                    stacked = jnp.stack(out, axis=0)
+                    if reduction_fn == "sum":
+                        return jnp.sum(stacked, axis=0)
+                    if reduction_fn == "mean":
+                        return jnp.mean(stacked, axis=0)
+                    if reduction_fn == "max":
+                        return jnp.max(stacked, axis=0)
+                    if reduction_fn == "min":
+                        return jnp.min(stacked, axis=0)
+                    if reduction_fn == "cat":
+                        return jnp.concatenate([jnp.atleast_1d(o) for o in out], axis=0)
+                    if callable(reduction_fn):
+                        return reduction_fn(stacked)
+                    if reduction_fn is None:
+                        return stacked
+                    raise MetricsTPUUserError(f"Unsupported reduction: {reduction_fn}")
+
+                jobs.append((attr, issue, fold))
+
+        state.update(run_gather_jobs(jobs, pipeline=pipeline))
         return state
 
     def sync(

@@ -85,9 +85,26 @@ def _shape_bytes(dtype: str, dims: str) -> int:
 def collective_payload_bytes(hlo: str) -> Dict[str, int]:
     """Total on-wire payload bytes per collective op in one optimized HLO
     module: for every collective instruction line, the byte size of its
-    RESULT shape(s) (combined tuple-shaped ops sum their members; an async
-    ``-start``/``-done`` pair counts once, on the start — the same
-    counting rule as ``analysis/graph_audit.py::collective_counts``)."""
+    RESULT shape(s) — combined tuple-shaped ops sum their members, and an
+    async ``-start``/``-done`` pair counts once, on the start (the same
+    one-instruction-per-line rule as
+    ``analysis/graph_audit.py::collective_counts``).
+
+    **Chunk-aware by construction:** a chunked ``fused_sync`` pipeline
+    (``METRICS_TPU_SYNC_CHUNKS``, ``parallel/sync.py``) lowers one
+    collective instruction PER CHUNK, each with its slice's shape — the
+    per-line walk sums them, so a k-chunk schedule reports the same total
+    payload as the monolithic op it replaced (the wire bytes moved are
+    identical; only the schedule changed). ``collective_counts`` groups
+    those same lines back into one LOGICAL collective via the
+    ``fused_sync_chunk_*`` markers — together: one logical op, its true
+    total payload.
+
+    **Async tuple results count once:** an ``all-reduce-start`` result is
+    the tuple ``(operand_shape, result_shape)`` — summing every member
+    would double the payload, so when a ``-start`` result's shape list
+    splits into two identical halves only one half is counted.
+    """
     from metrics_tpu.analysis.graph_audit import COLLECTIVE_OPS
 
     out = {op: 0 for op in COLLECTIVE_OPS}
@@ -105,7 +122,14 @@ def collective_payload_bytes(hlo: str) -> Dict[str, int]:
             head = line.split(token, 1)[0]
             if "=" in head:
                 head = head.split("=", 1)[1]
-            out[op] += sum(_shape_bytes(d, dims) for d, dims in _SHAPE_RE.findall(head))
+            shapes = _SHAPE_RE.findall(head)
+            if token.endswith("-start(") and len(shapes) % 2 == 0 and shapes:
+                half = len(shapes) // 2
+                if shapes[:half] == shapes[half:]:
+                    # (operands..., results...) async-start tuple: the two
+                    # halves alias the same transfer — count one
+                    shapes = shapes[half:]
+            out[op] += sum(_shape_bytes(d, dims) for d, dims in shapes)
             break  # one instruction per line
     return out
 

@@ -33,6 +33,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops._envtools import EnvParse, WarnOnce
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
 Array = jax.Array
 Reduction = Union[str, Callable, None]
 
@@ -49,6 +52,173 @@ gather_sequence_lock = threading.RLock()
 def distributed_available() -> bool:
     """Multi-process JAX runtime present (reference ``metric.py:40``)."""
     return jax.process_count() > 1
+
+
+# --------------------------------------------------------------------------
+# Chunked collective schedule (ISSUE 16)
+# --------------------------------------------------------------------------
+
+# Below this fused-bucket payload size the env-driven chunk knob keeps the
+# single-collective schedule: splitting a few hundred bytes into k psums
+# pays k dispatch latencies to overlap nothing. An explicit `chunks=`
+# argument bypasses the floor — the caller knows its payload.
+SYNC_CHUNK_MIN_BYTES = 1 << 14  # 16 KiB
+
+
+def _parse_sync_chunks(raw: str) -> Optional[int]:
+    try:
+        n = int(raw)
+        if n < 1:
+            raise ValueError
+        return n
+    except ValueError:
+        _chunks_warn_once(
+            ("sync-chunks", raw),
+            f"METRICS_TPU_SYNC_CHUNKS={raw!r} is not a positive integer; "
+            "keeping the single-collective fused_sync schedule.",
+        )
+        return None
+
+
+_chunks_warn_once = WarnOnce()
+_ENV_SYNC_CHUNKS = EnvParse("METRICS_TPU_SYNC_CHUNKS", _parse_sync_chunks, None)
+
+
+def resolve_sync_chunks(programmatic: Optional[int] = None) -> int:
+    """Resolve the fused-sync chunk count: programmatic override >
+    ``METRICS_TPU_SYNC_CHUNKS`` > 1 (the monolithic schedule).
+
+    Resolution happens at trace time (the env knob re-chunks without a code
+    change; a changed value recompiles, same as the transport knob). A
+    malformed env value warns ONCE and keeps 1 — chunking is a performance
+    schedule, never a correctness switch. A programmatic value must be a
+    positive integer (caller bug → raise, not warn).
+    """
+    if programmatic is not None:
+        if not isinstance(programmatic, int) or isinstance(programmatic, bool) or programmatic < 1:
+            raise MetricsTPUUserError(
+                f"sync chunk count must be a positive integer, got {programmatic!r}"
+            )
+        return programmatic
+    value = _ENV_SYNC_CHUNKS()
+    return 1 if value is None else value
+
+
+def reset_sync_chunks_env_state() -> None:
+    """Forget the memoized ``METRICS_TPU_SYNC_CHUNKS`` parse and its
+    warn-once memory (test isolation, the shared ``_envtools`` contract)."""
+    _chunks_warn_once.reset()
+    _ENV_SYNC_CHUNKS.reset()
+
+
+def _chunked_sync_leaf(
+    flat: Array,
+    fx: Reduction,
+    axis_name: str,
+    chunks: int,
+    min_bytes: int = 0,
+    tag: str = "",
+) -> Array:
+    """Pipelined chunk schedule for one fused bucket.
+
+    The flat payload splits into ``chunks`` contiguous slices, each synced as
+    its own collective under a ``fused_sync_chunk_<i>of<k>`` named scope (the
+    marker ``collective_counts`` groups back into ONE logical collective).
+    Emitting k independent psums lets the compiler's async scheduler overlap
+    chunk i's consumer (the scatter-back fold) with chunk i+1's transfer —
+    the start/done pair split T3-style — where the monolithic op serializes
+    transfer then fold. Every bucket reduction is elementwise (sum/mean/
+    max/min), so per-slice collectives followed by concatenation are
+    BIT-IDENTICAL to the single collective over the concatenation (pinned in
+    ``tests/parallel/test_chunked_sync.py``).
+
+    ``min_bytes`` (the env-auto floor) keeps the single op when the payload
+    is too small for overlap to beat per-op dispatch latency. ``tag``
+    disambiguates pipelines lowered at the same trace scope (fused_sync
+    appends the bucket's reduction+dtype) — without it two buckets' chunk
+    ops would share one op_name and miscount as a single logical pipeline.
+    """
+    n = int(flat.shape[0])
+    chunks = max(1, min(int(chunks), n if n else 1))
+    if chunks <= 1 or n * flat.dtype.itemsize < min_bytes:
+        return sync_leaf(flat, fx, axis_name)
+    suffix = f"_{tag}" if tag else ""
+    base, rem = divmod(n, chunks)
+    parts = []
+    offset = 0
+    for c in range(chunks):
+        size = base + (1 if c < rem else 0)
+        piece = jax.lax.slice_in_dim(flat, offset, offset + size)
+        with jax.named_scope(f"fused_sync_chunk_{c}of{chunks}{suffix}"):
+            parts.append(sync_leaf(piece, fx, axis_name))
+        offset += size
+    return jnp.concatenate(parts)
+
+
+def run_gather_jobs(
+    jobs: Sequence[Tuple[str, Callable[[], Any], Callable[[Any], Any]]],
+    pipeline: bool = False,
+) -> Dict[str, Any]:
+    """Run an ordered sequence of host-level gather jobs, optionally
+    overlapping each job's fold with the next job's transport gathers.
+
+    Each job is ``(key, issue, fold)``: ``issue()`` performs that job's
+    transport gather(s) and returns the raw results; ``fold(raw)`` turns
+    them into the final value. ``issue`` calls ALWAYS run strictly in list
+    order — process-level collectives pair across hosts by issue order, so
+    reordering them would desynchronize the pod. Sequential mode folds each
+    job before issuing the next (the pre-ISSUE-16 behavior, bit-identical by
+    construction). Pipelined mode moves the issue loop to a dedicated
+    daemon thread feeding a bounded queue while folds run on the calling
+    thread one job behind — fold compute of job i overlaps the wire time of
+    job i+1, the host-tier mirror of the in-graph chunk schedule. The
+    CALLER must hold ``gather_sequence_lock`` around the whole call (as
+    ``Metric._gathered_state`` does); the issuer thread inherits that
+    exclusivity because the lock serializes *sequences*, not threads.
+
+    A raised ``issue`` propagates to the caller; a raised ``fold`` stops the
+    issuer before it starts the next gather. Returns ``{key: fold(issue())}``
+    with every job folded, identical between the two modes.
+    """
+    if not pipeline or len(jobs) < 2:
+        return {key: fold(issue()) for key, issue, fold in jobs}
+
+    import queue
+
+    q: "queue.Queue" = queue.Queue(maxsize=2)
+    stop = threading.Event()
+    _ERR = object()
+
+    def _issuer() -> None:
+        try:
+            for key, issue, fold in jobs:
+                if stop.is_set():
+                    return
+                raw = issue()
+                q.put((key, fold, raw))
+        except BaseException as err:  # propagate to the folding thread
+            q.put((_ERR, err, None))
+
+    worker = threading.Thread(target=_issuer, daemon=True, name="metrics-tpu-gather-pipeline")
+    worker.start()
+    out: Dict[str, Any] = {}
+    try:
+        for _ in range(len(jobs)):
+            key, fold, raw = q.get()
+            if key is _ERR:
+                raise fold
+            out[key] = fold(raw)
+    finally:
+        stop.set()
+        # a fold failure leaves the issuer possibly blocked on the bounded
+        # queue; drain until the thread exits so it never outlives the call
+        while worker.is_alive():
+            try:
+                q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+            worker.join(timeout=0.05)
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -202,6 +372,7 @@ def fused_sync(
     axis_name: str,
     defaults: Optional[Sequence[Dict[str, Any]]] = None,
     transport: Optional[str] = None,
+    chunks: Optional[int] = None,
 ) -> List[Dict[str, Any]]:
     """Sync many metrics' states with one collective per (reduction, dtype).
 
@@ -236,6 +407,16 @@ def fused_sync(
     lossless paths stay lossless — and ``transport="exact"`` (the default)
     takes literally the pre-existing code path, bit-identical.
 
+    ``chunks`` selects the pipelined chunk schedule (ISSUE 16): each fused
+    bucket's flat payload splits into that many per-chunk collectives (see
+    :func:`_chunked_sync_leaf`) so the compiler can overlap chunk i's
+    scatter-back fold with chunk i+1's transfer. ``None`` resolves
+    ``METRICS_TPU_SYNC_CHUNKS`` at trace time with the
+    ``SYNC_CHUNK_MIN_BYTES`` auto-floor (small states keep the single-op
+    schedule); an explicit count is honored as given. Either way the synced
+    values are bit-identical to the monolithic schedule — bucket reductions
+    are elementwise, so slicing commutes with the collective.
+
     ``defaults`` (optional, one dict per metric) supplies templates for
     empty list states, as in :func:`sync_state`.
     """
@@ -245,6 +426,12 @@ def fused_sync(
 
     codec = resolve_codec(transport)
     quantized = codec.name != "exact"
+    if chunks is None:
+        n_chunks = resolve_sync_chunks(None)
+        chunk_floor = SYNC_CHUNK_MIN_BYTES
+    else:
+        n_chunks = resolve_sync_chunks(chunks)
+        chunk_floor = 0
 
     buckets: Dict[Tuple[str, Any], List[Tuple[int, str, Array]]] = {}
     fault_slots: set = set()
@@ -304,7 +491,14 @@ def fused_sync(
     gathered_payload: Optional[Array] = None
     for (fx, _dtype), leaves in buckets.items():
         flat = jnp.concatenate([v.ravel() for (_, _, v) in leaves])
-        synced = sync_leaf(flat, fx, axis_name)
+        synced = _chunked_sync_leaf(
+            flat,
+            fx,
+            axis_name,
+            n_chunks,
+            min_bytes=chunk_floor,
+            tag=f"{fx}_{jnp.dtype(_dtype).name}",
+        )
         offset = 0
         for (i, name, v) in leaves:
             leaf = jax.lax.dynamic_slice_in_dim(synced, offset, v.size).reshape(v.shape)

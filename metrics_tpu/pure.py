@@ -25,7 +25,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional
 
 import jax
 
-from metrics_tpu.parallel.sync import sync_state
+from metrics_tpu.parallel.sync import resolve_sync_chunks, sync_state
 
 
 class MetricDef(NamedTuple):
@@ -387,7 +387,10 @@ class OverlappedDef(NamedTuple):
 
 
 def _fused_sync_tree(
-    metric: "Metric", axis_name: str, transport: Optional[str] = None
+    metric: "Metric",
+    axis_name: str,
+    transport: Optional[str] = None,
+    chunks: Optional[int] = None,
 ) -> Callable[[Any], Any]:
     """Build ``state -> globally-synced state`` as ONE ``fused_sync`` over
     every leaf row of a metric / trace-safe wrapper / collection — one
@@ -396,7 +399,9 @@ def _fused_sync_tree(
     members separately; the cycle fuses them into the same buckets).
     ``transport`` names the wire codec for the float-sum/sketch lanes
     (``ops/quantize.py``; ``None`` resolves the env-backed default at
-    trace time)."""
+    trace time). ``chunks`` selects the pipelined chunk schedule for the
+    fused buckets (``parallel/sync.py``; ``None`` resolves
+    ``METRICS_TPU_SYNC_CHUNKS`` with its payload floor at trace time)."""
     from metrics_tpu.collections import MetricCollection  # local import to avoid cycle
     from metrics_tpu.parallel.sync import fused_sync
 
@@ -422,6 +427,7 @@ def _fused_sync_tree(
                 axis_name,
                 defaults=[d for _, _, _, d in row_meta],
                 transport=transport,
+                chunks=chunks,
             )
             out = {
                 name: (list(state[name]) if name in wrapper_names else state[name])
@@ -443,7 +449,12 @@ def _fused_sync_tree(
 
         def sync_tree(states):
             return fused_sync(
-                [dict(s) for s in states], reds, axis_name, defaults=defs, transport=transport
+                [dict(s) for s in states],
+                reds,
+                axis_name,
+                defaults=defs,
+                transport=transport,
+                chunks=chunks,
             )
 
         return sync_tree
@@ -453,7 +464,12 @@ def _fused_sync_tree(
 
     def sync_tree(state):
         return fused_sync(
-            [dict(state)], [reds_one], axis_name, defaults=[defs_one], transport=transport
+            [dict(state)],
+            [reds_one],
+            axis_name,
+            defaults=[defs_one],
+            transport=transport,
+            chunks=chunks,
         )[0]
 
     return sync_tree
@@ -463,6 +479,7 @@ def overlapped_functionalize(
     metric: "Metric",
     axis_name: Optional[str] = None,
     sync_transport: Optional[str] = None,
+    sync_chunks: Optional[int] = None,
 ) -> OverlappedDef:
     """Build the overlapped (double-buffered) pure API for a metric or
     collection — see :class:`OverlappedDef` for the state layout and
@@ -483,6 +500,14 @@ def overlapped_functionalize(
     hatch — ALWAYS syncs with the ``exact`` transport, whatever the cycle
     ships.
 
+    ``sync_chunks`` selects the pipelined chunk schedule for the cycle's
+    fused collectives (ISSUE 16, ``parallel/sync.py``): the cycle is the
+    first customer because its wall is pure collective latency — chunk i's
+    scatter-back fold overlaps chunk i+1's transfer, bit-identically.
+    ``None`` resolves ``METRICS_TPU_SYNC_CHUNKS`` (with the payload-size
+    auto-floor) at trace time; ``read_fresh`` shares the schedule (it
+    changes wall time, never values).
+
     Example (single-device form)::
 
         odef = overlapped_functionalize(Accuracy(num_classes=3))
@@ -496,15 +521,17 @@ def overlapped_functionalize(
     from metrics_tpu.ops.quantize import validate_transport
 
     validate_transport(sync_transport)
+    if sync_chunks is not None:
+        resolve_sync_chunks(sync_chunks)  # validate eagerly: caller bug → raise here
     mdef = functionalize(metric)  # NO axis: local update + local compute
     sync_tree = (
-        _fused_sync_tree(metric, axis_name, transport=sync_transport)
+        _fused_sync_tree(metric, axis_name, transport=sync_transport, chunks=sync_chunks)
         if axis_name is not None
         else (lambda s: s)
     )
     # the blocking escape hatch reads at full width: exact wire, always
     sync_tree_fresh = (
-        _fused_sync_tree(metric, axis_name, transport="exact")
+        _fused_sync_tree(metric, axis_name, transport="exact", chunks=sync_chunks)
         if axis_name is not None
         else (lambda s: s)
     )

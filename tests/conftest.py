@@ -107,6 +107,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "overlap: the chunked collective/compute overlap + delta-publishing "
+        "layer (parallel/sync.py chunked fused_sync schedules + the "
+        "run_gather_jobs pipeline, METRICS_TPU_SYNC_CHUNKS resolution, "
+        "graph_audit logical-vs-physical collective counting, fleet delta "
+        "publishing with re-base chaos coverage); select with -m overlap, "
+        "or run the lane via `make test-overlap`",
+    )
+    config.addinivalue_line(
+        "markers",
         "async_sync: the overlapped async sync layer (parallel/async_sync.py "
         "scheduler, Metric(sync_mode='overlapped'), pure.py::"
         "overlapped_functionalize) — double-buffered zero-collective-latency "

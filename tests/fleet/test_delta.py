@@ -1,0 +1,465 @@
+"""Delta fleet publishing (ISSUE 16, service-tier half): per-leaf dirty
+tracking against the last all-accepted view, the commit-on-all-accept /
+re-base-on-anything-else protocol, the ``delta-v1`` wire token old builds
+refuse loudly, delta × int8 composition, and the chaos paths — every one
+of which must leave the folded aggregator state bit-equal to a full-view
+publish of the same source.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.fleet import Aggregator, FleetPublisher, reset_fleet_env_state
+from metrics_tpu.fleet import wire
+from metrics_tpu.fleet.wire import (
+    ENCODING_DELTA,
+    WireError,
+    WireSchemaError,
+    apply_delta,
+    decode_view,
+    delta_changes,
+    encode_delta_view,
+    encode_view,
+    is_delta_payload,
+    _checksum_tree,
+)
+from metrics_tpu.obs.runtime_metrics import registry as obs_registry
+from metrics_tpu.resilience.health import registry as health_registry
+from tests.helpers.fault_injection import FlappingChannel, RecordingChannel
+
+pytestmark = [pytest.mark.fleet, pytest.mark.overlap, pytest.mark.faults]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_FLEET_DELTA", raising=False)
+    monkeypatch.delenv("METRICS_TPU_FLEET_ENCODING", raising=False)
+    health_registry.clear()
+    reset_fleet_env_state()
+    yield
+    health_registry.clear()
+    reset_fleet_env_state()
+
+
+def _metric(seed: int = 0, n: int = 64):
+    rng = np.random.default_rng(seed)
+    m = mt.Accuracy(num_classes=4)
+    m.update(jnp.asarray(rng.integers(0, 4, n)), jnp.asarray(rng.integers(0, 4, n)))
+    return m
+
+
+def _grow(m, seed: int):
+    rng = np.random.default_rng(seed)
+    m.update(jnp.asarray(rng.integers(0, 4, 16)), jnp.asarray(rng.integers(0, 4, 16)))
+
+
+def _held_digests(agg, host):
+    with agg._lock:
+        return _checksum_tree(agg._views[host]["payload"])
+
+
+class TestDeltaWire:
+    def test_roundtrip_applies_bit_equal(self):
+        m = _metric()
+        base = m.snapshot_state()
+        base_digests = _checksum_tree(base)
+        _grow(m, 1)
+        current = m.snapshot_state()
+        changed, digests = delta_changes(current, base_digests)
+        assert changed is not None and changed  # some leaves dirty
+        blob = encode_delta_view(changed, base_seq=7, host_id="h", seq=8)
+        header, payload = decode_view(blob)
+        assert header["encoding"] == ENCODING_DELTA
+        assert is_delta_payload(payload)
+        assert payload["base_seq"] == 7
+        rebuilt = apply_delta(base, payload)
+        assert _checksum_tree(rebuilt) == digests  # bit-equal to current
+
+    def test_unchanged_leaves_are_not_shipped(self):
+        m = _metric()
+        base = m.snapshot_state()
+        changed, digests = delta_changes(base, _checksum_tree(base))
+        assert changed == {}  # steady state: nothing dirty
+        blob = encode_delta_view(changed, base_seq=1, host_id="h", seq=2)
+        full = encode_view(base, host_id="h", seq=2)
+        assert len(blob) < len(full)
+
+    def test_structural_change_refuses_to_diff(self):
+        m = _metric()
+        base_digests = _checksum_tree(m.snapshot_state())
+        grown = dict(m.snapshot_state())
+        grown["extra_member"] = 1  # leaf path set differs
+        changed, _digests = delta_changes(grown, base_digests)
+        assert changed is None  # structural → re-base to full
+
+    def test_pre_delta_build_refuses_loudly(self, monkeypatch):
+        """An aggregator built before delta-v1 does not list the token in
+        SUPPORTED_ENCODINGS — decode must raise the schema error naming its
+        supported set, never fold a partial tree as a full view."""
+        blob = encode_delta_view({}, base_seq=1, host_id="h", seq=2)
+        monkeypatch.setattr(
+            wire, "SUPPORTED_ENCODINGS", (wire.ENCODING, wire.ENCODING_INT8)
+        )
+        with pytest.raises(WireSchemaError, match="delta-v1"):
+            decode_view(blob)
+
+    def test_mismatched_base_path_raises(self):
+        m = _metric()
+        base = m.snapshot_state()
+        blob = encode_delta_view(
+            {"/states/nonexistent": 3}, base_seq=1, host_id="h", seq=2
+        )
+        _header, payload = decode_view(blob)
+        with pytest.raises(WireError, match="re-base"):
+            apply_delta(base, payload)
+
+
+class TestSteadyState:
+    def test_second_publish_is_a_delta_and_folds_bit_equal(self):
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = RecordingChannel(agg.ingest)
+        m = _metric()
+        pub = FleetPublisher(m, chan, host_id="h0", start=False, delta=True)
+        assert pub.publish_now() == {"default": "ok"}  # no base yet: full
+        _grow(m, 2)
+        assert pub.publish_now() == {"default": "ok"}  # delta
+        _header, payload = decode_view(chan.blobs[-1])
+        assert is_delta_payload(payload)
+        # the aggregator's reconstructed view is bit-equal to the source
+        assert _held_digests(agg, "h0") == _checksum_tree(m.snapshot_state())
+        assert agg.report()["value"] == float(m.compute())
+
+    def test_steady_state_delta_is_under_ten_percent_of_full(self):
+        """The ISSUE 16 acceptance shape, wire-level: a view whose bytes
+        are dominated by unchanged leaves (the realistic large-state case)
+        ships a steady-state delta ≤10%% of the full blob — the same ratio
+        bench.py's fleet_bytes phase prices at 8/32/128 hosts."""
+
+        class BigSource:
+            # one 32 KiB leaf that never changes + a counter that does
+            def __init__(self):
+                self.n = 0
+                self.big = np.zeros(8192, np.float32)
+
+            def snapshot_state(self):
+                return {
+                    "states": {"big": self.big, "n": np.int64(self.n)},
+                    "update_count": self.n,
+                }
+
+        src = BigSource()
+        chan = RecordingChannel(lambda blob: "accepted")
+        pub = FleetPublisher(src, chan, host_id="h0", start=False, delta=True)
+        pub.publish_now()
+        full_bytes = len(chan.blobs[-1])
+        src.n += 1
+        pub.publish_now()
+        _header, payload = decode_view(chan.blobs[-1])
+        assert is_delta_payload(payload)
+        assert set(payload["changed"]) == {"/states/n", "/update_count"}
+        assert len(chan.blobs[-1]) <= 0.1 * full_bytes
+
+    def test_idle_cadence_delta_is_near_empty(self):
+        """No updates between cadences: the delta carries zero changed
+        leaves — pure header+checksum overhead, well below the full view
+        even for a tiny Accuracy payload."""
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(_metric(), chan, host_id="h0", start=False, delta=True)
+        pub.publish_now()
+        full_bytes = len(chan.blobs[-1])
+        pub.publish_now()  # nothing changed
+        _header, payload = decode_view(chan.blobs[-1])
+        assert is_delta_payload(payload) and payload["changed"] == {}
+        assert len(chan.blobs[-1]) < 0.6 * full_bytes
+
+    def test_env_knob_opts_in(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_FLEET_DELTA", "on")
+        reset_fleet_env_state()
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(_metric(), chan, host_id="h0", start=False)
+        pub.publish_now()
+        pub.publish_now()
+        _header, payload = decode_view(chan.blobs[-1])
+        assert is_delta_payload(payload)
+
+    def test_off_by_default_ships_full_views(self):
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(_metric(), chan, host_id="h0", start=False)
+        pub.publish_now()
+        pub.publish_now()
+        for blob in chan.blobs:
+            _header, payload = decode_view(blob)
+            assert not is_delta_payload(payload)
+
+    def test_self_metrics_and_scrape(self):
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        pub = FleetPublisher(
+            _metric(), RecordingChannel(agg.ingest), host_id="h0", start=False, delta=True
+        )
+        full0 = obs_registry.counter("fleet_publish_full_total").value
+        delta0 = obs_registry.counter("fleet_publish_delta_total").value
+        pub.publish_now()
+        pub.publish_now()
+        assert obs_registry.counter("fleet_publish_full_total").value == full0 + 1
+        assert obs_registry.counter("fleet_publish_delta_total").value == delta0 + 1
+        ratio = obs_registry.gauge("fleet_delta_ratio").value
+        assert 0.0 < ratio < 1.0  # steady-state delta beats the full view
+        from metrics_tpu.obs.export import prometheus_text
+
+        text = prometheus_text()
+        assert "fleet_delta_ratio" in text
+        assert "fleet_publish_delta_total" in text
+
+
+class TestDeltaInt8:
+    def test_delta_times_int8_folds_bit_equal_to_full_int8(self):
+        """Deterministic quantization: unchanged leaves held at the
+        aggregator equal what a fresh full int8 view would decode, so the
+        delta+int8 fold is bit-equal to the full+int8 fold."""
+        m = _metric(seed=3, n=512)
+        agg_delta = Aggregator(mt.Accuracy(num_classes=4), node_id="d")
+        agg_full = Aggregator(mt.Accuracy(num_classes=4), node_id="f")
+        cd = RecordingChannel(agg_delta.ingest)
+        cf = RecordingChannel(agg_full.ingest)
+        pd = FleetPublisher(m, cd, host_id="h", start=False, delta=True, encoding="int8")
+        pf = FleetPublisher(m, cf, host_id="h", start=False, encoding="int8")
+        for seed in (11, 12, 13):
+            pd.publish_now()
+            pf.publish_now()
+            _grow(m, seed)
+        pd.publish_now()
+        pf.publish_now()
+        # at least one of the delta publisher's blobs was a real delta
+        kinds = [is_delta_payload(decode_view(b)[1]) for b in cd.blobs]
+        assert any(kinds)
+        assert _held_digests(agg_delta, "h") == _held_digests(agg_full, "h")
+        assert agg_delta.report()["value"] == agg_full.report()["value"]
+
+
+class TestRebaseChaos:
+    """Every re-base path: the folded state afterwards must be bit-equal
+    to the publisher's current view (the full-view reference)."""
+
+    def test_aggregator_restart_answers_rebase_then_recovers(self):
+        m = _metric()
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(m, chan, host_id="h0", start=False, delta=True)
+        pub.publish_now()
+        _grow(m, 4)
+        pub.publish_now()  # delta; base committed
+        # SIGKILL-equivalent: a fresh aggregator holds nothing
+        agg2 = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan.sink = agg2.ingest
+        _grow(m, 5)
+        out = pub.publish_now()
+        assert out == {"default": "ok"}
+        # the delta was refused with a rebase answer, not folded
+        assert agg2.stats()["hosts"] == 0
+        assert any(
+            e["kind"] == "fleet_delta_rebase" for e in health_registry.events()
+        )
+        # next pass re-bases to a full view and the fold catches up bit-equal
+        pub.publish_now()
+        assert _held_digests(agg2, "h0") == _checksum_tree(m.snapshot_state())
+        assert agg2.report()["value"] == float(m.compute())
+
+    def test_rebase_against_partial_history(self):
+        """The aggregator restarts holding a REPLAYED older full view (seq
+        mismatch, not absence): the delta names a base_seq the node does
+        not hold — rebase answer, then full re-ship."""
+        m = _metric()
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(m, chan, host_id="h0", start=False, delta=True)
+        pub.publish_now()
+        first_full = chan.blobs[-1]
+        _grow(m, 6)
+        pub.publish_now()  # delta on top of publish 1 (base advances to 2)
+        _grow(m, 7)
+        pub.publish_now()  # delta on top of publish 2
+        last_delta = chan.blobs[-1]
+        agg2 = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        assert agg2.ingest(first_full) == "accepted"  # replayed OLD view only
+        # the latest delta names base_seq=2; agg2 holds seq 1 — refuse
+        answer = agg2.ingest(last_delta)
+        assert answer.startswith("rebase:")
+        # the held (old) view keeps serving; nothing was corrupted
+        assert agg2.stats()["accepted"] == 1
+        # the publisher re-bases and the fold catches up bit-equal
+        chan.sink = agg2.ingest
+        _grow(m, 8)
+        pub.publish_now()  # answered rebase (or folds, if base still matches)
+        pub.publish_now()  # at most one pass later, a full view lands
+        assert _held_digests(agg2, "h0") == _checksum_tree(m.snapshot_state())
+
+    def test_reject_mid_stream_clears_the_base(self):
+        """A destination failure mid-stream (every attempt fails for one
+        pass) must clear the base: the next accepted publish is a FULL
+        view, never a delta the destination cannot fold."""
+        m = _metric()
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        chan = FlappingChannel(0, agg.ingest)
+        pub = FleetPublisher(
+            m,
+            chan,
+            host_id="h0",
+            start=False,
+            delta=True,
+            deadline_s=0.5,
+            max_retries=0,
+            backoff_s=0.01,
+            breaker_cooldown_s=0.05,
+        )
+        pub.publish_now()
+        _grow(m, 8)
+        pub.publish_now()  # delta; base now at seq 2
+        chan.fail_times = chan.calls + 100  # outage starts
+        _grow(m, 9)
+        out = pub.publish_now()
+        assert out["default"].startswith("failed:") or out["default"].startswith("skipped:")
+        chan.fail_times = 0  # recovery
+        import time
+
+        time.sleep(0.1)  # let the breaker cooldown pass
+        _grow(m, 10)
+        pub.publish_now()
+        _header, payload = decode_view(chan.blobs[-1])
+        assert not is_delta_payload(payload)  # re-based to full
+        assert _held_digests(agg, "h0") == _checksum_tree(m.snapshot_state())
+
+    def test_seq_regression_after_host_restart(self):
+        """A restarted host (same host_id, backward-stepped clock) publishes
+        duplicate-answered views; the jump clears the delta base, so the
+        post-jump publish is a FULL view the aggregator folds bit-equal."""
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        m = _metric()
+        chan = RecordingChannel(agg.ingest)
+        pub = FleetPublisher(m, chan, host_id="h0", start=False, delta=True)
+        pub.publish_now()
+        pub.publish_now()  # delta; base committed
+        # restart: a new publisher whose clock stepped backward
+        m2 = _metric(seed=42)
+        pub2 = FleetPublisher(m2, chan, host_id="h0", start=False, delta=True)
+        with pub2._lock:
+            pub2._seq = 1  # far below the aggregator's held seq
+        import metrics_tpu.fleet.publisher as pubmod
+
+        orig = pubmod.next_seq
+        pubmod.next_seq = lambda prev: prev + 1  # freeze the wall-clock floor
+        try:
+            outs = [pub2.publish_now() for _ in range(4)]
+        finally:
+            pubmod.next_seq = orig
+        assert all(o == {"default": "ok"} for o in outs)
+        # three consecutive duplicates → jump; the next publish folds
+        assert any(
+            e["kind"] == "fleet_seq_regression" for e in health_registry.events()
+        )
+        pub2.publish_now()
+        assert _held_digests(agg, "h0") == _checksum_tree(m2.snapshot_state())
+        assert agg.report()["value"] == float(m2.compute())
+
+    def test_flapping_destination_every_accepted_state_bit_equal(self):
+        """A destination alternating dead/alive: whatever subset of passes
+        lands, after every ACCEPTED publish the held view is bit-equal to
+        the source at that moment (deltas only ever fold onto
+        all-accepted bases)."""
+        m = _metric()
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+
+        class Alternating(RecordingChannel):
+            def __call__(self, blob):
+                self.calls += 1
+                if self.calls % 2 == 0:
+                    raise ConnectionError("flap")
+                return self.deliver(blob)
+
+        chan = Alternating(agg.ingest)
+        pub = FleetPublisher(
+            m,
+            chan,
+            host_id="h0",
+            start=False,
+            delta=True,
+            deadline_s=0.5,
+            max_retries=0,
+            backoff_s=0.01,
+            breaker_cooldown_s=0.001,
+        )
+        import time
+
+        ok_passes = 0
+        for seed in range(20, 30):
+            out = pub.publish_now()
+            if out["default"] == "ok":
+                ok_passes += 1
+                assert _held_digests(agg, "h0") == _checksum_tree(m.snapshot_state())
+            _grow(m, seed)
+            time.sleep(0.002)  # let any opened breaker cool down
+        assert ok_passes >= 3  # the flap injected real successes AND failures
+        assert chan.calls > ok_passes
+        assert agg.stats()["hosts"] == 1
+
+    def test_multi_destination_partial_failure_blocks_the_commit(self):
+        """Two destinations, one dead and ATTEMPTED: the pass cannot commit
+        a base (the dead one holds nothing), so the next publish is full.
+        Once the dead destination's breaker opens it stops being attempted
+        — the healthy destination then earns deltas, and the dead one, on
+        recovery, answers rebase and is healed by a full re-ship."""
+        m = _metric()
+        agg = Aggregator(mt.Accuracy(num_classes=4), node_id="pod")
+        good = RecordingChannel(agg.ingest)
+
+        class Dead(RecordingChannel):
+            def __init__(self, sink=None):
+                super().__init__(sink)
+                self.dead = True
+
+            def __call__(self, blob):
+                self.calls += 1
+                if self.dead:
+                    raise ConnectionError("dead")
+                return self.deliver(blob)
+
+        agg_b = Aggregator(mt.Accuracy(num_classes=4), node_id="pod-b")
+        dead = Dead(agg_b.ingest)
+        pub = FleetPublisher(
+            m,
+            {"good": good, "dead": dead},
+            host_id="h0",
+            start=False,
+            delta=True,
+            deadline_s=0.5,
+            max_retries=0,
+            backoff_s=0.01,
+            breaker_cooldown_s=1000.0,
+        )
+        pub.publish_now()  # dead attempted and failed → no base commit
+        assert pub._delta_base is None
+        _grow(m, 31)
+        pub.publish_now()  # dead now breaker-open: only good attempted
+        _header, payload = decode_view(good.blobs[-1])
+        assert not is_delta_payload(payload)  # no base → still full
+        assert pub._delta_base is not None  # good accepted → commit
+        _grow(m, 32)
+        pub.publish_now()  # good earns a delta now
+        _header, payload = decode_view(good.blobs[-1])
+        assert is_delta_payload(payload)
+        assert _held_digests(agg, "h0") == _checksum_tree(m.snapshot_state())
+        # recovery: force the breaker shut by rebuilding the policy window —
+        # simplest honest path is a fresh publisher, same host identity
+        dead.dead = False
+        pub2 = FleetPublisher(
+            m, {"good": good, "dead": dead}, host_id="h0", start=False, delta=True
+        )
+        with pub2._lock:
+            pub2._seq = pub._seq  # continue the sequence
+        _grow(m, 33)
+        pub2.publish_now()  # fresh publisher: full view to both
+        assert _held_digests(agg, "h0") == _checksum_tree(m.snapshot_state())
+        assert _held_digests(agg_b, "h0") == _checksum_tree(m.snapshot_state())

@@ -1,0 +1,354 @@
+"""Chunked collective/compute overlap (ISSUE 16, in-graph half): the
+pipelined ``fused_sync`` chunk schedule is bit-identical to the monolithic
+psum, ``METRICS_TPU_SYNC_CHUNKS`` resolves with the auto-floor, the budget
+auditor counts a k-chunk pipeline as ONE logical collective (while the
+physical count and payload totals stay honest), and the host-tier
+``run_gather_jobs`` pipeline preserves issue order under faults.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu import metric as metric_mod
+from metrics_tpu.analysis.graph_audit import (
+    collective_counts,
+    hlo_of,
+    physical_collective_counts,
+)
+from metrics_tpu.obs.profile import collective_payload_bytes
+from metrics_tpu.parallel.sync import (
+    SYNC_CHUNK_MIN_BYTES,
+    _pad_gather_trim,
+    fused_sync,
+    reset_sync_chunks_env_state,
+    resolve_sync_chunks,
+    run_gather_jobs,
+)
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+pytestmark = [pytest.mark.overlap, pytest.mark.async_sync]
+
+NDEV = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_chunks_env(monkeypatch):
+    monkeypatch.delenv("METRICS_TPU_SYNC_CHUNKS", raising=False)
+    reset_sync_chunks_env_state()
+    yield
+    reset_sync_chunks_env_state()
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+
+class TestResolveSyncChunks:
+    def test_default_is_monolithic(self):
+        assert resolve_sync_chunks(None) == 1
+
+    def test_env_resolves(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_CHUNKS", "4")
+        reset_sync_chunks_env_state()
+        assert resolve_sync_chunks(None) == 4
+
+    def test_programmatic_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_CHUNKS", "4")
+        reset_sync_chunks_env_state()
+        assert resolve_sync_chunks(2) == 2
+
+    @pytest.mark.parametrize("raw", ["zero?", "-3", "0", "1.5"])
+    def test_malformed_env_warns_once_and_falls_back(self, monkeypatch, raw):
+        monkeypatch.setenv("METRICS_TPU_SYNC_CHUNKS", raw)
+        reset_sync_chunks_env_state()
+        with pytest.warns(UserWarning, match="METRICS_TPU_SYNC_CHUNKS"):
+            assert resolve_sync_chunks(None) == 1
+        # memoized: the second read must not warn again
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert resolve_sync_chunks(None) == 1
+
+    @pytest.mark.parametrize("bad", [0, -1, "4"])
+    def test_programmatic_typo_raises(self, bad):
+        with pytest.raises(MetricsTPUUserError):
+            resolve_sync_chunks(bad)
+
+
+def _fused_step(chunks):
+    """One fused_sync over a >16KiB float sum bucket + a max bucket + an
+    int32 counter, inside shard_map — big enough that even the env
+    auto-floor keeps it chunked."""
+
+    def step(v):
+        state = {
+            "s": v * 2.0,
+            "mx": v + 1.0,
+            "n": jnp.ones((), jnp.int32),
+        }
+        red = {"s": "sum", "mx": "max", "n": "sum"}
+        # the synced arrays come back verbatim (replicated after the
+        # collectives) — the bit-identity pin is on THESE values
+        return fused_sync([state], [red], "data", chunks=chunks)[0]
+
+    return jax.jit(
+        jax.shard_map(step, mesh=_mesh(), in_specs=(P("data"),), out_specs=P())
+    )
+
+
+# 8192 f32 rows per device: the flat sum bucket is 32 KiB, above the floor
+VALS = jnp.asarray(
+    np.random.default_rng(16).normal(0, 3, 8192 * NDEV).astype(np.float32)
+)
+
+
+class TestChunkedSchedule:
+    def test_bit_identical_to_monolithic(self):
+        ref = _fused_step(None)(VALS)
+        for k in (2, 4, 7):
+            out = _fused_step(k)(VALS)
+            for key in ref:
+                assert np.array_equal(np.asarray(ref[key]), np.asarray(out[key])), (k, key)
+
+    def test_chunked_hlo_one_logical_many_physical(self):
+        hlo = hlo_of(_fused_step(4), VALS)
+        assert "fused_sync_chunk_0of4" in hlo
+        logical = collective_counts(hlo)
+        physical = physical_collective_counts(hlo)
+        # sum bucket: 4 chunk psums group to 1 logical; max bucket rides
+        # its own pipeline; int bucket its own — logical total ≤ the
+        # monolithic schedule's count, physical strictly above it
+        mono = collective_counts(hlo_of(_fused_step(None), VALS))
+        assert logical["all-reduce"] <= mono["all-reduce"]
+        assert physical["all-reduce"] > logical["all-reduce"]
+
+    def test_chunked_payload_total_matches_monolithic(self):
+        mono = collective_payload_bytes(hlo_of(_fused_step(None), VALS))
+        chunked = collective_payload_bytes(hlo_of(_fused_step(4), VALS))
+        # same bytes moved — only the schedule changed
+        assert chunked["all-reduce"] == mono["all-reduce"]
+        assert mono["all-reduce"] > 0
+
+    def test_env_auto_floor_keeps_small_states_monolithic(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SYNC_CHUNKS", "4")
+        reset_sync_chunks_env_state()
+        small = jnp.asarray(
+            np.random.default_rng(3).normal(0, 1, 16 * NDEV).astype(np.float32)
+        )
+        assert 16 * 4 < SYNC_CHUNK_MIN_BYTES  # the premise: below the floor
+        hlo = hlo_of(_fused_step(None), small)  # chunks resolve from env
+        assert "fused_sync_chunk_" not in hlo
+
+    def test_explicit_chunks_bypass_the_floor(self):
+        small = jnp.asarray(
+            np.random.default_rng(3).normal(0, 1, 16 * NDEV).astype(np.float32)
+        )
+        hlo = hlo_of(_fused_step(4), small)
+        assert "fused_sync_chunk_0of4" in hlo
+
+    def test_overlapped_cycle_chunked_parity(self):
+        """The first customer: the overlapped cycle with sync_chunks=4
+        reads bit-equal to the default schedule (guarded StatScores
+        collection — the chunked_fused_step registry surface)."""
+
+        def build(sync_chunks):
+            coll = mt.MetricCollection(
+                {
+                    "acc": mt.Accuracy(num_classes=4, on_invalid="warn"),
+                    "f1": mt.F1Score(num_classes=4, average="macro", on_invalid="warn"),
+                }
+            )
+            odef = mt.overlapped_functionalize(
+                coll, axis_name="data", sync_chunks=sync_chunks
+            )
+
+            def step(p, t):
+                s = jax.tree_util.tree_map(
+                    lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+                )
+                return odef.read(odef.cycle(odef.update(s, p, t)))
+
+            return jax.jit(
+                jax.shard_map(
+                    step, mesh=_mesh(), in_specs=(P("data"), P("data")), out_specs=P()
+                )
+            )
+
+        rng = np.random.default_rng(8)
+        p = jnp.asarray(rng.random((8 * NDEV, 4), dtype=np.float32))
+        t = jnp.asarray(rng.integers(0, 4, 8 * NDEV).astype(np.int32))
+        ref = build(None)(p, t)
+        out = build(4)(p, t)
+        for key in ref:
+            assert np.array_equal(np.asarray(ref[key]), np.asarray(out[key])), key
+
+
+def _marked_line(op, c, k, tag, shape="f32[256]{0}"):
+    return (
+        f"  %x.{c} = {shape} {op}({shape} %p.{c}), replica_groups={{}}, "
+        f'metadata={{op_name="jit(step)/jit(shmap_body)/fused_sync_chunk_{c}of{k}_{tag}/psum"}}'
+    )
+
+
+class TestLogicalCounting:
+    def test_chunk_pipeline_counts_once(self):
+        hlo = "\n".join(_marked_line("all-reduce", c, 4, "sum_float32") for c in range(4))
+        assert collective_counts(hlo)["all-reduce"] == 1
+        assert physical_collective_counts(hlo)["all-reduce"] == 4
+
+    def test_two_tagged_pipelines_count_separately(self):
+        lines = [_marked_line("all-reduce", c, 2, "sum_float32") for c in range(2)]
+        lines += [_marked_line("all-reduce", c, 2, "max_float32") for c in range(2)]
+        assert collective_counts("\n".join(lines))["all-reduce"] == 2
+
+    def test_unmarked_ops_count_individually(self):
+        lines = [
+            '  %a = f32[8]{0} all-reduce(f32[8]{0} %p), metadata={op_name="jit(f)/psum"}',
+            '  %b = f32[8]{0} all-reduce(f32[8]{0} %q), metadata={op_name="jit(f)/psum2"}',
+        ]
+        assert collective_counts("\n".join(lines))["all-reduce"] == 2
+
+    def test_start_done_pair_counts_once(self):
+        hlo = "\n".join(
+            [
+                "  %s = (f32[64]{0}, f32[64]{0}) all-reduce-start(f32[64]{0} %p)",
+                "  %d = f32[64]{0} all-reduce-done((f32[64]{0}, f32[64]{0}) %s)",
+            ]
+        )
+        assert collective_counts(hlo)["all-reduce"] == 1
+        assert physical_collective_counts(hlo)["all-reduce"] == 1
+
+
+class TestPayloadParse:
+    def test_async_start_tuple_counts_one_half(self):
+        hlo = "  %s = (f32[64]{0}, f32[64]{0}) all-reduce-start(f32[64]{0} %p)"
+        assert collective_payload_bytes(hlo)["all-reduce"] == 64 * 4
+
+    def test_sync_tuple_members_sum(self):
+        hlo = "  %r = (f32[8]{0}, s32[4]{0}) all-reduce((f32[8]{0}, s32[4]{0}) %p)"
+        assert collective_payload_bytes(hlo)["all-reduce"] == 8 * 4 + 4 * 4
+
+    def test_chunk_lines_sum_to_the_monolithic_payload(self):
+        chunked = "\n".join(
+            _marked_line("all-reduce", c, 4, "sum_float32", shape="f32[64]{0}")
+            for c in range(4)
+        )
+        mono = '  %x = f32[256]{0} all-reduce(f32[256]{0} %p), metadata={op_name="psum"}'
+        assert (
+            collective_payload_bytes(chunked)["all-reduce"]
+            == collective_payload_bytes(mono)["all-reduce"]
+            == 256 * 4
+        )
+
+
+class TestRunGatherJobs:
+    def _jobs(self, issued, n=6):
+        def make(i):
+            def issue():
+                issued.append(i)
+                return i * 10
+
+            def fold(raw):
+                return raw + i
+
+            return (f"k{i}", issue, fold)
+
+        return [make(i) for i in range(n)]
+
+    def test_pipeline_matches_sequential_and_preserves_issue_order(self):
+        seq_issued, pipe_issued = [], []
+        seq = run_gather_jobs(self._jobs(seq_issued), pipeline=False)
+        pipe = run_gather_jobs(self._jobs(pipe_issued), pipeline=True)
+        assert seq == pipe
+        # the cross-host pairing contract: issue order is the job order,
+        # exactly, in both modes
+        assert seq_issued == pipe_issued == list(range(6))
+
+    def test_fold_exception_propagates_and_drains_the_issuer(self):
+        issued = []
+        jobs = self._jobs(issued)
+
+        def boom(raw):
+            raise RuntimeError("fold failed")
+
+        jobs[1] = ("k1", jobs[1][1], boom)
+        before = threading.active_count()
+        with pytest.raises(RuntimeError, match="fold failed"):
+            run_gather_jobs(jobs, pipeline=True)
+        # the daemon issuer thread must not leak past the error
+        for _ in range(50):
+            if threading.active_count() <= before:
+                break
+            import time
+
+            time.sleep(0.02)
+        assert threading.active_count() <= before
+
+    def test_issue_exception_propagates(self):
+        issued = []
+        jobs = self._jobs(issued)
+
+        def bad_issue():
+            raise ValueError("issue failed")
+
+        jobs[2] = ("k2", bad_issue, jobs[2][2])
+        with pytest.raises(ValueError, match="issue failed"):
+            run_gather_jobs(jobs, pipeline=True)
+
+
+def _fake_gather(x, group=None):
+    def fake_transport(a):
+        arr = np.asarray(a)
+        return np.stack([arr, arr])
+
+    return _pad_gather_trim(x, fake_transport)
+
+
+class TestGatheredStatePipeline:
+    def _parity(self, monkeypatch, build):
+        """METRICS_TPU_SYNC_CHUNKS>1 flips _gathered_state into pipelined
+        issue/fold; the synced states must equal the sequential path's."""
+        monkeypatch.setattr(metric_mod, "distributed_available", lambda: True)
+        ref = build()
+        ref.sync(dist_sync_fn=_fake_gather, distributed_available_fn=lambda: True)
+        monkeypatch.setenv("METRICS_TPU_SYNC_CHUNKS", "2")
+        reset_sync_chunks_env_state()
+        piped = build()
+        piped.sync(dist_sync_fn=_fake_gather, distributed_available_fn=lambda: True)
+        assert set(ref._state) == set(piped._state)
+        ref_leaves = jax.tree_util.tree_leaves(ref._state)
+        piped_leaves = jax.tree_util.tree_leaves(piped._state)
+        assert len(ref_leaves) == len(piped_leaves)
+        for a, b in zip(ref_leaves, piped_leaves):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_plain_state_parity(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        p = jnp.asarray(rng.random((40, 4), dtype=np.float32))
+        t = jnp.asarray(rng.integers(0, 4, 40))
+
+        def build():
+            m = mt.Accuracy(num_classes=4)
+            m.update(p, t)
+            m.update(p[:8], t[:8])
+            return m
+
+        self._parity(monkeypatch, build)
+
+    def test_sketch_special_job_parity(self, monkeypatch):
+        vals = jnp.asarray(
+            np.random.default_rng(6).lognormal(0, 2, 3000).astype(np.float32)
+        )
+
+        def build():
+            m = mt.QuantileSketch(quantiles=(0.5, 0.9), eps=0.1, k=64, levels=6)
+            m.update(vals)
+            return m
+
+        self._parity(monkeypatch, build)
