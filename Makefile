@@ -6,10 +6,10 @@
 SHELL := /bin/bash
 
 PYTHON        ?= python
-TIER1_TIMEOUT ?= 870
+TIER1_TIMEOUT ?= 1080
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift test-overlap
+.PHONY: test doctest bench dryrun lint profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift test-overlap test-sliced
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -130,6 +130,18 @@ test-drift:
 # everything the `overlap` marker selects.
 test-overlap:
 	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'overlap and not slow' -p no:cacheprovider
+
+# The sliced multi-tenant metrics engine (ISSUE 19): SlicedMetric
+# segment-reduce rings (demux bit-parity, quarantine/discard routing),
+# sliced_functionalize incl. the sharded-K compute path on the 8-device
+# mesh, the <=2-all-reduce fused-cycle pin at K=256, warmup/fleet-delta/
+# drift/serving ride-alongs, and the bounded-cardinality scrape surface —
+# everything the `sliced` marker selects, INCLUDING the compile-heavy
+# acceptance tests marked slow (tier-1 keeps a fast routing/lifecycle/
+# parity core; this lane is where the full demux bit-parity, K=256 HLO
+# pin, and warmed full-matrix sweep run).
+test-sliced:
+	timeout -k 10 600 env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m sliced -p no:cacheprovider
 
 # The quantized sync transport layer (ops/quantize.py wire codecs + the
 # fused_sync quantized wire + overlapped-cycle compressed gathers + the

@@ -2011,6 +2011,149 @@ def _phase_fleet_bytes(jax, platform) -> None:
         print(f"bench: fleet_bytes failed: {err}", file=sys.stderr)
 
 
+def _phase_sliced(jax, platform) -> None:
+    """Sliced multi-tenant engine (ISSUE 19): per-cohort metrics via ONE
+    segment-reduce update. Part 1 pins the O(batch) claim — the compiled
+    update wall of a guarded sliced Accuracy at K=256 must stay within 3x
+    of K=1 (the work is per-row deltas + one scatter; K only sizes the
+    rings). Part 2 extends the delta-publishing points: a host whose state
+    is a large idle sketch next to a hot SlicedMetric publishes deltas at
+    K=16 and K=256 — the (K+2,) rings are single leaves whose steady-state
+    sparsity zlib flattens, so delta bytes grow far sub-linearly in K
+    (acceptance: 16x more slices costs <= 3x steady-state delta bytes, and
+    delta stays <= 25% of the full view at both K)."""
+    _stamp("sliced start")
+    import numpy as np
+    import jax.numpy as jnp
+
+    import metrics_tpu as mt
+
+    B, C = 4096, 4
+    rng = np.random.default_rng(19)
+    preds = jnp.asarray(rng.random((B, C), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, C, B).astype(np.int32))
+
+    try:
+        walls = {}
+        for K in (1, 16, 256):
+            mdef = mt.sliced_functionalize(
+                mt.Accuracy(num_classes=C, on_invalid="warn"), num_slices=K
+            )
+            ids = jnp.asarray(rng.integers(0, K, B).astype(np.int32))
+            step = jax.jit(
+                lambda s, p, t, i, _m=mdef: _m.update(s, p, t, slice_ids=i),
+                donate_argnums=0,
+            )
+            state = step(mdef.init(), preds, target, ids)  # compile + warm
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+            iters = 30
+            start = time.perf_counter()
+            for _ in range(iters):
+                state = step(state, preds, target, ids)
+            jax.block_until_ready(jax.tree_util.tree_leaves(state))
+            walls[K] = (time.perf_counter() - start) / iters * 1e3
+            _emit(
+                f"sliced_update_ms_k{K}",
+                round(walls[K], 4),
+                f"ms/update (guarded Accuracy x {K} slices in one segment-reduce "
+                f"graph, B={B}, {platform})",
+            )
+        ratio = walls[256] / walls[1] if walls[1] else float("inf")
+        _emit(
+            "sliced_update_k256_vs_k1",
+            round(ratio, 4),
+            f"K=256 update wall / K=1 update wall (acceptance <= 3.0, {platform})",
+        )
+        if ratio > 3.0:
+            print(
+                f"bench: PARITY-MISMATCH sliced acceptance: K=256 update wall is "
+                f"{ratio:.2f}x K=1 (budget 3.0x) — the segment-reduce is no longer "
+                f"O(batch)",
+                file=sys.stderr,
+            )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: sliced update scaling failed: {err}", file=sys.stderr)
+
+    try:
+        from metrics_tpu.fleet import Aggregator, FleetPublisher
+
+        CADENCES = 5
+        per_k = {}
+        for K in (16, 256):
+            def make_coll(k=K):
+                return mt.MetricCollection(
+                    {
+                        "lat": mt.QuantileSketch(
+                            eps=0.01, k=16384, levels=4, quantiles=(0.5, 0.99)
+                        ),
+                        "acc": mt.SlicedMetric(mt.Accuracy(num_classes=C), num_slices=k),
+                    }
+                )
+
+            def hot_batch(k=K):
+                # steady-state traffic touches a handful of cohorts
+                return (
+                    jnp.asarray(rng.random((16, C), dtype=np.float32)),
+                    jnp.asarray(rng.integers(0, C, 16).astype(np.int32)),
+                    jnp.asarray(rng.integers(0, min(k, 4), 16).astype(np.int32)),
+                )
+
+            agg_d = Aggregator(make_coll(), node_id=f"pod-sliced-d{K}")
+            agg_f = Aggregator(make_coll(), node_id=f"pod-sliced-f{K}")
+            coll = make_coll()
+            coll["lat"].update(jnp.asarray(rng.lognormal(0, 2, 4096).astype(np.float32)))
+            p, t, i = hot_batch()
+            coll["acc"].update(p, t, slice_ids=i)
+            d_bytes, f_bytes = [], []
+            pub_d = FleetPublisher(
+                coll, lambda b: (d_bytes.append(len(b)) or agg_d.ingest(b)),
+                host_id="h0", start=False, delta=True,
+            )
+            pub_f = FleetPublisher(
+                coll, lambda b: (f_bytes.append(len(b)) or agg_f.ingest(b)),
+                host_id="h0", start=False, delta=False,
+            )
+            pub_d.publish_now()  # cadence 0 ships the full view
+            pub_f.publish_now()
+            d_bytes.clear(), f_bytes.clear()
+            for _c in range(CADENCES):  # steady state: only `acc` rings move
+                p, t, i = hot_batch()
+                coll["acc"].update(p, t, slice_ids=i)
+                pub_d.publish_now()
+                pub_f.publish_now()
+            delta_cad = sum(d_bytes) / CADENCES
+            full_cad = sum(f_bytes) / CADENCES
+            per_k[K] = delta_cad
+            _emit(
+                f"sliced_fleet_delta_bytes_k{K}",
+                round(delta_cad, 1),
+                f"steady-state delta bytes/cadence (idle 0.01-eps sketch + hot "
+                f"{K}-slice Accuracy; full view {full_cad / 1024:.1f} KiB/cadence; "
+                f"acceptance <= 25% of full, {platform})",
+            )
+            if full_cad and delta_cad / full_cad > 0.25:
+                print(
+                    f"bench: PARITY-MISMATCH sliced fleet acceptance: delta/full "
+                    f"{delta_cad / full_cad:.3f} > 0.25 at K={K}",
+                    file=sys.stderr,
+                )
+        growth = per_k[256] / per_k[16] if per_k.get(16) else float("inf")
+        _emit(
+            "sliced_fleet_delta_growth_k256_vs_k16",
+            round(growth, 4),
+            f"steady-state delta bytes K=256 / K=16 (16x more slices; "
+            f"acceptance <= 3.0, {platform})",
+        )
+        if growth > 3.0:
+            print(
+                f"bench: PARITY-MISMATCH sliced fleet acceptance: delta payload "
+                f"grew {growth:.2f}x from K=16 to K=256 (budget 3.0x for 16x K)",
+                file=sys.stderr,
+            )
+    except Exception as err:  # pragma: no cover
+        print(f"bench: sliced fleet bytes failed: {err}", file=sys.stderr)
+
+
 _PHASES = {
     "headline": (_phase_headline, 420),
     "auroc": (_phase_auroc, 240),
@@ -2031,6 +2174,7 @@ _PHASES = {
     "transport": (_phase_transport, 300),
     "overlap": (_phase_overlap, 240),
     "fleet_bytes": (_phase_fleet_bytes, 420),
+    "sliced": (_phase_sliced, 420),
 }
 
 _HEADLINE_METRIC = "fused_collection_step_ms"

@@ -101,6 +101,12 @@ from metrics_tpu.pure import (  # noqa: E402
     bootstrap_functionalize,
     functionalize,
     overlapped_functionalize,
+    sliced_functionalize,
+)
+from metrics_tpu.sliced import (  # noqa: E402
+    SlicedMetric,
+    SlicedValue,
+    slices_max_labels,
 )
 from metrics_tpu.streaming import (  # noqa: E402
     CountMinSketch,
@@ -257,6 +263,8 @@ __all__ = [
     "ShortTimeObjectiveIntelligibility",
     "SignalDistortionRatio",
     "SignalNoiseRatio",
+    "SlicedMetric",
+    "SlicedValue",
     "SpearmanCorrCoef",
     "Specificity",
     "SpectralAngleMapper",
@@ -278,6 +286,8 @@ __all__ = [
     "ensure_backend",
     "functionalize",
     "overlapped_functionalize",
+    "sliced_functionalize",
+    "slices_max_labels",
     "health_report",
     "obs",
     "ServeLoop",

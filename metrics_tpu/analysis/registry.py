@@ -591,6 +591,123 @@ def _build_ladder_served_step(ndev: int):
     return jax.jit(_build_ladder_raw_step()), _ladder_make_args(32)
 
 
+_SLICED_K = 256
+
+
+def _sliced_coll():
+    """The ISSUE 19 acceptance surface: the guarded fused 4-metric
+    collection with every member sliced over K=256 cohorts. The (K+2,)
+    rings are plain int32-sum / uint32-sum states, so they land in the
+    SAME fused_sync dtype buckets as the unsliced collection — the
+    guarded-collection <=2-all-reduce ceiling must hold unchanged at any
+    K."""
+    import metrics_tpu as mt
+
+    return mt.MetricCollection(
+        {
+            "acc": mt.SlicedMetric(
+                mt.Accuracy(num_classes=4, on_invalid="warn"), num_slices=_SLICED_K
+            ),
+            "prec": mt.SlicedMetric(
+                mt.Precision(num_classes=4, average="macro", on_invalid="warn"),
+                num_slices=_SLICED_K,
+            ),
+            "rec": mt.SlicedMetric(
+                mt.Recall(num_classes=4, average="macro", on_invalid="warn"),
+                num_slices=_SLICED_K,
+            ),
+            "f1": mt.SlicedMetric(
+                mt.F1Score(num_classes=4, average="macro", on_invalid="warn"),
+                num_slices=_SLICED_K,
+            ),
+        }
+    )
+
+
+def _sliced_make_args(batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(batch)
+    p, t = _overlapped_make_args(batch)
+    # a few out-of-range ids per batch: the quarantine routing is part of
+    # the audited graph, not a separate code path
+    ids = rng.integers(0, _SLICED_K, batch).astype(np.int32)
+    if batch >= 4:
+        ids[-2:] = (_SLICED_K + 7, -3)
+    return (p, t, jnp.asarray(ids))
+
+
+def _build_sliced_raw_step():
+    import metrics_tpu as mt
+
+    odef = mt.overlapped_functionalize(_sliced_coll())
+
+    def step(p, t, ids):
+        s = odef.cycle(odef.update(odef.init(), p, t, slice_ids=ids))
+        return odef.read(s)
+
+    return step
+
+
+def _build_sliced_fused_step(ndev: int):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import metrics_tpu as mt
+
+    odef = mt.overlapped_functionalize(_sliced_coll(), axis_name="data")
+
+    def step(p, t, ids):
+        s = jax.tree_util.tree_map(
+            lambda x: jax.lax.pcast(x, ("data",), to="varying"), odef.init()
+        )
+        s = odef.update(s, p, t, slice_ids=ids)  # segment-reduce, 0 collectives
+        s = odef.cycle(s)  # one fused_sync over every (K+2,) ring
+        return odef.read(s)
+
+    p, t, ids = _sliced_make_args(8 * ndev)
+    fn = jax.jit(
+        jax.shard_map(
+            step, mesh=_mesh(ndev), in_specs=(P("data"), P("data"), P("data")), out_specs=P()
+        )
+    )
+    return fn, (p, t, ids)
+
+
+def _build_sliced_ladder_raw_step():
+    import metrics_tpu as mt
+
+    # the serving-shaped SLICED path: a sliced guarded member behind the
+    # padding ladder — pad rows (valid=False) route to the discard slice,
+    # so the wrapper consumes the row mask for any child
+    mdef = mt.functionalize(
+        mt.SlicedMetric(mt.Accuracy(num_classes=4, on_invalid="warn"), num_slices=16)
+    )
+
+    def update(p, t, ids, valid):
+        s = mdef.update(mdef.init(), p, t, slice_ids=ids, valid=valid)
+        return mdef.compute(s), mdef.faults(s)
+
+    return update
+
+
+def _sliced_ladder_make_args(batch: int):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu.ops.padding import pad_rows
+
+    rng = np.random.default_rng(batch)
+    p = jnp.asarray(rng.random((batch, 4), dtype=np.float32))
+    t = jnp.asarray(rng.integers(0, 4, batch).astype(np.int32))
+    ids = jnp.asarray(rng.integers(0, 16, batch).astype(np.int32))
+    # slice_ids pads with id 0 — but pad rows carry valid=False, which
+    # routes them to the discard slice before any id is honored
+    (p, t, ids), valid = pad_rows((p, t, ids), ladder=_SERVE_LADDER)
+    return (p, t, ids, valid)
+
+
 REGISTRY: Tuple[AuditEntry, ...] = (
     AuditEntry(
         name="fused_stat_collection",
@@ -758,6 +875,29 @@ REGISTRY: Tuple[AuditEntry, ...] = (
         name="traced_fleet_publish",
         budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
         build=_build_traced_fleet_publish,
+    ),
+    AuditEntry(
+        name="sliced_fused_step",
+        # ISSUE 19 acceptance pin: the 4-metric guarded collection sliced
+        # over K=256 cohorts must clear a full overlapped cycle within the
+        # same <=2-all-reduce ceiling as its unsliced twin — slicing widens
+        # payloads (K+2 rows/leaf), it must never add collectives
+        budget=GraphBudget(max_all_reduce=2, max_all_gather=0),
+        build=_build_sliced_fused_step,
+        build_recompile=lambda: (_build_sliced_raw_step(), _sliced_make_args),
+    ),
+    AuditEntry(
+        name="warmed_sliced_serving",
+        budget=None,
+        # warmed_ladder_serving extended to a SLICED member: the padding
+        # ladder's tiers are the only shape source (slice_ids is just one
+        # more row-aligned operand, re-led by Warmup.tier_avals like any
+        # other), so AOT-warming _SERVE_LADDER must leave the same ragged
+        # sweep trace-free for the sliced path too
+        build_recompile=lambda: (_build_sliced_ladder_raw_step(), _sliced_ladder_make_args),
+        sweep_sizes=(1, 3, 7, 8, 9, 20, 31, 32, 33, 57, 100, 127, 128),
+        warmup_sizes=_SERVE_LADDER,
+        max_new_graphs=0,
     ),
 )
 

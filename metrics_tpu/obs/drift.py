@@ -469,9 +469,18 @@ class DriftMonitor:
         trip_after: int = 1,
         clear_after: int = 2,
         extract: Optional[Callable[[tuple, dict], Any]] = None,
+        slice_id: Optional[int] = None,
+        slice_ids_key: str = "slice_ids",
     ) -> None:
         if not name:
             raise MetricsTPUUserError("`name` must be a non-empty string")
+        if slice_id is not None:
+            if not isinstance(slice_id, int) or isinstance(slice_id, bool) or slice_id < 0:
+                raise MetricsTPUUserError(
+                    f"`slice_id` must be a non-negative int cohort id, got {slice_id!r}"
+                )
+            if not slice_ids_key:
+                raise MetricsTPUUserError("`slice_ids_key` must be a non-empty kwarg name")
         if window < 2:
             raise MetricsTPUUserError(f"`window` must be >= 2 rows, got {window}")
         if min_rows is None:
@@ -531,6 +540,12 @@ class DriftMonitor:
             hll_precision=int(hll_precision),
         )
         self._extract = extract
+        # slice selector (sliced/): when set, this monitor watches ONE
+        # cohort of a SlicedMetric's demuxed stream — extract_from keeps
+        # only rows whose `slice_ids` kwarg equals slice_id (respecting a
+        # `valid` row mask), so per-cohort drift rides the same offer path
+        self.slice_id = slice_id
+        self._slice_ids_key = slice_ids_key
         self._lock = threading.RLock()
         # serializes whole check() passes (scheduler cadence + manual test
         # drivers) so hysteresis never double-counts; observe() only ever
@@ -713,10 +728,40 @@ class DriftMonitor:
         """The value stream this monitor watches, out of one serving
         request's ``(*args, **kwargs)``: the ``extract`` hook when
         configured, else the first positional argument (``None`` = nothing
-        to observe for this request)."""
+        to observe for this request). With ``slice_id`` set, the extracted
+        rows are filtered to the one cohort whose ``slice_ids`` kwarg row
+        matches (rows under a False ``valid`` mask are excluded too); a
+        request without slice ids, or whose ids don't row-align with the
+        extracted values, contributes nothing — mis-attribution is worse
+        than a thin window."""
         if self._extract is not None:
-            return self._extract(args, kwargs)
-        return args[0] if args else None
+            values = self._extract(args, kwargs)
+        else:
+            values = args[0] if args else None
+        if self.slice_id is None or values is None:
+            return values
+        ids = kwargs.get(self._slice_ids_key)
+        if ids is None:
+            return None
+        try:
+            vals = np.asarray(values, np.float64).reshape(-1)
+            idarr = np.asarray(ids, np.int64).reshape(-1)
+        except (TypeError, ValueError):
+            return None
+        if vals.shape[0] != idarr.shape[0]:
+            return None
+        mask = idarr == self.slice_id
+        valid = kwargs.get("valid")
+        if valid is not None:
+            try:
+                vmask = np.asarray(valid, bool).reshape(-1)
+            except (TypeError, ValueError):
+                return None
+            if vmask.shape[0] != mask.shape[0]:
+                return None
+            mask &= vmask
+        out = vals[mask]
+        return out if out.size else None
 
     def _fold_pending_locked(self) -> None:
         if not self._pending:
@@ -999,6 +1044,7 @@ class DriftMonitor:
         ref = self._reference
         return {
             "name": self.name,
+            "slice": self.slice_id,
             "active": self._active,
             "scores": dict(self._last_scores),
             "breaching": list(self._last_breaching),
@@ -1042,4 +1088,6 @@ class DriftMonitor:
             }
             out["active"] = self._active
             out["windows"] = self._windows
+            if self.slice_id is not None:
+                out["slice"] = self.slice_id
             return out

@@ -152,6 +152,71 @@ def _health_lines(health: Dict[str, Any]) -> List[str]:
         if check_lines:
             lines.append(f"# TYPE {_PREFIX}_drift_checks_total counter")
             lines.extend(check_lines)
+    slices = health.get("slices")
+    if slices:
+        # the per-cohort surface (sliced/): only the top-N-by-traffic rows
+        # per SlicedMetric ever reach the wire (hard label-cardinality cap,
+        # METRICS_TPU_SLICES_MAX_LABELS) — the tail folds into one `other`
+        # row, so scrape cardinality is bounded no matter how large K grows
+        value_lines: List[str] = []
+        row_lines: List[str] = []
+        other_lines: List[str] = []
+        quar_lines: List[str] = []
+        disc_lines: List[str] = []
+        for name, sc in sorted(slices.items()):
+            if "error" in sc:
+                continue
+            for row in sc.get("top") or ():
+                sid = row.get("slice")
+                row_lines.append(
+                    _line(f"{_PREFIX}_slice_rows", row.get("rows"), metric=name, slice=sid)
+                )
+                for path, value in sorted((row.get("values") or {}).items()):
+                    value_lines.append(
+                        _line(
+                            f"{_PREFIX}_slice_value",
+                            value,
+                            metric=name,
+                            slice=sid,
+                            path=path,
+                        )
+                    )
+            other = sc.get("other") or {}
+            if other.get("slices"):
+                other_lines.append(
+                    _line(f"{_PREFIX}_slice_other_rows", other.get("rows"), metric=name)
+                )
+            if sc.get("quarantined_rows") is not None:
+                quar_lines.append(
+                    _line(
+                        f"{_PREFIX}_slice_quarantined_rows_total",
+                        sc["quarantined_rows"],
+                        metric=name,
+                    )
+                )
+            if sc.get("discarded_rows") is not None:
+                disc_lines.append(
+                    _line(
+                        f"{_PREFIX}_slice_discarded_rows_total",
+                        sc["discarded_rows"],
+                        metric=name,
+                    )
+                )
+        if value_lines:
+            lines.append(f"# TYPE {_PREFIX}_slice_value gauge")
+            lines.extend(value_lines)
+        if row_lines:
+            lines.append(f"# TYPE {_PREFIX}_slice_rows gauge")
+            lines.extend(row_lines)
+        if other_lines:
+            lines.append(f"# TYPE {_PREFIX}_slice_other_rows gauge")
+            lines.extend(other_lines)
+        if quar_lines:
+            lines.append(f"# TYPE {_PREFIX}_slice_quarantined_rows_total counter")
+            lines.extend(quar_lines)
+        if disc_lines:
+            lines.append(f"# TYPE {_PREFIX}_slice_discarded_rows_total counter")
+            lines.extend(disc_lines)
     fleet = health.get("fleet")
     if fleet:
         # the federated surface: one scrape at the global aggregator shows

@@ -55,9 +55,16 @@ __all__ = [
     "pad_update_args",
     "supports_row_mask",
     "reset_padding_state",
+    "SLICE_STATE_PREFIX",
 ]
 
 _ENV_VAR = "METRICS_TPU_PAD_LADDER"
+
+# state-name prefix of the sliced subsystem's (K,)-leading ring states
+# (``metrics_tpu/sliced``). Defined HERE — the lowest layer that must know
+# it — so `leading_rows` can tell a slice axis from a batch tier without
+# importing upward.
+SLICE_STATE_PREFIX = "sl__"
 
 _warn_once = WarnOnce()
 
@@ -155,10 +162,23 @@ def leading_rows(tree: Any) -> Optional[int]:
     """Leading-axis row count of the first >=1-dim array leaf of ``tree``
     (for a padded request: its ladder tier). One implementation shared by
     the AOT warmup matrix (``serving/warmup.py``), the cost profiler
-    (``obs/profile.py``), and the per-tier jit-wall tap (``metric.py``)."""
+    (``obs/profile.py``), and the per-tier jit-wall tap (``metric.py``).
+
+    Sliced state trees are excluded from the tap: a ``sl__*`` ring leaf
+    (``metrics_tpu/sliced``) leads with the ``(K+2,)`` slice axis, not a
+    batch tier, and reporting ``K+2`` as the request's row count would
+    corrupt the warmup matrix and the per-tier wall buckets. Any leaf
+    reached through a mapping key containing :data:`SLICE_STATE_PREFIX`
+    is skipped (this also covers composed rings like ``win__sl__*``)."""
     import jax
 
-    for leaf in jax.tree_util.tree_leaves(tree):
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in leaves_with_path:
+        if any(
+            SLICE_STATE_PREFIX in str(getattr(entry, "key", ""))
+            for entry in path
+        ):
+            continue
         shape = getattr(leaf, "shape", None)
         if shape is not None and len(shape) >= 1:
             return int(shape[0])

@@ -97,11 +97,18 @@ def _faults_in_state(state: Dict[str, Any]) -> Any:
     """The metric's fault-counter vector, all-zero when unguarded."""
     import jax.numpy as jnp
 
+    from metrics_tpu.ops.padding import SLICE_STATE_PREFIX
     from metrics_tpu.utilities.guard import NUM_FAULT_CLASSES, FaultCounters
 
     fc = state.get("_faults")
     if isinstance(fc, FaultCounters):
         return fc.counts
+    ring = state.get(f"{SLICE_STATE_PREFIX}_faults")
+    if ring is not None:
+        # a SlicedMetric routes the child's fault deltas into the (K+2,)
+        # ring (its own flat ``_faults`` never accumulates) — fold every
+        # row, quarantine and discard included
+        return ring.sum(axis=0)
     return jnp.zeros((NUM_FAULT_CLASSES,), jnp.uint32)
 
 
@@ -585,6 +592,164 @@ def overlapped_functionalize(
         lag=lag,
         faults=faults,
         dropped=dropped,
+    )
+
+
+def sliced_functionalize(
+    metric: "Metric",
+    num_slices: int,
+    axis_name: Optional[str] = None,
+    shard_slices: Optional[str] = None,
+    shard_count: Optional[int] = None,
+) -> MetricDef:
+    """Per-cohort pure functions: wrap ``metric`` (or every member of a
+    collection) in :class:`~metrics_tpu.SlicedMetric` and functionalize the
+    result, so ``update(state, *batch, slice_ids=ids)`` folds all K slices
+    in one compiled graph and ``compute`` returns per-slice values plus the
+    count-weighted global rollup. The ``(K+2,)``-leading rings are plain
+    sum/max/min states, so under ``axis_name`` they ride ``fused_sync``'s
+    existing dtype buckets — a guarded stat-scores collection stays inside
+    the <=2-all-reduce cycle budget at any K (the ``sliced_fused_step``
+    registry entry pins K=256).
+
+    **Sharded-K mode** (``shard_slices=<mesh_axis>``, huge-K deployments):
+    each host along the named mesh axis *owns* ``K / shard_count``
+    slices — the PAPERS.md cross-replica weight-update-sharding stance
+    applied to metric state. ``update`` still accumulates the full-K local
+    rings (no id remapping, O(batch) work); ``compute`` reduce-scatters the
+    slice axis so each shard reads its OWNED slices locally
+    (``psum_scatter`` for sum-reduced states — stat scores, means, fault
+    counters, CountMin; max/min states degrade to a ``pmax``/``pmin`` of
+    the full ring) and the global rollup costs ONE ``psum`` of the
+    slice-reduced extensive tree. Sharded ``compute`` returns
+    ``{"per_slice": <(K/S,)-leading owned values>, "slice_offset":
+    <first owned slice id>, "slice_rows": <(K/S,) rows>, "global_value",
+    "quarantined_rows"}`` and must run inside ``shard_map`` with the axis
+    present. Requirements: a single metric (not a collection),
+    ``shard_count`` equal to the mesh axis size, and ``K % shard_count ==
+    0``. ``axis_name`` must be omitted or equal to ``shard_slices`` (the
+    slice shard IS the data-parallel axis).
+    """
+    from metrics_tpu.collections import MetricCollection  # local import to avoid cycle
+    from metrics_tpu.sliced import SlicedMetric  # local import to avoid cycle
+
+    if isinstance(metric, SlicedMetric):
+        wrapped: Any = metric
+    elif isinstance(metric, MetricCollection):
+        if shard_slices is not None:
+            raise ValueError(
+                "sliced_functionalize(shard_slices=...) shards a single metric's slice "
+                "axis; shard each collection member separately."
+            )
+        wrapped = MetricCollection(
+            {
+                name: m if isinstance(m, SlicedMetric) else SlicedMetric(m, num_slices=num_slices)
+                for name, m in metric.items(keep_base=True, copy_state=False)
+            }
+        )
+    else:
+        wrapped = SlicedMetric(metric, num_slices=num_slices)
+
+    if shard_slices is None:
+        return functionalize(wrapped, axis_name=axis_name)
+    if axis_name is not None and axis_name != shard_slices:
+        raise ValueError(
+            f"sliced_functionalize: axis_name={axis_name!r} conflicts with "
+            f"shard_slices={shard_slices!r} — the slice shard IS the data axis; pass one."
+        )
+    if not (isinstance(shard_count, int) and shard_count >= 1):
+        raise ValueError(
+            f"sliced_functionalize(shard_slices={shard_slices!r}) needs a static "
+            f"`shard_count` (the mesh axis size), got {shard_count!r}"
+        )
+    if wrapped.num_slices % shard_count:
+        raise ValueError(
+            f"num_slices ({wrapped.num_slices}) must divide evenly over "
+            f"shard_count ({shard_count}) so every shard owns the same slice quota"
+        )
+    return _sliced_sharded_def(wrapped, shard_slices, shard_count)
+
+
+def _sliced_sharded_def(w: Any, shard_slices: str, shard_count: int) -> MetricDef:
+    """The sharded-K compute path over a :class:`SlicedMetric`'s state (see
+    :func:`sliced_functionalize` for the deployment contract)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.padding import SLICE_STATE_PREFIX as PFX
+
+    mdef = functionalize(w)  # local update/merge; state = [wrapper, child]
+    K, Kloc = w.num_slices, w.num_slices // shard_count
+    specs = dict(w._specs)
+
+    def compute(states):
+        wstate = dict(states[0])
+        rows_full = wstate[f"{PFX}rows"]
+        rows_body, rows_tail = rows_full[:K], rows_full[K:]
+        # the global rollup: ONE psum over the slice-reduced extensive tree
+        # (max/min states join via pmax/pmin below — a documented extra
+        # collective for those reductions only)
+        sum_tree: Dict[str, Any] = {
+            "rows_tail": rows_tail,
+            "rows_total": rows_body.sum(),
+        }
+        for name, kind in specs.items():
+            if kind in ("sum", "mean", "faults", "sketch_sum"):
+                sum_tree[name] = wstate[f"{PFX}{name}"][:K].sum(axis=0)
+        sum_tree = jax.lax.psum(sum_tree, shard_slices)
+
+        idx = jax.lax.axis_index(shard_slices)
+        rows_owned = jax.lax.psum_scatter(
+            rows_body, shard_slices, scatter_dimension=0, tiled=True
+        )
+        total = jnp.maximum(sum_tree["rows_total"], 1).astype(jnp.float32)
+        raw_owned: Dict[str, Any] = {}
+        raw_roll: Dict[str, Any] = {}
+        for name, kind in specs.items():
+            ring = wstate[f"{PFX}{name}"][:K]
+            if kind in ("sum", "mean", "faults", "sketch_sum"):
+                owned = jax.lax.psum_scatter(
+                    ring, shard_slices, scatter_dimension=0, tiled=True
+                )
+                if kind == "mean":
+                    denom = jnp.maximum(rows_owned, 1).astype(jnp.float32)
+                    raw_owned[name] = owned / denom.reshape((Kloc,) + (1,) * (ring.ndim - 1))
+                    raw_roll[name] = sum_tree[name] / total
+                else:
+                    raw_owned[name] = owned
+                    raw_roll[name] = sum_tree[name]
+            elif kind in ("max", "sketch_max"):
+                g = jax.lax.pmax(ring, shard_slices)
+                raw_owned[name] = jax.lax.dynamic_slice_in_dim(g, idx * Kloc, Kloc, axis=0)
+                raw_roll[name] = g.max(axis=0)
+            else:  # min
+                g = jax.lax.pmin(ring, shard_slices)
+                raw_owned[name] = jax.lax.dynamic_slice_in_dim(g, idx * Kloc, Kloc, axis=0)
+                raw_roll[name] = g.min(axis=0)
+
+        def run(raw):
+            return w._run_child_compute(w._child_state_from_raw(raw))
+
+        return {
+            "per_slice": jax.vmap(run)(raw_owned),
+            "slice_offset": idx * Kloc,
+            "slice_rows": rows_owned,
+            "global_value": run(raw_roll),
+            "quarantined_rows": sum_tree["rows_tail"][0],
+        }
+
+    def dropped(states):
+        return jax.lax.psum(mdef.dropped(states), shard_slices)
+
+    def faults(states):
+        return jax.lax.psum(mdef.faults(states), shard_slices)
+
+    return MetricDef(
+        init=mdef.init,
+        update=mdef.update,
+        compute=compute,
+        merge=mdef.merge,
+        dropped=dropped,
+        faults=faults,
     )
 
 

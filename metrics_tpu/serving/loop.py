@@ -741,6 +741,24 @@ class ServeLoop:
             # flags, window/check accounting per monitor — what the
             # exporters render as metrics_tpu_drift_* gauges
             rep["drift"] = {name: m.status() for name, m in self._drift.items()}
+        if self._last_reporter is not None:
+            # per-cohort surface (sliced/): each SlicedMetric member's
+            # top-N-by-traffic scrape rows (hard label-cardinality cap —
+            # see slices_max_labels) + quarantine accounting; rendered as
+            # metrics_tpu_slice_* series by the exporters
+            from metrics_tpu.sliced import SlicedMetric
+
+            slices = {}
+            for name, m in _members(self._last_reporter):
+                if isinstance(m, SlicedMetric):
+                    try:
+                        slices[name or type(m.wrapped).__name__] = m.scrape_slices()
+                    except Exception as err:  # noqa: BLE001 — scrape degrades, never sheds
+                        slices[name or type(m.wrapped).__name__] = {
+                            "error": f"{type(err).__name__}: {err}"
+                        }
+            if slices:
+                rep["slices"] = slices
         return rep
 
     def fleet_view(self) -> Optional[Dict[str, Any]]:

@@ -1,0 +1,11 @@
+"""Sliced multi-tenant metrics: per-cohort values via segment-reduce in
+one compiled update (see ``slicing.py`` for the state layout, quarantine
+semantics, and the label-cardinality cap)."""
+from metrics_tpu.sliced.slicing import (
+    SlicedMetric,
+    SlicedValue,
+    reset_sliced_state,
+    slices_max_labels,
+)
+
+__all__ = ["SlicedMetric", "SlicedValue", "slices_max_labels", "reset_sliced_state"]

@@ -123,3 +123,11 @@ def pytest_configure(config):
         "parity; select with -m async_sync, or run the directory via "
         "`make test-async`",
     )
+    config.addinivalue_line(
+        "markers",
+        "sliced: the sliced multi-tenant metrics engine (sliced/ SlicedMetric "
+        "segment-reduce rings, pure.py::sliced_functionalize incl. sharded-K, "
+        "quarantine/discard routing, per-slice scrape cap, warmup/fleet-delta "
+        "ride-alongs); select with -m sliced, or run the lane via "
+        "`make test-sliced`",
+    )

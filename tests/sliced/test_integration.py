@@ -1,0 +1,236 @@
+"""Sliced ride-alongs across the substrate: WindowedMetric composition,
+the padding tap's slice-axis exclusion, warmup zero-trace serving for a
+sliced member, the delta/int8 fleet wire treating a ``(K+2,)`` ring as ONE
+leaf, the DriftMonitor slice selector, and the ServeLoop health/scrape
+surface.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import metrics_tpu as mt
+from metrics_tpu.analysis.graph_audit import audit_recompilation
+from metrics_tpu.ops.padding import SLICE_STATE_PREFIX, leading_rows
+from metrics_tpu.sliced import SlicedMetric
+
+pytestmark = [pytest.mark.sliced]
+
+
+class TestWindowedComposition:
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_windowed_sliced_windows_every_slice(self):
+        """WindowedMetric(SlicedMetric(m)): per-slice values over the
+        trailing window — old evidence ages out of every slice at once."""
+        m = mt.WindowedMetric(
+            SlicedMetric(mt.SumMetric(), num_slices=2), window=2, buckets=2
+        )
+        m.update(jnp.asarray([1.0, 8.0]), slice_ids=jnp.asarray([0, 1]))
+        m.update(jnp.asarray([2.0, 16.0]), slice_ids=jnp.asarray([0, 1]))
+        out = m.compute()
+        assert [float(v) for v in out.per_slice] == [3.0, 24.0]
+        # a third update evicts the first bucket from BOTH slices
+        m.update(jnp.asarray([4.0, 32.0]), slice_ids=jnp.asarray([0, 1]))
+        out = m.compute()
+        assert [float(v) for v in out.per_slice] == [6.0, 48.0]
+
+
+class TestPaddingTap:
+    def test_leading_rows_skips_slice_axis(self):
+        """Regression: the jit-wall/warmup row tap must not mistake the
+        (K+2,) slice axis of a ring leaf for a batch tier."""
+        k_plus_2 = 66
+        tree = {
+            f"{SLICE_STATE_PREFIX}value": jnp.zeros((k_plus_2,)),
+            f"{SLICE_STATE_PREFIX}rows": jnp.zeros((k_plus_2,), jnp.int32),
+            "preds": jnp.zeros((8, 4)),
+        }
+        assert leading_rows(tree) == 8
+
+    def test_leading_rows_skips_composed_rings(self):
+        # windowed-over-sliced rings (win__sl__*) carry the slice axis too
+        tree = {
+            f"win__{SLICE_STATE_PREFIX}value": jnp.zeros((2, 66)),
+            "t": jnp.zeros((16,), jnp.int32),
+        }
+        assert leading_rows(tree) == 16
+
+    def test_leading_rows_all_sliced_is_none(self):
+        assert leading_rows({f"{SLICE_STATE_PREFIX}value": jnp.zeros((66,))}) is None
+
+
+class TestWarmedSlicedServing:
+    @pytest.mark.slow
+    def test_warmed_sliced_full_matrix_traces_zero(self):
+        """The warmed_ladder_serving audit extended to a sliced member: AOT
+        warmup over the ladder tiers leaves the ragged sweep trace-free
+        (slice_ids is one more row-aligned operand, re-led per tier)."""
+        from metrics_tpu.analysis.registry import (
+            _SERVE_LADDER,
+            _build_sliced_ladder_raw_step,
+            _sliced_ladder_make_args,
+        )
+
+        violations = audit_recompilation(
+            _build_sliced_ladder_raw_step(),
+            _sliced_ladder_make_args,
+            entry="warmed_sliced_serving",
+            sweep_sizes=(1, 3, 7, 8, 9, 20, 31, 32, 33, 57, 100, 127, 128),
+            warmup_sizes=_SERVE_LADDER,
+            max_new_graphs=0,
+        )
+        assert violations == []
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_warmed_sliced_seeded_gap_fails(self):
+        from metrics_tpu.analysis.registry import (
+            _build_sliced_ladder_raw_step,
+            _sliced_ladder_make_args,
+        )
+
+        violations = audit_recompilation(
+            _build_sliced_ladder_raw_step(),
+            _sliced_ladder_make_args,
+            entry="sliced-gap",
+            sweep_sizes=(1, 8, 9, 20, 32),
+            warmup_sizes=(8,),  # tier 32 missing: sizes 9..32 must retrace
+            max_new_graphs=0,
+        )
+        assert len(violations) == 1
+        assert "warmup matrix has a gap" in violations[0].detail
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_sliced_ladder_pads_to_discard(self):
+        """Pad rows (valid=False) are provably invisible: the padded tier
+        computes the same value as the raw rows, pads land in discard."""
+        import jax
+
+        from metrics_tpu.analysis.registry import (
+            _build_sliced_ladder_raw_step,
+            _sliced_ladder_make_args,
+        )
+
+        step = jax.jit(_build_sliced_ladder_raw_step())
+        p, t, ids, valid = _sliced_ladder_make_args(5)  # pads to tier 8
+        out, _faults = step(p, t, ids, valid)
+        eager = SlicedMetric(mt.Accuracy(num_classes=4, on_invalid="warn"), num_slices=16)
+        eager.update(p[:5], t[:5], slice_ids=ids[:5])
+        np.testing.assert_array_equal(
+            np.asarray(out.per_slice), np.asarray(eager.compute().per_slice)
+        )
+
+
+class TestFleetWire:
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_ring_is_one_delta_leaf(self):
+        """Delta dirty-leaf tracking treats a (K+2,)-leading ring as ONE
+        leaf: an update touching 3 slices of K=256 dirties the same number
+        of leaves as an update touching 1 slice of K=1."""
+        from metrics_tpu.fleet.wire import _checksum_tree, delta_changes
+
+        def dirty_leaves(k):
+            m = SlicedMetric(mt.SumMetric(), num_slices=k)
+            m.update(jnp.asarray([1.0]), slice_ids=jnp.asarray([0]))
+            base = _checksum_tree(m.snapshot_state())
+            m.update(
+                jnp.asarray([2.0, 3.0, 4.0]),
+                slice_ids=jnp.asarray([0, min(k - 1, 128), min(k - 1, 200)]),
+            )
+            changed, _ = delta_changes(m.snapshot_state(), base)
+            return changed
+
+        small, large = dirty_leaves(1), dirty_leaves(256)
+        assert len(small) == len(large) > 0
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_int8_wire_roundtrips_sliced_view(self):
+        from metrics_tpu.fleet.wire import decode_view, encode_view
+
+        m = SlicedMetric(mt.MeanMetric(), num_slices=8)
+        m.update(
+            jnp.asarray([1.0, 5.0, 3.0]), slice_ids=jnp.asarray([0, 3, 3])
+        )
+        payload = m.snapshot_state()
+        blob = encode_view(payload, host_id="h", seq=1, encoding="int8")
+        header, decoded = decode_view(blob)
+        assert header["encoding"].startswith("int8")
+        # shapes survive: every (K+2,) ring comes back with its slice axis
+        import jax
+
+        want = jax.tree_util.tree_map(lambda x: np.asarray(x).shape, payload)
+        got = jax.tree_util.tree_map(lambda x: np.asarray(x).shape, decoded)
+        assert want == got
+
+
+class TestDriftSelector:
+    def test_selector_filters_to_cohort(self):
+        mon = mt.DriftMonitor("lat_s3", window=16, slice_id=3)
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+        ids = np.array([3, 1, 3, 2, 3])
+        np.testing.assert_array_equal(
+            mon.extract_from((vals,), {"slice_ids": ids}), [1.0, 3.0, 5.0]
+        )
+        np.testing.assert_array_equal(
+            mon.extract_from(
+                (vals,),
+                {"slice_ids": ids, "valid": np.array([1, 1, 0, 1, 1], bool)},
+            ),
+            [1.0, 5.0],
+        )
+        # no ids / misaligned ids -> nothing observed (never mis-attributed)
+        assert mon.extract_from((vals,), {}) is None
+        assert mon.extract_from((vals,), {"slice_ids": ids[:3]}) is None
+        assert mon.status()["slice"] == 3
+        assert mon.fleet_scores()["slice"] == 3
+
+    def test_unsliced_monitor_unchanged(self):
+        mon = mt.DriftMonitor("lat", window=16)
+        vals = np.array([1.0, 2.0])
+        np.testing.assert_array_equal(
+            mon.extract_from((vals,), {"slice_ids": np.array([0, 1])}), vals
+        )
+        assert mon.status()["slice"] is None
+        assert "slice" not in mon.fleet_scores()
+
+    def test_bad_slice_id_refused(self):
+        from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError, match="slice_id"):
+            mt.DriftMonitor("x", slice_id=-1)
+
+
+class TestServingScrape:
+    def test_health_and_scrape_carry_slices(self):
+        proto = mt.MetricCollection(
+            {"acc": SlicedMetric(mt.Accuracy(num_classes=4), num_slices=4)}
+        )
+        rng = np.random.default_rng(0)
+        with mt.ServeLoop(proto, workers=1, reduce_every_s=0.05) as loop:
+            for _ in range(3):
+                loop.offer(
+                    jnp.asarray(rng.integers(0, 4, 8)),
+                    jnp.asarray(rng.integers(0, 4, 8)),
+                    slice_ids=jnp.asarray(rng.integers(0, 5, 8)),  # id 4 quarantines
+                )
+            assert loop.drain(20.0)
+            import time
+
+            sc, deadline = None, time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                sc = (loop.health().get("slices") or {}).get("acc")
+                folded = sc and (
+                    sum(r["rows"] for r in sc["top"])
+                    + sc["other"]["rows"]
+                    + sc["quarantined_rows"]
+                )
+                if folded == 24:  # all 3 offers reduced into the view
+                    break
+                time.sleep(0.05)
+            assert sc is not None and sc["num_slices"] == 4
+            assert sum(r["rows"] for r in sc["top"]) + sc["other"]["rows"] + sc[
+                "quarantined_rows"
+            ] == 24
+            text = loop.scrape()
+        assert "metrics_tpu_slice_rows{" in text
+        assert 'metrics_tpu_slice_value{metric="acc"' in text
+        assert "metrics_tpu_slice_quarantined_rows_total" in text

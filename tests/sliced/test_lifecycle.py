@@ -1,0 +1,126 @@
+"""SlicedMetric lifecycle (pickle/clone/reset), constructor refusals with
+named reasons, and the bounded-cardinality scrape surface with its
+``METRICS_TPU_SLICES_MAX_LABELS`` env knob.
+"""
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.sliced import reset_sliced_state, slices_max_labels
+
+pytestmark = [pytest.mark.sliced]
+
+
+def _updated(k: int = 3):
+    m = mt.SlicedMetric(mt.SumMetric(), num_slices=k)
+    m.update(jnp.asarray([1.0, 2.0, 4.0]), slice_ids=jnp.asarray([0, 1, 5]))
+    return m
+
+
+class TestLifecycle:
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_pickle_roundtrip_preserves_rings(self):
+        m = _updated()
+        clone = pickle.loads(pickle.dumps(m))
+        out, ref = clone.compute(), m.compute()
+        np.testing.assert_array_equal(np.asarray(out.per_slice), np.asarray(ref.per_slice))
+        assert int(out.quarantined_rows) == 1
+        # the restored wrapper keeps updating correctly
+        clone.update(jnp.asarray([8.0]), slice_ids=jnp.asarray([2]))
+        assert float(np.asarray(clone.compute().per_slice)[2]) == 8.0
+
+    def test_clone_is_independent(self):
+        m = _updated()
+        c = m.clone()
+        c.update(jnp.asarray([100.0]), slice_ids=jnp.asarray([0]))
+        assert float(np.asarray(m.compute().per_slice)[0]) == 1.0
+        assert float(np.asarray(c.compute().per_slice)[0]) == 101.0
+
+    def test_reset_restores_identity_rings(self):
+        m = _updated()
+        m.reset()
+        assert m.quarantined_rows == 0
+        assert m.discarded_rows == 0
+        np.testing.assert_array_equal(m.slice_rows, [0, 0, 0])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # compute-before-update warning
+            out = m.compute()
+        assert float(out.global_value) == 0.0
+
+
+class TestRefusals:
+    def test_kll_sketch_refused(self):
+        with pytest.raises(ValueError, match="compaction"):
+            mt.SlicedMetric(mt.QuantileSketch(eps=0.05), num_slices=4)
+
+    def test_cat_state_refused(self):
+        with pytest.raises(ValueError, match="cat/list"):
+            mt.SlicedMetric(mt.CatMetric(), num_slices=4)
+
+    def test_nested_trace_safe_wrapper_refused(self):
+        with pytest.raises(ValueError, match="Compose the other way"):
+            mt.SlicedMetric(mt.WindowedMetric(mt.SumMetric(), window=8), num_slices=4)
+
+    def test_bad_num_slices_refused(self):
+        with pytest.raises(ValueError, match="num_slices"):
+            mt.SlicedMetric(mt.SumMetric(), num_slices=0)
+
+
+class TestScrapeCap:
+    @pytest.fixture(autouse=True)
+    def _clean_env(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_SLICES_MAX_LABELS", raising=False)
+        reset_sliced_state()
+        yield
+        reset_sliced_state()
+
+    def _traffic(self, k: int = 12):
+        m = mt.SlicedMetric(mt.MeanMetric(), num_slices=k)
+        # traffic proportional to slice id: slice s gets s rows
+        vals, ids = [], []
+        for s in range(k):
+            vals += [float(s)] * s
+            ids += [s] * s
+        m.update(jnp.asarray(vals, jnp.float32), slice_ids=jnp.asarray(ids, jnp.int32))
+        return m
+
+    def test_top_n_by_traffic_plus_other(self):
+        m = self._traffic()
+        sc = m.scrape_slices()
+        assert sc["max_labels"] == 8  # the default cap
+        assert [row["slice"] for row in sc["top"]] == [11, 10, 9, 8, 7, 6, 5, 4]
+        assert all(row["values"]["value"] == float(row["slice"]) for row in sc["top"])
+        # slices 1..3 carried traffic but fell past the cap -> other bucket
+        assert sc["other"] == {"slices": 3, "rows": 1 + 2 + 3}
+
+    def test_env_knob_raises_cap(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SLICES_MAX_LABELS", "11")
+        reset_sliced_state()
+        assert slices_max_labels() == 11
+        sc = self._traffic().scrape_slices()
+        assert len(sc["top"]) == 11
+        assert sc["other"] == {"slices": 0, "rows": 0}
+
+    def test_malformed_env_warns_once_and_falls_back(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SLICES_MAX_LABELS", "lots")
+        reset_sliced_state()
+        with pytest.warns(UserWarning, match="malformed"):
+            assert slices_max_labels() == 8
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # second read: memoized, no re-warn
+            assert slices_max_labels() == 8
+
+    def test_explicit_max_labels_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_SLICES_MAX_LABELS", "2")
+        reset_sliced_state()
+        sc = self._traffic().scrape_slices(max_labels=5)
+        assert len(sc["top"]) == 5
+
+    def test_scrape_before_update_is_zero_struct(self):
+        m = mt.SlicedMetric(mt.SumMetric(), num_slices=4)
+        sc = m.scrape_slices()
+        assert sc["top"] == [] and sc["quarantined_rows"] == 0

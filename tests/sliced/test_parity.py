@@ -1,0 +1,205 @@
+"""Sliced-vs-demuxed parity: a ``SlicedMetric`` over K cohorts must hold
+exactly the evidence K independently-updated instances of the wrapped
+metric would hold — bit-equal for array states (the segment-reduce is the
+same float additions in the same per-row order), including under
+fault-injected streams, quarantined ids, and empty slices.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as mt
+from metrics_tpu.utilities.exceptions import MetricsTPUUserError
+
+pytestmark = [pytest.mark.sliced]
+
+K = 4
+
+
+def _stream(seed: int, n: int, num_classes: int = 4, k: int = K):
+    rng = np.random.default_rng(seed)
+    p = rng.random((n, num_classes), dtype=np.float32)
+    t = rng.integers(0, num_classes, n).astype(np.int32)
+    ids = rng.integers(0, k, n).astype(np.int32)
+    return jnp.asarray(p), jnp.asarray(t), ids
+
+
+def _demux(metric_factory, batches, k: int = K):
+    """K independent instances fed the demuxed per-slice streams."""
+    refs = [metric_factory() for _ in range(k)]
+    for args, ids in batches:
+        for s in range(k):
+            sel = np.flatnonzero(ids == s)
+            if sel.size:
+                refs[s].update(*(a[np.asarray(sel)] for a in args))
+    return refs
+
+
+class TestDemuxBitParity:
+    @pytest.mark.parametrize(
+        "factory",
+        [mt.SumMetric, mt.MeanMetric, mt.MaxMetric, mt.MinMetric],
+        ids=["sum", "mean", "max", "min"],
+    )
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_aggregators_bit_equal(self, factory):
+        """Integer-valued floats: every addition is exact, so any reduce
+        order yields the same bits — the parity check isolates the routing/
+        evidence claim from float-summation associativity (covered with a
+        tolerance by ``test_aggregators_close_on_continuous_stream``)."""
+        m = mt.SlicedMetric(factory(), num_slices=K)
+        rng = np.random.default_rng(0)
+        batches = []
+        for step in range(5):
+            vals = jnp.asarray(rng.integers(-8, 9, 16).astype(np.float32))
+            ids = rng.integers(0, K, 16).astype(np.int32)
+            m.update(vals, slice_ids=jnp.asarray(ids))
+            batches.append(((vals,), ids))
+        refs = _demux(factory, batches)
+        out = m.compute()
+        for s, ref in enumerate(refs):
+            assert np.asarray(out.per_slice)[s] == np.asarray(ref.compute()), (
+                f"slice {s} diverged from its demuxed twin"
+            )
+
+    @pytest.mark.parametrize(
+        "factory", [mt.SumMetric, mt.MeanMetric], ids=["sum", "mean"]
+    )
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_aggregators_close_on_continuous_stream(self, factory):
+        """Continuous floats: the segment-reduce folds one per-batch partial
+        per slice into the ring, the twin folds its rows directly — same
+        evidence, float-addition order differs, so parity is to rounding."""
+        m = mt.SlicedMetric(factory(), num_slices=K)
+        rng = np.random.default_rng(0)
+        batches = []
+        for step in range(5):
+            vals = jnp.asarray(rng.random(16, dtype=np.float32) * 10 - 5)
+            ids = rng.integers(0, K, 16).astype(np.int32)
+            m.update(vals, slice_ids=jnp.asarray(ids))
+            batches.append(((vals,), ids))
+        refs = _demux(factory, batches)
+        out = m.compute()
+        np.testing.assert_allclose(
+            np.asarray(out.per_slice),
+            np.array([float(r.compute()) for r in refs], np.float32),
+            rtol=1e-5,
+        )
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_accuracy_fault_injected_stream(self):
+        """Guarded child under an id-demuxed fault-injected stream: per-slice
+        values AND per-slice fault evidence bit-equal to the demuxed twins."""
+        factory = lambda: mt.Accuracy(num_classes=4, on_invalid="warn")
+        m = mt.SlicedMetric(factory(), num_slices=K)
+        rng = np.random.default_rng(1)
+        batches = []
+        for step in range(4):
+            p, t, ids = _stream(10 + step, 24)
+            t = np.asarray(t).copy()
+            t[rng.integers(0, 24, 3)] = 7  # out-of-range targets -> faults
+            t = jnp.asarray(t)
+            m.update(p, t, slice_ids=jnp.asarray(ids))
+            batches.append(((p, t), ids))
+        refs = _demux(factory, batches)
+        out = m.compute()
+        for s, ref in enumerate(refs):
+            np.testing.assert_array_equal(
+                np.asarray(out.per_slice)[s], np.asarray(ref.compute())
+            )
+        # total fault evidence across all rows == sum of the twins'
+        total = {}
+        for ref in refs:
+            for kind, n in (ref.fault_counts or {}).items():
+                total[kind] = total.get(kind, 0) + n
+        assert m.fault_counts == total or (not m.fault_counts and not total)
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_sketch_parity(self):
+        """Elementwise-mergeable sketches (CountMin sum, HLL max): per-slice
+        sketch state holds exactly what the demuxed twins hold, so the
+        estimates agree exactly — not just within eps."""
+        rng = np.random.default_rng(2)
+        keys = rng.integers(0, 50, 200).astype(np.float32)
+        ids = rng.integers(0, K, 200).astype(np.int32)
+
+        cm = mt.SlicedMetric(mt.CountMinSketch(depth=4, width=256), num_slices=K)
+        cm.update(jnp.asarray(keys), slice_ids=jnp.asarray(ids))
+        cm_refs = _demux(lambda: mt.CountMinSketch(depth=4, width=256), [((keys,), ids)])
+
+        hll = mt.SlicedMetric(mt.HyperLogLog(precision=8), num_slices=K)
+        hll.update(jnp.asarray(keys), slice_ids=jnp.asarray(ids))
+        hll_refs = _demux(lambda: mt.HyperLogLog(precision=8), [((keys,), ids)])
+
+        hll_out = hll.compute()
+        for s in range(K):
+            np.testing.assert_allclose(
+                np.asarray(hll_out.per_slice)[s],
+                np.asarray(hll_refs[s].compute()),
+                rtol=1e-6,
+            )
+        # CM ring rows == the twins' count tables, leaf-for-leaf
+        import jax
+
+        name = next(n for n, kind in cm._specs.items() if kind == "sketch_sum")
+        ring = np.asarray(getattr(cm, f"sl__{name}"))
+        for s in range(K):
+            leaf = jax.tree_util.tree_leaves(getattr(cm_refs[s], name))[0]
+            np.testing.assert_array_equal(ring[s], np.asarray(leaf))
+
+
+class TestRouting:
+    def test_quarantine_accounting(self):
+        m = mt.SlicedMetric(mt.SumMetric(), num_slices=2)
+        vals = jnp.asarray([1.0, 2.0, 4.0, 8.0])
+        ids = jnp.asarray([0, 1, 5, -3])  # two out-of-range
+        m.update(vals, slice_ids=ids)
+        out = m.compute()
+        assert [float(v) for v in out.per_slice] == [1.0, 2.0]
+        # quarantined rows are counted, surfaced, and EXCLUDED from global
+        assert int(out.quarantined_rows) == 2
+        assert m.quarantined_rows == 2
+        assert float(out.global_value) == 3.0
+
+    def test_discard_via_valid_mask(self):
+        m = mt.SlicedMetric(mt.SumMetric(), num_slices=2)
+        m.update(
+            jnp.asarray([1.0, 2.0, 4.0]),
+            slice_ids=jnp.asarray([0, 1, 1]),
+            valid=jnp.asarray([True, True, False]),
+        )
+        out = m.compute()
+        assert [float(v) for v in out.per_slice] == [1.0, 2.0]
+        assert m.discarded_rows == 1
+        assert m.quarantined_rows == 0
+        # invalid beats out-of-range: a masked row never quarantines
+        m.update(
+            jnp.asarray([16.0]), slice_ids=jnp.asarray([99]), valid=jnp.asarray([False])
+        )
+        assert m.quarantined_rows == 0
+        assert m.discarded_rows == 2
+
+    def test_empty_slice_matches_fresh_instance(self):
+        m = mt.SlicedMetric(mt.MeanMetric(), num_slices=3)
+        m.update(jnp.asarray([2.0, 4.0]), slice_ids=jnp.asarray([0, 0]))
+        out = m.compute()
+        with pytest.warns(UserWarning, match="before the ``update``"):
+            fresh = float(mt.MeanMetric().compute())
+        # slices 1 and 2 never saw a row: same value as a fresh instance
+        # (NaN for a mean — 0 rows / 0 weight — so compare as bit patterns)
+        assert np.isnan(fresh)
+        assert np.isnan(np.asarray(out.per_slice)[1])
+        assert np.isnan(np.asarray(out.per_slice)[2])
+        assert float(np.asarray(out.per_slice)[0]) == 3.0
+        # global rollup weights by rows, so empty slices contribute nothing
+        assert float(out.global_value) == 3.0
+
+    def test_missing_slice_ids_refused(self):
+        m = mt.SlicedMetric(mt.SumMetric(), num_slices=2)
+        with pytest.raises(MetricsTPUUserError, match="slice_ids"):
+            m.update(jnp.asarray([1.0]))
+
+    def test_slice_rows_property(self):
+        m = mt.SlicedMetric(mt.SumMetric(), num_slices=3)
+        m.update(jnp.asarray([1.0, 1.0, 1.0]), slice_ids=jnp.asarray([0, 0, 2]))
+        np.testing.assert_array_equal(m.slice_rows, [2, 0, 1])

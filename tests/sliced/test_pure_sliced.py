@@ -1,0 +1,250 @@
+"""The sliced substrate ride-along: ``functionalize``/``sliced_functionalize``
+parity with the eager wrapper, overlapped-cycle parity, the <=2-all-reduce
+fused cycle on an 8-device mesh, and the sharded-K compute path
+(``shard_slices=``) bit-matching the unsharded reference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu as mt
+from metrics_tpu.sliced import SlicedMetric, SlicedValue
+
+pytestmark = [pytest.mark.sliced]
+
+NDEV = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NDEV]), ("data",))
+
+
+def _batch(seed: int, n: int, k: int, num_classes: int = 4):
+    rng = np.random.default_rng(seed)
+    p = jnp.asarray(rng.random((n, num_classes), dtype=np.float32))
+    t = jnp.asarray(rng.integers(0, num_classes, n).astype(np.int32))
+    ids = jnp.asarray(rng.integers(0, k, n).astype(np.int32))
+    return p, t, ids
+
+
+class TestFunctionalized:
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_pure_update_matches_eager(self):
+        k = 5
+        p, t, ids = _batch(0, 32, k)
+        mdef = mt.sliced_functionalize(mt.Accuracy(num_classes=4), num_slices=k)
+        state = mdef.update(mdef.init(), p, t, slice_ids=ids)
+        pure = mdef.compute(state)
+
+        eager = SlicedMetric(mt.Accuracy(num_classes=4), num_slices=k)
+        eager.update(p, t, slice_ids=ids)
+        ref = eager.compute()
+        np.testing.assert_array_equal(np.asarray(pure.per_slice), np.asarray(ref.per_slice))
+        np.testing.assert_array_equal(
+            np.asarray(pure.global_value), np.asarray(ref.global_value)
+        )
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_collection_members_each_sliced(self):
+        k = 3
+        coll = mt.MetricCollection(
+            {"acc": mt.Accuracy(num_classes=4), "rec": mt.Recall(num_classes=4, average="macro")}
+        )
+        mdef = mt.sliced_functionalize(coll, num_slices=k)
+        p, t, ids = _batch(1, 16, k)
+        out = mdef.compute(mdef.update(mdef.init(), p, t, slice_ids=ids))
+        # member keys survive (SlicedValue is a NamedTuple, so the
+        # collection's one-level dict flattening leaves it alone)
+        assert set(out) == {"acc", "rec"}
+        assert isinstance(out["acc"], SlicedValue)
+        assert np.asarray(out["acc"].per_slice).shape == (k,)
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_faults_read_the_ring(self):
+        """Regression: MetricDef.faults must fold the sl___faults ring —
+        a SlicedMetric's flat ``_faults`` state never accumulates (deltas
+        route per-row into the ring), so the generic lookup reads zero."""
+        mdef = mt.sliced_functionalize(
+            mt.Accuracy(num_classes=4, on_invalid="drop"), num_slices=3
+        )
+        st = mdef.update(
+            mdef.init(),
+            jnp.asarray([0, 1, 2, 3]),
+            jnp.asarray([0, 1, 99, 99]),  # 2 out-of-range targets -> dropped
+            slice_ids=jnp.asarray([0, 1, 2, 5]),  # one of them quarantined too
+        )
+        counts = np.asarray(mdef.faults(st))
+        assert counts.sum() > 0
+        eager = SlicedMetric(
+            mt.Accuracy(num_classes=4, on_invalid="drop"), num_slices=3
+        )
+        eager.update(
+            jnp.asarray([0, 1, 2, 3]),
+            jnp.asarray([0, 1, 99, 99]),
+            slice_ids=jnp.asarray([0, 1, 2, 5]),
+        )
+        np.testing.assert_array_equal(counts, np.asarray(eager._aggregated_fault_counts()))
+
+        # the collection path folds member rings the same way
+        cdef = mt.sliced_functionalize(
+            mt.MetricCollection({"a": mt.Accuracy(num_classes=4, on_invalid="drop")}),
+            num_slices=3,
+        )
+        cs = cdef.update(
+            cdef.init(),
+            jnp.asarray([0, 1, 2, 3]),
+            jnp.asarray([0, 1, 99, 99]),
+            slice_ids=jnp.asarray([0, 1, 2, 5]),
+        )
+        np.testing.assert_array_equal(np.asarray(cdef.faults(cs)), counts)
+
+    def test_collection_sharding_refused(self):
+        coll = mt.MetricCollection({"acc": mt.Accuracy(num_classes=4)})
+        with pytest.raises(ValueError, match="collection"):
+            mt.sliced_functionalize(coll, num_slices=8, shard_slices="data", shard_count=8)
+
+    def test_shard_count_must_divide(self):
+        with pytest.raises(ValueError, match="divide evenly"):
+            mt.sliced_functionalize(
+                mt.SumMetric(), num_slices=10, shard_slices="data", shard_count=8
+            )
+
+
+class TestOverlapped:
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_overlapped_cycle_matches_blocking_compute(self):
+        k = 4
+        odef = mt.overlapped_functionalize(SlicedMetric(mt.Accuracy(num_classes=4), num_slices=k))
+        mdef = mt.functionalize(SlicedMetric(mt.Accuracy(num_classes=4), num_slices=k))
+        ostate, bstate = odef.init(), mdef.init()
+        for seed in range(3):
+            p, t, ids = _batch(seed, 16, k)
+            ostate = odef.update(ostate, p, t, slice_ids=ids)
+            bstate = mdef.update(bstate, p, t, slice_ids=ids)
+        ostate = odef.cycle(ostate)
+        ostate = odef.cycle(ostate)  # second cycle: the first's sync lands
+        out, ref = odef.read(ostate), mdef.compute(bstate)
+        np.testing.assert_array_equal(np.asarray(out.per_slice), np.asarray(ref.per_slice))
+        np.testing.assert_array_equal(
+            np.asarray(out.global_value), np.asarray(ref.global_value)
+        )
+
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_fused_cycle_on_mesh_within_two_all_reduces(self):
+        """The sliced_fused_step acceptance, in-tree: a 4-metric guarded
+        sliced collection at K=256 clears one overlapped cycle within the
+        unsliced <=2-all-reduce ceiling, and the read matches folding the
+        same global stream through one unsharded instance."""
+        from metrics_tpu.analysis.registry import (
+            _build_sliced_fused_step,
+            _sliced_coll,
+            _sliced_make_args,
+        )
+
+        fn, args = _build_sliced_fused_step(NDEV)
+        hlo = fn.lower(*args).compile().as_text()
+        n_ar = hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
+        assert 1 <= n_ar <= 2, f"sliced fused cycle lowered {n_ar} all-reduces"
+
+        out = fn(*args)
+        # reference: the SAME global stream through one eager sliced
+        # collection (the mesh shards rows, evidence is row-additive)
+        ref = mt.overlapped_functionalize(_sliced_coll())
+        p, t, ids = args
+        s = ref.cycle(ref.update(ref.init(), p, t, slice_ids=ids))
+        want = ref.read(s)
+        for name in ("acc", "prec", "rec", "f1"):
+            np.testing.assert_array_equal(
+                np.asarray(out[name].per_slice), np.asarray(want[name].per_slice)
+            )
+            assert int(out[name].quarantined_rows) == int(want[name].quarantined_rows)
+
+        # fault-injected ids (the make_args stream plants out-of-range ids)
+        assert int(out["acc"].quarantined_rows) == 2
+
+
+class TestShardedK:
+    @pytest.mark.slow  # compile-heavy; `make test-sliced` runs the full marker
+    def test_sharded_matches_unsharded_reference(self):
+        k = 16
+        p, t, ids = _batch(7, 64, k + 3)  # some ids out of range -> quarantine
+        sdef = mt.sliced_functionalize(
+            mt.Accuracy(num_classes=4), num_slices=k, shard_slices="data", shard_count=NDEV
+        )
+
+        def step(p, t, ids):
+            s = sdef.update(sdef.init(), p, t, slice_ids=ids)
+            out = sdef.compute(s)
+            out["slice_offset"] = out["slice_offset"][None]  # per-shard scalar
+            return out
+
+        fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=_mesh(),
+                in_specs=(P("data"), P("data"), P("data")),
+                out_specs={
+                    "per_slice": P("data"),
+                    "slice_offset": P("data"),
+                    "slice_rows": P("data"),
+                    "global_value": P(),
+                    "quarantined_rows": P(),
+                },
+            )
+        )
+        out = fn(p, t, ids)
+
+        eager = SlicedMetric(mt.Accuracy(num_classes=4), num_slices=k)
+        eager.update(p, t, slice_ids=ids)
+        ref = eager.compute()
+        np.testing.assert_array_equal(np.asarray(out["per_slice"]), np.asarray(ref.per_slice))
+        np.testing.assert_array_equal(np.asarray(out["slice_rows"]), eager.slice_rows)
+        np.testing.assert_array_equal(
+            np.asarray(out["global_value"]), np.asarray(ref.global_value)
+        )
+        assert int(out["quarantined_rows"]) == int(ref.quarantined_rows) > 0
+        np.testing.assert_array_equal(
+            np.asarray(out["slice_offset"]), np.arange(NDEV) * (k // NDEV)
+        )
+
+    def test_sharded_compute_single_psum_for_rollup(self):
+        """The sharded contract: per-slice reads are local (psum_scatter for
+        the sum states), the global rollup costs ONE psum."""
+        k = 16
+        sdef = mt.sliced_functionalize(
+            mt.SumMetric(), num_slices=k, shard_slices="data", shard_count=NDEV
+        )
+
+        def step(v, ids):
+            s = sdef.update(sdef.init(), v, slice_ids=ids)
+            out = sdef.compute(s)
+            out["slice_offset"] = out["slice_offset"][None]
+            return out
+
+        fn = jax.jit(
+            jax.shard_map(
+                step,
+                mesh=_mesh(),
+                in_specs=(P("data"), P("data")),
+                out_specs={
+                    "per_slice": P("data"),
+                    "slice_offset": P("data"),
+                    "slice_rows": P("data"),
+                    "global_value": P(),
+                    "quarantined_rows": P(),
+                },
+            )
+        )
+        rng = np.random.default_rng(3)
+        v = jnp.asarray(rng.random(64, dtype=np.float32))
+        ids = jnp.asarray(rng.integers(0, k, 64).astype(np.int32))
+        hlo = fn.lower(v, ids).compile().as_text()
+        n_ar = hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
+        rs = hlo.count(" reduce-scatter(") + hlo.count(" reduce-scatter-start(")
+        # ONE logical psum of the slice-reduced extensive tree; XLA lowers
+        # at most one op per dtype bucket (f32 sums + i32 row counters)
+        assert n_ar <= 2, f"sharded compute lowered {n_ar} all-reduces (budget: one psum)"
+        assert rs >= 1, "owned-slice reads should lower a reduce-scatter, not a gather"
+        assert " all-gather(" not in hlo and " all-gather-start(" not in hlo
