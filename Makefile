@@ -9,7 +9,7 @@ PYTHON        ?= python
 TIER1_TIMEOUT ?= 1080
 TIER1_LOG     ?= /tmp/_t1.log
 
-.PHONY: test doctest bench dryrun lint profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift test-overlap test-sliced
+.PHONY: test doctest bench dryrun lint lockcheck profile test-resilience test-streaming test-analysis test-ops test-serving test-async test-obs test-fleet test-transport test-coldstart test-drift test-overlap test-sliced
 
 # ROADMAP.md "Tier-1 verify", verbatim semantics: fast lane (`-m 'not slow'`)
 # on the CPU backend under a hard timeout, with the dot-count echoed for the
@@ -43,6 +43,18 @@ dryrun:
 # by construction; new findings (not in lint_baseline.txt) fail the build.
 lint:
 	env JAX_PLATFORMS=cpu $(PYTHON) -m metrics_tpu.analysis all
+
+# Runtime lock-witness lane (ISSUE 20): re-run the threaded suites with
+# METRICS_TPU_LOCKCHECK=1, so every named lock wraps in the order-recording
+# proxy and the conftest gate asserts ZERO findings per test — no
+# acquisition-order inversions, no blocking seam (fsync/json/HTTP/
+# collective) reached under a hot lock. Complements `analysis locks` (the
+# static pass): the witness sees the callbacks and cross-thread
+# interleavings the AST cannot.
+lockcheck:
+	timeout -k 10 900 env JAX_PLATFORMS=cpu METRICS_TPU_LOCKCHECK=1 $(PYTHON) -m pytest \
+	  tests/serving/ tests/fleet/ tests/parallel/ tests/async_sync/ tests/obs/ \
+	  -q -m 'not slow' -p no:cacheprovider
 
 # Compiled-graph cost profiler (ISSUE 15): per-registry-entry flops / bytes
 # accessed / collective payload bytes (from the optimized HLO) joined with

@@ -3,8 +3,9 @@
 Subcommands::
 
     python -m metrics_tpu.analysis lint    # AST rules over metrics_tpu/
+    python -m metrics_tpu.analysis locks   # lock-order graph vs LOCK_ORDER.md
     python -m metrics_tpu.analysis audit   # compiled-graph budget registry
-    python -m metrics_tpu.analysis all     # both (the `make lint` target)
+    python -m metrics_tpu.analysis all    # all three (the `make lint` target)
     python -m metrics_tpu.analysis profile # per-entry cost table (ISSUE 15):
                                            #   flops / bytes accessed /
                                            #   collective payload bytes +
@@ -60,6 +61,27 @@ def _cmd_lint(args: argparse.Namespace) -> int:
         f"(baseline: {baseline_path})"
     )
     return 1 if new else 0
+
+
+def _cmd_locks(args: argparse.Namespace) -> int:
+    from metrics_tpu.analysis.concurrency import (
+        analyze_package,
+        check_manifest,
+        default_manifest_path,
+        render_report,
+    )
+
+    report = analyze_package()
+    manifest_path = args.manifest or default_manifest_path()
+    try:
+        with open(manifest_path, "r", encoding="utf-8") as fh:
+            manifest_text = fh.read()
+    except FileNotFoundError:
+        print(f"lock-order: manifest {manifest_path} missing", file=sys.stderr)
+        return 1
+    violations = check_manifest(report, manifest_text)
+    print(render_report(report, violations))
+    return 1 if violations else 0
 
 
 def _cmd_audit(args: argparse.Namespace) -> int:
@@ -123,12 +145,16 @@ def main(argv=None) -> int:
         "command",
         nargs="?",
         default="all",
-        choices=("lint", "audit", "all", "rules", "profile"),
-        help="which pass to run (default: all); `rules` prints the rule catalog; "
+        choices=("lint", "locks", "audit", "all", "rules", "profile"),
+        help="which pass to run (default: all); `locks` checks the lock-order "
+        "graph against analysis/LOCK_ORDER.md; `rules` prints the rule catalog; "
         "`profile` dumps the per-entry cost table (flops/bytes/collective "
         "payload bytes + wall p50/p99)",
     )
     parser.add_argument("--baseline", help="baseline file path (default: <repo>/lint_baseline.txt)")
+    parser.add_argument(
+        "--manifest", help="lock-hierarchy manifest path (default: analysis/LOCK_ORDER.md)"
+    )
     parser.add_argument(
         "--write-baseline",
         action="store_true",
@@ -169,6 +195,8 @@ def main(argv=None) -> int:
     rc = 0
     if args.command in ("lint", "all"):
         rc |= _cmd_lint(args)
+    if args.command in ("locks", "all"):
+        rc |= _cmd_locks(args)
     if args.command in ("audit", "all"):
         rc |= _cmd_audit(args)
     return rc

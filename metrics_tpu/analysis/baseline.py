@@ -9,11 +9,16 @@ handle identical lines appearing more than once in one file.
 
 Format (one entry per line, ``|``-separated, ``#`` comments)::
 
+    # why this entry is provably benign (kept across --write-baseline)
     GL102|metrics_tpu/foo.py|1|HALF = jnp.float32(0.5)
 
-The shipped baseline (``lint_baseline.txt``) is empty: ISSUE 5's self-clean
-satellite fixed every real finding on the first full-package run. Keep it
-that way — ``--write-baseline`` exists for emergencies, not as a landfill.
+Every grandfathered entry MUST carry a comment block naming why it is
+benign — the baseline is an annotated debt ledger, not a landfill.
+``--write-baseline`` regeneration is deterministic (sorted findings,
+normalized snippets, atomic write, byte-stable across runs), preserves
+those per-entry comment blocks by fingerprint, and prunes entries whose
+source no longer produces the finding — so ``git diff lint_baseline.txt``
+in review shows exactly the debt taken on or paid down.
 """
 import os
 from collections import Counter
@@ -60,13 +65,52 @@ def load_baseline(path: str) -> Counter:
     return counts
 
 
+def _entry_comments(path: str) -> Dict[str, List[str]]:
+    """fingerprint -> the contiguous ``#`` comment block directly above
+    that entry in the existing file (header lines excluded), so hand-written
+    benign-why annotations survive ``--write-baseline`` regeneration."""
+    header_lines = {line for line in _HEADER.splitlines()}
+    out: Dict[str, List[str]] = {}
+    if not os.path.exists(path):
+        return out
+    block: List[str] = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.rstrip("\n")
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                if stripped not in header_lines:
+                    block.append(line)
+                continue
+            if not stripped:
+                block = []
+                continue
+            parts = stripped.split("|", 3)
+            if len(parts) == 4 and block:
+                rule_id, rel, _, snippet = parts
+                snippet = " ".join(snippet.split())
+                out[f"{rule_id}|{rel}|{snippet}"] = block
+            block = []
+    return out
+
+
 def save_baseline(path: str, findings: Iterable[Finding]) -> None:
+    """Deterministic regeneration: sorted fingerprints, normalized
+    snippets, per-entry comments preserved, stale entries pruned (only
+    fingerprints the CURRENT findings produce are written), and the write
+    itself goes through the atomic tmp+fsync+rename path — byte-stable
+    across runs of the same tree."""
     counts = Counter(fingerprint(f) for f in findings)
-    with open(path, "w", encoding="utf-8") as fh:
-        fh.write(_HEADER)
-        for fp in sorted(counts):
-            rule_id, rel, snippet = fp.split("|", 2)
-            fh.write(f"{rule_id}|{rel}|{counts[fp]}|{snippet}\n")
+    comments = _entry_comments(path)
+    lines: List[str] = [_HEADER]
+    for fp in sorted(counts):
+        for comment in comments.get(fp, ()):
+            lines.append(comment + "\n")
+        rule_id, rel, snippet = fp.split("|", 2)
+        lines.append(f"{rule_id}|{rel}|{counts[fp]}|{snippet}\n")
+    from metrics_tpu.resilience.snapshot import atomic_write_bytes
+
+    atomic_write_bytes(path, "".join(lines).encode("utf-8"))
 
 
 def apply_baseline(

@@ -22,10 +22,37 @@ rule id     family              what it catches
 ``GL301``   state discipline    direct ``_state``/``_defaults`` writes outside
                                 ``add_state``
 ``GL302``   state discipline    list ('cat') state declared without ``template=``
+``GL401``   concurrency         ``threading.Thread`` without both ``daemon=`` and
+                                ``name=``
+``GL402``   concurrency         listener/callback/hook invoked while a lock is
+                                held (call outside the lock — the PR-15 class)
+``GL403``   concurrency         lock attribute created outside a construction-path
+                                method (lazy minting races its own creation)
+``GL501``   contract            ``os.environ``/``os.getenv`` read outside
+                                ``ops/_envtools.py`` (the EnvParse contract)
+``GL502``   contract            write-mode ``open()`` bypassing
+                                ``resilience/snapshot.py::atomic_write_bytes``
+``GL503``   contract            unconditional ``record_degradation`` in a loop
+                                body (cadence-rate spam; gate behind an episode)
 ==========  ==================  ====================================================
+
+The static lock-order pass (cycles + hierarchy manifest) is not a per-module
+rule — it is whole-package by construction and lives in
+:mod:`metrics_tpu.analysis.concurrency` (``python -m metrics_tpu.analysis
+locks``).
 """
 from typing import Dict, Tuple
 
+from metrics_tpu.analysis.rules.concurrency_discipline import (
+    BareThread,
+    CallbackUnderLock,
+    LockCreatedOutsideInit,
+)
+from metrics_tpu.analysis.rules.contract_discipline import (
+    BareWriteOpen,
+    EnvReadOutsideEnvtools,
+    UngatedHealthEventInLoop,
+)
 from metrics_tpu.analysis.rules.import_purity import DeviceDiscoveryAtImport, JnpCallAtImport
 from metrics_tpu.analysis.rules.state_discipline import DirectStateWrite, ListStateWithoutTemplate
 from metrics_tpu.analysis.rules.trace_safety import (
@@ -42,6 +69,12 @@ ALL_RULES: Tuple = (
     HostClockInUpdatePath(),
     DirectStateWrite(),
     ListStateWithoutTemplate(),
+    BareThread(),
+    CallbackUnderLock(),
+    LockCreatedOutsideInit(),
+    EnvReadOutsideEnvtools(),
+    BareWriteOpen(),
+    UngatedHealthEventInLoop(),
 )
 
 

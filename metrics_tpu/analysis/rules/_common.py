@@ -1,8 +1,75 @@
 """Helpers shared across graft-lint rules (one definition per AST pattern,
 so trace-safety and state-discipline cannot drift apart on what counts as a
-host-side class or a declared state)."""
+host-side class or a declared state — and the concurrency family cannot
+drift from :mod:`metrics_tpu.analysis.concurrency` on what counts as a lock
+creation)."""
 import ast
+import re
 from typing import List, Optional, Set, Tuple
+
+LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+# names the concurrency-discipline heuristics treat as lock-like when no
+# definition is resolvable: `_lock`, `_cv`, `_cond`, `_guard` suffixes plus
+# the bare spellings
+LOCKISH_NAME_RE = re.compile(r"(^|_)(lock|locks|cv|cond|condition|guard)$")
+
+
+def is_lockish_name(name: str) -> bool:
+    return bool(LOCKISH_NAME_RE.search(name))
+
+
+def lock_ctor_kind(expr: ast.AST) -> Optional[str]:
+    """The lock kind a creation expression yields, seeing through wrapper
+    calls (``named_lock("x", threading.Lock())``): the FIRST
+    ``threading.Lock/RLock/Condition`` call anywhere in the expression
+    (bare names count only for the from-import spelling)."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        parts = dotted_parts(node.func)
+        if parts is None or parts[-1] not in LOCK_CTORS:
+            continue
+        if len(parts) == 1 or parts[0] == "threading":
+            return parts[-1]
+    return None
+
+
+def self_attr_assignment(stmt: ast.stmt) -> Optional[Tuple[str, ast.AST]]:
+    """(attr name, value expr) when ``stmt`` binds an instance attribute by
+    any of the package's three spellings: ``self.x = v``,
+    ``object.__setattr__(self_or_obj, "x", v)`` (the frozen-dataclass
+    idiom), or ``self.__dict__["x"] = v``."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        t = stmt.targets[0]
+        if (
+            isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+        ):
+            return t.attr, stmt.value
+        if (
+            isinstance(t, ast.Subscript)
+            and isinstance(t.value, ast.Attribute)
+            and t.value.attr == "__dict__"
+            and isinstance(t.value.value, ast.Name)
+            and t.value.value.id == "self"
+            and isinstance(t.slice, ast.Constant)
+            and isinstance(t.slice.value, str)
+        ):
+            return t.slice.value, stmt.value
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+        parts = dotted_parts(call.func)
+        if (
+            parts is not None
+            and parts[-1] == "__setattr__"
+            and len(call.args) == 3
+            and isinstance(call.args[1], ast.Constant)
+            and isinstance(call.args[1].value, str)
+        ):
+            return call.args[1].value, call.args[2]
+    return None
 
 
 def dotted_parts(node: ast.AST) -> Optional[Tuple[str, ...]]:

@@ -45,6 +45,7 @@ from metrics_tpu.fleet.wire import (
     is_delta_payload,
     next_seq,
 )
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.fleet._env import resolve_fleet_knob
 from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.resilience.health import health_report, record_degradation
@@ -108,7 +109,7 @@ class Aggregator:
         self.node_id = node_id
         self.stale_after_s = resolve_fleet_knob("stale_after_s", stale_after_s)
         self._proto = metric
-        self._lock = threading.Lock()
+        self._lock = named_lock("aggregator._lock", threading.Lock(), hot=True)
         # host_id -> {"seq", "snap", "updates", "published_unix",
         #             "received_unix", "received_mono", "stale_reported"}
         self._views: Dict[str, Dict[str, Any]] = {}
@@ -118,7 +119,8 @@ class Aggregator:
         self._downstream_reported: Dict[str, bool] = {}  # stale-episode state
         self._fold_cache: Optional[Any] = None  # (accepted_count, reporter)
         self._seq = 0  # this node's own publish sequence (multi-hop)
-        self._publish_lock = threading.Lock()  # (payload, seq) pairing order
+        # (payload, seq) pairing order
+        self._publish_lock = named_lock("aggregator._publish_lock", threading.Lock(), hot=True)
         # per-host timeline sections accumulated from wire header trace
         # extras: host_id -> {"clock", "events" (bounded), "offset_s"} —
         # what fleet_trace() merges into ONE Perfetto document

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Union
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.fleet.wire import delta_changes, encode_delta_view, encode_view, next_seq
 from metrics_tpu.fleet._env import resolve_fleet_delta, resolve_fleet_knob
 from metrics_tpu.obs import trace as _obs_trace
@@ -200,8 +201,9 @@ class FleetPublisher:
         self._encode_error_reported = False  # snapshot/encode failure episode
         self._dup_streak: Dict[str, int] = {name: 0 for name in self._channels}
         self._seq = 0
-        self._lock = threading.Lock()
-        self._snapshot_lock = threading.Lock()  # (payload, seq) pairing order
+        self._lock = named_lock("publisher._lock", threading.Lock(), hot=True)
+        # (payload, seq) pairing order
+        self._snapshot_lock = named_lock("publisher._snapshot_lock", threading.Lock(), hot=True)
         self._stop_evt = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(

@@ -53,6 +53,9 @@ class HttpViewChannel:
         # urlopen raises URLError (refused/unreachable) or HTTPError (4xx/5xx,
         # e.g. a server-side wire refusal) — exactly the signals the retry
         # policy and breaker consume
+        from metrics_tpu.analysis.lockwitness import note_blocking
+
+        note_blocking("http", self.url)  # witness seam: HTTP under a hot lock
         with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
             return resp.read()
 

@@ -44,6 +44,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.obs.runtime_metrics import note_jit_retrace as _note_jit_retrace
 from metrics_tpu.parallel.sync import distributed_available, gather_all_arrays, sync_state
@@ -201,7 +202,11 @@ class Metric:
             # blocking-sync swap, overlapped-view read, snapshot_state) so
             # the scheduler's background snapshot can never capture a torn
             # mid-swap state — and crash snapshots stay consistent
-            object.__setattr__(self, "_overlap_lock", threading.RLock())
+            # hot=False for the witness: device work under this lock IS the
+            # designed swap-window contract
+            object.__setattr__(
+                self, "_overlap_lock", named_lock("metric._overlap_lock", threading.RLock())
+            )
         else:
             if sync_every_n is not None or sync_every_s is not None:
                 raise ValueError(
@@ -1646,7 +1651,7 @@ class Metric:
         # scheduler; its own (plain-state) views carry no head keying
         self.__dict__["_sync_view_key"] = None
         if self.sync_mode == "overlapped":
-            self.__dict__["_overlap_lock"] = threading.RLock()
+            self.__dict__["_overlap_lock"] = named_lock("metric._overlap_lock", threading.RLock())
         self.__dict__["_state"] = _migrate_fault_vectors(
             jax.tree_util.tree_map(jnp.asarray, state["_state"])
         )
@@ -1686,7 +1691,9 @@ class Metric:
         object.__setattr__(new, "_sync_scheduler", None)
         object.__setattr__(new, "_sync_view_key", None)
         if getattr(new, "sync_mode", "blocking") == "overlapped":
-            object.__setattr__(new, "_overlap_lock", threading.RLock())
+            object.__setattr__(
+                new, "_overlap_lock", named_lock("metric._overlap_lock", threading.RLock())
+            )
         return new
 
     # ------------------------------------------------------------------

@@ -94,6 +94,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 
@@ -546,12 +547,12 @@ class DriftMonitor:
         # `valid` row mask), so per-cohort drift rides the same offer path
         self.slice_id = slice_id
         self._slice_ids_key = slice_ids_key
-        self._lock = threading.RLock()
+        self._lock = named_lock("drift._lock", threading.RLock(), hot=True)
         # serializes whole check() passes (scheduler cadence + manual test
         # drivers) so hysteresis never double-counts; observe() only ever
         # takes _lock, so the request path never waits behind a check's
         # scoring (which runs OUTSIDE _lock on immutable sketch states)
-        self._check_lock = threading.Lock()
+        self._check_lock = named_lock("drift._check_lock", threading.Lock(), hot=True)
         self._reference: Optional[ReferenceWindow] = None
         # frozen-side score inputs, precomputed per set_reference
         self._qgrid: Optional[np.ndarray] = None

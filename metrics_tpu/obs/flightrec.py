@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from metrics_tpu.analysis.lockwitness import named_lock, note_blocking
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 from metrics_tpu.resilience.health import (
     INFORMATIONAL_EVENT_KINDS,
@@ -120,7 +121,7 @@ _ENV_KEEP: "EnvParse[int]" = EnvParse(_KEEP_ENV, _parse_keep, _DEFAULT_KEEP)
 # a ServeLoop registers once and whichever recorder is active reads it)
 # --------------------------------------------------------------------------
 
-_sources_lock = threading.Lock()
+_sources_lock = named_lock("flightrec._sources_lock", threading.Lock(), hot=True)
 _SOURCES: Dict[str, Callable[[], Any]] = {}
 _source_seq = 0
 
@@ -186,12 +187,14 @@ class FlightRecorder:
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         probe = os.path.join(self.directory, f".flightrec_probe_{os.getpid()}")
-        with open(probe, "w") as f:
+        # writability probe, removed immediately: torn-write durability is
+        # meaningless here — tearing IS an acceptable probe outcome
+        with open(probe, "w") as f:  # graft-lint: disable=GL502
             f.write("probe")
         os.remove(probe)
         self._keep = keep
         self.min_interval_s = float(min_interval_s)
-        self._lock = threading.Lock()
+        self._lock = named_lock("flightrec.FlightRecorder._lock", threading.Lock(), hot=True)
         self._seq = 0
         self._last_dump_mono: Dict[str, float] = {}  # kind -> last dump time
         self._dumps = 0
@@ -281,6 +284,9 @@ class FlightRecorder:
                 self.directory,
                 f"flightrec.{int(time.time() * 1000)}.{os.getpid()}.{seq}.{safe_kind}.json",
             )
+            # serializing a whole black box is a blocking seam the witness
+            # flags under any hot lock (the dump thread must hold none)
+            note_blocking("json-serialize", path)
             atomic_write_bytes(path, json.dumps(doc, default=str).encode())
             with self._lock:
                 self._dumps += 1
@@ -353,7 +359,7 @@ class FlightRecorder:
 # arming: programmatic > env; the health listener + process-exit hooks
 # --------------------------------------------------------------------------
 
-_state_lock = threading.Lock()
+_state_lock = named_lock("flightrec._state_lock", threading.Lock(), hot=True)
 _installed: Optional[FlightRecorder] = None
 _env_recorder: Optional[Tuple[str, Optional[FlightRecorder]]] = None  # (raw dir, recorder)
 _atexit_armed = False

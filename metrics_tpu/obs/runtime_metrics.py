@@ -27,6 +27,7 @@ contract (``utilities/backend.py``) holds.
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.ops._envtools import WarnOnce
 
 _warn_once = WarnOnce()
@@ -81,7 +82,7 @@ class Counter:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = named_lock("runtime_metrics.Counter._lock", threading.Lock(), hot=True)
         self._value = 0
 
     def inc(self, n: int = 1) -> None:
@@ -103,7 +104,7 @@ class Gauge:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = named_lock("runtime_metrics.Gauge._lock", threading.Lock(), hot=True)
         self._value: Optional[float] = None
 
     def set(self, value: float) -> None:
@@ -155,7 +156,9 @@ class LatencyHistogram:
         self.name = name
         self.eps = float(eps)
         self.max_items = int(max_items)
-        self._lock = threading.RLock()
+        self._lock = named_lock(
+            "runtime_metrics.LatencyHistogram._lock", threading.RLock(), hot=True
+        )
         self._pending: List[float] = []
         self._sketch = None  # QuantileSketchState, built at the first fold
         self._count = 0
@@ -268,7 +271,7 @@ class RuntimeMetrics:
     """One registry of named counters and histograms (get-or-create)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("runtime_metrics.RuntimeMetrics._lock", threading.Lock(), hot=True)
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._hists: Dict[str, LatencyHistogram] = {}

@@ -65,6 +65,7 @@ import uuid
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tuple
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce, bool_token
 
 __all__ = [
@@ -263,8 +264,8 @@ def clock_sync() -> Dict[str, float]:
 # tiny critical section making seq allocation + append ONE step, so seq
 # order IS append order and an incremental-export cursor can never commit
 # past a record whose seq was allocated but not yet appended
-_ring_lock = threading.Lock()
-_append_lock = threading.Lock()
+_ring_lock = named_lock("trace._ring_lock", threading.Lock(), hot=True)
+_append_lock = named_lock("trace._append_lock", threading.Lock(), hot=True)
 _ring: "deque[TraceRecord]" = deque(maxlen=_DEFAULT_BUFFER)
 
 # populated at import: obs/__init__.py imports runtime_metrics, whose
@@ -585,13 +586,17 @@ def chrome_trace_events(host_id: Optional[str] = None) -> List[Dict[str, Any]]:
 
 def export_chrome_trace(path: Optional[str] = None, host_id: Optional[str] = None) -> str:
     """The ring as a Chrome/Perfetto-loadable JSON document; optionally
-    written to ``path`` (load via ``chrome://tracing`` or ui.perfetto.dev)."""
+    written to ``path`` (load via ``chrome://tracing`` or ui.perfetto.dev).
+    The write rides ``atomic_write_bytes`` (GL502): an export raced by a
+    crash or a second exporter must never leave a half-JSON file for the
+    trace-merge tooling to choke on."""
     doc = json.dumps(
         {"traceEvents": chrome_trace_events(host_id=host_id), "displayTimeUnit": "ms"}
     )
     if path is not None:
-        with open(path, "w") as f:
-            f.write(doc)
+        from metrics_tpu.resilience.snapshot import atomic_write_bytes
+
+        atomic_write_bytes(path, doc.encode("utf-8"))
     return doc
 
 

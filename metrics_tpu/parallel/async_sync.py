@@ -70,6 +70,7 @@ import threading
 import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 
@@ -195,7 +196,7 @@ class AsyncSyncScheduler:
         self.on_error = on_error
         self.name = name
 
-        self._lock = threading.Lock()
+        self._lock = named_lock("async_sync._lock", threading.Lock(), hot=True)
         self._seq = 0  # bumped by notify(); the coverage watermark unit
         self._steps = 0  # producer's own step counter (last notify)
         self._cycle_seq = 0  # seq at the last cycle *attempt* (cadence base)
@@ -206,7 +207,7 @@ class AsyncSyncScheduler:
         self._in_flight_since: Optional[float] = None
         self._stall_reported = False
 
-        self._cv = threading.Condition()
+        self._cv = named_lock("async_sync._cv", threading.Condition(), hot=True)
         self._view: Optional[SyncView] = None
         self._stopped = False
 

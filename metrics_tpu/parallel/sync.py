@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.analysis.lockwitness import named_lock, note_blocking
 from metrics_tpu.ops._envtools import EnvParse, WarnOnce
 from metrics_tpu.utilities.exceptions import MetricsTPUUserError
 
@@ -46,7 +47,10 @@ Reduction = Union[str, Callable, None]
 # gathers — each sequence holds this lock end to end (re-entrant: a sequence
 # may nest helper gathers). Cross-host sequence ordering is a deployment
 # contract documented in `parallel/async_sync.py`.
-gather_sequence_lock = threading.RLock()
+# hot=False: blocking transport work UNDER this lock is the designed
+# contract (it serializes whole gather sequences), so the witness must not
+# flag the collectives it exists to serialize
+gather_sequence_lock = named_lock("gather_sequence_lock", threading.RLock(), hot=False)
 
 
 def distributed_available() -> bool:
@@ -180,6 +184,10 @@ def run_gather_jobs(
     issuer before it starts the next gather. Returns ``{key: fold(issue())}``
     with every job folded, identical between the two modes.
     """
+    # collective seam: the caller holds gather_sequence_lock by contract
+    # (hot=False, so THAT hold is sanctioned); any OTHER hot lock held here
+    # would stall its contenders for a whole wire round-trip
+    note_blocking("collective", "run_gather_jobs")
     if not pipeline or len(jobs) < 2:
         return {key: fold(issue()) for key, issue, fold in jobs}
 

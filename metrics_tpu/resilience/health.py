@@ -23,6 +23,10 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+# the witness layer is stdlib-only python (ast/threading), so this import
+# keeps the works-while-wedged contract above
+from metrics_tpu.analysis.lockwitness import named_lock
+
 # Known degradation kinds (informative, not enforced — new subsystems may
 # record new kinds without touching this module):
 #   backend_probe_timeout  backend init probe exceeded its deadline
@@ -103,7 +107,7 @@ class HealthRegistry:
     must survive wall-clock steps (NTP slew, clock jumps)."""
 
     def __init__(self, max_events: int = _MAX_EVENTS) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("health.HealthRegistry._lock", threading.Lock(), hot=True)
         self._events: "deque[Dict[str, Any]]" = deque(maxlen=max_events)
         self._kinds: Dict[str, Dict[str, Any]] = {}
         # event listeners (obs/flightrec.py's degraded-edge trigger): called

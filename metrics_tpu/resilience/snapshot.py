@@ -110,6 +110,12 @@ def atomic_write_bytes(path: str, blob: bytes) -> None:
     untouched and at worst a stale tmp. Shared by the snapshot writer and
     the flight recorder (``obs/flightrec.py``), so the atomicity argument
     lives in exactly one implementation."""
+    # fsync is a blocking seam: the lock witness flags reaching it while a
+    # hot lock is held (lazy import — the lint/witness layer must never be
+    # on this module's import path)
+    from metrics_tpu.analysis.lockwitness import note_blocking
+
+    note_blocking("fsync", path)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         f.write(blob)

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.ops import _envtools
 from metrics_tpu.ops import ascending_order, stable_key_order
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
 from metrics_tpu.utilities.data import dim_zero_cat
@@ -34,32 +35,32 @@ _HOST_GROUPED_WARN_N = 50_000
 _host_grouped_warned: set = set()
 
 
-def _eager_warn_rows() -> int:
-    """The effective warn threshold: ``METRICS_TPU_EAGER_WARN_ROWS`` when
-    set and parseable (malformed values warn once and fall back — a bad
-    env var must never break compute, same stance as the probe deadline in
-    ``utilities/backend.py``), else the module default."""
-    import os
-
-    raw = os.environ.get("METRICS_TPU_EAGER_WARN_ROWS")
-    if raw is None:
-        return _HOST_GROUPED_WARN_N
+def _parse_warn_rows(raw: str) -> Optional[int]:
     try:
         value = int(raw)
         if value < 0:
             raise ValueError("negative")
+        return value
     except ValueError:
-        from metrics_tpu.utilities.prints import rank_zero_warn
+        _env_warn_once(
+            ("METRICS_TPU_EAGER_WARN_ROWS", raw),
+            f"METRICS_TPU_EAGER_WARN_ROWS={raw!r} is not a non-negative integer; "
+            f"using the default of {_HOST_GROUPED_WARN_N}",
+        )
+        return None  # -> module default at the read site
 
-        if "__env__" not in _host_grouped_warned:
-            _host_grouped_warned.add("__env__")
-            rank_zero_warn(
-                f"METRICS_TPU_EAGER_WARN_ROWS={raw!r} is not a non-negative integer; "
-                f"using the default of {_HOST_GROUPED_WARN_N}",
-                UserWarning,
-            )
-        return _HOST_GROUPED_WARN_N
-    return value
+
+_env_warn_once = _envtools.WarnOnce()
+_ENV_WARN_ROWS = _envtools.EnvParse("METRICS_TPU_EAGER_WARN_ROWS", _parse_warn_rows, None)
+
+
+def _eager_warn_rows() -> int:
+    """The effective warn threshold: ``METRICS_TPU_EAGER_WARN_ROWS`` when
+    set and parseable (the shared ``ops/_envtools`` contract: call-time
+    resolution, memoized parse, malformed values warn once and fall back —
+    a bad env var must never break compute), else the module default."""
+    value = _ENV_WARN_ROWS()
+    return _HOST_GROUPED_WARN_N if value is None else value
 
 
 def _group_layout(indexes: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

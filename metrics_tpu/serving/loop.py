@@ -45,6 +45,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from metrics_tpu.analysis.lockwitness import named_lock
 from metrics_tpu.obs import trace as _obs_trace
 from metrics_tpu.parallel.async_sync import AsyncSyncScheduler
 from metrics_tpu.resilience.health import health_report, record_degradation
@@ -246,7 +247,7 @@ class ServeLoop:
         self._base_snap: Optional[_Snapshot] = None  # restored pre-crash state
 
         self._queue: "queue.Queue[Tuple[tuple, dict, Any]]" = queue.Queue(maxsize=queue_size)
-        self._stats_lock = threading.Lock()
+        self._stats_lock = named_lock("loop._stats_lock", threading.Lock(), hot=True)
         self._offered = 0
         self._accepted = 0
         self._shed = 0

@@ -103,6 +103,12 @@ def _parse_warmup(raw: str) -> bool:
 
 _ENV_WARMUP: "EnvParse[bool]" = EnvParse(_WARMUP_ENV, _parse_warmup, True)
 
+# the cache-dir var carries a path, not a token: the "parse" is the
+# side-effecting application in configure_compile_cache (makedirs + probe +
+# jax config write, memoized on the raw value there) — the EnvParse here is
+# identity, existing so the READ rides the shared env contract
+_ENV_CACHE_DIR: "EnvParse[str]" = EnvParse(_CACHE_ENV, lambda raw: raw, "")
+
 
 def warmup_enabled() -> bool:
     """Is AOT warmup allowed? ``METRICS_TPU_WARMUP=0`` is the operator
@@ -131,7 +137,7 @@ def configure_compile_cache() -> Optional[str]:
     skip exactly the small per-tier graphs a restarted host wants back.
     """
     global _cache_applied
-    raw = os.environ.get(_CACHE_ENV, "").strip()
+    raw = _ENV_CACHE_DIR()
     if _cache_applied is not None and _cache_applied[0] == raw:
         return _cache_applied[1]
     if not raw:
@@ -141,7 +147,9 @@ def configure_compile_cache() -> Optional[str]:
     try:
         os.makedirs(raw, exist_ok=True)
         probe = os.path.join(raw, f".metrics_tpu_probe_{os.getpid()}")
-        with open(probe, "w") as f:
+        # writability probe, removed immediately: torn-write durability is
+        # meaningless here — tearing IS an acceptable probe outcome
+        with open(probe, "w") as f:  # graft-lint: disable=GL502
             f.write("probe")
         os.remove(probe)
     except OSError as err:
@@ -766,4 +774,5 @@ def reset_warmup_state() -> None:
     global _cache_applied
     _warn_once.reset()
     _ENV_WARMUP.reset()
+    _ENV_CACHE_DIR.reset()
     _cache_applied = None

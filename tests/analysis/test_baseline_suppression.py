@@ -152,11 +152,17 @@ class TestBaseline:
 class TestFullPackage:
     def test_package_is_lint_clean_against_shipped_baseline(self):
         """The `make lint` gate in test form: every finding on the real
-        package is covered by the checked-in baseline — which is EMPTY
-        after the ISSUE 5 self-clean, so this asserts zero findings."""
+        package is covered by the checked-in baseline. The only entries the
+        shipped baseline may carry are the ISSUE 20 provably-benign GL503
+        list-drain sites (events episode-gated under the lock, emitted
+        outside it to keep the HealthRegistry lock unnested — each entry's
+        rationale is a comment block in lint_baseline.txt); anything else
+        is debt that must be fixed, not grandfathered."""
         findings = lint_package()
         baseline = load_baseline(default_baseline_path())
         new, stale = apply_baseline(findings, baseline)
         assert new == [], "new lint findings:\n" + "\n".join(f.format() for f in new)
         assert stale == {}, f"stale baseline entries to prune: {stale}"
-        assert sum(baseline.values()) == 0, "shipped baseline must stay (near-)empty"
+        off_ledger = {fp: n for fp, n in baseline.items() if not fp.startswith("GL503|")}
+        assert off_ledger == {}, f"only the documented GL503 drains may be grandfathered: {off_ledger}"
+        assert sum(baseline.values()) <= 3, "the grandfathered-GL503 ledger must not grow"

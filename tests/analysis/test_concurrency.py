@@ -1,0 +1,339 @@
+"""Lock-order static analyzer (``analysis/concurrency.py``) fixtures.
+
+Seeded-violation fixtures per ISSUE 20: a two-module lock cycle the
+analyzer MUST report, a clean hierarchy twin that must pass, the
+lock-provider and inter-procedural resolution cases, and the manifest
+contract (rank order, undeclared locks both directions, ``allow`` lines).
+The tree-wide gate itself runs as ``python -m metrics_tpu.analysis locks``
+(``make lint``); the pins here keep each moving part honest in isolation.
+"""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis.concurrency import (
+    analyze_package,
+    analyze_sources,
+    check_manifest,
+    default_manifest_path,
+    parse_manifest,
+    render_report,
+)
+
+pytestmark = pytest.mark.analysis
+
+
+def _report(*named):
+    return analyze_sources([(textwrap.dedent(text), relpath) for text, relpath in named])
+
+
+CYCLIC = (
+    """
+    import threading
+
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+
+    def forward():
+        with a_lock:
+            with b_lock:
+                pass
+
+    def backward():
+        with b_lock:
+            with a_lock:
+                pass
+    """,
+    "metrics_tpu/fake/cyclic.py",
+)
+
+ACYCLIC = (
+    """
+    import threading
+
+    a_lock = threading.Lock()
+    b_lock = threading.Lock()
+
+    def forward():
+        with a_lock:
+            with b_lock:
+                pass
+
+    def also_forward():
+        with a_lock, b_lock:
+            pass
+    """,
+    "metrics_tpu/fake/acyclic.py",
+)
+
+
+class TestCycleDetection:
+    def test_seeded_cycle_is_reported(self):
+        report = _report(CYCLIC)
+        assert len(report.cycles) == 1
+        cyc = report.cycles[0]
+        assert set(cyc[:-1]) == {
+            "metrics_tpu/fake/cyclic.py:a_lock",
+            "metrics_tpu/fake/cyclic.py:b_lock",
+        }
+        # a cycle fails regardless of what the manifest declares
+        violations = check_manifest(report, "")
+        assert any(v.kind == "cycle" for v in violations)
+
+    def test_clean_twin_has_no_cycle(self):
+        report = _report(ACYCLIC)
+        assert report.cycles == []
+        assert (
+            "metrics_tpu/fake/acyclic.py:a_lock",
+            "metrics_tpu/fake/acyclic.py:b_lock",
+        ) in report.edges
+
+    def test_self_cycle_on_plain_lock_only(self):
+        """A non-reentrant lock re-acquired while held is a self-deadlock;
+        the same shape on an RLock is the designed re-entrancy."""
+        plain = _report(
+            (
+                """
+                import threading
+
+                lk = threading.Lock()
+
+                def f():
+                    with lk:
+                        with lk:
+                            pass
+                """,
+                "metrics_tpu/fake/self_plain.py",
+            )
+        )
+        assert plain.cycles == [
+            ["metrics_tpu/fake/self_plain.py:lk", "metrics_tpu/fake/self_plain.py:lk"]
+        ]
+        reentrant = _report(
+            (
+                """
+                import threading
+
+                lk = threading.RLock()
+
+                def f():
+                    with lk:
+                        with lk:
+                            pass
+                """,
+                "metrics_tpu/fake/self_rlock.py",
+            )
+        )
+        assert reentrant.cycles == []
+
+
+class TestDiscovery:
+    def test_named_lock_wrapper_is_seen_through(self):
+        report = _report(
+            (
+                """
+                import threading
+
+                from metrics_tpu.analysis.lockwitness import named_lock
+
+                guard = named_lock("guard", threading.RLock(), hot=False)
+
+                class Box:
+                    def __init__(self):
+                        self._lock = named_lock("box", threading.Lock(), hot=True)
+                """,
+                "metrics_tpu/fake/wrapped.py",
+            )
+        )
+        assert report.locks["metrics_tpu/fake/wrapped.py:guard"].kind == "RLock"
+        assert report.locks["metrics_tpu/fake/wrapped.py:Box._lock"].kind == "Lock"
+
+    def test_dunder_setattr_spellings(self):
+        """The frozen-instance spellings metric.py actually uses."""
+        report = _report(
+            (
+                """
+                import threading
+
+                class M:
+                    def __init__(self):
+                        object.__setattr__(self, "_overlap_lock", threading.RLock())
+
+                    def __setstate__(self, state):
+                        self.__dict__["_overlap_lock"] = threading.RLock()
+                """,
+                "metrics_tpu/fake/frozen.py",
+            )
+        )
+        assert list(report.locks) == ["metrics_tpu/fake/frozen.py:M._overlap_lock"]
+
+
+class TestInterProcedural:
+    def test_edge_through_method_call_chain(self):
+        report = _report(
+            (
+                """
+                import threading
+
+                class Pub:
+                    def __init__(self):
+                        self._snapshot_lock = threading.Lock()
+                        self._lock = threading.Lock()
+
+                    def _next_seq(self):
+                        with self._lock:
+                            return 1
+
+                    def publish(self):
+                        with self._snapshot_lock:
+                            return self._next_seq()
+                """,
+                "metrics_tpu/fake/pub.py",
+            )
+        )
+        key = (
+            "metrics_tpu/fake/pub.py:Pub._snapshot_lock",
+            "metrics_tpu/fake/pub.py:Pub._lock",
+        )
+        assert key in report.edges
+        assert report.edges[key].via == "_next_seq()"
+
+    def test_lock_provider_method_resolves(self):
+        """``with self._guard():`` where _guard returns a lock attribute."""
+        report = _report(
+            (
+                """
+                import threading
+
+                class S:
+                    def __init__(self):
+                        self._swap = threading.RLock()
+                        self._inner = threading.Lock()
+
+                    def _guard(self):
+                        return self._swap
+
+                    def commit(self):
+                        with self._guard():
+                            with self._inner:
+                                pass
+                """,
+                "metrics_tpu/fake/provider.py",
+            )
+        )
+        key = (
+            "metrics_tpu/fake/provider.py:S._swap",
+            "metrics_tpu/fake/provider.py:S._inner",
+        )
+        assert key in report.edges
+
+    def test_release_breaks_the_hold(self):
+        """acquire()/release() pairs are tracked linearly: an acquisition
+        AFTER the release carries no edge."""
+        report = _report(
+            (
+                """
+                import threading
+
+                a = threading.Lock()
+                b = threading.Lock()
+
+                def staged():
+                    a.acquire()
+                    a.release()
+                    with b:
+                        pass
+                """,
+                "metrics_tpu/fake/staged.py",
+            )
+        )
+        assert report.edges == {}
+
+
+class TestManifest:
+    MANIFEST = """
+    - rank 10: metrics_tpu/fake/acyclic.py:a_lock
+    - rank 20: metrics_tpu/fake/acyclic.py:b_lock
+    """
+
+    def test_clean_tree_against_matching_manifest(self):
+        report = _report(ACYCLIC)
+        assert check_manifest(report, textwrap.dedent(self.MANIFEST)) == []
+
+    def test_rank_order_violation(self):
+        flipped = textwrap.dedent(
+            """
+            - rank 20: metrics_tpu/fake/acyclic.py:a_lock
+            - rank 10: metrics_tpu/fake/acyclic.py:b_lock
+            """
+        )
+        violations = check_manifest(_report(ACYCLIC), flipped)
+        assert [v.kind for v in violations] == ["order"]
+
+    def test_same_rank_edge_is_a_violation(self):
+        same = textwrap.dedent(
+            """
+            - rank 10: metrics_tpu/fake/acyclic.py:a_lock
+            - rank 10: metrics_tpu/fake/acyclic.py:b_lock
+            """
+        )
+        violations = check_manifest(_report(ACYCLIC), same)
+        assert [v.kind for v in violations] == ["order"]
+
+    def test_undeclared_lock_fails(self):
+        violations = check_manifest(_report(ACYCLIC), "- rank 10: metrics_tpu/fake/acyclic.py:a_lock")
+        kinds = sorted(v.kind for v in violations)
+        # b_lock missing a rank + the a->b edge losing an endpoint
+        assert kinds == ["undeclared-edge", "undeclared-lock"]
+
+    def test_stale_manifest_entry_fails(self):
+        stale = textwrap.dedent(self.MANIFEST) + "- rank 30: metrics_tpu/gone.py:dead_lock\n"
+        violations = check_manifest(_report(ACYCLIC), stale)
+        assert [v.kind for v in violations] == ["undeclared-lock"]
+        assert "prune" in violations[0].message
+
+    def test_allow_line_overrides_rank_order(self):
+        flipped_with_allow = textwrap.dedent(
+            """
+            - rank 20: metrics_tpu/fake/acyclic.py:a_lock
+            - rank 10: metrics_tpu/fake/acyclic.py:b_lock
+            - allow: metrics_tpu/fake/acyclic.py:a_lock -> metrics_tpu/fake/acyclic.py:b_lock
+            """
+        )
+        assert check_manifest(_report(ACYCLIC), flipped_with_allow) == []
+
+    def test_parse_manifest_ignores_prose(self):
+        ranks, allowed = parse_manifest(
+            "prose about locking\n- rank 10: x:a\nmore prose - rank 99\n- allow: x:a -> x:b\n"
+        )
+        assert ranks == {"x:a": 10}
+        assert allowed == {("x:a", "x:b")}
+
+
+class TestTreeGate:
+    """The real package against the real manifest — the `make lint` gate."""
+
+    def test_package_is_clean_against_lock_order_md(self):
+        report = analyze_package()
+        with open(default_manifest_path(), encoding="utf-8") as fh:
+            manifest = fh.read()
+        violations = check_manifest(report, manifest)
+        assert violations == [], render_report(report, violations)
+
+    def test_known_coordinator_edges_are_present(self):
+        """The three PR-15-era pairing-order edges the analyzer must keep
+        seeing (regression pin for the inter-procedural pass)."""
+        report = analyze_package()
+        edges = set(report.edges)
+        assert (
+            "metrics_tpu/fleet/publisher.py:FleetPublisher._snapshot_lock",
+            "metrics_tpu/fleet/publisher.py:FleetPublisher._lock",
+        ) in edges
+        assert (
+            "metrics_tpu/fleet/aggregator.py:Aggregator._publish_lock",
+            "metrics_tpu/fleet/aggregator.py:Aggregator._lock",
+        ) in edges
+        assert (
+            "metrics_tpu/obs/drift.py:DriftMonitor._check_lock",
+            "metrics_tpu/obs/drift.py:DriftMonitor._lock",
+        ) in edges

@@ -1,0 +1,313 @@
+"""GL4xx (concurrency-discipline) + GL5xx (contract-discipline) fixtures.
+
+Each seeded violation must be caught by EXACTLY its intended rule, each
+clean twin must stay silent, and the shared suppression syntax must work —
+the same good/bad-fixture discipline ``test_lint_rules.py`` applies to the
+GL1xx–GL3xx families.
+"""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis.lint import lint_source
+
+pytestmark = pytest.mark.analysis
+
+
+def _ids(src, relpath="metrics_tpu/fake/mod.py"):
+    return [f.rule_id for f in lint_source(textwrap.dedent(src), relpath=relpath)]
+
+
+# --------------------------------------------------------------------------
+# GL401 — bare Thread
+# --------------------------------------------------------------------------
+
+
+class TestBareThread:
+    def test_thread_missing_both_kwargs(self):
+        src = """
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+            """
+        assert _ids(src) == ["GL401"]
+
+    def test_thread_missing_only_name(self):
+        src = """
+            import threading
+
+            def spawn(fn):
+                threading.Thread(target=fn, daemon=True).start()
+            """
+        assert _ids(src) == ["GL401"]
+
+    def test_fully_specified_thread_is_clean(self):
+        src = """
+            import threading
+
+            def spawn(fn):
+                threading.Thread(target=fn, daemon=True, name="metrics-tpu-worker").start()
+            """
+        assert _ids(src) == []
+
+    def test_unrelated_thread_named_call_is_ignored(self):
+        assert _ids("def f(pool):\n    return pool.Thread\n") == []
+
+    def test_suppression_comment(self):
+        src = """
+            import threading
+
+            def spawn(fn):
+                threading.Thread(target=fn).start()  # graft-lint: disable=GL401
+            """
+        assert _ids(src) == []
+
+
+# --------------------------------------------------------------------------
+# GL402 — callback under lock
+# --------------------------------------------------------------------------
+
+
+class TestCallbackUnderLock:
+    def test_listener_called_under_lock(self):
+        src = """
+            class Reg:
+                def record(self, event):
+                    with self._lock:
+                        for fn in self._listeners:
+                            fn(event)
+            """
+        assert _ids(src) == ["GL402"]
+
+    def test_direct_callback_attr_under_lock(self):
+        src = """
+            class Reg:
+                def record(self, event):
+                    with self._lock:
+                        self.on_event_callback(event)
+            """
+        assert _ids(src) == ["GL402"]
+
+    def test_snapshot_then_call_outside_is_clean(self):
+        """The resilience/health.py shape the rule exists to pin."""
+        src = """
+            class Reg:
+                def record(self, event):
+                    with self._lock:
+                        listeners = list(self._listeners)
+                    for fn in listeners:
+                        fn(event)
+            """
+        assert _ids(src) == []
+
+    def test_lock_provider_call_counts_as_held(self):
+        src = """
+            class M:
+                def commit(self):
+                    with self._state_swap_guard():
+                        self.flush_hooks()
+            """
+        assert _ids(src) == ["GL402"]
+
+    def test_nested_def_body_is_not_under_the_lock(self):
+        src = """
+            class Reg:
+                def record(self, event):
+                    with self._lock:
+                        def later():
+                            self.fire_callbacks(event)
+                        self._pending.append(later)
+            """
+        assert _ids(src) == []
+
+
+# --------------------------------------------------------------------------
+# GL403 — lock created outside construction
+# --------------------------------------------------------------------------
+
+
+class TestLazyLock:
+    def test_lock_minted_in_hot_method(self):
+        src = """
+            import threading
+
+            class Box:
+                def get(self):
+                    if self._lock is None:
+                        self._lock = threading.Lock()
+                    return self._lock
+            """
+        assert _ids(src) == ["GL403"]
+
+    def test_init_and_setstate_are_exempt(self):
+        src = """
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def __setstate__(self, state):
+                    self.__dict__["_lock"] = threading.RLock()
+
+                def __deepcopy__(self, memo):
+                    new = type(self)()
+                    object.__setattr__(new, "_lock", threading.RLock())
+                    return new
+            """
+        assert _ids(src) == []
+
+    def test_named_lock_wrapper_still_flagged(self):
+        """Seeing through `named_lock(...)` applies to the rule too."""
+        src = """
+            import threading
+
+            from metrics_tpu.analysis.lockwitness import named_lock
+
+            class Box:
+                def ensure(self):
+                    self._lock = named_lock("box", threading.Lock())
+            """
+        assert _ids(src) == ["GL403"]
+
+    def test_nested_factory_reports_its_own_function(self):
+        """A constructor CALLED from a hot method is still a construction
+        path — the statement belongs to the nested def, not `get`."""
+        src = """
+            import threading
+
+            class Box:
+                def get(self):
+                    def __init__(inner_self):
+                        inner_self._lock = threading.Lock()
+                    return __init__
+            """
+        assert _ids(src) == []
+
+
+# --------------------------------------------------------------------------
+# GL501 — env read outside _envtools
+# --------------------------------------------------------------------------
+
+
+class TestEnvRead:
+    def test_os_environ_get_flagged(self):
+        src = """
+            import os
+
+            def knob():
+                return os.environ.get("METRICS_TPU_X", "")
+            """
+        assert _ids(src) == ["GL501"]
+
+    def test_os_getenv_flagged(self):
+        src = """
+            import os
+
+            def knob():
+                return os.getenv("METRICS_TPU_X")
+            """
+        assert _ids(src) == ["GL501"]
+
+    def test_owner_modules_are_exempt(self):
+        src = "import os\nRAW = os.environ.get('X', '')\n"
+        assert _ids(src, relpath="metrics_tpu/ops/_envtools.py") == []
+        assert _ids(src, relpath="metrics_tpu/utilities/backend.py") == []
+
+    def test_envparse_usage_is_clean(self):
+        src = """
+            from metrics_tpu.ops._envtools import EnvParse
+
+            _KNOB = EnvParse("METRICS_TPU_X", int, 0)
+            """
+        assert _ids(src) == []
+
+
+# --------------------------------------------------------------------------
+# GL502 — bare write-mode open
+# --------------------------------------------------------------------------
+
+
+class TestBareWrite:
+    def test_write_mode_flagged(self):
+        assert _ids("def f(p):\n    open(p, 'w').write('x')\n") == ["GL502"]
+
+    def test_append_and_plus_modes_flagged(self):
+        assert _ids("def f(p):\n    open(p, 'ab')\n") == ["GL502"]
+        assert _ids("def f(p):\n    open(p, mode='r+')\n") == ["GL502"]
+
+    def test_read_mode_is_clean(self):
+        assert _ids("def f(p):\n    return open(p).read()\n") == []
+        assert _ids("def f(p):\n    return open(p, 'rb').read()\n") == []
+
+    def test_owner_module_is_exempt(self):
+        assert (
+            _ids("def f(p):\n    open(p, 'wb')\n", relpath="metrics_tpu/resilience/snapshot.py")
+            == []
+        )
+
+    def test_dynamic_mode_is_not_guessed(self):
+        # a non-literal mode can't be proven durable-write; stay silent
+        assert _ids("def f(p, m):\n    open(p, m)\n") == []
+
+
+# --------------------------------------------------------------------------
+# GL503 — ungated health event in a loop
+# --------------------------------------------------------------------------
+
+
+class TestUngatedHealthEvent:
+    def test_unconditional_emit_in_loop(self):
+        src = """
+            from metrics_tpu.resilience.health import record_degradation
+
+            def cadence(views):
+                for v in views:
+                    record_degradation("stale", "view is stale")
+            """
+        assert _ids(src) == ["GL503"]
+
+    def test_condition_gated_emit_is_clean(self):
+        src = """
+            from metrics_tpu.resilience.health import record_degradation
+
+            def cadence(views):
+                for v in views:
+                    if v.stale and not v.reported:
+                        record_degradation("stale", "view went stale")
+            """
+        assert _ids(src) == []
+
+    def test_except_handler_counts_as_gated(self):
+        src = """
+            from metrics_tpu.resilience.health import record_degradation
+
+            def cadence(views):
+                for v in views:
+                    try:
+                        v.fold()
+                    except Exception:
+                        record_degradation("fold_failed", "fold raised")
+            """
+        assert _ids(src) == []
+
+    def test_emit_outside_any_loop_is_clean(self):
+        src = """
+            from metrics_tpu.resilience.health import record_degradation
+
+            def once():
+                record_degradation("snapshot_fallback", "skipped corrupt snapshot")
+            """
+        assert _ids(src) == []
+
+    def test_while_loop_also_counts(self):
+        src = """
+            from metrics_tpu.resilience.health import record_degradation
+
+            def worker(q):
+                while True:
+                    record_degradation("tick", "beat")
+            """
+        assert _ids(src) == ["GL503"]

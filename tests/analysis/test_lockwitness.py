@@ -1,0 +1,213 @@
+"""Runtime lock witness (``analysis/lockwitness.py``) contracts.
+
+The seeded-violation fixtures ISSUE 20 requires: a two-thread A/B
+acquisition inversion the witness MUST flag, a blocking call under a hot
+lock, and the twin contracts that keep production safe — the disabled shim
+is the IDENTITY (zero overhead, pinned), re-entrancy records no self-edge,
+Condition.wait un-holds for its duration, and findings dump through the
+torn-write-proof snapshot path.
+"""
+import json
+import threading
+
+import pytest
+
+from metrics_tpu.analysis import lockwitness as lw
+
+pytestmark = pytest.mark.analysis
+
+
+@pytest.fixture(autouse=True)
+def _isolated_witness():
+    lw.reset_lockwitness_state()
+    yield
+    lw.reset_lockwitness_state()
+
+
+class TestDisabledIsIdentity:
+    def test_unset_env_means_identity(self, monkeypatch):
+        """The zero-overhead pin: with the knob unset the shim IS the
+        identity (run env-agnostic — the armed lockcheck lane exports
+        METRICS_TPU_LOCKCHECK=1, so clear it here)."""
+        monkeypatch.delenv("METRICS_TPU_LOCKCHECK", raising=False)
+        lw.reset_lockwitness_state()
+        base = threading.Lock()
+        assert lw.named_lock("x", base) is base
+
+    def test_default_lock_is_a_real_lock(self, monkeypatch):
+        monkeypatch.delenv("METRICS_TPU_LOCKCHECK", raising=False)
+        lw.reset_lockwitness_state()
+        lk = lw.named_lock("x")
+        assert type(lk) is type(threading.Lock())
+
+    def test_explicit_off_is_identity_too(self):
+        lw.force_lockcheck(False)
+        base = threading.RLock()
+        assert lw.named_lock("x", base) is base
+
+    def test_note_blocking_is_inert_when_disabled(self):
+        lw.note_blocking("fsync", "/tmp/x")
+        assert lw.findings() == []
+
+    def test_malformed_env_token_warns_once_and_stays_off(self, monkeypatch):
+        monkeypatch.setenv("METRICS_TPU_LOCKCHECK", "banana")
+        with pytest.warns(UserWarning, match="METRICS_TPU_LOCKCHECK"):
+            enabled = lw.lockcheck_enabled()
+        assert enabled is False
+        base = threading.Lock()
+        assert lw.named_lock("x", base) is base
+
+
+class TestInversionDetection:
+    def _armed_pair(self):
+        lw.force_lockcheck(True)
+        return (
+            lw.named_lock("A", threading.Lock()),
+            lw.named_lock("B", threading.Lock()),
+        )
+
+    def test_two_thread_inversion_is_flagged(self):
+        a, b = self._armed_pair()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        # run sequentially: the witness flags the ORDER cycle, no actual
+        # deadlock needed (that is the point — it fires on the quiet runs)
+        th1 = threading.Thread(target=t1, name="wit-t1", daemon=True)
+        th1.start()
+        th1.join()
+        th2 = threading.Thread(target=t2, name="wit-t2", daemon=True)
+        th2.start()
+        th2.join()
+
+        found = lw.findings()
+        assert len(found) == 1
+        f = found[0]
+        assert f["kind"] == "inversion"
+        assert f["edge"] == "B -> A"
+        assert "wit-t2" in f["site"]
+
+    def test_consistent_order_is_clean(self):
+        a, b = self._armed_pair()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert lw.findings() == []
+
+    def test_transitive_inversion_through_a_third_lock(self):
+        """A->B and B->C observed, then C->A: the cycle closes through the
+        path, not a direct reverse edge."""
+        lw.force_lockcheck(True)
+        a = lw.named_lock("A", threading.Lock())
+        b = lw.named_lock("B", threading.Lock())
+        c = lw.named_lock("C", threading.Lock())
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with a:
+                pass
+        kinds = [f["kind"] for f in lw.findings()]
+        assert kinds == ["inversion"]
+
+    def test_rlock_reentrancy_records_no_self_edge(self):
+        lw.force_lockcheck(True)
+        r = lw.named_lock("R", threading.RLock())
+        with r:
+            with r:
+                pass
+        assert lw.findings() == []
+
+    def test_condition_wait_unholds(self):
+        """A waiter inside ``cv.wait()`` does NOT hold cv for ordering
+        purposes — the notifier's independent acquisition is not an
+        inversion (the async_sync scheduler's exact shape)."""
+        lw.force_lockcheck(True)
+        cv = lw.named_lock("CV", threading.Condition())
+        outer = lw.named_lock("OUTER", threading.Lock())
+        ready = threading.Event()
+
+        def waiter():
+            with cv:
+                ready.set()
+                cv.wait(timeout=5)
+                # reacquired after wait: the held stack must be restored
+                with outer:
+                    pass
+
+        th = threading.Thread(target=waiter, name="wit-waiter", daemon=True)
+        th.start()
+        ready.wait(timeout=5)
+        with cv:
+            cv.notify_all()
+        th.join(timeout=5)
+        assert not th.is_alive()
+        found = [f for f in lw.findings() if f["kind"] == "inversion"]
+        assert found == []
+
+
+class TestBlockingUnderHotLock:
+    def test_blocking_under_hot_lock_is_flagged(self):
+        lw.force_lockcheck(True)
+        hot = lw.named_lock("HOT", threading.Lock(), hot=True)
+        with hot:
+            lw.note_blocking("fsync", "/tmp/dump.json")
+        found = lw.findings()
+        assert len(found) == 1
+        assert found[0]["kind"] == "blocking-under-hot-lock"
+        assert found[0]["blocking"] == "fsync"
+        assert found[0]["held"] == ["HOT"]
+
+    def test_blocking_under_cold_lock_is_sanctioned(self):
+        """gather_sequence_lock's contract: hot=False means blocking under
+        it is the designed behavior."""
+        lw.force_lockcheck(True)
+        cold = lw.named_lock("COLD", threading.RLock(), hot=False)
+        with cold:
+            lw.note_blocking("collective", "run_gather_jobs")
+        assert lw.findings() == []
+
+    def test_blocking_with_nothing_held_is_clean(self):
+        lw.force_lockcheck(True)
+        lw.named_lock("HOT", threading.Lock(), hot=True)  # arm _active
+        lw.note_blocking("http", "http://example")
+        assert lw.findings() == []
+
+
+class TestFindingsLifecycle:
+    def test_dump_findings_writes_torn_proof_json(self, tmp_path):
+        lw.force_lockcheck(True)
+        hot = lw.named_lock("HOT", threading.Lock(), hot=True)
+        with hot:
+            lw.note_blocking("json-serialize", "payload")
+        path = str(tmp_path / "lockcheck.json")
+        assert lw.dump_findings(path) == path
+        doc = json.loads((tmp_path / "lockcheck.json").read_text())
+        assert doc["findings"][0]["blocking"] == "json-serialize"
+        # atomic_write_bytes leaves no tmp droppings behind
+        assert [p.name for p in tmp_path.iterdir()] == ["lockcheck.json"]
+
+    def test_clear_and_reset(self):
+        lw.force_lockcheck(True)
+        hot = lw.named_lock("HOT", threading.Lock(), hot=True)
+        with hot:
+            lw.note_blocking("fsync")
+        assert lw.findings()
+        lw.clear_findings()
+        assert lw.findings() == []
+        lw.reset_lockwitness_state()
+        # reset drops the forced override AND the observed order graph
+        assert lw.lockcheck_enabled() in (False, True)  # env-resolved, no crash
+        assert lw.findings() == []

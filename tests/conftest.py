@@ -7,12 +7,33 @@ process-pool DDP simulation. Backend reset rationale lives in
 ``metrics_tpu/utilities/backend.py``.
 """
 import jax
+import pytest
 
 from metrics_tpu.utilities.backend import force_cpu_backend
 
 NUM_DEVICES = 8
 
 force_cpu_backend(NUM_DEVICES)
+
+
+@pytest.fixture(autouse=True)
+def _lockwitness_gate():
+    """The `make lockcheck` lane's per-test assertion: with
+    ``METRICS_TPU_LOCKCHECK=1`` in the environment, every test must finish
+    with ZERO witness findings — no lock-order inversions, no blocking
+    calls under a hot lock. Unarmed (the default), this is two function
+    calls of overhead. Witness self-tests that seed findings on purpose
+    clear them via ``reset_lockwitness_state()`` in their own teardown,
+    which runs before this gate's assert."""
+    from metrics_tpu.analysis import lockwitness
+
+    if not lockwitness.lockcheck_enabled():
+        yield
+        return
+    lockwitness.clear_findings()
+    yield
+    found = lockwitness.findings()
+    assert found == [], "lock witness findings:\n" + "\n".join(map(repr, found))
 
 
 def pytest_configure(config):

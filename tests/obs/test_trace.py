@@ -155,6 +155,20 @@ def test_chrome_trace_export_is_valid_trace_event_json(tmp_path, monkeypatch):
     assert marker["ph"] == "i" and marker["args"]["n"] == 3
 
 
+def test_chrome_trace_export_is_atomic(tmp_path, monkeypatch):
+    """ISSUE 20 GL502 regression: the export rides atomic_write_bytes —
+    an existing document is replaced whole (never truncated in place) and
+    no tmp droppings survive the write."""
+    monkeypatch.setenv("METRICS_TPU_TRACE", "1")
+    path = tmp_path / "trace.json"
+    path.write_text("PREVIOUS DOCUMENT " * 100000)  # longer than the new doc
+    trace.instant("only.event")
+    trace.export_chrome_trace(str(path))
+    doc = json.loads(path.read_text())  # a torn/truncated write would fail here
+    assert any(e["name"] == "only.event" for e in doc["traceEvents"])
+    assert [p.name for p in tmp_path.iterdir()] == ["trace.json"]
+
+
 def test_chrome_trace_export_names_processes_and_threads(monkeypatch):
     """The ISSUE 15 readability satellite: metadata rows name the process
     (host_id when given) and every seen thread, so a merged fleet trace

@@ -16,6 +16,10 @@ def _reset_warn_once(monkeypatch):
     monkeypatch.setattr(retrieval_base, "_host_grouped_warned", set())
     # keep the test fast: a tiny threshold instead of 50k real rows
     monkeypatch.setattr(retrieval_base, "_HOST_GROUPED_WARN_N", 32)
+    # the env knob rides the shared _envtools contract now: reset its
+    # memoized parse + warn-once memory per test, like the other knobs
+    retrieval_base._ENV_WARN_ROWS.reset()
+    retrieval_base._env_warn_once.reset()
 
 
 def _feed(metric, n=64, queries=8):
